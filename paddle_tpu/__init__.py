@@ -1,0 +1,15 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the PaddlePaddle
+Fluid API surface (reference: Operater9/Paddle @ Fluid 0.15).
+
+Compute path: programs built through ``paddle_tpu.fluid`` trace into XLA
+computations (jit/pjit); parallelism is SPMD over a ``jax.sharding.Mesh``
+with collectives over ICI.  See SURVEY.md for the layer-by-layer mapping.
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+
+batch = reader.batch

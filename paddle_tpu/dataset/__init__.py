@@ -1,0 +1,11 @@
+"""Datasets (ref: python/paddle/dataset/ — mnist, cifar, uci_housing, ...).
+
+The reference auto-downloads into ~/.cache/paddle.  This environment has no
+network egress, so each dataset falls back to a deterministic synthetic
+generator with the real shapes/dtypes/cardinalities when the cached copy is
+absent — enough for the train-loop, checkpoint, and benchmark harnesses.
+"""
+
+from . import mnist, cifar, uci_housing, imdb, common
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "common"]

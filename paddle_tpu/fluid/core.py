@@ -1,0 +1,204 @@
+"""Core runtime primitives: places, dtypes, device resolution.
+
+TPU-native analogue of the reference's ``paddle/fluid/platform/place.h`` and the
+pybind ``core`` module (ref: pybind/pybind.cc:443-455).  Instead of a C++
+``boost::variant<CUDAPlace, CPUPlace, ...>`` dispatching to per-device kernels,
+a Place here selects a JAX/PJRT device set; all compute lowers to XLA.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+
+class VarType:
+    """Mirror of the reference's framework.proto VarType (framework.proto:104).
+
+    Values are stable small ints so programs can be serialized.
+    """
+
+    BOOL = 0
+    INT16 = 1
+    INT32 = 2
+    INT64 = 3
+    FP16 = 4
+    FP32 = 5
+    FP64 = 6
+    UINT8 = 7
+    INT8 = 8
+    BF16 = 9
+    # non-pod types
+    LOD_TENSOR = 20
+    SELECTED_ROWS = 21
+    FEED_MINIBATCH = 22
+    FETCH_LIST = 23
+    STEP_SCOPES = 24
+    LOD_RANK_TABLE = 25
+    LOD_TENSOR_ARRAY = 26
+    READER = 28
+    RAW = 30
+
+
+_STR_TO_NP = {
+    "bool": np.bool_,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "float16": np.float16,
+    "float32": np.float32,
+    "float64": np.float64,
+    "uint8": np.uint8,
+    "int8": np.int8,
+    # bfloat16 resolved lazily through ml_dtypes (always present with jax)
+}
+
+_STR_TO_VARTYPE = {
+    "bool": VarType.BOOL,
+    "int16": VarType.INT16,
+    "int32": VarType.INT32,
+    "int64": VarType.INT64,
+    "float16": VarType.FP16,
+    "float32": VarType.FP32,
+    "float64": VarType.FP64,
+    "uint8": VarType.UINT8,
+    "int8": VarType.INT8,
+    "bfloat16": VarType.BF16,
+}
+
+_VARTYPE_TO_STR = {v: k for k, v in _STR_TO_VARTYPE.items()}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec (string, numpy dtype, VarType int) to a string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        if dtype in _STR_TO_VARTYPE:
+            return dtype
+        # allow numpy-style names like "float" / "double"
+        return np.dtype(dtype).name
+    if isinstance(dtype, int):
+        if dtype in _VARTYPE_TO_STR:
+            return _VARTYPE_TO_STR[dtype]
+        raise ValueError(f"unknown VarType enum {dtype}")
+    try:
+        name = np.dtype(dtype).name
+        if name in _STR_TO_VARTYPE:
+            return name
+    except TypeError:
+        pass
+    # ml_dtypes bfloat16 etc.
+    name = getattr(dtype, "name", None) or str(dtype)
+    if name in _STR_TO_VARTYPE:
+        return name
+    raise ValueError(f"cannot convert dtype {dtype!r}")
+
+
+def np_dtype(dtype) -> np.dtype:
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_STR_TO_NP[name])
+
+
+# ---------------------------------------------------------------------------
+# Places
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Place:
+    device_type: str  # "cpu" | "tpu" | "gpu"
+    device_id: int = 0
+
+    def __repr__(self):  # pragma: no cover - cosmetic
+        return f"{self.device_type.upper()}Place({self.device_id})"
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPlace(Place):
+    """Accepted for API parity; resolves to whatever accelerator JAX has."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__("gpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def get_jax_device(place: Place):
+    """Resolve a Place to a concrete jax.Device (best effort)."""
+    jax = _jax()
+    kind = place.device_type
+    if kind == "cpu":
+        devs = jax.devices("cpu")
+    else:
+        # tpu / gpu: take the default backend's devices; on a TPU host this is
+        # the TPU chip, under forced-CPU tests it degrades to host devices.
+        try:
+            devs = jax.devices(kind)
+        except RuntimeError:
+            devs = jax.devices()
+    return devs[place.device_id % len(devs)]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in _jax().devices())
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def get_device_count(kind: str = None) -> int:
+    jax = _jax()
+    try:
+        return len(jax.devices(kind)) if kind else len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+# gflags-style runtime flags (ref: python/paddle/fluid/__init__.py:121-140
+# imports gflags from env via core.init_gflags).  We keep a plain dict bridged
+# from the environment.
+GLOBAL_FLAGS = {
+    "check_nan_inf": os.environ.get("FLAGS_check_nan_inf", "0") in ("1", "true", "True"),
+    "benchmark": os.environ.get("FLAGS_benchmark", "0") in ("1", "true", "True"),
+}
+
+
+def init_gflags(args=None):
+    return True
+
+
+def init_devices():
+    return True

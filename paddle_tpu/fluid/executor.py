@@ -1,0 +1,379 @@
+"""Executor: runs a Program on a Place by tracing it into one XLA computation.
+
+The reference's Executor is a per-op C++ interpreter (ref: executor.cc:129,
+hot loop :354 ``for op in ctx->ops_: op->Run(scope, place)``) — every op is a
+separate kernel launch.  On TPU that model wastes the machine: the idiomatic
+design is to trace the *whole block* into a single jitted function
+(feed, state) -> (fetches, new_state) and let XLA fuse/schedule it.  The Scope
+survives as the host-side name->buffer table holding persistable state
+(parameters, optimizer accumulators, RNG key) between runs.
+
+Mutation semantics (SURVEY.md hard part #2): Fluid ops mutate scope vars in
+place (sgd writes ParamOut into the Param var).  Tracing SSA-ifies this by
+rebinding names in a trace-time environment; vars that were read from the
+scope and rewritten become donated inputs / fresh outputs of the XLA program,
+so XLA can alias their buffers (true in-place update on TPU HBM).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core
+from .framework import (Program, RNG_STATE_VAR, Variable, default_main_program)
+from ..ops import registry as _reg
+
+
+# ---------------------------------------------------------------------------
+# Scope (ref: scope.h:41 — hierarchical name->Variable map)
+# ---------------------------------------------------------------------------
+
+
+class _ScopeTensor:
+    """Minimal LoDTensor-view over a scope entry, for API parity
+    (supports np.array(t), t.set(arr, place), t.shape)."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._scope._values[self._name])
+        return a.astype(dtype) if dtype is not None else a
+
+    def set(self, array, place=None):
+        self._scope._values[self._name] = np.asarray(array)
+
+    @property
+    def shape(self):
+        return tuple(self._scope._values[self._name].shape)
+
+    def recursive_sequence_lengths(self):
+        return self._scope._lods.get(self._name, [])
+
+    def set_recursive_sequence_lengths(self, lod):
+        self._scope._lods[self._name] = lod
+
+
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return _ScopeTensor(self._scope, self._name)
+
+
+class Scope:
+    """name -> value table; values are host numpy or device jax arrays."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._values: Dict[str, object] = {}
+        self._lods: Dict[str, list] = {}
+        self._parent = parent
+        self._kids: List[Scope] = []
+
+    def var(self, name) -> _ScopeVar:
+        if name not in self._values:
+            self._values[name] = np.zeros((), np.float32)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name) -> Optional[_ScopeVar]:
+        s = self
+        while s is not None:
+            if name in s._values:
+                return _ScopeVar(s, name)
+            s = s._parent
+        return None
+
+    def new_scope(self) -> "Scope":
+        k = Scope(self)
+        self._kids.append(k)
+        return k
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    # -- internal fast path --
+    def get(self, name, default=None):
+        s = self
+        while s is not None:
+            if name in s._values:
+                return s._values[name]
+            s = s._parent
+        return default
+
+    def set(self, name, value):
+        self._values[name] = value
+
+    def has(self, name) -> bool:
+        return self.get(name, _MISSING) is not _MISSING
+
+    def keys(self):
+        return self._values.keys()
+
+
+_MISSING = object()
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return _guard()
+
+
+# ---------------------------------------------------------------------------
+# Block tracing
+# ---------------------------------------------------------------------------
+
+
+_SIDE_EFFECT_OPS = frozenset(["print", "save", "save_combine"])
+
+
+class BlockPlan:
+    """Static analysis of a block: which ops are live for the requested
+    fetches (dead ops are pruned — XLA would DCE them anyway, but pruning
+    first avoids demanding un-fed inputs), which names come from scope
+    (state_in), which persistables are (re)written (state_out)."""
+
+    def __init__(self, program: Program, block_idx: int,
+                 feed_names: Sequence[str], fetch_names: Sequence[str]):
+        block = program.block(block_idx)
+        self.block = block
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+
+        def _is_persistable(name: str) -> bool:
+            return block._has_var_recursive(name) and \
+                block._var_recursive(name).persistable
+
+        # 1. live-op slice: keep ops needed for fetches or persistable updates
+        needed = set(fetch_names)
+        kept = []
+        for op in reversed(block.ops):
+            if op.type in _SKIP_OPS:
+                continue
+            outs = [n for n in op.output_arg_names if n]
+            live = (op.type in _SIDE_EFFECT_OPS
+                    or any(n in needed for n in outs)
+                    or any(_is_persistable(n) for n in outs))
+            if not live:
+                continue
+            kept.append(op)
+            needed.update(n for n in op.input_arg_names if n)
+        self.ops = list(reversed(kept))
+
+        # 2. dataflow analysis over the kept ops
+        written = set(feed_names)
+        state_in: List[str] = []
+        self.needs_rng = False
+        for op in self.ops:
+            d = _resolve_opdef(op.type)
+            if d is not None and d.stateful:
+                self.needs_rng = True
+            for name in op.input_arg_names:
+                if not name:
+                    continue
+                if name not in written and name not in state_in:
+                    state_in.append(name)
+            for name in op.output_arg_names:
+                if name:
+                    written.add(name)
+        state_out: List[str] = []
+        for op in self.ops:
+            for name in op.output_arg_names:
+                if not name or name in state_out:
+                    continue
+                if name in state_in or _is_persistable(name):
+                    state_out.append(name)
+        # fetches that are never produced in-block must come from state
+        for name in self.fetch_names:
+            if name not in written and name not in state_in:
+                state_in.append(name)
+        self.state_in = state_in
+        self.state_out = state_out
+
+
+def _resolve_opdef(op_type):
+    if _reg.is_registered(op_type):
+        return _reg.get_op_def(op_type)
+    if op_type.endswith("_grad") and _reg.is_registered(op_type[:-5]):
+        return _reg.get_op_def(op_type[:-5])
+    return None
+
+
+_SKIP_OPS = frozenset(["feed", "fetch"])
+
+
+def trace_block(program: Program, block_idx: int, plan: BlockPlan,
+                feed_vals: Dict[str, jnp.ndarray],
+                state_vals: Dict[str, jnp.ndarray]):
+    """Run every op in the block symbolically; returns (fetches, new_state)."""
+    env: Dict[str, object] = {}
+    env.update(state_vals)
+    env.update(feed_vals)
+    rng_box = None
+    if plan.needs_rng:
+        rng_box = [state_vals[RNG_STATE_VAR]]
+    for op in plan.ops:
+        run_op(op, env, rng_box)
+    fetches = [env[n] for n in plan.fetch_names]
+    new_state = {n: env[n] for n in plan.state_out if n in env}
+    if rng_box is not None:
+        new_state[RNG_STATE_VAR] = rng_box[0]
+    return fetches, new_state
+
+
+def run_op(op, env: Dict[str, object], rng_box=None):
+    """Execute one IR op against a trace environment."""
+    is_grad = (not _reg.is_registered(op.type)) and op.type.endswith("_grad") \
+        and _reg.is_registered(op.type[:-5])
+    opdef = _reg.get_op_def(op.type[:-5] if is_grad else op.type)
+
+    inputs = {}
+    for slot, names in op.inputs.items():
+        inputs[slot] = [env.get(n) if n else None for n in names]
+    outputs_spec = {slot: list(names) for slot, names in op.outputs.items() if names}
+    ctx = _reg.ExecContext(op.type, inputs, outputs_spec, op.attrs, rng_box)
+
+    if is_grad:
+        if opdef.grad_fn is not None:
+            outs = _reg._normalize_outputs(opdef.grad_fn(ctx))
+        else:
+            outs = _reg.run_grad_generic(opdef, ctx)
+            outs = _reg._normalize_outputs(outs)
+    else:
+        outs = _reg._normalize_outputs(opdef.fn(ctx))
+
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for i, name in enumerate(names):
+            if name and i < len(vals) and vals[i] is not None:
+                env[name] = vals[i]
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+class Executor:
+    """ref: python/paddle/fluid/executor.py:256.  ``place`` selects the JAX
+    device; everything else is handled by XLA."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else core.CPUPlace()
+        self._cache = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
+            fetch_var_name="fetch", scope=None, return_numpy=True,
+            use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        feed_arrays = {k: self._coerce_feed(program, k, v) for k, v in feed.items()}
+
+        key = (id(program), program._version, tuple(fetch_names),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               self.place.device_type)
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+            fn = self._build(program, plan)
+            entry = (plan, fn)
+            if use_program_cache:
+                self._cache[key] = entry
+        plan, fn = entry
+
+        state_vals = self._gather_state(program, plan, scope)
+        device = core.get_jax_device(self.place)
+        feed_dev = {k: jax.device_put(v, device) for k, v in feed_arrays.items()}
+
+        # only vars that get rewritten are donated; read-only state (lr,
+        # params in eval programs) must keep its buffers alive in the scope
+        mut_names = set(plan.state_out)
+        if plan.needs_rng:
+            mut_names.add(RNG_STATE_VAR)
+        mut_state = {k: v for k, v in state_vals.items() if k in mut_names}
+        const_state = {k: v for k, v in state_vals.items()
+                       if k not in mut_names}
+        fetches, new_state = fn(feed_dev, const_state, mut_state)
+        for name, val in new_state.items():
+            scope.set(name, val)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # -- helpers --
+    def _build(self, program, plan):
+        device = core.get_jax_device(self.place)
+        donate = (2,) if device.platform == "tpu" else ()
+
+        def fn(feed_vals, const_state, mut_state):
+            state = dict(const_state)
+            state.update(mut_state)
+            return trace_block(program, 0, plan, feed_vals, state)
+
+        return jax.jit(fn, donate_argnums=donate)
+
+    def _gather_state(self, program, plan, scope):
+        state = {}
+        for name in plan.state_in:
+            val = scope.get(name, _MISSING)
+            if val is _MISSING:
+                gb = program.global_block()
+                if gb._has_var_recursive(name) and \
+                        gb._var_recursive(name).is_data:
+                    raise RuntimeError(
+                        f"Data variable '{name}' was not fed. Pass it in the "
+                        f"feed dict (feed keys were misspelled or missing).")
+                raise RuntimeError(
+                    f"Variable '{name}' is not initialized in the scope. "
+                    f"Did you run the startup program?")
+            state[name] = val if isinstance(val, jax.Array) else jnp.asarray(val)
+        if plan.needs_rng:
+            rk = scope.get(RNG_STATE_VAR, _MISSING)
+            if rk is _MISSING:
+                rk = jax.random.PRNGKey(program.random_seed or 0)
+                scope.set(RNG_STATE_VAR, rk)
+            state[RNG_STATE_VAR] = rk
+        return state
+
+    def _coerce_feed(self, program, name, value):
+        arr = np.asarray(value)
+        gb = program.global_block()
+        if gb._has_var_recursive(name):
+            want = core.np_dtype(gb._var_recursive(name).dtype)
+            if arr.dtype != want:
+                arr = arr.astype(want)
+        return arr

@@ -1,0 +1,138 @@
+"""Checkpoint / model save-load (ref: python/paddle/fluid/io.py:89-677).
+
+Serialization format: one file per variable inside ``dirname`` (same layout
+contract as the reference's save/load ops) with numpy's .npy encoding inside;
+``save_inference_model`` writes a pickled pruned Program as ``__model__``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .executor import Executor, global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _resolve_vars(main_program, predicate, vars):
+    main_program = main_program or default_main_program()
+    if vars is not None:
+        return [main_program.global_block()._var_recursive(v)
+                if isinstance(v, str) else v for v in vars]
+    return [v for v in main_program.list_vars() if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    predicate = predicate or is_persistable
+    var_list = _resolve_vars(main_program, predicate, vars)
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        blob = {}
+        for v in var_list:
+            val = scope.get(v.name)
+            if val is None:
+                continue
+            blob[v.name] = np.asarray(val)
+        with open(os.path.join(dirname, filename), "wb") as f:
+            np.savez(f, **blob)
+        return
+    for v in var_list:
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        with open(os.path.join(dirname, v.name), "wb") as f:
+            np.save(f, np.asarray(val), allow_pickle=False)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    predicate = predicate or is_persistable
+    var_list = _resolve_vars(main_program, predicate, vars)
+    scope = global_scope()
+    if filename is not None:
+        with np.load(os.path.join(dirname, filename)) as data:
+            for v in var_list:
+                if v.name in data:
+                    scope.set(v.name, data[v.name])
+        return
+    for v in var_list:
+        path = os.path.join(dirname, v.name)
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            scope.set(v.name, np.load(f, allow_pickle=False))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program._prune(target_vars)
+    return pruned.inference_optimize()
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    main_program = main_program or default_main_program()
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    inference_program = main_program.clone(for_test=True)
+    inference_program = inference_program._prune(target_vars)
+    payload = {
+        "program": inference_program,
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [t.name for t in target_vars],
+    }
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        pickle.dump(payload, f)
+    save_params(executor, dirname, inference_program, params_filename)
+    return [t.name for t in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        payload = pickle.load(f)
+    program: Program = payload["program"]
+    load_params(executor, dirname, program, params_filename)
+    fetch_vars = [program.global_block()._var_recursive(n)
+                  for n in payload["fetch_names"]]
+    return program, payload["feed_names"], fetch_vars

@@ -1,0 +1,57 @@
+"""Control-flow layers (ref: python/paddle/fluid/layers/control_flow.py:30 —
+While, Switch, IfElse, DynamicRNN, StaticRNN, ParallelDo).
+
+TPU design: data-dependent control flow must be expressed as
+``lax.while_loop`` / ``lax.scan`` / ``lax.cond`` inside one XLA program; the
+sub-block ops are traced into the loop body.  This module currently covers
+the scalar helpers; While/StaticRNN land with the sequence/RNN milestone.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["increment", "is_empty", "less_than", "equal", "array_length"]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        out.shape = x.shape
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool",
+                                                         stop_gradient=True)
+        cond.shape = x.shape
+    helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool",
+                                                         stop_gradient=True)
+        cond.shape = x.shape
+    helper.append_op(type="equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def is_empty(x, cond=None):
+    raise NotImplementedError("is_empty requires dynamic shapes; "
+                              "not supported in the XLA trace yet")
+
+
+def array_length(array):
+    raise NotImplementedError("LoDTensorArray lands with the RNN milestone")

@@ -1,0 +1,24 @@
+"""IO layers: data declarations (ref: python/paddle/fluid/layers/io.py:38).
+
+py_reader / double_buffer live in reader-land; on TPU the host->device
+pipeline is handled by the executor's async dispatch, so ``data`` is the load-
+bearing part of this module and the reader layers are thin compat shims.
+"""
+
+from __future__ import annotations
+
+from .. import core
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().current_block()
+    return block.create_var(
+        name=name, shape=shape, dtype=core.convert_dtype(dtype),
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)

@@ -1,0 +1,165 @@
+"""ParallelExecutor: data-parallel execution over a device mesh.
+
+The reference's ParallelExecutor (ref: parallel_executor.cc:119, SSA-graph
+engine in framework/details/) replicates the program per GPU and inserts NCCL
+all-reduce op-handles per gradient.  The TPU-native equivalent needs none of
+that machinery: the same traced block function is jitted under a 1-D
+``jax.sharding.Mesh`` with the batch dimension of every fed tensor sharded
+across devices and all state replicated.  XLA's SPMD partitioner then derives
+the per-device program and inserts the gradient all-reduce collectives over
+ICI automatically — the multi_devices_graph_pass, AllReduceOpHandle and
+ThreadedSSAGraphExecutor collapse into GSPMD.
+
+Loss scaling: the reference writes a 1/N constant per device
+(ScaleLossGradOpHandle).  Here the loss `mean` already averages over the
+*global* batch, so gradients match the single-device program exactly — the
+"same loss single vs parallel" oracle (SURVEY.md §4.4) holds by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import core
+from .executor import BlockPlan, _MISSING, global_scope, trace_block
+from .framework import RNG_STATE_VAR, Variable, default_main_program
+
+
+class ExecutionStrategy:
+    """ref: pybind.cc:605-620.  Most knobs are XLA's business now; kept for
+    API parity and honored where meaningful."""
+
+    class ExecutorType:
+        Default = 0
+        Experimental = 1
+
+    def __init__(self):
+        self.num_threads = 0
+        self.use_cuda = False
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 100
+        self.type = ExecutionStrategy.ExecutorType.Default
+
+
+class BuildStrategy:
+    """ref: pybind.cc:621-643."""
+
+    class ReduceStrategy:
+        AllReduce = 0   # replicated params (psum grads) — GSPMD default
+        Reduce = 1      # sharded optimizer states (ZeRO-1 style)
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+
+
+class ParallelExecutor:
+    """ref: python/paddle/fluid/parallel_executor.py:32."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None, use_tpu=None,
+                 devices=None, **kwargs):
+        self._program = main_program or default_main_program()
+        self._loss_name = loss_name
+        self._scope = scope or global_scope()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._build_strategy = build_strategy or BuildStrategy()
+        if devices is not None:
+            self._devices = list(devices)
+        else:
+            self._devices = list(jax.devices())
+        self._mesh = Mesh(np.array(self._devices), ("dp",))
+        self._cache = {}
+
+    @property
+    def device_count(self):
+        return len(self._devices)
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        if isinstance(feed, list):
+            # per-device feed dicts: concatenate along batch
+            merged: Dict[str, np.ndarray] = {}
+            for d in feed:
+                for k, v in d.items():
+                    merged.setdefault(k, []).append(np.asarray(v))
+            feed = {k: np.concatenate(v, 0) for k, v in merged.items()}
+        feed = feed or {}
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        feed_arrays = {}
+        gb = self._program.global_block()
+        for k, v in feed.items():
+            arr = np.asarray(v)
+            if gb._has_var_recursive(k):
+                want = core.np_dtype(gb._var_recursive(k).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feed_arrays[k] = arr
+
+        key = (id(self._program), self._program._version, tuple(fetch_names),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_arrays.items())))
+        entry = self._cache.get(key)
+        if entry is None:
+            plan = BlockPlan(self._program, 0, list(feed_arrays), fetch_names)
+            fn = self._build(plan)
+            entry = (plan, fn)
+            self._cache[key] = entry
+        plan, fn = entry
+
+        batch_spec = NamedSharding(self._mesh, P("dp"))
+        repl = NamedSharding(self._mesh, P())
+        feed_dev = {k: jax.device_put(v, batch_spec)
+                    for k, v in feed_arrays.items()}
+        state_vals = {}
+        for name in plan.state_in:
+            val = self._scope.get(name, _MISSING)
+            if val is _MISSING:
+                if gb._has_var_recursive(name) and \
+                        gb._var_recursive(name).is_data:
+                    raise RuntimeError(f"Data variable '{name}' was not fed")
+                raise RuntimeError(f"Variable '{name}' is not initialized; "
+                                   f"run the startup program first")
+            state_vals[name] = jax.device_put(val, repl)
+        if plan.needs_rng:
+            rk = self._scope.get(RNG_STATE_VAR, _MISSING)
+            if rk is _MISSING:
+                rk = jax.random.PRNGKey(self._program.random_seed or 0)
+            state_vals[RNG_STATE_VAR] = jax.device_put(rk, repl)
+
+        fetches, new_state = fn(feed_dev, state_vals)
+        for name, val in new_state.items():
+            self._scope.set(name, val)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    def _build(self, plan):
+        program = self._program
+        repl = NamedSharding(self._mesh, P())
+
+        def fn(feed_vals, state_vals):
+            return trace_block(program, 0, plan, feed_vals, state_vals)
+
+        # state (params/accumulators) stays replicated; feeds arrive sharded
+        # on the batch dim; XLA SPMD inserts gradient all-reduces.
+        return jax.jit(fn, out_shardings=(None, repl))
+
+    def bcast_params(self):
+        """ref: parallel_executor.cc:234 BCastParamsToDevices — replication is
+        expressed via sharding; nothing to broadcast eagerly."""
+        return None

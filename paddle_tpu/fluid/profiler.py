@@ -1,0 +1,52 @@
+"""Profiler facade (ref: python/paddle/fluid/profiler.py:39-221).
+
+The reference aggregates host events + CUPTI records; here the same API
+fronts ``jax.profiler`` — traces open in TensorBoard/perfetto/XProf, which
+is the TPU-native replacement for tools/timeline.py's Chrome trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler"]
+
+_trace_dir = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # no CUDA on this stack; kept as a no-op shim for API parity
+    yield
+
+
+def reset_profiler():
+    pass
+
+
+def start_profiler(state="All", trace_dir=None):
+    global _trace_dir
+    import jax
+
+    _trace_dir = trace_dir or os.path.join(tempfile.gettempdir(),
+                                           "paddle_tpu_profile")
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    import jax
+
+    jax.profiler.stop_trace()
+    return _trace_dir
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,10 @@
+"""Transpilers (ref: python/paddle/fluid/transpiler/)."""
+
+from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from .inference_transpiler import InferenceTranspiler
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .ps_dispatcher import HashName, RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "InferenceTranspiler", "memory_optimize", "release_memory",
+           "HashName", "RoundRobin"]

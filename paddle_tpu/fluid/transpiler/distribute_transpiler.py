@@ -1,0 +1,74 @@
+"""DistributeTranspiler: multi-worker training (ref: transpiler/
+distribute_transpiler.py:132).
+
+North-star redesign (BASELINE.json): the reference rewrites the program into
+send/recv/listen_and_serv RPC ops against parameter servers.  On a TPU pod
+the parameter-server role is obsolete — parameters and optimizer state live
+sharded/replicated across the same chips that compute, and gradient exchange
+is an XLA all-reduce over ICI.  So ``transpile`` does not inject RPC ops;
+it records the trainer topology and marks the program for SPMD execution:
+
+ - get_trainer_program(): the program, unchanged op-wise — ParallelExecutor /
+   the multihost runner shard the batch over the global mesh
+   (trainers × local devices) and GSPMD inserts collectives.
+ - get_pserver_program(): raises with guidance — there is no pserver process
+   in the TPU deployment; its state-holding role maps onto sharded optimizer
+   state (BuildStrategy.ReduceStrategy.Reduce ≈ ZeRO-1).
+
+Async PS semantics (RunAsyncLoop) have no SPMD equivalent and are documented
+as unsupported (SURVEY.md hard part #4).
+"""
+
+from __future__ import annotations
+
+from ..framework import Program, default_main_program
+
+
+class DistributeTranspilerConfig:
+    """ref: distribute_transpiler.py:116."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None):
+        if not sync_mode:
+            raise NotImplementedError(
+                "async parameter-server mode has no SPMD equivalent on TPU; "
+                "use sync_mode=True (see SURVEY.md §2.6)")
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self._transpiled = True
+        # annotate for the executors / multihost runner
+        self.origin_program._dist_info = {
+            "trainer_id": trainer_id,
+            "trainers": trainers,
+            "mode": "spmd_ici",
+        }
+
+    def get_trainer_program(self) -> Program:
+        if not self._transpiled:
+            raise RuntimeError("call transpile() first")
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint) -> Program:
+        raise NotImplementedError(
+            "TPU pods have no parameter-server process: parameters and "
+            "optimizer state are sharded across the mesh and gradients "
+            "all-reduce over ICI.  Launch every host with the trainer "
+            "program (see paddle_tpu.parallel for multihost init).")
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        raise NotImplementedError(
+            "no pserver startup program in the TPU deployment")

@@ -1,0 +1,16 @@
+"""Inference transpiler (ref: transpiler/inference_transpiler.py — folds
+batch-norm into conv weights, fuses relu).
+
+XLA performs these algebraic fusions during compilation, so the transpile is
+behavior-preserving identity plus the is_test switch."""
+
+from __future__ import annotations
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place, scope=None):
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in ("batch_norm", "dropout"):
+                    op.attrs["is_test"] = True
+        return program

@@ -1,0 +1,41 @@
+"""PS dispatchers (ref: transpiler/ps_dispatcher.py) — kept for API parity;
+used only to partition variables when emulating pserver layouts."""
+
+from __future__ import annotations
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = pserver_endpoints
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    def _hash_block(self, block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server_id = self._hash_block(var.name, len(self._eps))
+            eplist.append(self._eps[server_id])
+        return eplist
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        eplist = []
+        for _ in varlist:
+            eplist.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return eplist

@@ -1,0 +1,16 @@
+"""Op library: importing this package registers every op implementation."""
+
+from . import registry  # noqa: F401
+from .registry import register_op, register_grad, is_registered, get_op_def  # noqa: F401
+
+from . import (  # noqa: F401
+    math_ops,
+    activation_ops,
+    reduce_ops,
+    shape_ops,
+    random_ops,
+    nn_ops,
+    loss_ops,
+    optimizer_ops,
+    metric_ops,
+)

@@ -1,0 +1,66 @@
+"""Metric ops (ref: accuracy_op.*, auc_op.*, mean_iou_op, precision_recall)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("accuracy", no_grad_inputs=("Out", "Indices", "Label"))
+def accuracy(ctx):
+    indices = ctx.input("Indices")  # [N, k] top-k indices
+    label = ctx.input("Label")      # [N, 1]
+    if label.ndim == 2:
+        label = label.reshape(-1)
+    hit = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.array(indices.shape[0], jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": acc.reshape(1), "Correct": correct.reshape(1),
+            "Total": total.reshape(1)}
+
+
+@register_op("auc", no_grad_inputs=("Predict", "Label", "StatPos", "StatNeg"))
+def auc(ctx):
+    """Streaming AUC over histogram buckets (ref: auc_op.h)."""
+    predict = ctx.input("Predict")  # [N, 2] probs
+    label = ctx.input("Label").reshape(-1)
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    num_thresholds = ctx.attr("num_thresholds", 4095)
+    pos_prob = predict[:, -1]
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (label > 0)
+    stat_pos = stat_pos.at[bucket].add(is_pos.astype(stat_pos.dtype))
+    stat_neg = stat_neg.at[bucket].add((~is_pos).astype(stat_neg.dtype))
+    # integrate: iterate buckets from high threshold to low
+    pos_cum = jnp.cumsum(stat_pos[::-1])
+    neg_cum = jnp.cumsum(stat_neg[::-1])
+    tot_pos = pos_cum[-1]
+    tot_neg = neg_cum[-1]
+    # trapezoid area between consecutive operating points
+    prev_pos = jnp.concatenate([jnp.zeros(1, pos_cum.dtype), pos_cum[:-1]])
+    prev_neg = jnp.concatenate([jnp.zeros(1, neg_cum.dtype), neg_cum[:-1]])
+    area = jnp.sum((neg_cum - prev_neg) * (pos_cum + prev_pos) / 2.0)
+    auc_val = jnp.where((tot_pos > 0) & (tot_neg > 0),
+                        area / jnp.maximum(tot_pos * tot_neg, 1e-12), 0.0)
+    return {"AUC": auc_val.reshape(1).astype(jnp.float64)
+            if auc_val.dtype == jnp.float64 else auc_val.reshape(1),
+            "StatPosOut": stat_pos, "StatNegOut": stat_neg}
+
+
+@register_op("mean_iou", no_grad_inputs=("Predictions", "Labels"))
+def mean_iou(ctx):
+    pred = ctx.input("Predictions").reshape(-1).astype(jnp.int32)
+    label = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    n = ctx.attr("num_classes")
+    conf = jnp.zeros((n, n), jnp.float32).at[label, pred].add(1.0)
+    inter = jnp.diag(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {"OutMeanIou": miou.reshape(1), "OutWrong": (conf.sum(1) - inter),
+            "OutCorrect": inter}

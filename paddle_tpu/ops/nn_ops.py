@@ -1,0 +1,250 @@
+"""NN ops: conv / pool / norm / embedding (ref: conv_op.*, conv_cudnn_op.cu.cc,
+pool_op.*, batch_norm_op.*, layer_norm_op.*, lrn_op.*, lookup_table_op.*).
+
+All convs lower to ``lax.conv_general_dilated`` — XLA tiles them onto the MXU;
+there is no cuDNN-style algo selection to port.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv(ctx, x, w):
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    nd = x.ndim - 2
+    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    pad = [(p, p) for p in paddings]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+
+
+@register_op("conv2d")
+def conv2d(ctx):
+    return {"Output": _conv(ctx, ctx.input("Input"), ctx.input("Filter"))}
+
+
+@register_op("conv3d")
+def conv3d(ctx):
+    return {"Output": _conv(ctx, ctx.input("Input"), ctx.input("Filter"))}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or x.shape[1]
+    pad = [(p, p) for p in paddings]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=groups)
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [C_in, C_out/g, kH, kW]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    pad = [(p, p) for p in paddings]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"), transpose_kernel=True)
+    return {"Output": out}
+
+
+def _pool2d_impl(x, ptype, ksize, strides, paddings, exclusive, global_pooling,
+                 adaptive=False):
+    if global_pooling or (adaptive and list(ksize) == [1, 1]):
+        axis = (2, 3)
+        out = jnp.max(x, axis, keepdims=True) if ptype == "max" \
+            else jnp.mean(x, axis, keepdims=True)
+        return out
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_, pad)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_, pad)
+    if exclusive and any(paddings):
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_, pad)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+@register_op("pool2d")
+def pool2d(ctx):
+    x = ctx.input("X")
+    out = _pool2d_impl(
+        x, ctx.attr("pooling_type", "max"), _pair(ctx.attr("ksize")),
+        _pair(ctx.attr("strides", [1, 1])), _pair(ctx.attr("paddings", [0, 0])),
+        ctx.attr("exclusive", True), ctx.attr("global_pooling", False),
+        ctx.attr("adaptive", False))
+    return {"Out": out}
+
+
+@register_op("batch_norm", no_grad_inputs=("Mean", "Variance"))
+def batch_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    momentum = ctx.attr("momentum", 0.9)
+    eps = ctx.attr("epsilon", 1e-5)
+    is_test = ctx.attr("is_test", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    if layout == "NCHW":
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        bshape = (1,) * (x.ndim - 1) + (-1,)
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axes)
+        use_var = jnp.var(x, axes)
+        saved_mean, saved_var = use_mean, use_var
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean
+        var_out = momentum * var + (1.0 - momentum) * use_var
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": inv}
+
+
+@register_op("layer_norm")
+def layer_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    axis = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axes, keepdims=True)
+    var = jnp.var(x, axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    rest = int(np.prod(x.shape[axis:]))
+    if scale is not None:
+        y = y * scale.reshape((1,) * axis + x.shape[axis:])
+    if bias is not None:
+        y = y + bias.reshape((1,) * axis + x.shape[axis:])
+    return {"Y": y, "Mean": mean.reshape(-1), "Variance": var.reshape(-1)}
+
+
+@register_op("lrn")
+def lrn(ctx):
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("lookup_table", no_grad_inputs=("Ids",))
+def lookup_table(ctx):
+    w = ctx.input("W")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": out}
+
+
+@register_op("maxout")
+def maxout(ctx):
+    x = ctx.input("X")  # NCHW
+    g = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // g, g, h, w), axis=2)}
+
+
+@register_op("im2sequence")
+def im2sequence(ctx):
+    x = ctx.input("X")  # NCHW
+    kernels = ctx.attr("kernels")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = ctx.attr("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                     (paddings[1], paddings[3])))
+    kh, kw = kernels
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), strides, padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": out}
+
+
+@register_op("group_norm")
+def group_norm(ctx):
+    x = ctx.input("X")  # NCHW
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    groups = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axes, keepdims=True)
+    var = jnp.var(xg, axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "Mean": mean.reshape(n, groups), "Variance": var.reshape(n, groups)}
+
+
+@register_op("spp")
+def spp(ctx):
+    """Spatial pyramid pooling (ref: spp_op.*)."""
+    x = ctx.input("X")
+    levels = ctx.attr("pyramid_height")
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = -(-h // bins), -(-w // bins)
+        sh, sw = kh, kw
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        o = _pool2d_impl(x, ptype, [kh, kw], [sh, sw], [ph, pw], False, False)
+        outs.append(o.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}

@@ -1,0 +1,31 @@
+"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax import
+(SURVEY.md §4 implication (c): multi-device tests via
+xla_force_host_platform_device_count instead of the pserver/port dance)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs, scope and name counters."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import executor as _executor
+    from paddle_tpu.fluid import framework as _framework
+    from paddle_tpu.fluid import unique_name as _unique_name
+
+    _framework.switch_main_program(_framework.Program())
+    _framework.switch_startup_program(_framework.Program())
+    _unique_name.switch()
+    _executor._global_scope = _executor.Scope()
+    yield

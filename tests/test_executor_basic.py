@@ -1,0 +1,72 @@
+"""Executor + IR basics: feed/fetch, startup init, persistable state."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_fill_and_fetch():
+    x = fluid.layers.fill_constant(shape=[2, 3], dtype="float32", value=7.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(fluid.default_main_program(), fetch_list=[x])
+    np.testing.assert_allclose(out, np.full((2, 3), 7.0, np.float32))
+
+
+def test_feed_passthrough_and_ops():
+    data = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.scale(data, scale=2.0, bias=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (out,) = exe.run(fluid.default_main_program(), feed={"x": arr},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, arr * 2.0 + 1.0)
+
+
+def test_startup_initializes_params():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.fc(input=x, size=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    params = fluid.default_main_program().global_block().all_parameters()
+    assert len(params) == 2  # weight + bias
+    scope = fluid.global_scope()
+    for p in params:
+        val = scope.get(p.name)
+        assert val is not None
+        assert tuple(val.shape) == tuple(p.shape)
+
+
+def test_uninitialized_param_raises():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.fc(input=x, size=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(RuntimeError, match="not initialized"):
+        exe.run(fluid.default_main_program(),
+                feed={"x": np.zeros((2, 3), np.float32)}, fetch_list=[y])
+
+
+def test_persistable_state_survives_runs():
+    counter = fluid.layers.autoincreased_step_counter()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    (c1,) = exe.run(prog, fetch_list=[counter])
+    (c2,) = exe.run(prog, fetch_list=[counter])
+    (c3,) = exe.run(prog, fetch_list=[counter])
+    assert int(c1[0]) == 1
+    assert int(c2[0]) == 2
+    assert int(c3[0]) == 3
+
+
+def test_program_clone_for_test_strips_backward():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.fc(input=x, size=4)
+    loss = fluid.layers.mean(y)
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "sgd" not in types
+    assert not any(t.endswith("_grad") for t in types)
+    assert "mul" in types

@@ -1,0 +1,62 @@
+"""Coverage for the review-flagged tensor layers: concat, sums, has_inf/nan."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(fetch, feed=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(fluid.default_main_program(), feed=feed or {},
+                   fetch_list=fetch)
+
+
+def test_concat():
+    a = fluid.layers.fill_constant([2, 3], "float32", 1.0)
+    b = fluid.layers.fill_constant([2, 2], "float32", 2.0)
+    out = fluid.layers.concat([a, b], axis=1)
+    assert out.shape == (2, 5)
+    (v,) = _run([out])
+    assert v.shape == (2, 5)
+    np.testing.assert_allclose(v[:, :3], 1.0)
+    np.testing.assert_allclose(v[:, 3:], 2.0)
+
+
+def test_sums():
+    a = fluid.layers.fill_constant([3], "float32", 1.5)
+    b = fluid.layers.fill_constant([3], "float32", 2.5)
+    out = fluid.layers.sums([a, b])
+    (v,) = _run([out])
+    np.testing.assert_allclose(v, np.full(3, 4.0, np.float32))
+
+
+def test_has_inf_has_nan_isfinite():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                          append_batch_size=False)
+    hi = fluid.layers.has_inf(x)
+    hn = fluid.layers.has_nan(x)
+    fin = fluid.layers.isfinite(x)
+    clean = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    v = _run([hi, hn, fin], feed={"x": clean})
+    assert (bool(v[0][0]), bool(v[1][0]), bool(v[2][0])) == (False, False, True)
+    with_nan = np.array([1.0, np.nan, 3.0, 4.0], np.float32)
+    v = _run([hi, hn, fin], feed={"x": with_nan})
+    assert (bool(v[0][0]), bool(v[1][0]), bool(v[2][0])) == (False, True, False)
+    with_inf = np.array([1.0, np.inf, 3.0, 4.0], np.float32)
+    v = _run([hi, hn, fin], feed={"x": with_inf})
+    assert (bool(v[0][0]), bool(v[1][0]), bool(v[2][0])) == (True, False, False)
+
+
+def test_global_norm_clip_minimize():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2)
+    loss = fluid.layers.mean(y)
+    for p in fluid.default_main_program().global_block().all_parameters():
+        p.gradient_clip_attr = fluid.clip.GradientClipByGlobalNorm(1.0)
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (l,) = exe.run(fluid.default_main_program(),
+                   feed={"x": np.ones((4, 3), np.float32)}, fetch_list=[loss])
+    assert np.isfinite(l).all()

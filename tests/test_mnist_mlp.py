@@ -1,0 +1,88 @@
+"""End-to-end oracle (SURVEY.md §7 stage 2): MNIST MLP trains and the loss
+decreases — the BASELINE config #1 slice."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def build_mlp():
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(input=img, size=128, act="relu")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="relu")
+    prediction = fluid.layers.fc(input=hidden, size=10, act="softmax")
+    loss = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return img, label, prediction, avg_loss, acc
+
+
+def test_mnist_mlp_trains():
+    img, label, prediction, avg_loss, acc = build_mlp()
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    train_reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=64)
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=fluid.CPUPlace())
+
+    losses = []
+    for batch_id, data in enumerate(train_reader()):
+        loss_v, acc_v = exe.run(fluid.default_main_program(),
+                                feed=feeder.feed(data),
+                                fetch_list=[avg_loss, acc])
+        losses.append(float(loss_v[0]))
+        if batch_id >= 40:
+            break
+    first = np.mean(losses[:5])
+    last = np.mean(losses[-5:])
+    assert last < first * 0.8, f"loss did not decrease: {first} -> {last}"
+
+
+def test_mnist_mlp_adam_trains():
+    img, label, prediction, avg_loss, acc = build_mlp()
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    losses = []
+    for step in range(30):
+        x = rng.normal(0, 0.5, size=(32, 784)).astype(np.float32)
+        y = rng.randint(0, 10, size=(32, 1)).astype(np.int64)
+        # learnable mapping: label encoded in first 10 features
+        x[np.arange(32), y[:, 0]] += 3.0
+        loss_v, _ = exe.run(fluid.default_main_program(),
+                            feed={"img": x, "label": y},
+                            fetch_list=[avg_loss, acc])
+        losses.append(float(loss_v[0]))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_save_load_inference_roundtrip(tmp_path):
+    img, label, prediction, avg_loss, acc = build_mlp()
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    x = np.random.RandomState(0).normal(size=(4, 784)).astype(np.float32)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    (before,) = exe.run(test_prog, feed={"img": x}, fetch_list=[prediction])
+
+    model_dir = str(tmp_path / "model")
+    fluid.save_inference_model(model_dir, ["img"], [prediction], exe)
+
+    # fresh scope: load and compare
+    from paddle_tpu.fluid import executor as _executor
+
+    _executor._global_scope = _executor.Scope()
+    infer_prog, feed_names, fetch_vars = fluid.load_inference_model(model_dir, exe)
+    (after,) = exe.run(infer_prog, feed={feed_names[0]: x},
+                       fetch_list=fetch_vars)
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
