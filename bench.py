@@ -1,0 +1,72 @@
+"""Benchmark: ResNet-50 ImageNet training throughput, images/sec/chip.
+
+Matches the driver metric (BASELINE.json: "ResNet-50 images/sec/chip").
+vs_baseline compares against the reference's best published ResNet-50
+*training* number: 84.08 images/sec on 2x Xeon 6148 with MKL-DNN at bs=256
+(reference benchmark/IntelOptimizedPaddle.md:43-45; the repo publishes no GPU
+or per-chip ResNet-50 training figure).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_RESNET50_TRAIN_IPS = 84.08
+
+
+def main():
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BS", "128" if on_accel else "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_accel else "3"))
+    image_hw = 224 if on_accel else 64
+    class_dim = 1000 if on_accel else 100
+
+    img, label, prediction, loss, acc = resnet.build(
+        class_dim=class_dim, depth=50, image_shape=(3, image_hw, image_hw),
+        lr=0.1)
+
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(batch, 3, image_hw, image_hw)).astype(np.float32)
+    y = rng.randint(0, class_dim, size=(batch, 1)).astype(np.int64)
+
+    prog = fluid.default_main_program()
+    # warmup: compile + 2 steps
+    for _ in range(2):
+        exe.run(prog, feed={"img": x, "label": y}, fetch_list=[loss])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(prog, feed={"img": x, "label": y}, fetch_list=[loss])
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": f"resnet50_{image_hw}px_bs{batch}_train_{platform}",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / REFERENCE_RESNET50_TRAIN_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Parallelism: device meshes, SPMD sharding rules, collectives.
+
+TPU-native replacement for the reference's distributed stack (SURVEY.md §2.5,
+§2.6): NCCL context maps + gRPC parameter servers become a
+``jax.sharding.Mesh`` with GSPMD-inserted collectives over ICI.
+"""
+
+from .mesh import make_mesh, local_device_count
+from .spmd import (batch_spec, infer_param_specs, shard_program_step,
+                   ShardedTrainStep)
+
+__all__ = ["make_mesh", "local_device_count", "batch_spec",
+           "infer_param_specs", "shard_program_step", "ShardedTrainStep"]

@@ -1,0 +1,32 @@
+"""Mesh construction (ref analogue: platform/nccl_helper.h NCCLContextMap —
+rank math over trainers × local GPUs becomes an N-D device mesh)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count(platform=None) -> int:
+    try:
+        return len(jax.devices(platform)) if platform else len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def make_mesh(n_devices=None, tp=1, axis_names=("dp", "mp")) -> Mesh:
+    """Build a (dp × tp) mesh over the first n_devices devices.
+
+    tp ("mp" axis) shards model weights; dp shards the batch.  On a real pod
+    the mesh should map tp to the innermost ICI dimension — jax device order
+    already enumerates ICI-adjacent chips first.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} visible")
+    if n % tp != 0:
+        raise ValueError(f"n_devices={n} not divisible by tp={tp}")
+    arr = np.array(devs[:n]).reshape(n // tp, tp)
+    return Mesh(arr, axis_names)

@@ -1,0 +1,170 @@
+"""OpTest harness (ref: python/paddle/fluid/tests/unittests/op_test.py).
+
+Same contract as the reference's workhorse: declare an op type, numpy inputs,
+attrs and expected outputs; ``check_output`` runs the single-op program
+through the real Executor; ``check_grad`` compares analytic gradients (from
+the IR-level append_backward + vjp kernels) against central-difference
+numeric gradients (ref: op_test.py:43 get_numeric_gradient).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.fluid import executor as _executor
+from paddle_tpu.fluid import unique_name as _unique_name
+
+
+def _as_slot_map(spec):
+    """{'X': array} or {'X': [('x0', a), ('x1', b)]} -> {slot: [(name, arr)]}"""
+    out = {}
+    for slot, v in spec.items():
+        if isinstance(v, list) and v and isinstance(v[0], tuple):
+            out[slot] = [(n, np.asarray(a)) for n, a in v]
+        else:
+            out[slot] = [(slot.lower(), np.asarray(v))]
+    return out
+
+
+class OpTest:
+    """Subclass and set: op_type, inputs, outputs, attrs (optional)."""
+
+    op_type: str
+    inputs: dict
+    outputs: dict
+    attrs: dict = {}
+
+    def _fresh(self):
+        from paddle_tpu.fluid import framework as _fw
+
+        self._main = Program()
+        self._startup = Program()
+        _unique_name.switch()
+        _executor._global_scope = _executor.Scope()
+
+    def _build(self, stop_gradient_all=False):
+        self._fresh()
+        in_map = _as_slot_map(self.inputs)
+        out_map = _as_slot_map(self.outputs)
+        with program_guard(self._main, self._startup):
+            block = self._main.global_block()
+            op_inputs = {}
+            feed = {}
+            for slot, pairs in in_map.items():
+                names = []
+                for name, arr in pairs:
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=core.convert_dtype(arr.dtype),
+                                     stop_gradient=stop_gradient_all,
+                                     is_data=True)
+                    feed[name] = arr
+                    names.append(name)
+                op_inputs[slot] = names
+            op_outputs = {}
+            fetch = []
+            for slot, pairs in out_map.items():
+                names = []
+                for name, arr in pairs:
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=core.convert_dtype(arr.dtype))
+                    names.append(name)
+                    fetch.append(name)
+                op_outputs[slot] = names
+            block.append_op(type=self.op_type, inputs=op_inputs,
+                            outputs=op_outputs, attrs=dict(self.attrs))
+        return feed, fetch
+
+    def check_output(self, atol=1e-5, rtol=1e-4, place=None):
+        feed, fetch = self._build(stop_gradient_all=True)
+        exe = fluid.Executor(place or fluid.CPUPlace())
+        results = exe.run(self._main, feed=feed, fetch_list=fetch)
+        out_map = _as_slot_map(self.outputs)
+        i = 0
+        for slot, pairs in out_map.items():
+            for name, expect in pairs:
+                got = results[i]
+                i += 1
+                if expect.dtype == np.bool_:
+                    np.testing.assert_array_equal(
+                        got, expect, err_msg=f"{self.op_type}.{name}")
+                else:
+                    np.testing.assert_allclose(
+                        got, expect.astype(got.dtype), atol=atol, rtol=rtol,
+                        err_msg=f"{self.op_type}.{name}")
+
+    # ---- gradient checking ----
+    def _scalar_loss_program(self, output_name):
+        """Append sum-reduction to make a scalar loss over `output_name`."""
+        with program_guard(self._main, self._startup):
+            block = self._main.global_block()
+            loss = block.create_var(name="__loss__", shape=(1,),
+                                    dtype="float32")
+            block.append_op(type="reduce_sum",
+                            inputs={"X": [output_name]},
+                            outputs={"Out": ["__loss_sum__"]},
+                            attrs={"reduce_all": True, "dim": None,
+                                   "keep_dim": False})
+            block.create_var(name="__loss_sum__", shape=(), dtype="float32")
+            block.append_op(type="reshape",
+                            inputs={"X": ["__loss_sum__"]},
+                            outputs={"Out": [loss.name]},
+                            attrs={"shape": [1]})
+            return block.var(loss.name)
+
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=0.005,
+                   no_grad_set=None, numeric_delta=1e-3, place=None):
+        feed, _ = self._build(stop_gradient_all=False)
+        loss = self._scalar_loss_program(output_name)
+        from paddle_tpu.fluid.backward import append_backward
+
+        block = self._main.global_block()
+        for n in feed:
+            block.var(n).stop_gradient = False
+        if no_grad_set:
+            for n in no_grad_set:
+                if block.has_var(n):
+                    block.var(n).stop_gradient = True
+        append_backward(loss, parameter_list=None, no_grad_set=no_grad_set)
+        grad_names = [n + "@GRAD" for n in inputs_to_check]
+        exe = fluid.Executor(place or fluid.CPUPlace())
+        analytic = exe.run(self._main, feed=feed, fetch_list=grad_names)
+
+        for n, a_grad in zip(inputs_to_check, analytic):
+            n_grad = self._numeric_grad(feed, n, exe, numeric_delta)
+            self._assert_grads_close(a_grad, n_grad, n, max_relative_error)
+
+    def _numeric_grad(self, feed, wrt_name, exe, delta):
+        """Central differences of sum(output) wrt feed[wrt_name]."""
+        base = {k: v.copy() for k, v in feed.items()}
+        x = base[wrt_name].astype(np.float64)
+        grad = np.zeros_like(x, dtype=np.float64)
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            for sign in (+1, -1):
+                xp = x.copy()
+                xp[idx] += sign * delta
+                base[wrt_name] = xp.astype(feed[wrt_name].dtype)
+                (val,) = exe.run(self._main, feed=base,
+                                 fetch_list=["__loss__"])
+                grad[idx] += sign * float(val[0])
+            grad[idx] /= (2.0 * delta)
+            it.iternext()
+        base[wrt_name] = feed[wrt_name]
+        return grad
+
+    def _assert_grads_close(self, analytic, numeric, name, max_rel_err):
+        analytic = np.asarray(analytic, np.float64)
+        numeric = np.asarray(numeric, np.float64)
+        assert analytic.shape == numeric.shape, \
+            f"{self.op_type} grad {name}: shape {analytic.shape} vs {numeric.shape}"
+        abs_a = np.abs(analytic).max()
+        scale = max(abs_a, np.abs(numeric).max(), 1e-3)
+        diff = np.abs(analytic - numeric).max()
+        assert diff / scale <= max_rel_err, (
+            f"{self.op_type} grad {name}: max diff {diff}, scale {scale}, "
+            f"rel {diff / scale} > {max_rel_err}\n"
+            f"analytic:\n{analytic}\nnumeric:\n{numeric}")

@@ -1,0 +1,163 @@
+"""Per-op tests: dense math family (ref test model: test_elementwise_*_op.py,
+test_mul_op.py, test_matmul_op.py, ...)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+        y = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    def test_output(self):
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+        y = rng.uniform(-1, 1, (3,)).astype(np.float32)
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+
+
+class TestElementwiseMul(OpTest):
+    def test_grad(self):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(0.5, 1, (3, 4)).astype(np.float32)
+        y = rng.uniform(0.5, 1, (3, 4)).astype(np.float32)
+        self.op_type = "elementwise_mul"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestElementwiseDiv(OpTest):
+    def test_grad(self):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(1, 2, (3, 3)).astype(np.float32)
+        y = rng.uniform(1, 2, (3, 3)).astype(np.float32)
+        self.op_type = "elementwise_div"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+        self.check_output()
+        self.check_grad(["x", "y"], "out", max_relative_error=0.01)
+
+
+class TestMul(OpTest):
+    def test_grad(self):
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+        y = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+        self.op_type = "mul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestMulFlatten(OpTest):
+    def test_output(self):
+        rng = np.random.RandomState(5)
+        x = rng.uniform(-1, 1, (2, 2, 3)).astype(np.float32)
+        y = rng.uniform(-1, 1, (6, 4)).astype(np.float32)
+        self.op_type = "mul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(2, 6) @ y).reshape(2, 4)}
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    def test_grad(self):
+        rng = np.random.RandomState(6)
+        x = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+        y = rng.uniform(-1, 1, (5, 4)).astype(np.float32)
+        self.op_type = "matmul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestScale(OpTest):
+    def test_grad(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        self.op_type = "scale"
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+class TestSum(OpTest):
+    def test_grad(self):
+        rng = np.random.RandomState(7)
+        a = rng.uniform(-1, 1, (3, 3)).astype(np.float32)
+        b = rng.uniform(-1, 1, (3, 3)).astype(np.float32)
+        c = rng.uniform(-1, 1, (3, 3)).astype(np.float32)
+        self.op_type = "sum"
+        self.inputs = {"X": [("a", a), ("b", b), ("c", c)]}
+        self.outputs = {"Out": a + b + c}
+        self.check_output()
+        self.check_grad(["a", "b", "c"], "out")
+
+
+class TestMean(OpTest):
+    def test_grad(self):
+        rng = np.random.RandomState(8)
+        x = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+        self.op_type = "mean"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([x.mean()], np.float32)}
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+class TestClip(OpTest):
+    def test_output(self):
+        x = np.linspace(-2, 2, 12).astype(np.float32).reshape(3, 4)
+        self.op_type = "clip"
+        self.inputs = {"X": x}
+        self.attrs = {"min": -1.0, "max": 1.0}
+        self.outputs = {"Out": np.clip(x, -1, 1)}
+        self.check_output()
+
+
+class TestCast(OpTest):
+    def test_output(self):
+        x = np.array([[1.6, -2.3], [0.0, 4.9]], np.float32)
+        self.op_type = "cast"
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype(np.int32)}
+        self.check_output()
+
+
+class TestCompareOps(OpTest):
+    def test_output(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        y = np.array([2.0, 2.0, 2.0], np.float32)
+        self.op_type = "less_than"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x < y}
+        self.check_output()
