@@ -38,8 +38,11 @@ from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrateg
 from . import transpiler
 from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimize, release_memory
 
+from . import lod_tensor
+from .lod_tensor import (LoDTensor, create_lod_tensor,
+                         create_random_int_lodtensor)
+
 Tensor = framework.Variable
-LoDTensor = framework.Variable
 
 __all__ = [
     "io", "initializer", "layers", "nets", "optimizer", "backward",
@@ -51,4 +54,5 @@ __all__ = [
     "ParamAttr", "WeightNormParamAttr", "DataFeeder", "ParallelExecutor",
     "ExecutionStrategy", "BuildStrategy", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
+    "LoDTensor", "create_lod_tensor", "create_random_int_lodtensor",
 ]

@@ -202,3 +202,8 @@ def init_gflags(args=None):
 
 def init_devices():
     return True
+
+
+# host-side LoDTensor lives in fluid.lod_tensor; re-export for the pybind
+# parity surface (ref exposes core.LoDTensor, pybind.cc:160)
+from .lod_tensor import LoDTensor  # noqa: E402,F401

@@ -40,9 +40,13 @@ class DataToLoDTensorConverter:
             except ValueError:
                 pass
             return arr
+        from .lod_tensor import LoDTensor
+
         flat = np.array(self.data, dtype=self.dtype)
-        return flat.reshape([-1] + [d for d in self.shape if d not in (-1, None)]) \
-            if flat.ndim == 1 else flat
+        if flat.ndim == 1:
+            flat = flat.reshape(
+                [-1] + [d for d in self.shape if d not in (-1, None)])
+        return LoDTensor(flat, self.lod)
 
 
 class DataFeeder:
@@ -79,10 +83,22 @@ class DataFeeder:
 
     def feed_parallel(self, iterable, num_places=None):
         # ParallelExecutor accepts a merged global batch; just concatenate.
+        from .lod_tensor import LoDTensor
+
         batches = [self.feed(batch) for batch in iterable]
         if len(batches) == 1:
             return batches[0]
         out = {}
         for k in batches[0]:
-            out[k] = np.concatenate([b[k] for b in batches], axis=0)
+            vals = [b[k] for b in batches]
+            if isinstance(vals[0], LoDTensor):
+                data = np.concatenate([np.asarray(v) for v in vals], axis=0)
+                lens = [v.recursive_sequence_lengths() for v in vals]
+                merged = [sum((l[i] for l in lens), [])
+                          for i in range(len(lens[0]))]
+                t = LoDTensor(data)
+                t.set_recursive_sequence_lengths(merged)
+                out[k] = t
+            else:
+                out[k] = np.concatenate(vals, axis=0)
         return out

@@ -54,10 +54,24 @@ class _ScopeTensor:
         return tuple(self._scope._values[self._name].shape)
 
     def recursive_sequence_lengths(self):
-        return self._scope._lods.get(self._name, [])
+        # scope._lods stores offsets form; convert at the API surface
+        from .lod_tensor import _offsets_to_lengths
 
-    def set_recursive_sequence_lengths(self, lod):
-        self._scope._lods[self._name] = lod
+        off = self._scope._lods.get(self._name) or ()
+        return [_offsets_to_lengths(level) for level in off]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        from .lod_tensor import _lengths_to_offsets
+
+        self._scope._lods[self._name] = tuple(
+            _lengths_to_offsets(l) for l in lengths)
+
+    def lod(self):
+        return self._scope._lods.get(self._name) or ()
+
+    def set_lod(self, lod):
+        self._scope._lods[self._name] = tuple(
+            tuple(int(x) for x in level) for level in lod)
 
 
 class _ScopeVar:
@@ -225,11 +239,27 @@ def _resolve_opdef(op_type):
 _SKIP_OPS = frozenset(["feed", "fetch"])
 
 
+LOD_SUFFIX = "@LOD"
+
+
 def trace_block(program: Program, block_idx: int, plan: BlockPlan,
                 feed_vals: Dict[str, jnp.ndarray],
-                state_vals: Dict[str, jnp.ndarray]):
-    """Run every op in the block symbolically; returns (fetches, new_state)."""
+                state_vals: Dict[str, jnp.ndarray],
+                static_env: Optional[Dict[str, object]] = None,
+                lod_box: Optional[Dict[str, object]] = None):
+    """Run every op in the block symbolically; returns (fetches, new_state).
+
+    ``static_env`` carries compile-time-constant entries — notably
+    ``<name>@LOD`` sequence metadata (tuples of offset tuples).  LoD is
+    *static* in this framework (SURVEY.md §5.7: the TPU answer to variable
+    length is bucketing + segment ids, not dynamic shapes): packed sequence
+    data keeps a static [sum_len, ...] shape and the offsets are baked into
+    the trace, so XLA sees fully static programs.  ``lod_box``, if given,
+    receives the lod of every fetch/state name produced by the trace.
+    """
     env: Dict[str, object] = {}
+    if static_env:
+        env.update(static_env)
     env.update(state_vals)
     env.update(feed_vals)
     rng_box = None
@@ -241,6 +271,11 @@ def trace_block(program: Program, block_idx: int, plan: BlockPlan,
     new_state = {n: env[n] for n in plan.state_out if n in env}
     if rng_box is not None:
         new_state[RNG_STATE_VAR] = rng_box[0]
+    if lod_box is not None:
+        for n in list(plan.fetch_names) + list(plan.state_out):
+            lod = env.get(n + LOD_SUFFIX)
+            if lod is not None:
+                lod_box[n] = lod
     return fetches, new_state
 
 
@@ -253,25 +288,54 @@ def run_op(op, env: Dict[str, object], rng_box=None):
     inputs = {}
     for slot, names in op.inputs.items():
         inputs[slot] = [env.get(n) if n else None for n in names]
+        # companion static LoD entries (sequence metadata; see trace_block)
+        lods = [env.get(n + LOD_SUFFIX) if n else None for n in names]
+        if any(l is not None for l in lods):
+            inputs[slot + LOD_SUFFIX] = lods
     outputs_spec = {slot: list(names) for slot, names in op.outputs.items() if names}
     ctx = _reg.ExecContext(op.type, inputs, outputs_spec, op.attrs, rng_box)
 
     if is_grad:
         if opdef.grad_fn is not None:
-            outs = _reg._normalize_outputs(opdef.grad_fn(ctx))
+            raw = opdef.grad_fn(ctx)
         else:
-            outs = _reg.run_grad_generic(opdef, ctx)
-            outs = _reg._normalize_outputs(outs)
+            raw = _reg.run_grad_generic(opdef, ctx)
     else:
-        outs = _reg._normalize_outputs(opdef.fn(ctx))
+        raw = opdef.fn(ctx)
+
+    # split off "<slot>@LOD" returns (each a list of lods parallel to the
+    # slot's output names) before array normalization
+    out_lods = {}
+    if raw:
+        for k in [k for k in raw if k.endswith(LOD_SUFFIX)]:
+            v = raw.pop(k)
+            out_lods[k[: -len(LOD_SUFFIX)]] = v if isinstance(v, list) else [v]
+    outs = _reg._normalize_outputs(raw)
+
+    # default ShareLoD (ref: ops declare ShareLoD in InferShape; here a
+    # guarded heuristic): a unique input lod propagates to any output whose
+    # leading dim still equals the packed row count
+    share_lod = None
+    in_lods = {tuple(map(tuple, l))
+               for k, ls in inputs.items() if k.endswith(LOD_SUFFIX)
+               for l in ls if l is not None}
+    if len(in_lods) == 1:
+        share_lod = next(iter(in_lods))
 
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
-        if vals is None:
-            continue
+        lods = out_lods.get(slot)
         for i, name in enumerate(names):
-            if name and i < len(vals) and vals[i] is not None:
+            if not name:
+                continue
+            if vals is not None and i < len(vals) and vals[i] is not None:
                 env[name] = vals[i]
+                if (lods is None or i >= len(lods)) and share_lod is not None \
+                        and getattr(vals[i], "shape", None) \
+                        and vals[i].shape[0] == share_lod[-1][-1]:
+                    env[name + LOD_SUFFIX] = share_lod
+            if lods is not None and i < len(lods) and lods[i] is not None:
+                env[name + LOD_SUFFIX] = tuple(tuple(l) for l in lods[i])
 
 
 # ---------------------------------------------------------------------------
@@ -300,20 +364,35 @@ class Executor:
 
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
-        feed_arrays = {k: self._coerce_feed(program, k, v) for k, v in feed.items()}
+        feed_arrays, feed_lods = {}, {}
+        for k, v in feed.items():
+            arr, lod = self._coerce_feed(program, k, v)
+            feed_arrays[k] = arr
+            if lod:
+                feed_lods[k] = lod
+
+        # lods recorded on persistable state vars by earlier runs re-enter
+        # the trace as static metadata, exactly like feed lods
+        state_lods = {n: lod for n, lod in scope._lods.items()
+                      if lod and program.global_block()._has_var_recursive(n)}
 
         key = (id(program), program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
+               tuple(sorted(feed_lods.items())),
+               tuple(sorted(state_lods.items())),
                self.place.device_type)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
-            fn = self._build(program, plan)
-            entry = (plan, fn)
+            lod_box = {}
+            all_lods = dict(state_lods)
+            all_lods.update(feed_lods)
+            fn = self._build(program, plan, all_lods, lod_box)
+            entry = (plan, fn, lod_box)
             if use_program_cache:
                 self._cache[key] = entry
-        plan, fn = entry
+        plan, fn, lod_box = entry
 
         state_vals = self._gather_state(program, plan, scope)
         device = core.get_jax_device(self.place)
@@ -330,19 +409,27 @@ class Executor:
         fetches, new_state = fn(feed_dev, const_state, mut_state)
         for name, val in new_state.items():
             scope.set(name, val)
+            if name in lod_box:
+                scope._lods[name] = lod_box[name]
         if return_numpy:
             return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        from .lod_tensor import LoDTensor
+
+        return [LoDTensor(np.asarray(v), lod_box.get(n))
+                for n, v in zip(plan.fetch_names, fetches)]
 
     # -- helpers --
-    def _build(self, program, plan):
+    def _build(self, program, plan, feed_lods=None, lod_box=None):
         device = core.get_jax_device(self.place)
         donate = (2,) if device.platform == "tpu" else ()
+        static_env = {k + LOD_SUFFIX: lod
+                      for k, lod in (feed_lods or {}).items()}
 
         def fn(feed_vals, const_state, mut_state):
             state = dict(const_state)
             state.update(mut_state)
-            return trace_block(program, 0, plan, feed_vals, state)
+            return trace_block(program, 0, plan, feed_vals, state,
+                               static_env=static_env, lod_box=lod_box)
 
         return jax.jit(fn, donate_argnums=donate)
 
@@ -370,10 +457,25 @@ class Executor:
         return state
 
     def _coerce_feed(self, program, name, value):
+        lod = None
+        from .lod_tensor import LoDTensor
+
+        if isinstance(value, LoDTensor):
+            lod = value.lod() or None
+            value = np.asarray(value)
+        elif isinstance(value, tuple) and len(value) == 2 \
+                and isinstance(value[1], (list, tuple)):
+            # (array, recursive_sequence_lengths) convenience form
+            from .lod_tensor import _lengths_to_offsets
+
+            value, lengths = value
+            lod = tuple(tuple(_lengths_to_offsets(l)) for l in lengths) or None
         arr = np.asarray(value)
         gb = program.global_block()
         if gb._has_var_recursive(name):
             want = core.np_dtype(gb._var_recursive(name).dtype)
             if arr.dtype != want:
                 arr = arr.astype(want)
-        return arr
+        if lod is not None:
+            lod = tuple(tuple(int(x) for x in level) for level in lod)
+        return arr, lod

@@ -986,8 +986,17 @@ def prelu(x, mode, param_attr=None, name=None):
 
 
 def lod_reset(x, y=None, target_lod=None):
-    """LoD is host-side metadata here; on-device layout is unchanged."""
-    return x
+    """ref: lod_reset_op.cc — replace x's LoD from y or target_lod."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.shape = x.shape
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"target_lod": list(target_lod or [])})
+    return out
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

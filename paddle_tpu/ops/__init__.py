@@ -13,4 +13,6 @@ from . import (  # noqa: F401
     loss_ops,
     optimizer_ops,
     metric_ops,
+    sequence_ops,
+    rnn_ops,
 )

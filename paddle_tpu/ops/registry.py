@@ -50,6 +50,21 @@ class ExecContext:
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)
 
+    def in_lod(self, slot: str, idx: int = 0):
+        """Static LoD (tuple of offset tuples) of the idx-th input of a slot,
+        or None.  Injected by the executor from `<name>@LOD` env entries."""
+        vals = self.inputs.get(slot + "@LOD") or []
+        return vals[idx] if idx < len(vals) else None
+
+    def seq_offsets(self, slot: str, idx: int = 0, level: int = -1):
+        """Finest (or given) level offsets of an input's LoD, as a tuple."""
+        lod = self.in_lod(slot, idx)
+        if not lod:
+            raise ValueError(
+                f"op {self.op_type}: input slot {slot} carries no LoD "
+                f"(feed it as a LoDTensor / set recursive_sequence_lengths)")
+        return lod[level]
+
     def n_outputs(self, slot: str) -> int:
         return len(self.outputs_spec.get(slot) or [])
 

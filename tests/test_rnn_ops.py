@@ -1,0 +1,201 @@
+"""RNN op tests: dynamic_lstm / dynamic_gru / unit cells vs numpy
+recurrences (mirrors ref test_lstm_op.py / test_gru_op.py oracles)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_unit_matches_numpy():
+    rng = np.random.RandomState(0)
+    B, D = 3, 4
+    x = rng.randn(B, 4 * D).astype(np.float32)
+    c_prev = rng.randn(B, D).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        block.create_var(name="x", shape=x.shape, dtype="float32",
+                         is_data=True)
+        block.create_var(name="c_prev", shape=c_prev.shape, dtype="float32",
+                         is_data=True)
+        block.create_var(name="c", shape=(B, D), dtype="float32")
+        block.create_var(name="h", shape=(B, D), dtype="float32")
+        block.append_op(type="lstm_unit",
+                        inputs={"X": ["x"], "C_prev": ["c_prev"]},
+                        outputs={"C": ["c"], "H": ["h"]},
+                        attrs={"forget_bias": 0.5})
+    exe = fluid.Executor(fluid.CPUPlace())
+    c, h = exe.run(main, feed={"x": x, "c_prev": c_prev},
+                   fetch_list=["c", "h"])
+    i, f, o, j = np.split(x, 4, axis=1)
+    c_exp = c_prev * _sigmoid(f + 0.5) + _sigmoid(i) * np.tanh(j)
+    h_exp = c_exp * _sigmoid(o)
+    np.testing.assert_allclose(c, c_exp, rtol=1e-5)
+    np.testing.assert_allclose(h, h_exp, rtol=1e-5)
+
+
+def test_gru_unit_matches_numpy():
+    rng = np.random.RandomState(1)
+    B, D = 2, 3
+    x = rng.randn(B, 3 * D).astype(np.float32)
+    h_prev = rng.randn(B, D).astype(np.float32)
+    w = rng.randn(D, 3 * D).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        for nm, arr in [("x", x), ("h_prev", h_prev), ("w", w)]:
+            block.create_var(name=nm, shape=arr.shape, dtype="float32",
+                             is_data=True)
+        for nm in ["gate", "rhp", "h"]:
+            block.create_var(name=nm, shape=(B, D), dtype="float32")
+        block.append_op(type="gru_unit",
+                        inputs={"Input": ["x"], "HiddenPrev": ["h_prev"],
+                                "Weight": ["w"]},
+                        outputs={"Gate": ["gate"], "ResetHiddenPrev": ["rhp"],
+                                 "Hidden": ["h"]},
+                        attrs={"activation": 2, "gate_activation": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (h,) = exe.run(main, feed={"x": x, "h_prev": h_prev, "w": w},
+                   fetch_list=["h"])
+    xu, xr, xc = np.split(x, 3, axis=1)
+    u = _sigmoid(xu + h_prev @ w[:, :D])
+    r = _sigmoid(xr + h_prev @ w[:, D:2 * D])
+    c = np.tanh(xc + (r * h_prev) @ w[:, 2 * D:])
+    h_exp = (1 - u) * h_prev + u * c
+    np.testing.assert_allclose(h, h_exp, rtol=1e-5)
+
+
+def _np_dynamic_gru(x, lens, w, b):
+    """Per-sequence numpy GRU over packed rows."""
+    D = w.shape[0]
+    out = np.zeros((x.shape[0], D), np.float32)
+    start = 0
+    for L in lens:
+        h = np.zeros((D,), np.float32)
+        for t in range(L):
+            g = x[start + t] + b[0]
+            xu, xr, xc = g[:D], g[D:2 * D], g[2 * D:]
+            u = _sigmoid(xu + h @ w[:, :D])
+            r = _sigmoid(xr + h @ w[:, D:2 * D])
+            c = np.tanh(xc + (r * h) @ w[:, 2 * D:])
+            h = (1 - u) * h + u * c
+            out[start + t] = h
+        start += L
+    return out
+
+
+def test_dynamic_gru_matches_numpy():
+    rng = np.random.RandomState(2)
+    D = 4
+    lens = [3, 1, 2]
+    total = sum(lens)
+    x = rng.randn(total, 3 * D).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3 * D], dtype="float32",
+                               lod_level=1)
+        h = fluid.layers.dynamic_gru(xv, size=D)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    params = [n for n in scope.keys() if "dynamic_gru" in n]
+    wname = [n for n in params if scope.get(n).shape == (D, 3 * D)][0]
+    bname = [n for n in params if scope.get(n).shape == (1, 3 * D)][0]
+    w = rng.randn(D, 3 * D).astype(np.float32) * 0.5
+    b = rng.randn(1, 3 * D).astype(np.float32) * 0.1
+    scope.set(wname, w)
+    scope.set(bname, b)
+    res = exe.run(main, feed={"x": fluid.create_lod_tensor(x, [lens])},
+                  fetch_list=[h], return_numpy=False)
+    expect = _np_dynamic_gru(x, lens, w, b)
+    np.testing.assert_allclose(np.asarray(res[0]), expect, rtol=1e-4,
+                               atol=1e-5)
+    assert res[0].recursive_sequence_lengths() == [lens]
+
+
+def _np_dynamic_lstm(x, lens, w, b, use_peep):
+    D = w.shape[0]
+    hs = np.zeros((x.shape[0], D), np.float32)
+    start = 0
+    bg = b[0, :4 * D]
+    w_ic = b[0, 4 * D:5 * D] if use_peep else 0
+    w_fc = b[0, 5 * D:6 * D] if use_peep else 0
+    w_oc = b[0, 6 * D:7 * D] if use_peep else 0
+    for L in lens:
+        h = np.zeros((D,), np.float32)
+        c = np.zeros((D,), np.float32)
+        for t in range(L):
+            g = x[start + t] + h @ w + bg
+            gc, gi, gf, go = np.split(g, 4)
+            i = _sigmoid(gi + w_ic * c)
+            f = _sigmoid(gf + w_fc * c)
+            cand = np.tanh(gc)
+            c = f * c + i * cand
+            o = _sigmoid(go + w_oc * c)
+            h = o * np.tanh(c)
+            hs[start + t] = h
+        start += L
+    return hs
+
+
+def test_dynamic_lstm_matches_numpy():
+    rng = np.random.RandomState(3)
+    D = 3
+    lens = [2, 4]
+    total = sum(lens)
+    x = rng.randn(total, 4 * D).astype(np.float32)
+
+    for use_peep in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data(name="x", shape=[4 * D], dtype="float32",
+                                   lod_level=1)
+            h, c = fluid.layers.dynamic_lstm(xv, size=4 * D,
+                                             use_peepholes=use_peep)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        params = sorted(n for n in scope.keys() if "dynamic_lstm" in n)
+        wname = [n for n in params
+                 if scope.get(n).shape == (D, 4 * D)][-1]
+        bname = [n for n in params
+                 if scope.get(n).shape[0] == 1][-1]
+        w = (rng.randn(D, 4 * D) * 0.4).astype(np.float32)
+        b = (rng.randn(1, 7 * D if use_peep else 4 * D) * 0.1).astype(
+            np.float32)
+        scope.set(wname, w)
+        scope.set(bname, b)
+        res = exe.run(main, feed={"x": fluid.create_lod_tensor(x, [lens])},
+                      fetch_list=[h])
+        expect = _np_dynamic_lstm(x, lens, w, b, use_peep)
+        np.testing.assert_allclose(res[0], expect, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"peepholes={use_peep}")
+
+
+def test_dynamic_lstm_reverse_and_training():
+    """is_reverse runs the recurrence backwards; whole stack trains."""
+    rng = np.random.RandomState(4)
+    D = 8
+    lens = [3, 5, 2]
+    emb = rng.randn(sum(lens), 16).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[16], dtype="float32",
+                               lod_level=1)
+        proj = fluid.layers.fc(xv, size=4 * D)
+        h, c = fluid.layers.dynamic_lstm(proj, size=4 * D, is_reverse=True)
+        last = fluid.layers.sequence_pool(h, "last")
+        loss = fluid.layers.reduce_mean(last)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": fluid.create_lod_tensor(emb, [lens])}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

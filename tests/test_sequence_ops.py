@@ -1,0 +1,234 @@
+"""Sequence (LoD) op tests — packed data + static lod through the XLA trace.
+
+Mirrors ref tests: test_sequence_pool.py, test_sequence_expand.py,
+test_seq_conv.py, test_sequence_pad_op.py, test_row_conv_op.py, ...
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run_seq_op(op_type, x, lod_lengths, attrs=None, extra_inputs=None,
+                outputs=("Out",), extra_feed=None):
+    """Build a one-op program with a lod-carrying feed and run it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        block.create_var(name="x", shape=x.shape, dtype=str(x.dtype),
+                         is_data=True)
+        inputs = {"X": ["x"]}
+        feed = {"x": fluid.create_lod_tensor(x, [lod_lengths])}
+        for slot, (nm, arr, lens) in (extra_inputs or {}).items():
+            block.create_var(name=nm, shape=arr.shape, dtype=str(arr.dtype),
+                             is_data=True)
+            inputs[slot] = [nm]
+            feed[nm] = fluid.create_lod_tensor(arr, [lens]) if lens \
+                else arr
+        out_spec = {}
+        for slot in outputs:
+            block.create_var(name=f"o_{slot}", shape=(1,), dtype=str(x.dtype))
+            out_spec[slot] = [f"o_{slot}"]
+        block.append_op(type=op_type, inputs=inputs, outputs=out_spec,
+                        attrs=attrs or {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed=feed,
+                  fetch_list=[f"o_{s}" for s in outputs],
+                  return_numpy=False)
+    return res
+
+
+def test_sequence_pool_sum_avg_max():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lens = [2, 3, 1]
+    for pooltype, expect in [
+        ("SUM", np.array([[2, 4], [18, 21], [10, 11]], np.float32)),
+        ("AVERAGE", np.array([[1, 2], [6, 7], [10, 11]], np.float32)),
+        ("MAX", np.array([[2, 3], [8, 9], [10, 11]], np.float32)),
+        ("LAST", np.array([[2, 3], [8, 9], [10, 11]], np.float32)),
+        ("FIRST", np.array([[0, 1], [4, 5], [10, 11]], np.float32)),
+        ("SQRT", np.array([[2 / np.sqrt(2), 4 / np.sqrt(2)],
+                           [18 / np.sqrt(3), 21 / np.sqrt(3)],
+                           [10, 11]], np.float32)),
+    ]:
+        (out,) = _run_seq_op("sequence_pool", x, lens,
+                             attrs={"pooltype": pooltype})
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5,
+                                   err_msg=pooltype)
+
+
+def test_sequence_softmax():
+    x = np.random.RandomState(0).randn(7).astype(np.float32)
+    lens = [3, 4]
+    (out,) = _run_seq_op("sequence_softmax", x, lens)
+    out = np.asarray(out)
+    for s, e in [(0, 3), (3, 7)]:
+        seg = x[s:e]
+        expect = np.exp(seg - seg.max())
+        expect /= expect.sum()
+        np.testing.assert_allclose(out[s:e], expect, rtol=1e-5)
+
+
+def test_sequence_expand_and_lod():
+    x = np.array([[1], [2], [3], [4]], np.float32)  # 2 seqs: [1,2], [3,4]
+    y = np.zeros((5, 1), np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                               lod_level=1)
+        yv = fluid.layers.data(name="y", shape=[1], dtype="float32",
+                               lod_level=1)
+        out = fluid.layers.sequence_expand(xv, yv, ref_level=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main,
+                  feed={"x": fluid.create_lod_tensor(x, [[2, 2]]),
+                        "y": fluid.create_lod_tensor(y, [[2, 3]])},
+                  fetch_list=[out], return_numpy=False)
+    got = res[0]
+    np.testing.assert_allclose(
+        np.asarray(got).ravel(), [1, 2, 1, 2, 3, 4, 3, 4, 3, 4])
+    assert got.recursive_sequence_lengths() == [[2, 2, 2, 2, 2]]
+
+
+def test_sequence_expand_as():
+    x = np.array([[1], [2]], np.float32)
+    y = np.zeros((5, 1), np.float32)
+    (out,) = _run_seq_op("sequence_expand_as", x, [1, 1],
+                         extra_inputs={"Y": ("y", y, [3, 2])})
+    np.testing.assert_allclose(np.asarray(out).ravel(), [1, 1, 1, 2, 2])
+
+
+def test_sequence_concat():
+    a = np.array([[1], [2], [3]], np.float32)      # seqs [1] [2,3]
+    b = np.array([[4], [5], [6]], np.float32)      # seqs [4,5] [6]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        av = fluid.layers.data(name="a", shape=[1], dtype="float32",
+                               lod_level=1)
+        bv = fluid.layers.data(name="b", shape=[1], dtype="float32",
+                               lod_level=1)
+        out = fluid.layers.sequence_concat([av, bv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main,
+                  feed={"a": fluid.create_lod_tensor(a, [[1, 2]]),
+                        "b": fluid.create_lod_tensor(b, [[2, 1]])},
+                  fetch_list=[out], return_numpy=False)
+    np.testing.assert_allclose(np.asarray(res[0]).ravel(),
+                               [1, 4, 5, 2, 3, 6])
+    assert res[0].recursive_sequence_lengths() == [[3, 3]]
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lens = [2, 3]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                               lod_level=1)
+        pad_value = fluid.layers.fill_constant([1], "float32", -1.0)
+        padded, length = fluid.layers.sequence_pad(xv, pad_value)
+        unpadded = fluid.layers.sequence_unpad(padded, length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed={"x": fluid.create_lod_tensor(x, [lens])},
+                  fetch_list=[padded, length, unpadded],
+                  return_numpy=False)
+    p, l, u = (np.asarray(r) for r in res)
+    assert p.shape == (2, 3, 2)
+    np.testing.assert_allclose(p[0, 2], [-1, -1])
+    np.testing.assert_allclose(l, [2, 3])
+    np.testing.assert_allclose(u, x)
+    assert res[2].recursive_sequence_lengths() == [[2, 3]]
+
+
+def test_sequence_reshape_reverse_mask_enumerate():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    (out,) = _run_seq_op("sequence_reshape", x, [2, 4],
+                         attrs={"new_dim": 4})
+    assert np.asarray(out).shape == (3, 4)
+    assert out.recursive_sequence_lengths() == [[1, 2]]
+
+    (rev,) = _run_seq_op("sequence_reverse", x, [2, 4], outputs=("Y",))
+    np.testing.assert_allclose(np.asarray(rev)[:2], x[:2][::-1])
+    np.testing.assert_allclose(np.asarray(rev)[2:], x[2:][::-1])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lv = fluid.layers.data(name="l", shape=[3], dtype="int64",
+                               append_batch_size=False)
+        mask = fluid.layers.sequence_mask(lv, maxlen=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed={"l": np.array([1, 0, 3], np.int64)},
+                  fetch_list=[mask])
+    np.testing.assert_array_equal(
+        res[0], [[1, 0, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    ids = np.array([[1], [2], [3], [4], [5]], np.int64)
+    (en,) = _run_seq_op("sequence_enumerate", ids, [3, 2],
+                        attrs={"win_size": 2, "pad_value": 0})
+    np.testing.assert_array_equal(
+        np.asarray(en), [[1, 2], [2, 3], [3, 0], [4, 5], [5, 0]])
+
+
+def test_sequence_conv_shape_and_grad_flow():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 4).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                               lod_level=1)
+        y = fluid.layers.sequence_conv(xv, num_filters=5, filter_size=3)
+        pooled = fluid.layers.sequence_pool(y, "sum")
+        loss = fluid.layers.reduce_mean(pooled)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": fluid.create_lod_tensor(x, [[2, 4]])}
+    l0 = exe.run(main, feed=feed, fetch_list=[loss])[0]
+    for _ in range(5):
+        l1 = exe.run(main, feed=feed, fetch_list=[loss])[0]
+    assert np.isfinite(l1).all()
+
+
+def test_row_conv():
+    x = np.ones((4, 2), np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                               lod_level=1)
+        y = fluid.layers.row_conv(xv, future_context_size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # deterministic: set filter to ones -> out[t] = x[t] + x[t+1] (masked)
+    scope = fluid.global_scope()
+    fname = [n for n in scope.keys() if "row_conv" in n][0]
+    scope.set(fname, np.ones((2, 2), np.float32))
+    res = exe.run(main, feed={"x": fluid.create_lod_tensor(x, [[2, 2]])},
+                  fetch_list=[y])
+    np.testing.assert_allclose(
+        res[0], [[2, 2], [1, 1], [2, 2], [1, 1]])
+
+
+def test_data_feeder_lod_path():
+    """DataFeeder packs ragged samples into a LoDTensor the executor
+    understands (review finding: done() used to drop the lod)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="w", shape=[1], dtype="int64",
+                                  lod_level=1)
+        pooled = fluid.layers.sequence_pool(words, "sum")
+        feeder = fluid.DataFeeder(feed_list=[words], place=fluid.CPUPlace())
+    feed = feeder.feed([([1, 2, 3],), ([10, 20],)])
+    assert isinstance(feed["w"], fluid.LoDTensor)
+    assert feed["w"].recursive_sequence_lengths() == [[3, 2]]
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed=feed, fetch_list=[pooled])
+    np.testing.assert_allclose(res[0].ravel(), [6, 30])
+
+
+def test_lod_reset():
+    x = np.arange(6, dtype=np.float32).reshape(6, 1)
+    (out,) = _run_seq_op("lod_reset", x, [3, 3],
+                         attrs={"target_lod": [0, 2, 4, 6]})
+    assert out.recursive_sequence_lengths() == [[2, 2, 2]]
