@@ -125,8 +125,13 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
         for slot, names in fop.outputs.items():
             inputs[slot] = list(names)
         inputs.update(out_grad_slots)
+        # __fwd_op_idx__ links the grad op to its forward op so the executor
+        # can replay the forward's *host* inputs (loop counters mutated
+        # in-place between forward and backward — e.g. array indices)
         gop = block.append_op(type=gtype, inputs=inputs, outputs=in_grad_slots,
-                              attrs=dict(fop.attrs, **{OpRole.KEY: OpRole.Backward}))
+                              attrs=dict(fop.attrs,
+                                         **{OpRole.KEY: OpRole.Backward,
+                                            "__fwd_op_idx__": i}))
         if callbacks:
             for cb in callbacks:
                 cb(block=block, context={"__current_op_desc__": gop})

@@ -201,10 +201,26 @@ class BlockPlan:
         written = set(feed_names)
         state_in: List[str] = []
         self.needs_rng = False
-        for op in self.ops:
+        self.needs_eager = False
+
+        def _scan_special(op):
+            """stateful (rng) / eager flags, recursing into sub-blocks."""
+            from ..ops.array_ops import EAGER_OPS
+
             d = _resolve_opdef(op.type)
             if d is not None and d.stateful:
                 self.needs_rng = True
+            base = op.type[:-5] if op.type.endswith("_grad") else op.type
+            if base in EAGER_OPS:
+                self.needs_eager = True
+            sub = op.attr("sub_block") if hasattr(op, "attr") else None
+            if isinstance(sub, int):
+                for bop in program.block(sub).ops:
+                    _scan_special(bop)
+
+        for op in self.ops:
+            _scan_special(op)
+        for op in self.ops:
             for name in op.input_arg_names:
                 if not name:
                     continue
@@ -281,6 +297,12 @@ def trace_block(program: Program, block_idx: int, plan: BlockPlan,
 
 def run_op(op, env: Dict[str, object], rng_box=None):
     """Execute one IR op against a trace environment."""
+    from . import control_flow_exec
+
+    if op.type in control_flow_exec.HANDLERS:
+        control_flow_exec.HANDLERS[op.type](op, env, rng_box, run_op)
+        return
+
     is_grad = (not _reg.is_registered(op.type)) and op.type.endswith("_grad") \
         and _reg.is_registered(op.type[:-5])
     opdef = _reg.get_op_def(op.type[:-5] if is_grad else op.type)
@@ -292,6 +314,31 @@ def run_op(op, env: Dict[str, object], rng_box=None):
         lods = [env.get(n + LOD_SUFFIX) if n else None for n in names]
         if any(l is not None for l in lods):
             inputs[slot + LOD_SUFFIX] = lods
+    # current values of in-out outputs (tensor arrays accumulate)
+    for slot, names in op.outputs.items():
+        cur = [env.get(n) if n else None for n in names]
+        if any(c is not None for c in cur):
+            inputs[slot + "@CURRENT"] = cur
+
+    # host inputs (loop counters, array indices) mutate in place between
+    # forward and backward; forward ops stash theirs so the matching grad op
+    # (linked via __fwd_op_idx__, see backward.py) replays the values it
+    # actually saw
+    if is_grad:
+        fwd_idx = op.attr("__fwd_op_idx__")
+        if fwd_idx is not None and fwd_idx < len(op.block.ops):
+            stash = env.get("@FWD_HOST@", {}).get(
+                id(op.block.ops[fwd_idx]))
+            if stash:
+                inputs.update(stash)
+    else:
+        host_slots = {
+            slot: vals for slot, vals in inputs.items()
+            if not slot.endswith(LOD_SUFFIX)
+            and any(isinstance(v, np.ndarray) for v in vals)}
+        if host_slots:
+            env.setdefault("@FWD_HOST@", {})[id(op)] = {
+                s: list(v) for s, v in host_slots.items()}
     outputs_spec = {slot: list(names) for slot, names in op.outputs.items() if names}
     ctx = _reg.ExecContext(op.type, inputs, outputs_spec, op.attrs, rng_box)
 
@@ -431,6 +478,11 @@ class Executor:
             return trace_block(program, 0, plan, feed_vals, state,
                                static_env=static_env, lod_box=lod_box)
 
+        if plan.needs_eager:
+            # programs with data-dependent ops (beam search, mask split)
+            # run op-by-op eagerly — the two-tier executor fallback
+            # (SURVEY.md §7 hard part #2)
+            return fn
         return jax.jit(fn, donate_argnums=donate)
 
     def _gather_state(self, program, plan, scope):

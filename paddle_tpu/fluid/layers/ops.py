@@ -28,7 +28,38 @@ _UNARY_ATTR_OPS = {
 
 __all__ = list(_UNARY_OPS) + list(_UNARY_ATTR_OPS) + [
     "uniform_random", "cumsum",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
 ]
+
+
+def _make_logical(op_type):
+    binary = op_type != "logical_not"
+
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference(dtype="bool")
+            # static shape = the broadcast of both operands
+            shp = x.shape
+            if binary and y is not None and y.shape is not None:
+                if shp is None or len(y.shape) > len(shp):
+                    shp = y.shape
+            out.shape = shp
+        inputs = {"X": [x]}
+        if binary:
+            inputs["Y"] = [y]
+        helper.append_op(type=op_type, inputs=inputs,
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _make_logical("logical_and")
+logical_or = _make_logical("logical_or")
+logical_xor = _make_logical("logical_xor")
+logical_not = _make_logical("logical_not")
 
 
 def _make_unary(op_type, default_attrs=None):

@@ -13,7 +13,7 @@ __all__ = [
     "sequence_first_step", "sequence_last_step", "sequence_expand",
     "sequence_expand_as", "sequence_pad", "sequence_unpad", "sequence_slice",
     "sequence_reshape", "sequence_enumerate", "sequence_mask",
-    "sequence_reverse", "row_conv",
+    "sequence_reverse", "row_conv", "beam_search", "beam_search_decode",
 ]
 
 
@@ -306,6 +306,43 @@ def sequence_reverse(x, name=None):
     helper.append_op(type="sequence_reverse", inputs={"X": [x]},
                      outputs={"Y": [out]})
     return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None):
+    """ref: layers/nn.py:2780 — one beam-search step (executor eager tier;
+    fixed-width beams, see ops/array_ops.py beam_search)."""
+    helper = LayerHelper("beam_search", **locals())
+    selected_ids = helper.create_variable_for_type_inference(dtype="int64")
+    selected_scores = helper.create_variable_for_type_inference(
+        dtype=scores.dtype)
+    inputs = {"pre_ids": [pre_ids], "scores": [scores]}
+    if pre_scores is not None:
+        inputs["pre_scores"] = [pre_scores]
+    if ids is not None:
+        inputs["ids"] = [ids]
+    helper.append_op(
+        type="beam_search", inputs=inputs,
+        outputs={"selected_ids": [selected_ids],
+                 "selected_scores": [selected_scores]},
+        attrs={"level": level, "beam_size": beam_size, "end_id": end_id})
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size=None, end_id=None, name=None):
+    """ref: layers/nn.py:2892 — backtrack hypotheses from step arrays."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sentence_ids = helper.create_variable_for_type_inference(dtype="int64")
+    sentence_scores = helper.create_variable_for_type_inference(
+        dtype="float32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores]},
+        outputs={"SentenceIds": [sentence_ids],
+                 "SentenceScores": [sentence_scores]},
+        attrs={"beam_size": beam_size or 0, "end_id": -1 if end_id is None
+               else end_id})
+    return sentence_ids, sentence_scores
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):

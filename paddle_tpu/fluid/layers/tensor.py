@@ -114,7 +114,8 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None):
     helper.append_op(type="fill_constant", outputs={"Out": [out]},
                      attrs={"shape": list(shape),
                             "dtype": core.convert_dtype(dtype),
-                            "value": float(value)})
+                            "value": float(value),
+                            "force_cpu": bool(force_cpu)})
     return out
 
 

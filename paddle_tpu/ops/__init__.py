@@ -15,4 +15,5 @@ from . import (  # noqa: F401
     metric_ops,
     sequence_ops,
     rnn_ops,
+    array_ops,
 )

@@ -118,6 +118,7 @@ def cast(ctx):
     from ..fluid import core as _core
 
     dt = _core.np_dtype(ctx.attr("out_dtype", ctx.attr("dtype", "float32")))
+    # .astype preserves host-ness: numpy in -> numpy out (counter path)
     return {"Out": ctx.input("X").astype(dt)}
 
 
@@ -135,39 +136,55 @@ def clip_by_norm(ctx):
     return {"Out": x * scale.astype(x.dtype)}
 
 
-def _compare(name, fn):
+def _host(*vals):
+    """True when every value is a host (numpy) array — the counter path.
+    Host values stay concrete through jit traces (see fill_constant's
+    force_cpu), so loop conditions computed from them can drive trace-time
+    unrolling of while sub-blocks."""
+    import numpy as np
+
+    return all(isinstance(v, np.ndarray) for v in vals)
+
+
+def _compare(name, fn, npfn):
     @register_op(name, no_grad_inputs=("X", "Y"))
-    def _impl(ctx, _fn=fn):
+    def _impl(ctx, _fn=fn, _npfn=npfn):
         x, y = ctx.input("X"), ctx.input("Y")
+        if _host(x, y):
+            return {"Out": _npfn(x, y)}
         y = _bcast_y(x, y, ctx.attr("axis", -1))
         return {"Out": _fn(x, y)}
     return _impl
 
 
-_compare("less_than", jnp.less)
-_compare("less_equal", jnp.less_equal)
-_compare("greater_than", jnp.greater)
-_compare("greater_equal", jnp.greater_equal)
-_compare("equal", jnp.equal)
-_compare("not_equal", jnp.not_equal)
+import numpy as _np  # noqa: E402
+
+_compare("less_than", jnp.less, _np.less)
+_compare("less_equal", jnp.less_equal, _np.less_equal)
+_compare("greater_than", jnp.greater, _np.greater)
+_compare("greater_equal", jnp.greater_equal, _np.greater_equal)
+_compare("equal", jnp.equal, _np.equal)
+_compare("not_equal", jnp.not_equal, _np.not_equal)
 
 
-def _logical(name, fn, binary=True):
+def _logical(name, fn, npfn, binary=True):
     if binary:
         @register_op(name, no_grad_inputs=("X", "Y"))
-        def _impl(ctx, _fn=fn):
-            return {"Out": _fn(ctx.input("X"), ctx.input("Y"))}
+        def _impl(ctx, _fn=fn, _npfn=npfn):
+            x, y = ctx.input("X"), ctx.input("Y")
+            return {"Out": _npfn(x, y) if _host(x, y) else _fn(x, y)}
     else:
         @register_op(name, no_grad_inputs=("X",))
-        def _impl(ctx, _fn=fn):
-            return {"Out": _fn(ctx.input("X"))}
+        def _impl(ctx, _fn=fn, _npfn=npfn):
+            x = ctx.input("X")
+            return {"Out": _npfn(x) if _host(x) else _fn(x)}
     return _impl
 
 
-_logical("logical_and", jnp.logical_and)
-_logical("logical_or", jnp.logical_or)
-_logical("logical_xor", jnp.logical_xor)
-_logical("logical_not", jnp.logical_not, binary=False)
+_logical("logical_and", jnp.logical_and, _np.logical_and)
+_logical("logical_or", jnp.logical_or, _np.logical_or)
+_logical("logical_xor", jnp.logical_xor, _np.logical_xor)
+_logical("logical_not", jnp.logical_not, _np.logical_not, binary=False)
 
 
 @register_op("isfinite", no_grad_inputs=("X",))
@@ -192,7 +209,11 @@ def sign(ctx):
 
 @register_op("increment")
 def increment(ctx):
-    return {"Out": ctx.input("X") + ctx.attr("step", 1.0)}
+    x = ctx.input("X")
+    step = ctx.attr("step", 1.0)
+    if _host(x):
+        return {"Out": _np.asarray(x + step).astype(x.dtype)}
+    return {"Out": (x + step).astype(x.dtype)}
 
 
 @register_op("maximum")

@@ -32,7 +32,15 @@ def _key(ctx):
 @register_op("fill_constant")
 def fill_constant(ctx):
     dt = _np_dtype(ctx)
-    return {"Out": jnp.full(tuple(ctx.attr("shape", [])), ctx.attr("value", 0.0), dt)}
+    shape = tuple(ctx.attr("shape", []))
+    value = ctx.attr("value", 0.0)
+    # Always a host (numpy) value: constants fold into the trace either way,
+    # and host-ness keeps loop counters / conditions concrete under jit so
+    # while sub-blocks can unroll (the role force_cpu plays in the
+    # reference; here it is the default).  jnp consumers auto-promote.
+    import numpy as np
+
+    return {"Out": np.full(shape, value, dt)}
 
 
 @register_op("fill_constant_batch_size_like")

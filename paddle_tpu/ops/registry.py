@@ -50,6 +50,12 @@ class ExecContext:
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)
 
+    def cur_out(self, slot: str, idx: int = 0):
+        """Current value of an output var (in-out semantics, e.g. a tensor
+        array being appended to).  Injected by the executor."""
+        vals = self.inputs.get(slot + "@CURRENT") or []
+        return vals[idx] if idx < len(vals) else None
+
     def in_lod(self, slot: str, idx: int = 0):
         """Static LoD (tuple of offset tuples) of the idx-th input of a slot,
         or None.  Injected by the executor from `<name>@LOD` env entries."""

@@ -1,0 +1,150 @@
+"""Beam search step + decode (eager executor tier).
+
+Mirrors ref test_beam_search_op.py / test_beam_search_decode_op.py at the
+behavioral level: fixed-width beams (the TPU-native formulation — ended
+beams carry end_id with frozen scores instead of being pruned).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+
+def test_beam_search_step_topk():
+    """2 sources x 2 beams x 3 candidates -> top-2 per source."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = layers.data("pre_ids", shape=[4, 1], dtype="int64",
+                              append_batch_size=False)
+        ids = layers.data("ids", shape=[4, 3], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        scores = layers.data("scores", shape=[4, 3], dtype="float32",
+                             append_batch_size=False, lod_level=1)
+        sel_ids, sel_scores = layers.beam_search(
+            pre_ids, None, ids, scores, beam_size=2, end_id=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    pre = np.array([[1], [2], [3], [4]], np.int64)
+    cand_ids = np.arange(12, dtype=np.int64).reshape(4, 3) + 10
+    cand_scores = np.array([
+        [0.1, 0.9, 0.2],   # beam rows 0-1 -> source 0
+        [0.8, 0.3, 0.4],
+        [0.5, 0.6, 0.1],   # beam rows 2-3 -> source 1
+        [0.7, 0.2, 0.3],
+    ], np.float32)
+    lod = [[2, 2]]
+    res = exe.run(main, feed={
+        "pre_ids": pre,
+        "ids": fluid.create_lod_tensor(cand_ids, lod),
+        "scores": fluid.create_lod_tensor(cand_scores, lod),
+    }, fetch_list=[sel_ids, sel_scores], return_numpy=False)
+    got_ids = np.asarray(res[0]).ravel()
+    got_scores = np.asarray(res[1]).ravel()
+    # source 0: best two scores 0.9 (id 11), 0.8 (id 13)
+    # source 1: best two scores 0.7 (id 19), 0.6 (id 17)
+    np.testing.assert_array_equal(got_ids, [11, 13, 19, 17])
+    np.testing.assert_allclose(got_scores, [0.9, 0.8, 0.7, 0.6], rtol=1e-6)
+
+
+def test_beam_search_ended_beam_frozen():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = layers.data("pre_ids", shape=[2, 1], dtype="int64",
+                              append_batch_size=False)
+        ids = layers.data("ids", shape=[2, 2], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        scores = layers.data("scores", shape=[2, 2], dtype="float32",
+                             append_batch_size=False, lod_level=1)
+        sel_ids, sel_scores = layers.beam_search(
+            pre_ids, None, ids, scores, beam_size=2, end_id=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed={
+        "pre_ids": np.array([[0], [5]], np.int64),  # beam 0 already ended
+        "ids": fluid.create_lod_tensor(
+            np.array([[7, 8], [9, 10]], np.int64), [[2]]),
+        "scores": fluid.create_lod_tensor(
+            np.array([[0.95, 0.4], [0.5, 0.3]], np.float32), [[2]]),
+    }, fetch_list=[sel_ids], return_numpy=False)
+    got = np.asarray(res[0]).ravel()
+    # ended beam contributes only end_id (frozen at 0.95); next best is 9
+    assert 0 in got and 9 in got
+
+
+def test_beam_search_into_decode_roundtrip():
+    """Lods produced by beam_search must backtrack correctly in decode —
+    regression: both step-2 winners descend from beam row 1."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        zero = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        pre0 = layers.data("pre0", shape=[2, 1], dtype="int64",
+                           append_batch_size=False)
+        ids1 = layers.data("ids1", shape=[2, 2], dtype="int64",
+                           append_batch_size=False, lod_level=1)
+        sc1 = layers.data("sc1", shape=[2, 2], dtype="float32",
+                          append_batch_size=False, lod_level=1)
+        s_ids, s_sc = layers.beam_search(pre0, None, ids1, sc1,
+                                         beam_size=2, end_id=0)
+        # step arrays: step0 = the pre ids themselves (identity parents)
+        pre0_f = layers.cast(pre0, "int64")
+        id_arr = layers.array_write(pre0_f, zero)
+        layers.array_write(s_ids, one, array=id_arr)
+        sc0 = layers.fill_constant(shape=[2, 1], dtype="float32", value=0.0)
+        sc_arr = layers.array_write(sc0, zero)
+        layers.array_write(s_sc, one, array=sc_arr)
+        out_ids, out_sc = layers.beam_search_decode(id_arr, sc_arr,
+                                                    beam_size=2, end_id=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed={
+        "pre0": np.array([[7], [8]], np.int64),
+        # both best candidates live on beam row 1
+        "ids1": fluid.create_lod_tensor(
+            np.array([[3, 4], [5, 6]], np.int64), [[2]]),
+        "sc1": fluid.create_lod_tensor(
+            np.array([[0.1, 0.2], [0.9, 0.8]], np.float32), [[2]]),
+    }, fetch_list=[out_ids], return_numpy=False)
+    got = np.asarray(res[0]).reshape(-1, 2)
+    # both hypotheses must trace back to parent row 1 (token 8)
+    np.testing.assert_array_equal(got, [[8, 5], [8, 6]])
+
+
+def test_beam_search_decode_backtrack():
+    """Write two steps into arrays, decode full hypotheses."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        zero = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        s0_ids = layers.data("s0_ids", shape=[2, 1], dtype="int64",
+                             append_batch_size=False, lod_level=2)
+        s1_ids = layers.data("s1_ids", shape=[2, 1], dtype="int64",
+                             append_batch_size=False, lod_level=2)
+        s0_sc = layers.data("s0_sc", shape=[2, 1], dtype="float32",
+                            append_batch_size=False, lod_level=2)
+        s1_sc = layers.data("s1_sc", shape=[2, 1], dtype="float32",
+                            append_batch_size=False, lod_level=2)
+        ids_arr = layers.array_write(s0_ids, zero)
+        layers.array_write(s1_ids, one, array=ids_arr)
+        sc_arr = layers.array_write(s0_sc, zero)
+        layers.array_write(s1_sc, one, array=sc_arr)
+        out_ids, out_scores = layers.beam_search_decode(
+            ids_arr, sc_arr, beam_size=2, end_id=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # step 0: beams chose ids [3, 4]; step 1: row0 from parent0, row1 from
+    # parent1 (lod level 1 = parent offsets [0,1,2])
+    feed = {
+        "s0_ids": fluid.create_lod_tensor(
+            np.array([[3], [4]], np.int64), [[2], [1, 1]]),
+        "s1_ids": fluid.create_lod_tensor(
+            np.array([[5], [6]], np.int64), [[2], [1, 1]]),
+        "s0_sc": fluid.create_lod_tensor(
+            np.array([[0.5], [0.4]], np.float32), [[2], [1, 1]]),
+        "s1_sc": fluid.create_lod_tensor(
+            np.array([[0.9], [0.8]], np.float32), [[2], [1, 1]]),
+    }
+    res = exe.run(main, feed=feed, fetch_list=[out_ids, out_scores],
+                  return_numpy=False)
+    ids_out = np.asarray(res[0]).ravel()
+    lens = res[0].recursive_sequence_lengths()
+    # two hypotheses: [3,5] and [4,6]
+    np.testing.assert_array_equal(ids_out, [3, 5, 4, 6])
+    assert lens[-1] == [2, 2]

@@ -1,0 +1,241 @@
+"""Control-flow tests: While / arrays / StaticRNN / DynamicRNN / IfElse /
+Switch (mirrors ref test_while_op.py, test_dyn_rnn.py, test_recurrent_op.py).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+
+def test_while_sum_of_array():
+    """ref test_while_op: sum array entries with a counter loop."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d0 = layers.data("d0", shape=[10], dtype="float32",
+                         append_batch_size=False)
+        d1 = layers.data("d1", shape=[10], dtype="float32",
+                         append_batch_size=False)
+        d2 = layers.data("d2", shape=[10], dtype="float32",
+                         append_batch_size=False)
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i.stop_gradient = True
+        init = layers.zeros(shape=[10], dtype="float32")
+        mem_array = layers.array_write(x=init, i=i)
+        data_array = layers.array_write(x=d0, i=i)
+        i = layers.increment(i)
+        layers.array_write(d1, i, array=data_array)
+        i = layers.increment(i)
+        layers.array_write(d2, i, array=data_array)
+
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i.stop_gradient = True
+        array_len = layers.fill_constant(shape=[1], dtype="int64", value=3)
+        array_len.stop_gradient = True
+        cond = layers.less_than(x=i, y=array_len)
+
+        while_op = layers.While(cond=cond)
+        with while_op.block():
+            d = layers.array_read(array=data_array, i=i)
+            prev = layers.array_read(array=mem_array, i=i)
+            result = layers.sums(input=[d, prev])
+            i = layers.increment(x=i, in_place=True)
+            layers.array_write(result, i=i, array=mem_array)
+            layers.less_than(x=i, y=array_len, cond=cond)
+        sum_result = layers.array_read(array=mem_array, i=i)
+        loss = layers.mean(sum_result)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    d = [rng.rand(10).astype(np.float32) for _ in range(3)]
+    out = exe.run(main, feed={"d0": d[0], "d1": d[1], "d2": d[2]},
+                  fetch_list=[sum_result])
+    np.testing.assert_allclose(out[0], d[0] + d[1] + d[2], rtol=1e-5)
+
+
+def test_while_grad_flows():
+    """Gradients flow through the unrolled while into pre-loop vars."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32",
+                        append_batch_size=False)
+        x.stop_gradient = False
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i.stop_gradient = True
+        n = layers.fill_constant(shape=[1], dtype="int64", value=3)
+        n.stop_gradient = True
+        acc_arr = layers.array_write(x=x, i=i)
+        cond = layers.less_than(x=i, y=n)
+        w = layers.While(cond=cond)
+        with w.block():
+            prev = layers.array_read(array=acc_arr, i=i)
+            doubled = layers.scale(prev, scale=2.0)
+            i = layers.increment(x=i, in_place=True)
+            layers.array_write(doubled, i=i, array=acc_arr)
+            layers.less_than(x=i, y=n, cond=cond)
+        final = layers.array_read(array=acc_arr, i=i)
+        loss = layers.reduce_sum(final)
+        g = fluid.calc_gradient(loss, x)[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    lv, gv = exe.run(main, feed={"x": xv}, fetch_list=[loss, g])
+    # loss = sum(8x) -> dloss/dx = 8
+    np.testing.assert_allclose(lv, [8 * xv.sum()], rtol=1e-5)
+    np.testing.assert_allclose(gv, np.full(4, 8.0), rtol=1e-5)
+
+
+def test_static_rnn_trains():
+    """StaticRNN accumulator over [T, B, D] input learns."""
+    T, B, D = 4, 5, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, D], dtype="float32",
+                        append_batch_size=False)
+        x.stop_gradient = False
+        label = layers.data("label", shape=[B, 1], dtype="float32",
+                            append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)                     # [B, D]
+            mem = rnn.memory(shape=[-1, D], batch_ref=xt,
+                             ref_batch_dim_idx=0)
+            hidden = layers.fc([xt, mem], size=D, act="tanh")
+            rnn.update_memory(mem, hidden)
+            rnn.step_output(hidden)
+        outs = rnn()                                   # [T, B, D]
+        last = layers.slice(outs, axes=[0], starts=[T - 1], ends=[T])
+        last = layers.reshape(last, shape=[B, D])
+        pred = layers.fc(last, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, label))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(T, B, D).astype(np.float32)
+    yv = xv[0, :, :1].copy()  # learn to remember first step
+    losses = [float(exe.run(main, feed={"x": xv, "label": yv},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_dynamic_rnn_matches_dynamic_gru_style_loop():
+    """DynamicRNN over a ragged batch: correct per-sequence last states."""
+    D = 4
+    lens = [3, 1, 2]
+    total = sum(lens)
+    rng = np.random.RandomState(2)
+    xv = rng.randn(total, D).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32", lod_level=1)
+        x.stop_gradient = False
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            mem = drnn.memory(shape=[D], value=0.0)
+            new_mem = layers.elementwise_add(xt, mem)
+            drnn.update_memory(mem, new_mem)
+            drnn.output(new_mem)
+        outs = drnn()           # packed, running cumulative sums
+        last = layers.sequence_last_step(outs)
+        loss = layers.reduce_sum(last)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed={"x": fluid.create_lod_tensor(xv, [lens])},
+                  fetch_list=[outs, last], return_numpy=False)
+    got_out, got_last = np.asarray(res[0]), np.asarray(res[1])
+    # expected: per-sequence cumulative sum; last = per-sequence total
+    start = 0
+    for si, L in enumerate(lens):
+        seg = xv[start:start + L]
+        np.testing.assert_allclose(got_out[start:start + L],
+                                   np.cumsum(seg, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(got_last[si], seg.sum(0), rtol=1e-5)
+        start += L
+    assert res[0].recursive_sequence_lengths() == [lens]
+
+
+def test_dynamic_rnn_trains_with_fc():
+    """DynamicRNN with parameters + memory learns (grad through while)."""
+    D, H = 6, 8
+    lens_pool = [[3, 2, 4, 2], [2, 5, 3, 1]]
+    rng = np.random.RandomState(3)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32", lod_level=1)
+        x.stop_gradient = False
+        label = layers.data("label", shape=[1], dtype="float32")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            mem = drnn.memory(shape=[H], value=0.0)
+            hidden = layers.fc([xt, mem], size=H, act="tanh")
+            drnn.update_memory(mem, hidden)
+            drnn.output(hidden)
+        outs = drnn()
+        last = layers.sequence_last_step(outs)
+        pred = layers.fc(last, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, label))
+        fluid.optimizer.Adam(learning_rate=0.03).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for step in range(24):
+        lens = lens_pool[step % 2]
+        xv = rng.randn(sum(lens), D).astype(np.float32)
+        starts = np.cumsum([0] + lens[:-1])
+        yv = xv[starts, :1].astype(np.float32)
+        l = exe.run(main,
+                    feed={"x": fluid.create_lod_tensor(xv, [lens]),
+                          "label": yv},
+                    fetch_list=[loss])[0]
+        losses.append(float(l))
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-6:]) < np.mean(losses[:6])
+
+
+def test_ifelse_split_merge():
+    """IfElse routes rows by mask through different transforms (eager)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="float32",
+                        append_batch_size=False)
+        zero = layers.fill_constant(shape=[5, 1], dtype="float32", value=0.0)
+        cond = layers.less_than(zero, x)  # x > 0 per row
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(layers.scale(xt, scale=10.0))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(layers.scale(xf, scale=-1.0))
+        out = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1.0], [-2.0], [3.0], [-4.0], [5.0]], np.float32)
+    res = exe.run(main, feed={"x": xv.reshape(5, 1)}, fetch_list=[out])
+    np.testing.assert_allclose(
+        res[0].ravel(), [10.0, 2.0, 30.0, 4.0, 50.0], rtol=1e-5)
+
+
+def test_switch_scalar_case():
+    """Switch assigns by scalar condition (concrete at trace time)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = layers.create_global_var(shape=[1], value=0.0, dtype="float32",
+                                      persistable=True, name="lr")
+        one = layers.fill_constant(shape=[1], dtype="float32", value=1.0,
+                                   force_cpu=True)
+        two = layers.fill_constant(shape=[1], dtype="float32", value=2.0,
+                                   force_cpu=True)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(one, two)):
+                layers.assign(input=one, output=lr)
+            with switch.default():
+                layers.assign(input=two, output=lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, fetch_list=[lr])
+    np.testing.assert_allclose(res[0], [1.0])
