@@ -32,6 +32,13 @@ class LayerHelper:
     def append_op(self, *args, **kwargs):
         return self.main_program.current_block().append_op(*args, **kwargs)
 
+    def get_parameter(self, name):
+        """Look up an existing parameter by name (ref: layer_helper.py)."""
+        v = self.main_program.global_block()._var_recursive(name)
+        if not isinstance(v, Parameter):
+            raise ValueError(f"var {name} is not a Parameter")
+        return v
+
     def multiple_input(self, input_param_name="input"):
         inputs = self.kwargs.get(input_param_name, [])
         if isinstance(inputs, Variable):

@@ -29,6 +29,8 @@ __all__ = [
     "im2sequence", "maxout", "relu", "log", "crop", "mean_iou",
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
+    "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
+    "edit_distance", "ctc_greedy_decoder",
 ]
 
 
@@ -1011,3 +1013,140 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
         attrs={"step": float(step)})
     counter.stop_gradient = True
     return counter
+
+
+# ---------------------------------------------------------------------------
+# structured losses (ref: layers/nn.py linear_chain_crf/crf_decoding/nce/
+# hsigmoid/warpctc/edit_distance/ctc_greedy_decoder)
+# ---------------------------------------------------------------------------
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """ref: layers/nn.py linear_chain_crf — emission + learned transition
+    ([start; end; A] rows, crf_decoding_op.cc doc)."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[1]
+    transition = helper.create_parameter(attr=helper.param_attr,
+                                         shape=[size + 2, size],
+                                         dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(dtype=input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label]},
+        outputs={"Alpha": [alpha], "EmissionExps": [emission_exps],
+                 "TransitionExps": [transition_exps],
+                 "LogLikelihood": [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """ref: layers/nn.py crf_decoding."""
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [viterbi_path]})
+    return viterbi_path
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        seed=0):
+    """ref: layers/nn.py nce."""
+    helper = LayerHelper("nce", **locals())
+    if sample_weight is not None:
+        raise NotImplementedError("nce: sample_weight is not supported")
+    dim = input.shape[1]
+    num_neg_samples = int(num_neg_samples or 10)
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(dtype=input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    sample_labels = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="nce",
+        inputs={"Input": [input], "Label": [label], "Weight": [w],
+                "Bias": [b]},
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                 "SampleLabels": [sample_labels]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples, "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """ref: layers/nn.py hsigmoid (hierarchical sigmoid over a complete
+    binary class tree)."""
+    helper = LayerHelper("hierarchical_sigmoid", **locals())
+    dim = input.shape[1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[1, num_classes - 1],
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pre_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs={"X": [input], "W": [w], "Label": [label], "Bias": [b]},
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": num_classes})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """ref: layers/nn.py warpctc (CTC loss on lod logits/labels)."""
+    helper = LayerHelper("warpctc", **locals())
+    loss_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    grad_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="warpctc", inputs={"Logits": [input], "Label": [label]},
+        outputs={"WarpCTCGrad": [grad_out], "Loss": [loss_out]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss_out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    """ref: layers/nn.py edit_distance."""
+    helper = LayerHelper("edit_distance", **locals())
+    if ignored_tokens:
+        raise NotImplementedError(
+            "ignored_tokens: erase tokens in the reader pipeline instead "
+            "(sequence_erase is host-side preprocessing on TPU)")
+    edit_distance_out = helper.create_variable_for_type_inference(
+        dtype="float32")
+    sequence_num = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="edit_distance", inputs={"Hyps": [input], "Refs": [label]},
+        outputs={"Out": [edit_distance_out], "SequenceNum": [sequence_num]},
+        attrs={"normalized": normalized})
+    return edit_distance_out, sequence_num
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """ref: layers/nn.py ctc_greedy_decoder = argmax + ctc_align."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    _, topk_indices = topk(input, k=1)
+    ctc_out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="ctc_align", inputs={"Input": [topk_indices]},
+        outputs={"Output": [ctc_out]},
+        attrs={"merge_repeated": True, "blank": blank})
+    return ctc_out

@@ -16,4 +16,5 @@ from . import (  # noqa: F401
     sequence_ops,
     rnn_ops,
     array_ops,
+    struct_loss_ops,
 )

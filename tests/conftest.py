@@ -13,6 +13,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# sitecustomize in this environment pre-imports jax pinned to the axon TPU
+# tunnel; the env var above is then too late.  Override the live config so
+# tests never touch the tunnel (it can hang when the backend is wedged).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

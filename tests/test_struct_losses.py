@@ -1,0 +1,219 @@
+"""Structured loss tests: CRF (vs brute-force partition), CTC (vs
+brute-force path enumeration), NCE/hsigmoid training, edit distance,
+chunk_eval, ctc_align."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+
+def _run_op(op_type, inputs, outputs, attrs=None, lods=None, fetch=None):
+    main, startup = fluid.Program(), fluid.Program()
+    lods = lods or {}
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_spec, feed = {}, {}
+        for slot, (name, arr) in inputs.items():
+            block.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype),
+                             is_data=True)
+            in_spec[slot] = [name]
+            feed[name] = fluid.create_lod_tensor(arr, [lods[name]]) \
+                if name in lods else arr
+        out_spec = {}
+        for slot, name in outputs.items():
+            block.create_var(name=name, shape=(1,), dtype="float32")
+            out_spec[slot] = [name]
+        block.append_op(type=op_type, inputs=in_spec, outputs=out_spec,
+                        attrs=attrs or {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    fetch = fetch or list(outputs.values())
+    return exe.run(main, feed=feed, fetch_list=fetch, return_numpy=False)
+
+
+def _crf_brute_nll(em, trans, labels):
+    """Brute-force -log p(labels | em) for one sequence."""
+    k = em.shape[1]
+    start, end, a = trans[0], trans[1], trans[2:]
+
+    def score(path):
+        s = start[path[0]] + end[path[-1]] + sum(em[t, p]
+                                                 for t, p in enumerate(path))
+        s += sum(a[path[t - 1], path[t]] for t in range(1, len(path)))
+        return s
+
+    zs = [score(p) for p in itertools.product(range(k), repeat=em.shape[0])]
+    logz = np.log(np.sum(np.exp(np.array(zs) - max(zs)))) + max(zs)
+    return logz - score(labels)
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    k = 3
+    lens = [3, 2]
+    em = rng.randn(sum(lens), k).astype(np.float32)
+    trans = (rng.randn(k + 2, k) * 0.5).astype(np.float32)
+    lab = rng.randint(0, k, size=(sum(lens), 1)).astype(np.int64)
+    res = _run_op(
+        "linear_chain_crf",
+        {"Emission": ("em", em), "Transition": ("tr", trans),
+         "Label": ("lab", lab)},
+        {"LogLikelihood": "nll", "Alpha": "alpha",
+         "EmissionExps": "eex", "TransitionExps": "tex"},
+        lods={"em": lens, "lab": lens}, fetch=["nll"])
+    got = np.asarray(res[0]).ravel()
+    exp0 = _crf_brute_nll(em[:3], trans, lab[:3, 0])
+    exp1 = _crf_brute_nll(em[3:], trans, lab[3:, 0])
+    np.testing.assert_allclose(got, [exp0, exp1], rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    k = 3
+    lens = [4, 2]
+    em = rng.randn(sum(lens), k).astype(np.float32)
+    trans = (rng.randn(k + 2, k) * 0.5).astype(np.float32)
+    res = _run_op(
+        "crf_decoding",
+        {"Emission": ("em", em), "Transition": ("tr", trans)},
+        {"ViterbiPath": "path"}, lods={"em": lens}, fetch=["path"])
+    got = np.asarray(res[0]).ravel()
+
+    def best(emseq):
+        start, end, a = trans[0], trans[1], trans[2:]
+        paths = list(itertools.product(range(k), repeat=emseq.shape[0]))
+        scores = [start[p[0]] + end[p[-1]]
+                  + sum(emseq[t, pt] for t, pt in enumerate(p))
+                  + sum(a[p[t - 1], p[t]] for t in range(1, len(p)))
+                  for p in paths]
+        return list(paths[int(np.argmax(scores))])
+
+    np.testing.assert_array_equal(got[:4], best(em[:4]))
+    np.testing.assert_array_equal(got[4:], best(em[4:]))
+
+
+def _ctc_brute(lp, labels, blank=0):
+    """-log sum over alignments, brute force (T small)."""
+    T, C = lp.shape
+
+    def collapse(path):
+        out, prev = [], None
+        for t in path:
+            if t != prev and t != blank:
+                out.append(t)
+            prev = t
+        return tuple(out)
+
+    tot = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(labels):
+            s = sum(lp[t, c] for t, c in enumerate(path))
+            tot = np.logaddexp(tot, s)
+    return -tot
+
+
+def test_warpctc_matches_bruteforce():
+    rng = np.random.RandomState(2)
+    C = 4  # classes incl blank(=0)
+    t_lens, l_lens = [4, 3], [2, 1]
+    logits = rng.randn(sum(t_lens), C).astype(np.float32)
+    label = np.array([[1], [2], [3]], np.int64)  # seqs: [1,2], [3]
+    res = _run_op(
+        "warpctc",
+        {"Logits": ("lg", logits), "Label": ("lb", label)},
+        {"Loss": "loss", "WarpCTCGrad": "g"},
+        lods={"lg": t_lens, "lb": l_lens}, fetch=["loss"])
+    got = np.asarray(res[0]).ravel()
+    lp = np.log(np.exp(logits) /
+                np.exp(logits).sum(-1, keepdims=True))
+    exp0 = _ctc_brute(lp[:4], [1, 2])
+    exp1 = _ctc_brute(lp[4:], [3])
+    np.testing.assert_allclose(got, [exp0, exp1], rtol=1e-4)
+
+
+def test_crf_trains_label_semantic_roles_style():
+    """emission fc + linear_chain_crf trains; crf_decoding agrees more
+    with labels as loss drops."""
+    rng = np.random.RandomState(3)
+    k, d = 4, 6
+    lens = [5, 3, 4]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = layers.data("feat", shape=[d], dtype="float32", lod_level=1)
+        lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        emission = layers.fc(feat, size=k)
+        crf_cost = layers.linear_chain_crf(
+            emission, lab, param_attr=fluid.ParamAttr(name="crfw"))
+        loss = layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    total = sum(lens)
+    feats = rng.randn(total, d).astype(np.float32)
+    labels = (feats[:, :1] > 0).astype(np.int64)  # learnable tagging
+    feed = {"feat": fluid.create_lod_tensor(feats, [lens]),
+            "lab": fluid.create_lod_tensor(labels, [lens])}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.6, losses[::6]
+
+
+def test_nce_and_hsigmoid_train():
+    rng = np.random.RandomState(4)
+    B, D, C = 16, 8, 12
+    for loss_kind in ("nce", "hsigmoid"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = layers.data("x", shape=[D], dtype="float32")
+            yv = layers.data("y", shape=[1], dtype="int64")
+            if loss_kind == "nce":
+                cost = layers.nce(xv, yv, num_total_classes=C,
+                                  num_neg_samples=4, seed=1)
+            else:
+                cost = layers.hsigmoid(xv, yv, num_classes=C)
+            loss = layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        x = rng.randn(B, D).astype(np.float32)
+        y = rng.randint(0, C, size=(B, 1)).astype(np.int64)
+        losses = [float(exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss])[0]) for _ in range(20)]
+        assert losses[-1] < losses[0], (loss_kind, losses[::5])
+
+
+def test_edit_distance():
+    hyp = np.array([[1], [2], [3], [7], [8]], np.int64)   # [1,2,3], [7,8]
+    ref = np.array([[1], [3], [7], [8]], np.int64)        # [1,3], [7,8]
+    res = _run_op(
+        "edit_distance", {"Hyps": ("h", hyp), "Refs": ("r", ref)},
+        {"Out": "d", "SequenceNum": "n"},
+        attrs={"normalized": False},
+        lods={"h": [3, 2], "r": [2, 2]}, fetch=["d", "n"])
+    np.testing.assert_allclose(np.asarray(res[0]).ravel(), [1.0, 0.0])
+    assert int(np.asarray(res[1])[0]) == 2
+
+
+def test_chunk_eval_iob():
+    # tags: type*2 + {0:B, 1:I}; 'O' = 4 (num_types=2)
+    inf = np.array([[0], [1], [4], [2]], np.int64)  # B0 I0 O B1
+    lab = np.array([[0], [1], [4], [4]], np.int64)  # B0 I0 O O
+    res = _run_op(
+        "chunk_eval", {"Inference": ("inf", inf), "Label": ("lab", lab)},
+        {"Precision": "p", "Recall": "r", "F1-Score": "f",
+         "NumInferChunks": "ni", "NumLabelChunks": "nl",
+         "NumCorrectChunks": "nc"},
+        attrs={"num_chunk_types": 2, "chunk_scheme": "IOB"},
+        lods={"inf": [4], "lab": [4]}, fetch=["p", "r", "f"])
+    p, r, f = (float(np.asarray(v)[0]) for v in res)
+    assert abs(p - 0.5) < 1e-6 and abs(r - 1.0) < 1e-6
+
+
+def test_ctc_align():
+    x = np.array([[0], [1], [1], [0], [2], [2]], np.int64)
+    res = _run_op("ctc_align", {"Input": ("x", x)}, {"Output": "y"},
+                  attrs={"blank": 0, "merge_repeated": True},
+                  lods={"x": [6]}, fetch=["y"])
+    np.testing.assert_array_equal(np.asarray(res[0]).ravel(), [1, 2])
