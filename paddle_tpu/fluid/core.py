@@ -204,6 +204,12 @@ def init_devices():
     return True
 
 
+class EOFException(Exception):
+    """Raised when a reader's queue is exhausted (ref: the C++ executor
+    throws EOFException from the read op; users catch fluid.core.
+    EOFException around their train loop)."""
+
+
 # host-side LoDTensor lives in fluid.lod_tensor; re-export for the pybind
 # parity surface (ref exposes core.LoDTensor, pybind.cc:160)
 from .lod_tensor import LoDTensor  # noqa: E402,F401

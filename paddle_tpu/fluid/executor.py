@@ -252,7 +252,7 @@ def _resolve_opdef(op_type):
     return None
 
 
-_SKIP_OPS = frozenset(["feed", "fetch"])
+_SKIP_OPS = frozenset(["feed", "fetch", "read", "create_py_reader"])
 
 
 LOD_SUFFIX = "@LOD"
@@ -405,9 +405,23 @@ class Executor:
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True):
         program = program or default_main_program()
-        feed = feed or {}
+        feed = dict(feed or {})
         fetch_list = fetch_list or []
         scope = scope or global_scope()
+
+        # host infeed: pop one batch per `read` op from its reader queue
+        # and make it this step's feed (ref: the C++ read op pulls from
+        # LoDTensorBlockingQueue inside the executor loop)
+        for op in program.global_block().ops:
+            if op.type != "read":
+                continue
+            from .layers import io as _io
+            from .lod_tensor import LoDTensor
+
+            state = _io._reader_state(op.inputs["Reader"][0])
+            batch = state.next_batch()  # raises core.EOFException
+            for name, (arr, lod) in zip(op.outputs["Out"], batch):
+                feed[name] = LoDTensor(arr, lod) if lod else arr
 
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]

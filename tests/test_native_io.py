@@ -1,0 +1,209 @@
+"""Native runtime tests: C++ recordio + blocking queue, py_reader infeed,
+recordio dataset pipeline (ref: recordio tests + test_py_reader*)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+from paddle_tpu.native import (BlockingQueue, RecordIOScanner,
+                               RecordIOWriter, native_available)
+from paddle_tpu.native.tensor_pack import pack_batch, unpack_batch
+
+
+def test_native_library_builds():
+    assert native_available(), "C++ native library failed to build"
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.recordio")
+    recs = [os.urandom(n) for n in (1, 10, 1000, 100000)] + [b""]
+    with RecordIOWriter(path, compressor=1, max_chunk_bytes=2048) as w:
+        for r in recs:
+            w.write(r)
+    with RecordIOScanner(path) as sc:
+        got = list(sc)
+    assert got == recs
+
+
+def test_recordio_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "c.recordio")
+    with RecordIOWriter(path) as w:
+        w.write(b"hello world" * 100)
+    data = bytearray(open(path, "rb").read())
+    data[-3] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises((IOError, OSError)):
+        list(RecordIOScanner(path))
+
+
+def test_blocking_queue_threads():
+    q = BlockingQueue(4)
+    got = []
+
+    def consumer():
+        while True:
+            item = q.pop()
+            if item is None:
+                return
+            got.append(item)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(50):
+        assert q.push(f"item{i}".encode())
+    q.close()
+    t.join(timeout=10)
+    assert got == [f"item{i}".encode() for i in range(50)]
+    assert q.pop() is None  # closed and drained
+
+
+def test_blocking_queue_capacity_blocks():
+    q = BlockingQueue(2)
+    assert q.push(b"a") and q.push(b"b")
+    with pytest.raises(TimeoutError):
+        q.push(b"c", timeout=0.1)
+    assert q.pop() == b"a"
+    q.close()
+
+
+def test_tensor_pack_roundtrip():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.arange(5, dtype=np.int64).reshape(5, 1)
+    items = [(a, ()), (b, ((0, 2, 5),))]
+    out = unpack_batch(pack_batch(items))
+    np.testing.assert_array_equal(out[0][0], a)
+    assert out[0][1] == ()
+    np.testing.assert_array_equal(out[1][0], b)
+    assert out[1][1] == ((0, 2, 5),)
+
+
+def test_py_reader_trains_mnist_style():
+    """py_reader feeds a training loop until EOF (ref: test_py_reader...)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[-1, 16], [-1, 1]],
+                                  dtypes=["float32", "int64"])
+        img, label = layers.read_file(reader)
+        pred = layers.fc(img, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def provider():
+        for _ in range(12):
+            x = rng.randn(8, 16).astype(np.float32)
+            y = rng.randint(0, 4, size=(8, 1)).astype(np.int64)
+            yield [x, y]
+
+    reader.decorate_tensor_provider(provider)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    for epoch in range(2):
+        reader.start()
+        steps = 0
+        while True:
+            try:
+                exe.run(main, fetch_list=[loss])
+                steps += 1
+            except fluid.core.EOFException:
+                reader.reset()
+                break
+        assert steps == 12, steps
+
+
+def test_py_reader_paddle_reader_contract():
+    """decorate_paddle_reader takes minibatches (paddle.batch output) and
+    preserves the declared batch dims (review regression)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=4, shapes=[[-1, 3], [-1, 1]],
+                                  dtypes=["float32", "int64"])
+        x, y = layers.read_file(reader)
+
+    rng = np.random.RandomState(0)
+    samples = [(rng.randn(3).astype(np.float32).tolist(), [i % 2])
+               for i in range(10)]
+
+    def minibatch_reader():          # what paddle.batch(reader, 5) yields
+        yield samples[:5]
+        yield samples[5:]
+
+    reader.decorate_paddle_reader(minibatch_reader)
+    exe = fluid.Executor(fluid.CPUPlace())
+    reader.start()
+    out = exe.run(main, fetch_list=[x, y])
+    assert out[0].shape == (5, 3) and out[1].shape == (5, 1)
+    exe.run(main, fetch_list=[x])
+    with pytest.raises(fluid.core.EOFException):
+        exe.run(main, fetch_list=[x])
+    reader.reset()
+
+
+def test_py_reader_producer_error_propagates():
+    """A crash in the data source raises, not silent EOF (review fix)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=4, shapes=[[-1, 2]],
+                                  dtypes=["float32"])
+        x = layers.read_file(reader)
+
+    def provider():
+        yield [np.zeros((2, 2), np.float32)]
+        raise ValueError("bad record")
+
+    reader.decorate_tensor_provider(provider)
+    exe = fluid.Executor(fluid.CPUPlace())
+    reader.start()
+    exe.run(main, fetch_list=[x])
+    with pytest.raises(RuntimeError, match="producer thread failed"):
+        while True:
+            exe.run(main, fetch_list=[x])
+    reader.reset()
+
+
+def test_recordio_dataset_pipeline(tmp_path):
+    """convert_reader_to_recordio_file -> open_recordio_file -> batch ->
+    train (the reference's recordio dataset path)."""
+    from paddle_tpu.fluid import recordio_writer
+
+    path = str(tmp_path / "ds.recordio")
+    rng = np.random.RandomState(1)
+    samples = [(rng.randn(6).astype(np.float32),
+                np.array([i % 3], np.int64)) for i in range(20)]
+
+    prep, startup0 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prep, startup0):
+        x = layers.data("x", shape=[6], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    n = recordio_writer.convert_reader_to_recordio_file(
+        path, lambda: iter(samples), feeder)
+    assert n == 20
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.open_recordio_file(
+            path, shapes=[[-1, 6], [-1, 1]], dtypes=["float32", "int64"])
+        reader = layers.batch(reader, batch_size=5)
+        xv, yv = layers.read_file(reader)
+        pred = layers.fc(xv, size=3, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, yv))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    reader.start()
+    batches = 0
+    while True:
+        try:
+            out = exe.run(main, fetch_list=[loss])
+            batches += 1
+        except fluid.core.EOFException:
+            reader.reset()
+            break
+    assert batches == 4  # 20 samples / bs 5
