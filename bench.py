@@ -1,38 +1,98 @@
-"""Benchmark: ResNet-50 ImageNet training throughput, images/sec/chip.
+"""Benchmark driver: prints ONE JSON line with the headline metric.
 
-Matches the driver metric (BASELINE.json: "ResNet-50 images/sec/chip").
-vs_baseline compares against the reference's best published ResNet-50
-*training* number: 84.08 images/sec on 2x Xeon 6148 with MKL-DNN at bs=256
-(reference benchmark/IntelOptimizedPaddle.md:43-45; the repo publishes no GPU
-or per-chip ResNet-50 training figure).
+Default model is ResNet-50 training throughput (images/sec/chip), matching
+the driver metric (BASELINE.json: "ResNet-50 images/sec/chip").  Set
+BENCH_MODEL=transformer for Transformer-base tokens/sec/chip (the second
+driver metric), BENCH_MODEL=mnist for the MLP sanity config.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+vs_baseline compares against the reference's best published number for the
+model (reference benchmark/IntelOptimizedPaddle.md:43-45 — ResNet-50
+training 84.08 images/sec on 2x Xeon 6148 MKL-DNN bs=256; the reference
+publishes no per-chip TPU or Transformer figure, so the Transformer baseline
+is the same hardware-era proxy documented in BASELINE.md).
+
+Hardening (round-1 postmortem): the TPU backend behind the `axon` tunnel can
+HANG on first use, not just error — so the platform is probed in a
+subprocess with a timeout, and on probe failure the bench falls back to CPU
+via jax.config.update (env vars are too late: sitecustomize pre-imports
+jax).  Every failure path still emits one JSON diagnostic line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-REFERENCE_RESNET50_TRAIN_IPS = 84.08
+# Reference numbers to compare against (see module docstring).
+BASELINES = {
+    "resnet": 84.08,        # images/sec, ResNet-50 train bs=256, 2x Xeon 6148
+    "transformer": 1655.0,  # tokens/sec proxy: LSTM h=1280 bs=256 is the only
+                            # published seq2seq-scale figure (BASELINE.md); the
+                            # reference has no Transformer number.
+    "mnist": 10000.0,       # images/sec, no published figure; nominal.
+}
+
+PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "v = (x @ x).sum();"
+    "print('PROBE_OK', jax.devices()[0].platform, float(v))"
+)
 
 
-def main():
-    import jax
+def probe_platform(timeout: float = 180.0) -> str:
+    """Run a tiny jitted matmul in a subprocess; return its platform.
 
-    import paddle_tpu.fluid as fluid
+    Returns 'cpu' if the default backend fails to initialise or hangs
+    (the axon tunnel wedges rather than erroring, so an in-process
+    try/except cannot catch it).
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout)
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE_OK"):
+                return line.split()[1]
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return "cpu"
+
+
+def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
+    """Shared harness: startup program, warmup (compile), timed steps.
+
+    Returns (seconds, executor) for `steps` timed executions."""
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    for _ in range(warmup):
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    return time.perf_counter() - t0, exe
+
+
+def result_line(name, value, unit, baseline_key, **extra):
+    return {"metric": name, "value": round(value, 2), "unit": unit,
+            "vs_baseline": round(value / BASELINES[baseline_key], 3), **extra}
+
+
+def bench_resnet(fluid, platform, on_accel):
     from paddle_tpu.models import resnet
 
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    batch = int(os.environ.get("BENCH_BS", "128" if on_accel else "8"))
+    batch = int(os.environ.get("BENCH_BS", "128" if on_accel else "4"))
     steps = int(os.environ.get("BENCH_STEPS", "20" if on_accel else "3"))
     image_hw = 224 if on_accel else 64
     class_dim = 1000 if on_accel else 100
@@ -40,33 +100,102 @@ def main():
     img, label, prediction, loss, acc = resnet.build(
         class_dim=class_dim, depth=50, image_shape=(3, image_hw, image_hw),
         lr=0.1)
-
-    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
-    exe = fluid.Executor(place)
-    exe.run(fluid.default_startup_program())
-
     rng = np.random.RandomState(0)
-    x = rng.normal(size=(batch, 3, image_hw, image_hw)).astype(np.float32)
-    y = rng.randint(0, class_dim, size=(batch, 1)).astype(np.int64)
-
-    prog = fluid.default_main_program()
-    # warmup: compile + 2 steps
-    for _ in range(2):
-        exe.run(prog, feed={"img": x, "label": y}, fetch_list=[loss])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        (l,) = exe.run(prog, feed={"img": x, "label": y}, fetch_list=[loss])
-    dt = time.perf_counter() - t0
+    feed = {"img": rng.normal(size=(batch, 3, image_hw, image_hw)).astype(np.float32),
+            "label": rng.randint(0, class_dim, size=(batch, 1)).astype(np.int64)}
+    dt, _ = timed_run(fluid, on_accel, loss, feed, steps)
 
     ips = batch * steps / dt
-    print(json.dumps({
-        "metric": f"resnet50_{image_hw}px_bs{batch}_train_{platform}",
-        "value": round(ips, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips / REFERENCE_RESNET50_TRAIN_IPS, 3),
-    }))
+    # MFU input: ResNet-50 fwd ~3.86 GFLOP/img at 224px (scales ~(hw/224)^2);
+    # train ~= 3x fwd.  Only meaningful on a real accelerator.
+    extra = {}
+    if on_accel:
+        gflop_per_img = 3 * 3.86 * (image_hw / 224.0) ** 2
+        extra["achieved_tflops"] = round(ips * gflop_per_img / 1e3, 2)
+    return result_line(f"resnet50_{image_hw}px_bs{batch}_train_{platform}",
+                       ips, "images/sec/chip", "resnet", **extra)
+
+
+def bench_transformer(fluid, platform, on_accel):
+    from paddle_tpu.models import transformer
+
+    batch = int(os.environ.get("BENCH_BS", "32" if on_accel else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_accel else "3"))
+    seq_len = 256 if on_accel else 32
+    cfg = (transformer.base_config() if on_accel
+           else transformer.tiny_config())
+
+    src, tgt, lbl, loss = transformer.build(
+        cfg, src_len=seq_len, tgt_len=seq_len, lr=1e-3)
+    rng = np.random.RandomState(0)
+    feed = {"src_word": rng.randint(1, cfg.src_vocab_size, size=(batch, seq_len)).astype(np.int64),
+            "tgt_word": rng.randint(1, cfg.tgt_vocab_size, size=(batch, seq_len)).astype(np.int64),
+            "lbl_word": rng.randint(1, cfg.tgt_vocab_size, size=(batch, seq_len, 1)).astype(np.int64)}
+    dt, _ = timed_run(fluid, on_accel, loss, feed, steps)
+
+    tps = batch * seq_len * steps / dt  # target tokens/sec
+    return result_line(
+        f"transformer_{cfg.name}_len{seq_len}_bs{batch}_train_{platform}",
+        tps, "tokens/sec/chip", "transformer")
+
+
+def bench_mnist(fluid, platform, on_accel):
+    from paddle_tpu.models import mnist
+
+    batch = int(os.environ.get("BENCH_BS", "512" if on_accel else "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "50" if on_accel else "10"))
+    img, label, prediction, loss, acc = mnist.mlp()
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(size=(batch, 784)).astype(np.float32),
+            "label": rng.randint(0, 10, size=(batch, 1)).astype(np.int64)}
+    dt, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    ips = batch * steps / dt
+    return result_line(f"mnist_mlp_bs{batch}_train_{platform}",
+                       ips, "images/sec/chip", "mnist")
+
+
+BENCHES = {"resnet": bench_resnet, "transformer": bench_transformer,
+           "mnist": bench_mnist}
+
+
+def main():
+    model = os.environ.get("BENCH_MODEL", "resnet")
+    for i, a in enumerate(sys.argv):
+        if a == "--model" and i + 1 < len(sys.argv):
+            model = sys.argv[i + 1]
+        elif a.startswith("--model="):
+            model = a.split("=", 1)[1]
+    if model not in BENCHES:
+        print(json.dumps({"metric": f"unknown_model_{model}", "value": 0,
+                          "unit": "none", "vs_baseline": 0,
+                          "error": f"BENCH_MODEL must be one of {sorted(BENCHES)}"}))
+        return 1
+
+    platform = probe_platform(
+        timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
+    import jax
+    if platform == "cpu":
+        # Default backend unusable (or genuinely CPU): pin to CPU so the
+        # in-process backend cannot hang the way the probe did.
+        jax.config.update("jax_platforms", "cpu")
+    on_accel = platform not in ("cpu",)
+
+    try:
+        import paddle_tpu.fluid as fluid
+        result = BENCHES[model](fluid, platform, on_accel)
+        print(json.dumps(result))
+        return 0
+    except Exception as exc:  # emit a diagnostic JSON line, never die silently
+        print(json.dumps({
+            "metric": f"{model}_failed_{platform}", "value": 0,
+            "unit": "none", "vs_baseline": 0,
+            "error": f"{type(exc).__name__}: {exc}",
+            "trace": traceback.format_exc(limit=5),
+        }))
+        return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

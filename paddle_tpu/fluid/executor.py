@@ -377,6 +377,9 @@ def run_op(op, env: Dict[str, object], rng_box=None):
                 continue
             if vals is not None and i < len(vals) and vals[i] is not None:
                 env[name] = vals[i]
+                # rebinding a var invalidates any previous LoD; it is
+                # re-attached below only if this op declares/shares one
+                env.pop(name + LOD_SUFFIX, None)
                 if (lods is None or i >= len(lods)) and share_lod is not None \
                         and getattr(vals[i], "shape", None) \
                         and vals[i].shape[0] == share_lod[-1][-1]:

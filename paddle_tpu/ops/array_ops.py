@@ -361,6 +361,21 @@ def tensor_array_stack_grad(ctx):
 def split_lod_tensor(ctx):
     x = ctx.input("X")
     mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
+    lod = ctx.in_lod("X")
+    # Row-wise split equals the reference's sequence-level split whenever
+    # every sequence is a single row; only true multi-row sequences need
+    # the unimplemented sequence-level path (split_lod_tensor_op.cc).
+    if lod:
+        fin = np.asarray(lod[-1])
+        if np.any(np.diff(fin) != 1) or int(ctx.attr("level", 0)) != 0:
+            raise NotImplementedError(
+                "split_lod_tensor: sequence-level split of multi-row LoD "
+                "sequences is not supported; only row-wise split where each "
+                "sequence is one row. Ref: split_lod_tensor_op.cc.")
+    if mask.shape[0] != np.asarray(x).shape[0]:
+        raise ValueError(
+            f"split_lod_tensor: mask length {mask.shape[0]} != input rows "
+            f"{np.asarray(x).shape[0]}")
     t_idx = np.nonzero(mask)[0]
     f_idx = np.nonzero(~mask)[0]
     return {"OutTrue": x[jnp.asarray(t_idx)],
@@ -386,6 +401,14 @@ def split_lod_tensor_grad(ctx):
 def merge_lod_tensor(ctx):
     mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
     in_true, in_false = ctx.input("InTrue"), ctx.input("InFalse")
+    if int(ctx.attr("level", 0)) != 0:
+        raise NotImplementedError(
+            "merge_lod_tensor: only level=0 row-wise merge is supported.")
+    n_rows = (np.asarray(in_true).shape[0] + np.asarray(in_false).shape[0])
+    if mask.shape[0] != n_rows:
+        raise ValueError(
+            f"merge_lod_tensor: mask length {mask.shape[0]} != total rows "
+            f"{n_rows}")
     shape = (len(mask),) + tuple(np.asarray(in_true).shape[1:])
     out = jnp.zeros(shape, in_true.dtype)
     out = out.at[jnp.asarray(np.nonzero(mask)[0])].set(in_true)
@@ -491,32 +514,47 @@ def beam_search_decode(ctx):
         steps.append((ids_t, sc_t, lod_t))
 
     # reconstruct parent chains: at each step, lod level-1 maps selected
-    # rows to parent rows of the previous step
+    # rows to parent rows of the previous step.  Per the reference output
+    # contract (beam_search_decode_op.h), SentenceScores carries the
+    # per-step score along each backtracked chain (not the final score
+    # repeated), and each source's hypotheses are sorted best-first.
     n_final = len(steps[-1][0]) if steps else 0
-    hyps, hyp_scores = [], []
-    for j in range(n_final):
-        chain = []
-        row = j
-        for t in range(len(steps) - 1, -1, -1):
-            ids_t, sc_t, lod_t = steps[t]
-            chain.append(int(ids_t[row]))
-            if lod_t and len(lod_t) > 1:
-                par_off = lod_t[1]
-                row = int(np.searchsorted(np.asarray(par_off), row,
-                                          side="right") - 1)
-        chain.reverse()
-        if end_id >= 0 and end_id in chain:
-            chain = chain[: chain.index(end_id) + 1]
-        hyps.append(chain)
-        hyp_scores.append(float(steps[-1][1][j]))
+    final_lod = steps[-1][2] if steps else None
+    if final_lod and len(final_lod) >= 1 and len(final_lod[0]) > 1:
+        src_off = [int(o) for o in final_lod[0]]
+    else:
+        src_off = [0, n_final]
 
-    flat = [t for h in hyps for t in h]
-    lens = [len(h) for h in hyps]
-    off = tuple(np.concatenate([[0], np.cumsum(lens)]).tolist())
-    lod = ((0, len(hyps)), off)
-    out_ids = jnp.asarray(np.asarray(flat, np.int64).reshape(-1, 1))
-    out_sc = jnp.asarray(
-        np.asarray([s for h, s in zip(hyps, hyp_scores)
-                    for _ in h], np.float32).reshape(-1, 1))
+    groups = []  # per source: list of (final_score, chain_ids, chain_scores)
+    for s in range(len(src_off) - 1):
+        group = []
+        for j in range(src_off[s], src_off[s + 1]):
+            chain, chain_sc = [], []
+            row = j
+            for t in range(len(steps) - 1, -1, -1):
+                ids_t, sc_t, lod_t = steps[t]
+                chain.append(int(ids_t[row]))
+                chain_sc.append(float(sc_t[row]))
+                if lod_t and len(lod_t) > 1:
+                    par_off = lod_t[1]
+                    row = int(np.searchsorted(np.asarray(par_off), row,
+                                              side="right") - 1)
+            chain.reverse()
+            chain_sc.reverse()
+            if end_id >= 0 and end_id in chain:
+                k = chain.index(end_id) + 1
+                chain, chain_sc = chain[:k], chain_sc[:k]
+            group.append((float(steps[-1][1][j]), chain, chain_sc))
+        group.sort(key=lambda t: -t[0])
+        groups.append(group)
+
+    flat_ids = [t for g in groups for _, h, _ in g for t in h]
+    flat_sc = [s for g in groups for _, _, hs in g for s in hs]
+    lens = [len(h) for g in groups for _, h, _ in g]
+    off = tuple(np.concatenate([[0], np.cumsum(lens)]).astype(int).tolist())
+    src_counts = np.concatenate([[0], np.cumsum([len(g) for g in groups])])
+    lod = (tuple(int(o) for o in src_counts), off)
+    out_ids = jnp.asarray(np.asarray(flat_ids, np.int64).reshape(-1, 1))
+    out_sc = jnp.asarray(np.asarray(flat_sc, np.float32).reshape(-1, 1))
     return {"SentenceIds": out_ids, "SentenceScores": out_sc,
             "SentenceIds@LOD": [lod], "SentenceScores@LOD": [lod]}

@@ -81,6 +81,10 @@ def sequence_pool(ctx):
             maxidx = (maxidx - jnp.asarray(
                 np.concatenate([[0], np.cumsum(lens)[:-1]])).reshape(
                     (-1,) + (1,) * (x.ndim - 1))).astype(jnp.int32)
+            # empty sequences: segment_min returned the `big` sentinel;
+            # mask those rows to 0 the same way Out is masked
+            maxidx = jnp.where(jnp.asarray(lens).reshape(
+                (-1,) + (1,) * (x.ndim - 1)) > 0, maxidx, 0)
     elif pooltype == "LAST":
         idx = np.where(lens > 0, np.asarray(off[1:]) - 1, 0)
         out = x[jnp.asarray(idx)]

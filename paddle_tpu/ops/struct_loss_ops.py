@@ -299,7 +299,20 @@ def nce(ctx):
     num_true = label.shape[1] if label.ndim > 1 else 1
     label = label.reshape(b, num_true)
 
-    samples = jax.random.randint(ctx.rng(), (b, k), 0, num_classes)
+    # Determinism tiers (ref nce_op.h PrepareSamples): custom_neg_classes
+    # pins the negatives outright (the reference's unit-test hook); a
+    # nonzero seed attr gives one fixed PRNGKey-derived sample matrix
+    # (reproducible across runs/sessions); else fresh draws from the
+    # session-threaded rng each step.
+    custom = ctx.attr("custom_neg_classes") or []
+    seed = int(ctx.attr("seed", 0))
+    if custom:
+        samples = jnp.broadcast_to(
+            jnp.asarray(np.asarray(custom, np.int64)[None, :]), (b, len(custom)))
+        k = len(custom)
+    else:
+        key = jax.random.PRNGKey(seed) if seed != 0 else ctx.rng()
+        samples = jax.random.randint(key, (b, k), 0, num_classes)
     cost, true_lg, noise_lg = _nce_cost(x, weight, bias, label, samples,
                                         k, num_classes)
     return {"Cost": cost.reshape(-1, 1),
@@ -318,11 +331,11 @@ def nce_grad(ctx):
     sample_labels = ctx.input("SampleLabels")
     gcost = ctx.input("Cost@GRAD")
     num_classes = int(ctx.attr("num_total_classes"))
-    k = int(ctx.attr("num_neg_samples", 10))
     b = x.shape[0]
     num_true = label.shape[1] if label.ndim > 1 else 1
     label = label.reshape(b, num_true)
     samples = sample_labels[:, num_true:]
+    k = samples.shape[1]  # actual draw count (custom_neg_classes may differ)
 
     cot = gcost.reshape(-1).astype(x.dtype)
     if bias is not None:
@@ -505,9 +518,12 @@ def chunk_eval(ctx):
         "Precision": jnp.asarray([p], jnp.float32),
         "Recall": jnp.asarray([r], jnp.float32),
         "F1-Score": jnp.asarray([f1], jnp.float32),
-        "NumInferChunks": jnp.asarray([n_inf], jnp.int64),
-        "NumLabelChunks": jnp.asarray([n_lab], jnp.int64),
-        "NumCorrectChunks": jnp.asarray([n_correct], jnp.int64),
+        # int64 parity with the reference (chunk_eval_op.h outputs int64);
+        # host numpy arrays sidestep jax's disabled-x64 truncation — this is
+        # an eager metric op, nothing downstream re-enters jit with these.
+        "NumInferChunks": np.asarray([n_inf], np.int64),
+        "NumLabelChunks": np.asarray([n_lab], np.int64),
+        "NumCorrectChunks": np.asarray([n_correct], np.int64),
     }
 
 
