@@ -363,15 +363,17 @@ def split_lod_tensor(ctx):
     mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
     lod = ctx.in_lod("X")
     # Row-wise split equals the reference's sequence-level split whenever
-    # every sequence is a single row; only true multi-row sequences need
-    # the unimplemented sequence-level path (split_lod_tensor_op.cc).
-    if lod:
-        fin = np.asarray(lod[-1])
-        if np.any(np.diff(fin) != 1) or int(ctx.attr("level", 0)) != 0:
-            raise NotImplementedError(
-                "split_lod_tensor: sequence-level split of multi-row LoD "
-                "sequences is not supported; only row-wise split where each "
-                "sequence is one row. Ref: split_lod_tensor_op.cc.")
+    # every sequence is a single row; only true multi-row sequences (or a
+    # nonzero level attr) need the unimplemented sequence-level path
+    # (split_lod_tensor_op.cc).
+    if int(ctx.attr("level", 0)) != 0:
+        raise NotImplementedError(
+            "split_lod_tensor: only level=0 splits are supported.")
+    if lod and np.any(np.diff(np.asarray(lod[-1])) != 1):
+        raise NotImplementedError(
+            "split_lod_tensor: sequence-level split of multi-row LoD "
+            "sequences is not supported; only row-wise split where each "
+            "sequence is one row. Ref: split_lod_tensor_op.cc.")
     if mask.shape[0] != np.asarray(x).shape[0]:
         raise ValueError(
             f"split_lod_tensor: mask length {mask.shape[0]} != input rows "
