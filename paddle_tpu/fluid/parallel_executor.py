@@ -25,8 +25,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
-from .executor import BlockPlan, _MISSING, global_scope, trace_block
-from .framework import RNG_STATE_VAR, Variable, default_main_program
+from .executor import _MISSING, global_scope
+from .framework import Variable, default_main_program
+from ..parallel.spmd import ShardedTrainStep
 
 
 class ExecutionStrategy:
@@ -65,22 +66,41 @@ class BuildStrategy:
 
 
 class ParallelExecutor:
-    """ref: python/paddle/fluid/parallel_executor.py:32."""
+    """ref: python/paddle/fluid/parallel_executor.py:32.
+
+    Single-process: a "dp" mesh over the local devices.  Multi-process: if
+    the program carries DistributeTranspiler dist info (or num_trainers>1),
+    the coordination service is joined (parallel.multihost) and the mesh
+    spans ALL processes' devices — each process feeds its local batch shard
+    and GSPMD runs one global program, which is the redesigned pserver path.
+
+    BuildStrategy.ReduceStrategy.Reduce enables ZeRO-1 optimizer-state
+    sharding (see parallel.spmd.infer_param_specs)."""
 
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
                  num_trainers=1, trainer_id=0, scope=None, use_tpu=None,
                  devices=None, **kwargs):
+        from ..parallel import multihost as _mh
+
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
         self._scope = scope or global_scope()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._build_strategy = build_strategy or BuildStrategy()
+
+        dist_info = getattr(self._program, "_dist_info", None) or {}
+        if num_trainers > 1 and not dist_info:
+            dist_info = {"trainers": num_trainers, "trainer_id": trainer_id}
+        _mh.ensure_init(dist_info)
+        self._multihost = _mh.process_count() > 1
+
         if devices is not None:
             self._devices = list(devices)
+            self._mesh = Mesh(np.array(self._devices), ("dp",))
         else:
-            self._devices = list(jax.devices())
-        self._mesh = Mesh(np.array(self._devices), ("dp",))
+            self._mesh = _mh.global_mesh(("dp",))  # global when multihost
+            self._devices = list(self._mesh.devices.reshape(-1))
         self._cache = {}
 
     @property
@@ -99,13 +119,14 @@ class ParallelExecutor:
         feed = feed or {}
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
-
+        # normalize dtypes BEFORE the cache key so float64-from-list feeds
+        # don't compile a duplicate executable
+        gb_ = self._program.global_block()
         feed_arrays = {}
-        gb = self._program.global_block()
         for k, v in feed.items():
             arr = np.asarray(v)
-            if gb._has_var_recursive(k):
-                want = core.np_dtype(gb._var_recursive(k).dtype)
+            if gb_._has_var_recursive(k):
+                want = core.np_dtype(gb_._var_recursive(k).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
             feed_arrays[k] = arr
@@ -113,51 +134,32 @@ class ParallelExecutor:
         key = (id(self._program), self._program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())))
-        entry = self._cache.get(key)
-        if entry is None:
-            plan = BlockPlan(self._program, 0, list(feed_arrays), fetch_names)
-            fn = self._build(plan)
-            entry = (plan, fn)
-            self._cache[key] = entry
-        plan, fn = entry
+        step = self._cache.get(key)
+        if step is None:
+            zero1 = (self._build_strategy.reduce_strategy ==
+                     BuildStrategy.ReduceStrategy.Reduce)
+            step = ShardedTrainStep(
+                self._program, list(feed_arrays), fetch_names, self._mesh,
+                zero1=zero1, multihost=self._multihost)
+            self._cache[key] = step
 
-        batch_spec = NamedSharding(self._mesh, P("dp"))
-        repl = NamedSharding(self._mesh, P())
-        feed_dev = {k: jax.device_put(v, batch_spec)
-                    for k, v in feed_arrays.items()}
-        state_vals = {}
-        for name in plan.state_in:
-            val = self._scope.get(name, _MISSING)
-            if val is _MISSING:
+        gb = self._program.global_block()
+        for name in step.plan.state_in:
+            if self._scope.get(name, _MISSING) is _MISSING:
                 if gb._has_var_recursive(name) and \
                         gb._var_recursive(name).is_data:
                     raise RuntimeError(f"Data variable '{name}' was not fed")
                 raise RuntimeError(f"Variable '{name}' is not initialized; "
                                    f"run the startup program first")
-            state_vals[name] = jax.device_put(val, repl)
-        if plan.needs_rng:
-            rk = self._scope.get(RNG_STATE_VAR, _MISSING)
-            if rk is _MISSING:
-                rk = jax.random.PRNGKey(self._program.random_seed or 0)
-            state_vals[RNG_STATE_VAR] = jax.device_put(rk, repl)
+        feed_dev = step.place_feed(feed_arrays)
+        state_vals = step.place_state(self._scope)
 
-        fetches, new_state = fn(feed_dev, state_vals)
+        fetches, new_state = step(feed_dev, state_vals)
         for name, val in new_state.items():
             self._scope.set(name, val)
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            return [step.fetch_to_host(v) for v in fetches]
         return list(fetches)
-
-    def _build(self, plan):
-        program = self._program
-        repl = NamedSharding(self._mesh, P())
-
-        def fn(feed_vals, state_vals):
-            return trace_block(program, 0, plan, feed_vals, state_vals)
-
-        # state (params/accumulators) stays replicated; feeds arrive sharded
-        # on the batch dim; XLA SPMD inserts gradient all-reduces.
-        return jax.jit(fn, out_shardings=(None, repl))
 
     def bcast_params(self):
         """ref: parallel_executor.cc:234 BCastParamsToDevices — replication is

@@ -39,6 +39,11 @@ class DistributeTranspiler:
 
     def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
                   trainers=1, sync_mode=True, startup_program=None):
+        """Record the trainer topology on the program.  ParallelExecutor
+        reads this annotation and joins the coordination service
+        (parallel.multihost.init) with the first pserver endpoint as the
+        coordinator address — the TPU mapping of the reference's
+        gen_nccl_id-over-gRPC bootstrap (gen_nccl_id_op.cc:31)."""
         if not sync_mode:
             raise NotImplementedError(
                 "async parameter-server mode has no SPMD equivalent on TPU; "
@@ -49,12 +54,20 @@ class DistributeTranspiler:
         self.origin_program = program or default_main_program()
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
         self._transpiled = True
-        # annotate for the executors / multihost runner
         self.origin_program._dist_info = {
             "trainer_id": trainer_id,
             "trainers": trainers,
+            "coordinator": (self.pserver_endpoints[0]
+                            if self.pserver_endpoints else None),
             "mode": "spmd_ici",
         }
+        # Join the pod NOW: jax.distributed.initialize must run before any
+        # JAX computation touches the backend, and in the reference flow
+        # transpile() is exactly the pre-startup moment (the gen_nccl_id
+        # handshake).  ParallelExecutor re-checks idempotently.
+        from ...parallel import multihost as _mh
+
+        _mh.ensure_init(self.origin_program._dist_info)
 
     def get_trainer_program(self) -> Program:
         if not self._transpiled:

@@ -1,0 +1,125 @@
+"""Multi-host (multi-process) runtime over DCN.
+
+This is the TPU-native replacement for the reference's distributed transport
+(ref: operators/distributed/ gRPC client/server, send/recv/listen_and_serv
+ops, gen_nccl_id): instead of a parameter-server var transport, processes
+join one JAX coordination service (`jax.distributed.initialize`) and execute
+ONE GSPMD program over the global device mesh; gradient/parameter movement
+becomes XLA collectives over ICI/DCN.
+
+Role mapping:
+  - pserver endpoint list  -> coordination-service address (first endpoint)
+  - trainer_id / trainers  -> process_id / num_processes
+  - gen_nccl_id handshake  -> jax.distributed.initialize barrier
+  - send/recv param blocks -> GSPMD all-reduce / all-gather over the mesh
+
+Env contract mirrors the reference cluster env (fluid_benchmark.py:34-82):
+PADDLE_TRAINER_ID, PADDLE_TRAINERS, PADDLE_COORDINATOR_ADDR (falls back to
+the first entry of PADDLE_PSERVER_EPS).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init(coordinator_addr: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         local_device_ids: Optional[Sequence[int]] = None) -> tuple:
+    """Join the pod-wide coordination service.  Arguments fall back to the
+    PADDLE_* cluster env vars.  Idempotent; no-op for a 1-process world.
+
+    Returns (process_id, num_processes)."""
+    global _initialized
+    if coordinator_addr is None:
+        coordinator_addr = os.environ.get("PADDLE_COORDINATOR_ADDR")
+        if not coordinator_addr:
+            eps = os.environ.get("PADDLE_PSERVER_EPS", "")
+            coordinator_addr = eps.split(",")[0].strip() or None
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if num_processes <= 1:
+        return process_id, num_processes
+    if _initialized:
+        return jax.process_index(), jax.process_count()
+    if coordinator_addr is None:
+        raise ValueError(
+            "multihost.init: trainers > 1 but no coordinator address; set "
+            "PADDLE_COORDINATOR_ADDR (or PADDLE_PSERVER_EPS) or pass "
+            "coordinator_addr")
+    try:
+        jax.distributed.initialize(coordinator_addr, num_processes,
+                                   process_id, local_device_ids)
+    except RuntimeError as exc:
+        raise RuntimeError(
+            "jax.distributed.initialize failed — it must run BEFORE any JAX "
+            "computation initializes the backend.  Call "
+            "DistributeTranspiler.transpile() (or multihost.init()) before "
+            "running the startup program or any other device work."
+        ) from exc
+    _initialized = True
+    return jax.process_index(), jax.process_count()
+
+
+def ensure_init(dist_info: dict) -> None:
+    """Initialize from a DistributeTranspiler annotation (program._dist_info)."""
+    if dist_info and int(dist_info.get("trainers", 1)) > 1:
+        init(dist_info.get("coordinator"), int(dist_info["trainers"]),
+             int(dist_info.get("trainer_id", 0)))
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index() if _initialized else 0
+
+
+def process_count() -> int:
+    return jax.process_count() if _initialized else 1
+
+
+def global_mesh(axis_names: Sequence[str] = ("dp",),
+                mesh_shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Mesh over ALL processes' devices (ICI within a host, DCN across).
+
+    With no mesh_shape, all devices land on the first axis — pure DP.
+    A multi-axis shape lays the LAST axis over the fastest-varying device
+    index so tp/sp collectives ride ICI, dp rides DCN."""
+    devices = np.array(jax.devices())
+    if mesh_shape is None:
+        mesh_shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    return Mesh(devices.reshape(tuple(mesh_shape)), tuple(axis_names))
+
+
+def host_local_to_global(arr, mesh: Mesh, spec: P):
+    """Per-process host value -> global jax.Array (batch-sharded feeds use
+    P('dp'): global batch = num_processes x local batch; P() replicates)."""
+    from jax.experimental import multihost_utils as mhu
+
+    return mhu.host_local_array_to_global_array(np.asarray(arr), mesh, spec)
+
+
+def fetch_to_host(val) -> np.ndarray:
+    """Materialize a (replicated) global array on this host."""
+    if hasattr(val, "is_fully_addressable") and not val.is_fully_addressable:
+        return np.asarray(val.addressable_data(0))
+    return np.asarray(val)

@@ -34,20 +34,31 @@ def batch_spec(mesh: Mesh) -> P:
 
 
 def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
-                      tp_axis: str = "mp", zero1: bool = False) -> Dict[str, P]:
+                      tp_axis: str = "mp", zero1: bool = False,
+                      dp_axis: str = "dp") -> Dict[str, P]:
     """Choose a PartitionSpec per state var.
 
     2-D params with a dim divisible by the tp axis size get sharded on that
     dim (prefer the output/last dim); accumulators follow their param (same
     shape) — matching how Megatron-style TP shards fc/embedding weights.
+
+    zero1=True additionally shards optimizer accumulators over the dp axis
+    (ReduceStrategy.Reduce ≡ ZeRO-1, ref multi_devices_graph_pass.cc:434-446
+    kReduce): params stay replicated, their m/v/momentum state is partitioned
+    on dp, and GSPMD all-gathers the updated params after the (now sharded)
+    optimizer math — the reduce-to-owner + broadcast-param dataflow of the
+    reference expressed as shardings.
     """
-    if tp_axis not in mesh.axis_names:
+    has_tp = tp_axis in mesh.axis_names
+    has_dp = zero1 and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
+    if not has_tp and not has_dp:
         return {n: P() for n in set(plan.state_in) | set(plan.state_out)}
-    tp_size = mesh.shape[tp_axis]
+    tp_size = mesh.shape[tp_axis] if has_tp else 1
+    dp_size = mesh.shape[dp_axis] if has_dp else 1
     gb = program.global_block()
 
     def spec_for_shape(shape) -> P:
-        if shape is None or len(shape) < 2:
+        if not has_tp or shape is None or len(shape) < 2:
             return P()
         # shard last dim if divisible, else second-to-last, else replicate
         if shape[-1] is not None and shape[-1] % tp_size == 0 and shape[-1] >= tp_size:
@@ -55,6 +66,19 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
         if shape[0] is not None and shape[0] % tp_size == 0 and shape[0] >= tp_size:
             return P(*([tp_axis] + [None] * (len(shape) - 1)))
         return P()
+
+    def zero1_spec(shape, base: P) -> P:
+        """Shard an accumulator's first dp-divisible, not-already-sharded
+        dim on dp (ZeRO-1)."""
+        if not has_dp or shape is None:
+            return base
+        used = list(base) + [None] * (len(shape) - len(base))
+        for d, n in enumerate(shape):
+            if used[d] is None and n is not None and n % dp_size == 0 \
+                    and n >= dp_size:
+                used[d] = dp_axis
+                return P(*used)
+        return base
 
     specs: Dict[str, P] = {}
     param_shapes = {}
@@ -69,9 +93,14 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
                 specs[name] = spec_for_shape(v.shape)
                 param_shapes[name] = tuple(v.shape)
                 continue
+            if isinstance(v, Parameter):
+                specs[name] = P()
+                param_shapes[name] = tuple(v.shape) if v.shape else None
+                continue
         specs[name] = None  # decide below (maybe accumulator)
     # accumulators are named "<acc>_<param.name>_<k>" and share the param's
-    # shape; give them the param's spec so optimizer math stays local
+    # shape; give them the param's spec (plus dp under ZeRO-1) so optimizer
+    # math stays local
     for name, spec in list(specs.items()):
         if spec is not None:
             continue
@@ -79,8 +108,8 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
         shape = tuple(v.shape) if v is not None and v.shape else None
         matched = P()
         for pname, pshape in param_shapes.items():
-            if pname in name and shape == pshape:
-                matched = specs[pname]
+            if pname in name and shape == pshape and shape is not None:
+                matched = zero1_spec(shape, specs[pname])
                 break
         specs[name] = matched
     return specs
@@ -95,11 +124,14 @@ class ShardedTrainStep:
 
     def __init__(self, program: Program, feed_names: List[str],
                  fetch_names: List[str], mesh: Mesh, tp_axis: str = "mp",
-                 donate: bool = False):
+                 donate: bool = False, zero1: bool = False,
+                 multihost: bool = False):
         self.program = program
         self.mesh = mesh
+        self.multihost = multihost
         self.plan = BlockPlan(program, 0, feed_names, fetch_names)
-        self.specs = infer_param_specs(program, self.plan, mesh, tp_axis)
+        self.specs = infer_param_specs(program, self.plan, mesh, tp_axis,
+                                       zero1=zero1)
         self.bspec = batch_spec(mesh)
 
         plan = self.plan
@@ -107,13 +139,14 @@ class ShardedTrainStep:
         def fn(feed_vals, state_vals):
             return trace_block(program, 0, plan, feed_vals, state_vals)
 
-        # input shardings are carried by the device_put arrays (place_feed /
-        # place_state); pin only the output state so updated params keep
-        # their layout across steps.
+        # input shardings are carried by the placed arrays (place_feed /
+        # place_state); pin the output state so updated params keep their
+        # layout across steps, and pin fetches replicated so every host can
+        # materialize them (Fluid fetch semantics: full value on host).
         out_state_names = list(plan.state_out) + \
             ([RNG_STATE_VAR] if plan.needs_rng else [])
         out_shardings = (
-            None,
+            NamedSharding(mesh, P()),
             {k: NamedSharding(mesh, self.specs.get(k, P()))
              for k in out_state_names},
         )
@@ -122,8 +155,27 @@ class ShardedTrainStep:
             out_shardings=out_shardings,
             donate_argnums=(1,) if donate else ())
 
+    def _place(self, val, sh: NamedSharding):
+        if isinstance(val, jax.Array) and getattr(val, "sharding", None) == sh:
+            return val
+        if self.multihost:
+            if isinstance(val, jax.Array) and not val.is_fully_addressable:
+                return val  # already a global array from a previous step
+            from . import multihost as mh
+
+            arr = np.asarray(val)
+            if sh.spec == P():
+                # Replicated state must be bit-identical across hosts;
+                # broadcast process 0's value rather than trusting per-host
+                # init (ref: parallel_executor.cc:234 BCastParamsToDevices).
+                from jax.experimental import multihost_utils as mhu
+
+                arr = np.asarray(mhu.broadcast_one_to_all(arr))
+            return mh.host_local_to_global(arr, self.mesh, sh.spec)
+        return jax.device_put(jnp.asarray(val), sh)
+
     def place_state(self, scope=None):
-        """Device-put scope state with the chosen shardings."""
+        """Place scope state onto the mesh with the chosen shardings."""
         scope = scope or global_scope()
         state = {}
         for name in self.plan.state_in:
@@ -131,16 +183,18 @@ class ShardedTrainStep:
             if val is _MISSING:
                 raise RuntimeError(f"state var {name} missing from scope")
             sh = NamedSharding(self.mesh, self.specs.get(name, P()))
-            state[name] = jax.device_put(jnp.asarray(val), sh)
+            state[name] = self._place(val, sh)
         if self.plan.needs_rng:
             rk = scope.get(RNG_STATE_VAR, _MISSING)
             if rk is _MISSING:
                 rk = jax.random.PRNGKey(self.program.random_seed or 0)
-            state[RNG_STATE_VAR] = jax.device_put(
-                rk, NamedSharding(self.mesh, P()))
+            state[RNG_STATE_VAR] = self._place(rk,
+                                               NamedSharding(self.mesh, P()))
         return state
 
     def place_feed(self, feed: Dict[str, np.ndarray]):
+        """Shard feeds on the batch axis.  Multihost: each process passes its
+        LOCAL batch; the global batch is num_processes x local."""
         sh = NamedSharding(self.mesh, self.bspec)
         out = {}
         gb = self.program.global_block()
@@ -150,8 +204,13 @@ class ShardedTrainStep:
                 want = core.np_dtype(gb._var_recursive(k).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            out[k] = jax.device_put(arr, sh)
+            out[k] = self._place(arr, sh)
         return out
+
+    def fetch_to_host(self, val) -> np.ndarray:
+        from . import multihost as mh
+
+        return mh.fetch_to_host(val)
 
     def __call__(self, feed, state):
         return self._fn(feed, state)

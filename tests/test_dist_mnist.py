@@ -1,0 +1,122 @@
+"""Distributed (multi-process) training test, the reference's way:
+localhost subprocesses, compare distributed vs single-process losses
+(ref: test_dist_base.py:155,344 — pserver/trainer Popen dance becomes
+two SPMD trainer processes joined via jax.distributed)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys, json
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    trainer_id = int(sys.argv[1])
+    port = sys.argv[2]
+    sys.path.insert(0, %r)
+
+    from paddle_tpu.parallel import multihost
+    # join the pod BEFORE touching any device (the reference's gen_nccl_id
+    # moment); 2 processes x 2 local cpu devices = 4-device global mesh
+    multihost.init("127.0.0.1:" + port, 2, trainer_id)
+
+    import paddle_tpu.fluid as fluid
+    fluid.default_main_program().random_seed = 11
+    fluid.default_startup_program().random_seed = 11
+    img = fluid.layers.data(name="img", shape=[32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=16, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id, pservers="127.0.0.1:" + port, trainers=2)
+    prog = t.get_trainer_program()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=prog)
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    y = rng.randint(0, 10, size=(16, 1)).astype(np.int64)
+    # each trainer feeds ITS half of the global batch
+    lo, hi = trainer_id * 8, (trainer_id + 1) * 8
+    losses = []
+    for _ in range(5):
+        (l,) = pe.run([loss], feed={"img": x[lo:hi], "label": y[lo:hi]})
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    print("DIST_LOSSES " + json.dumps(losses), flush=True)
+""" % REPO)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_mnist_two_processes():
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(i), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    dist_losses = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("DIST_LOSSES")]
+        assert line, f"worker produced no losses:\n{out[-2000:]}"
+        dist_losses.append(json.loads(line[0].split(" ", 1)[1]))
+    # both workers observe the same (global) loss
+    np.testing.assert_allclose(dist_losses[0], dist_losses[1], rtol=1e-5)
+
+    # single-process reference: same seed, full batch
+    import paddle_tpu.fluid as fluid
+    fluid.default_main_program().random_seed = 11
+    fluid.default_startup_program().random_seed = 11
+    img = fluid.layers.data(name="img", shape=[32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=16, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    y = rng.randint(0, 10, size=(16, 1)).astype(np.int64)
+    single = []
+    for _ in range(5):
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": x, "label": y}, fetch_list=[loss])
+        single.append(float(np.asarray(l).reshape(-1)[0]))
+
+    np.testing.assert_allclose(single, dist_losses[0], rtol=1e-4, atol=1e-4)

@@ -1,0 +1,126 @@
+"""ParallelExecutor correctness oracles (ref: the de-facto DP oracle of
+test_parallel_executor_mnist.py — same model trained by plain Executor vs
+ParallelExecutor must produce matching loss curves; SURVEY.md §4.4), plus
+the ReduceStrategy.Reduce (ZeRO-1) vs AllReduce equivalence check
+(ref: multi_devices_graph_pass.cc:434-446)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+
+
+def _build_mlp(seed=42):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    img = fluid.layers.data(name="img", shape=[64], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=32, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _data(steps=5, batch=16):
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(batch, 64)).astype(np.float32)
+    y = rng.randint(0, 10, size=(batch, 1)).astype(np.int64)
+    return [(x, y)] * steps  # fixed batch: loss must fall monotonically-ish
+
+
+def _snapshot(scope):
+    return {k: np.asarray(scope.get(k)) for k in scope.keys()}
+
+
+def _restore(scope, snap):
+    for k, v in snap.items():
+        scope.set(k, v)
+
+
+def _run_executor(loss, data):
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = []
+    for x, y in data:
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": x, "label": y}, fetch_list=[loss])
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out
+
+
+def _run_pe(loss, data, reduce_strategy=None):
+    bs = fluid.parallel_executor.BuildStrategy()
+    if reduce_strategy is not None:
+        bs.reduce_strategy = reduce_strategy
+    pe = fluid.ParallelExecutor(loss_name=loss.name, build_strategy=bs)
+    assert pe.device_count == 8  # conftest forces the 8-device CPU mesh
+    out = []
+    for x, y in data:
+        (l,) = pe.run([loss], feed={"img": x, "label": y})
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out
+
+
+def test_pe_matches_executor_and_zero1():
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = _snapshot(scope)
+    data = _data()
+
+    base = _run_executor(loss, data)
+    assert base[-1] < base[0]  # it actually trains
+
+    _restore(scope, init)
+    allreduce = _run_pe(
+        loss, data,
+        fluid.parallel_executor.BuildStrategy.ReduceStrategy.AllReduce)
+    np.testing.assert_allclose(base, allreduce, rtol=2e-4, atol=2e-4)
+
+    _restore(scope, init)
+    zero1 = _run_pe(
+        loss, data,
+        fluid.parallel_executor.BuildStrategy.ReduceStrategy.Reduce)
+    np.testing.assert_allclose(base, zero1, rtol=2e-4, atol=2e-4)
+
+
+def test_pe_conv_model_matches_executor():
+    """Conv/pool/batch-norm path through the DP mesh (mini ResNet-ish)."""
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    img = fluid.layers.data(name="img", shape=[3, 16, 16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                            padding=1, act=None, bias_attr=False)
+    c = fluid.layers.batch_norm(input=c, act="relu")
+    p = fluid.layers.pool2d(input=c, pool_size=2, pool_stride=2,
+                            pool_type="max")
+    pred = fluid.layers.fc(input=p, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = _snapshot(scope)
+    rng = np.random.RandomState(1)
+    data = [(rng.normal(size=(16, 3, 16, 16)).astype(np.float32),
+             rng.randint(0, 10, size=(16, 1)).astype(np.int64))
+            for _ in range(3)]
+
+    base = []
+    for x, y in data:
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": x, "label": y}, fetch_list=[loss])
+        base.append(float(np.asarray(l).reshape(-1)[0]))
+
+    _restore(scope, init)
+    pe = fluid.ParallelExecutor(loss_name=loss.name)
+    par = []
+    for x, y in data:
+        (l,) = pe.run([loss], feed={"img": x, "label": y})
+        par.append(float(np.asarray(l).reshape(-1)[0]))
+    np.testing.assert_allclose(base, par, rtol=5e-4, atol=5e-4)

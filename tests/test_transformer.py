@@ -57,8 +57,9 @@ def test_transformer_padding_masks_loss():
     logp = lg - lg.max(-1, keepdims=True)
     logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
     lbl = feed["lbl_word"][..., 0]
-    soft = np.full(lg.shape, eps / (V - 1))
-    np.put_along_axis(soft, lbl[..., None], 1.0 - eps, axis=-1)
+    # layers.label_smooth: (1-eps)*hot + eps/V
+    soft = np.full(lg.shape, eps / V)
+    np.put_along_axis(soft, lbl[..., None], 1.0 - eps + eps / V, axis=-1)
     per_tok = -(soft * logp).sum(-1)
     expected = per_tok[lbl != 0].sum() / (lbl != 0).sum()
     assert np.isclose(float(np.asarray(l_half).reshape(-1)[0]), expected,
