@@ -10,6 +10,7 @@ from .. import ops as _ops  # noqa: F401
 
 from . import core
 from .core import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace
+from . import amp
 from . import framework
 from .framework import (Program, Operator, Parameter, Variable,
                         default_main_program, default_startup_program,
@@ -41,11 +42,14 @@ from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimi
 from . import lod_tensor
 from .lod_tensor import (LoDTensor, create_lod_tensor,
                          create_random_int_lodtensor)
+from . import trainer
+from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
 
 Tensor = framework.Variable
 
 __all__ = [
-    "io", "initializer", "layers", "nets", "optimizer", "backward",
+    "io", "initializer", "layers", "nets", "optimizer", "backward", "amp",
     "regularizer", "metrics", "clip", "profiler", "unique_name",
     "Program", "Operator", "Parameter", "Variable",
     "default_main_program", "default_startup_program", "program_guard",
@@ -55,4 +59,6 @@ __all__ = [
     "ExecutionStrategy", "BuildStrategy", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
     "LoDTensor", "create_lod_tensor", "create_random_int_lodtensor",
+    "Trainer", "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
+    "BeginStepEvent", "EndStepEvent",
 ]

@@ -42,12 +42,25 @@ def _normalize_lod(lod) -> Tuple[Tuple[int, ...], ...]:
     return tuple(tuple(int(x) for x in level) for level in lod)
 
 
+def _is_device_array(a) -> bool:
+    import jax
+
+    return isinstance(a, jax.Array)
+
+
 class LoDTensor:
     """Packed data + offset-form LoD.  Mirrors the pybind LoDTensor surface
     (ref: pybind/pybind.cc:160 — set/lod/set_lod/recursive_sequence_lengths)."""
 
     def __init__(self, data=None, lod=None):
-        self._data = None if data is None else np.asarray(data)
+        # device (jax) arrays are kept as-is and materialize lazily on
+        # first numpy access — Executor.run(return_numpy=False) relies on
+        # this to avoid a blocking D2H round-trip per step (the transport
+        # behind a tunneled TPU charges ~100ms per forced fetch)
+        if data is None or _is_device_array(data):
+            self._data = data
+        else:
+            self._data = np.asarray(data)
         self._lod = _normalize_lod(lod)
 
     # numpy interop
@@ -55,6 +68,8 @@ class LoDTensor:
         a = self._data
         if a is None:
             raise ValueError("LoDTensor holds no data")
+        if _is_device_array(a):
+            a = self._data = np.asarray(a)
         return a.astype(dtype) if dtype is not None else a
 
     def set(self, array, place=None):

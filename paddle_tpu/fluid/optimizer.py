@@ -78,6 +78,14 @@ class Optimizer:
         self.helper.set_variable_initializer(
             var, ConstantInitializer(float(fill_value)))
         self._accumulators[name][param.name] = var
+        # explicit accumulator->param registry on the Program, consumed by
+        # parallel.spmd.infer_param_specs so sharding specs follow ownership
+        # instead of name heuristics (ref: the C++ side records this pairing
+        # via the optimize-op's OpRoleVar attr, op_proto_maker.h)
+        prog = var.block.program
+        if not hasattr(prog, "_accumulator_owner"):
+            prog._accumulator_owner = {}
+        prog._accumulator_owner[var.name] = param.name
         return var
 
     def _get_accumulator(self, name, param):

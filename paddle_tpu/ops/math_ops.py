@@ -31,7 +31,10 @@ def mul(ctx):
     ync = ctx.attr("y_num_col_dims", 1)
     x2 = _flatten2(x, xnc)
     y2 = _flatten2(y, ync)
-    out = x2 @ y2
+    from ..fluid import amp
+
+    x2, y2, back = amp.cast_operands(x2, y2)
+    out = amp.restore_astype(jnp.matmul(x2, y2), back)
     # restore leading dims of x and trailing dims of y
     out_shape = x.shape[:xnc] + y.shape[ync:]
     return {"Out": out.reshape(out_shape)}
@@ -50,7 +53,10 @@ def matmul(ctx):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    from ..fluid import amp
+
+    x, y, back = amp.cast_operands(x, y)
+    out = amp.restore_astype(jnp.matmul(x, y), back)
     if alpha != 1.0:
         out = out * alpha
     return {"Out": out}
@@ -100,7 +106,26 @@ def scale(ctx):
 
 @register_op("sum")
 def sum_op(ctx):
+    from ..fluid.selected_rows import SelectedRows
+
     xs = [v for v in ctx.inputs_list("X") if v is not None]
+    sparse = [v for v in xs if isinstance(v, SelectedRows)]
+    if sparse:
+        if len(sparse) == len(xs):
+            # all-sparse: concatenation IS the sum (ref: sum over
+            # SelectedRows, math/selected_rows_functor.h Add)
+            out = sparse[0]
+            for v in sparse[1:]:
+                out = out.merge_with(v)
+            return {"Out": out}
+        # mixed: densify the sparse parts into the dense accumulator
+        dense = [v for v in xs if not isinstance(v, SelectedRows)]
+        out = dense[0]
+        for v in dense[1:]:
+            out = out + v
+        for v in sparse:
+            out = out.at[v.rows].add(v.values.astype(out.dtype))
+        return {"Out": out}
     out = xs[0]
     for v in xs[1:]:
         out = out + v

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register_op
+from .registry import register_grad, register_op
 
 
 def _pair(v, n=2):
@@ -21,6 +21,8 @@ def _pair(v, n=2):
 
 
 def _conv(ctx, x, w):
+    from ..fluid import amp
+
     strides = _pair(ctx.attr("strides", [1, 1]))
     paddings = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
@@ -28,11 +30,13 @@ def _conv(ctx, x, w):
     nd = x.ndim - 2
     dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
     pad = [(p, p) for p in paddings]
-    return jax.lax.conv_general_dilated(
+    x, w, back = amp.cast_operands(x, w)
+    out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, dimension_numbers=dn,
         feature_group_count=groups,
         preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    return amp.restore_astype(out, back)
 
 
 @register_op("conv2d")
@@ -53,10 +57,13 @@ def depthwise_conv2d(ctx):
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or x.shape[1]
     pad = [(p, p) for p in paddings]
+    from ..fluid import amp
+
+    x, w, back = amp.cast_operands(x, w)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=groups)
-    return {"Output": out}
+    return {"Output": amp.restore_astype(out, back)}
 
 
 @register_op("conv2d_transpose")
@@ -66,10 +73,13 @@ def conv2d_transpose(ctx):
     paddings = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     pad = [(p, p) for p in paddings]
+    from ..fluid import amp
+
+    x, w, back = amp.cast_operands(x, w)
     out = jax.lax.conv_transpose(
         x, w, strides=strides, padding=pad, rhs_dilation=dilations,
         dimension_numbers=("NCHW", "IOHW", "NCHW"), transpose_kernel=True)
-    return {"Output": out}
+    return {"Output": amp.restore_astype(out, back)}
 
 
 def _pool2d_impl(x, ptype, ksize, strides, paddings, exclusive, global_pooling,
@@ -169,18 +179,47 @@ def lrn(ctx):
     return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
 
 
+def _lookup_ids(ctx):
+    ids = ctx.input("Ids").astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    return ids
+
+
 @register_op("lookup_table", no_grad_inputs=("Ids",))
 def lookup_table(ctx):
     w = ctx.input("W")
-    ids = ctx.input("Ids").astype(jnp.int32)
+    ids = _lookup_ids(ctx)
     padding_idx = ctx.attr("padding_idx", -1)
-    if ids.ndim >= 2 and ids.shape[-1] == 1:
-        ids = ids.reshape(ids.shape[:-1])
     out = jnp.take(w, ids, axis=0)
     if padding_idx is not None and padding_idx >= 0:
         mask = (ids != padding_idx)[..., None].astype(out.dtype)
         out = out * mask
     return {"Out": out}
+
+
+@register_grad("lookup_table")
+def lookup_table_grad(ctx):
+    """is_sparse=True emits a SelectedRows grad — (occurrence ids, per-
+    occurrence rows of dOut) with NO dense [V, D] materialization (ref:
+    lookup_table_op.cc LookupTableGradOpDescMaker switches the grad var to
+    SELECTED_ROWS on the same attr; sparse consumers scatter instead).
+    Dense mode scatter-adds into zeros like the reference's dense kernel."""
+    from ..fluid.selected_rows import SelectedRows
+
+    w = ctx.input("W")
+    ids = _lookup_ids(ctx)
+    dout = ctx.input("Out@GRAD")
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(dout.dtype)
+        dout = dout * mask
+    rows = ids.reshape(-1)
+    vals = dout.reshape(-1, dout.shape[-1])
+    if ctx.attr("is_sparse", False):
+        return {"W@GRAD": SelectedRows(rows, vals, height=w.shape[0])}
+    dw = jnp.zeros_like(w).at[rows].add(vals.astype(w.dtype))
+    return {"W@GRAD": dw}
 
 
 @register_op("maxout")

@@ -15,15 +15,39 @@ def _lr(ctx):
     return ctx.input("LearningRate").reshape(())
 
 
+def _grad(ctx, p):
+    """Dense view of the Grad input.  A SelectedRows grad (sparse embedding
+    backward) is folded by scatter-add; moment-carrying optimizers then run
+    exact dense semantics.  (Deviation from the reference's row-lazy sparse
+    adam/adagrad — ref adam_op.h SelectedRows branch skips moment decay on
+    untouched rows — is deliberate: dense decay is the mathematically
+    standard update and XLA fuses the scatter, so there is no kernel-launch
+    saving to chase on TPU.  The latency-critical sparse path is sgd, which
+    stays truly sparse below.)"""
+    from ..fluid.selected_rows import SelectedRows
+
+    g = ctx.input("Grad")
+    if isinstance(g, SelectedRows):
+        return g.to_dense(p.shape[0]).astype(p.dtype)
+    return g
+
+
 @register_op("sgd", no_grad_inputs=("Param", "Grad", "LearningRate"))
 def sgd(ctx):
+    from ..fluid.selected_rows import SelectedRows
+
     p, g = ctx.input("Param"), ctx.input("Grad")
+    if isinstance(g, SelectedRows):
+        # touch only the looked-up rows; duplicates fold in the scatter-add
+        # (ref: sgd_op.h SelectedRows branch)
+        return {"ParamOut": g.scatter_sub_into(p, _lr(ctx))}
     return {"ParamOut": p - _lr(ctx) * g}
 
 
 @register_op("momentum", no_grad_inputs=("Param", "Grad", "Velocity", "LearningRate"))
 def momentum(ctx):
-    p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
+    p, v = ctx.input("Param"), ctx.input("Velocity")
+    g = _grad(ctx, p)
     mu = ctx.attr("mu")
     lr = _lr(ctx)
     v_out = mu * v + g
@@ -37,7 +61,8 @@ def momentum(ctx):
 @register_op("adam", no_grad_inputs=("Param", "Grad", "LearningRate", "Moment1",
                                      "Moment2", "Beta1Pow", "Beta2Pow"))
 def adam(ctx):
-    p, g = ctx.input("Param"), ctx.input("Grad")
+    p = ctx.input("Param")
+    g = _grad(ctx, p)
     m1, m2 = ctx.input("Moment1"), ctx.input("Moment2")
     b1p, b2p = ctx.input("Beta1Pow").reshape(()), ctx.input("Beta2Pow").reshape(())
     b1 = ctx.attr("beta1", 0.9)
@@ -53,7 +78,8 @@ def adam(ctx):
 
 @register_op("adagrad", no_grad_inputs=("Param", "Grad", "Moment", "LearningRate"))
 def adagrad(ctx):
-    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    p, m = ctx.input("Param"), ctx.input("Moment")
+    g = _grad(ctx, p)
     eps = ctx.attr("epsilon", 1e-6)
     mo = m + g * g
     return {"ParamOut": p - _lr(ctx) * g / (jnp.sqrt(mo) + eps), "MomentOut": mo}
@@ -62,7 +88,8 @@ def adagrad(ctx):
 @register_op("adamax", no_grad_inputs=("Param", "Grad", "LearningRate", "Moment",
                                        "InfNorm", "Beta1Pow"))
 def adamax(ctx):
-    p, g = ctx.input("Param"), ctx.input("Grad")
+    p = ctx.input("Param")
+    g = _grad(ctx, p)
     m, inf = ctx.input("Moment"), ctx.input("InfNorm")
     b1p = ctx.input("Beta1Pow").reshape(())
     b1 = ctx.attr("beta1", 0.9)
@@ -78,7 +105,8 @@ def adamax(ctx):
 @register_op("adadelta", no_grad_inputs=("Param", "Grad", "AvgSquaredGrad",
                                          "AvgSquaredUpdate"))
 def adadelta(ctx):
-    p, g = ctx.input("Param"), ctx.input("Grad")
+    p = ctx.input("Param")
+    g = _grad(ctx, p)
     asg, asu = ctx.input("AvgSquaredGrad"), ctx.input("AvgSquaredUpdate")
     rho = ctx.attr("rho", 0.95)
     eps = ctx.attr("epsilon", 1e-6)
@@ -92,7 +120,8 @@ def adadelta(ctx):
 @register_op("rmsprop", no_grad_inputs=("Param", "Grad", "MeanSquare", "Moment",
                                         "LearningRate"))
 def rmsprop(ctx):
-    p, g = ctx.input("Param"), ctx.input("Grad")
+    p = ctx.input("Param")
+    g = _grad(ctx, p)
     ms, mom = ctx.input("MeanSquare"), ctx.input("Moment")
     eps = ctx.attr("epsilon", 1e-10)
     decay = ctx.attr("decay", 0.9)
@@ -105,7 +134,8 @@ def rmsprop(ctx):
 @register_op("decayed_adagrad", no_grad_inputs=("Param", "Grad", "Moment",
                                                 "LearningRate"))
 def decayed_adagrad(ctx):
-    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    p, m = ctx.input("Param"), ctx.input("Moment")
+    g = _grad(ctx, p)
     decay = ctx.attr("decay", 0.95)
     eps = ctx.attr("epsilon", 1e-6)
     mo = decay * m + (1.0 - decay) * g * g
@@ -115,7 +145,8 @@ def decayed_adagrad(ctx):
 @register_op("ftrl", no_grad_inputs=("Param", "Grad", "SquaredAccumulator",
                                      "LinearAccumulator", "LearningRate"))
 def ftrl(ctx):
-    p, g = ctx.input("Param"), ctx.input("Grad")
+    p = ctx.input("Param")
+    g = _grad(ctx, p)
     sq, lin = ctx.input("SquaredAccumulator"), ctx.input("LinearAccumulator")
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)

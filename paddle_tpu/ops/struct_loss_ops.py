@@ -217,7 +217,7 @@ def warpctc(ctx):
         def emit(t):
             return jnp.take_along_axis(lp[:, t, :], ext, axis=1)
 
-        alpha = jnp.full((n_seq, s_len), NEG)
+        alpha = jnp.full((n_seq, s_len), NEG, lp.dtype)
         alpha = alpha.at[:, 0].set(emit(0)[:, 0])
         if s_len > 1:
             alpha = alpha.at[:, 1].set(
@@ -226,9 +226,11 @@ def warpctc(ctx):
         def step(alpha, t):
             stay = alpha
             prev1 = jnp.concatenate(
-                [jnp.full((n_seq, 1), NEG), alpha[:, :-1]], axis=1)
+                [jnp.full((n_seq, 1), NEG, alpha.dtype), alpha[:, :-1]],
+                axis=1)
             prev2 = jnp.concatenate(
-                [jnp.full((n_seq, 2), NEG), alpha[:, :-2]], axis=1)
+                [jnp.full((n_seq, 2), NEG, alpha.dtype), alpha[:, :-2]],
+                axis=1)
             prev2 = jnp.where(skip_ok, prev2, NEG)
             merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
             new = merged + emit(t)

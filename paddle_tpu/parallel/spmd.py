@@ -98,19 +98,29 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
                 param_shapes[name] = tuple(v.shape) if v.shape else None
                 continue
         specs[name] = None  # decide below (maybe accumulator)
-    # accumulators are named "<acc>_<param.name>_<k>" and share the param's
-    # shape; give them the param's spec (plus dp under ZeRO-1) so optimizer
-    # math stays local
+    # accumulators share their param's spec (plus dp under ZeRO-1) so
+    # optimizer math stays local.  Ownership comes from the optimizer's
+    # explicit registry (Program._accumulator_owner, written by
+    # Optimizer._add_accumulator); the name-containment fallback only covers
+    # programs rebuilt without an optimizer object (e.g. deserialized).
+    acc_owner = getattr(program, "_accumulator_owner", {})
     for name, spec in list(specs.items()):
         if spec is not None:
             continue
         v = gb._var_recursive(name) if gb._has_var_recursive(name) else None
         shape = tuple(v.shape) if v is not None and v.shape else None
         matched = P()
-        for pname, pshape in param_shapes.items():
-            if pname in name and shape == pshape and shape is not None:
+        pname = acc_owner.get(name)
+        if pname is not None:
+            if pname in param_shapes and shape == param_shapes[pname] \
+                    and shape is not None:
                 matched = zero1_spec(shape, specs[pname])
-                break
+            # else: shape-[1] state like beta_pow stays replicated
+        else:
+            for pname, pshape in param_shapes.items():
+                if pname in name and shape == pshape and shape is not None:
+                    matched = zero1_spec(shape, specs[pname])
+                    break
         specs[name] = matched
     return specs
 
