@@ -123,3 +123,128 @@ def fetch_to_host(val) -> np.ndarray:
     if hasattr(val, "is_fully_addressable") and not val.is_fully_addressable:
         return np.asarray(val.addressable_data(0))
     return np.asarray(val)
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpointing (the multihost face of trainer.save_checkpoint)
+#
+# ref analogue: the pserver saves its own param shards on checkpoint_notify
+# (go/pserver/service.go:346 saves the local shard + etcd meta;
+# io.py:771 _save_lookup_tables_by_notify).  Here each process writes only
+# its ADDRESSABLE shards of every global array plus an index manifest; the
+# checkpoint directory is assumed shared (GCS/NFS — the same assumption the
+# reference's save_dirname on a cluster makes), so restore can rebuild
+# global arrays on any number of processes, even a different process count.
+# ---------------------------------------------------------------------------
+
+
+def _safe_name(name: str) -> str:
+    return name.replace("/", "%2F").replace("@", "%40")
+
+
+def save_sharded(state: dict, ckpt_dir: str) -> None:
+    """Write this process's addressable shards of every array in ``state``.
+
+    Layout: ckpt_dir/shard_<pid>/<var>.<i>.npy + manifest.json recording
+    each shard's global index slices.  Replicated (fully-addressable) vars
+    are written by process 0 only — once, not once per host."""
+    import json
+
+    pid = process_index()
+    d = os.path.join(ckpt_dir, f"shard_{pid}")
+    os.makedirs(d, exist_ok=True)
+    manifest = {}
+    for name, arr in state.items():
+        if not isinstance(arr, jax.Array):
+            arr = jax.numpy.asarray(arr)
+        entry = {"shape": [int(s) for s in arr.shape],
+                 "dtype": str(np.dtype(arr.dtype)), "shards": []}
+        if arr.is_fully_addressable:
+            # whole value visible on this host (replicated, or a single-host
+            # run): one blob, written by process 0 only
+            if pid == 0 or not _initialized:
+                fn = f"{_safe_name(name)}.full.npy"
+                np.save(os.path.join(d, fn), np.asarray(arr))
+                entry["shards"].append({"file": fn, "index": None})
+        else:
+            seen = set()
+            for i, sh in enumerate(arr.addressable_shards):
+                idx = tuple(
+                    (0 if sl.start is None else int(sl.start),
+                     int(dim) if sl.stop is None else int(sl.stop))
+                    for sl, dim in zip(sh.index, arr.shape))
+                if idx in seen:  # replicated across local devices
+                    continue
+                seen.add(idx)
+                fn = f"{_safe_name(name)}.{i}.npy"
+                np.save(os.path.join(d, fn), np.asarray(sh.data))
+                entry["shards"].append({"file": fn,
+                                        "index": [list(p) for p in idx]})
+        if entry["shards"]:
+            manifest[name] = entry
+    # manifest is written LAST: its presence marks this process's shard dir
+    # complete (a preempted writer leaves .npy files but no manifest)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({"process_count": process_count(), "vars": manifest}, f)
+
+
+def load_sharded(ckpt_dir: str, mesh: Mesh, specs: dict) -> dict:
+    """Rebuild global arrays from every shard_*/ manifest under ckpt_dir.
+
+    Requires the checkpoint directory to be readable by all processes
+    (shared storage).  Arrays come back with NamedSharding(mesh,
+    specs.get(name, P())), so restore works across a different process
+    count than the save ran with."""
+    import json
+
+    assembled: dict = {}
+    covered: dict = {}
+    expected_procs = None
+    found_procs = set()
+    for sub in sorted(os.listdir(ckpt_dir)):
+        sd = os.path.join(ckpt_dir, sub)
+        mf = os.path.join(sd, "manifest.json")
+        if not sub.startswith("shard_"):
+            continue
+        if not os.path.exists(mf):
+            raise IOError(
+                f"sharded checkpoint {ckpt_dir}: {sub} has no manifest — "
+                f"its writer was interrupted; checkpoint is incomplete")
+        with open(mf) as f:
+            payload = json.load(f)
+        found_procs.add(int(sub.split("_", 1)[1]))
+        expected_procs = int(payload.get("process_count", 1))
+        for name, entry in payload["vars"].items():
+            shape = tuple(entry["shape"])
+            if name not in assembled:
+                assembled[name] = np.zeros(shape, np.dtype(entry["dtype"]))
+                covered[name] = 0
+            for sh in entry["shards"]:
+                data = np.load(os.path.join(sd, sh["file"]))
+                if sh["index"] is None:
+                    assembled[name][...] = data
+                    covered[name] = assembled[name].size
+                else:
+                    sl = tuple(slice(a, b) for a, b in sh["index"])
+                    assembled[name][sl] = data
+                    covered[name] += int(data.size)
+    if expected_procs is not None and \
+            found_procs != set(range(expected_procs)):
+        raise IOError(
+            f"sharded checkpoint {ckpt_dir}: expected shards from "
+            f"{expected_procs} processes, found {sorted(found_procs)}")
+    # every element of every array must be covered by some shard — a gap
+    # would otherwise restore as silent zeros (disjoint rectangular GSPMD
+    # partitions make element-count a sound cover test)
+    for name, host in assembled.items():
+        if covered[name] < host.size:
+            raise IOError(
+                f"sharded checkpoint {ckpt_dir}: var '{name}' covers "
+                f"{covered[name]}/{host.size} elements — missing shards")
+    out = {}
+    for name, host in assembled.items():
+        spec = specs.get(name, P())
+        sharding = NamedSharding(mesh, spec if spec is not None else P())
+        out[name] = jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, h=host: h[idx])
+    return out

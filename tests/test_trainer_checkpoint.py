@@ -1,0 +1,173 @@
+"""Trainer + CheckpointConfig kill-and-resume oracles (ref:
+python/paddle/fluid/trainer.py:100,663,763,1190 — serial dirs, _SUCCESS
+markers, trainer-arg restore, scroll-delete) and the multihost sharded
+checkpoint (parallel.multihost.save_sharded/load_sharded)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+from paddle_tpu.fluid import trainer as trainer_mod
+
+
+def _fresh():
+    from paddle_tpu.fluid import framework as _fw
+    from paddle_tpu.fluid import unique_name as _un
+
+    _fw.switch_main_program(_fw.Program())
+    _fw.switch_startup_program(_fw.Program())
+    _un.switch()
+    _executor._global_scope = _executor.Scope()
+
+
+def _train_func():
+    fluid.default_main_program().random_seed = 17
+    fluid.default_startup_program().random_seed = 17
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    return loss
+
+
+def _optimizer_func():
+    return fluid.optimizer.SGD(learning_rate=0.05)
+
+
+def _reader(n_batches=8, batch=8):
+    rng = np.random.RandomState(0)
+    batches = [
+        [(rng.normal(size=(4,)).astype(np.float32),
+          rng.normal(size=(1,)).astype(np.float32)) for _ in range(batch)]
+        for _ in range(n_batches)]
+
+    def reader():
+        for b in batches:
+            yield b
+
+    return reader
+
+
+def _collect_losses(trainer, reader, epochs=1):
+    losses = []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses.append(float(np.asarray(event.metrics[0]).reshape(-1)[0]))
+
+    trainer.train(num_epochs=epochs, event_handler=handler, reader=reader,
+                  feed_order=["x", "y"])
+    return losses
+
+
+def test_trainer_trains_and_checkpoints(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, max_num_checkpoints=2,
+                                 step_interval=2)
+    t = fluid.Trainer(_train_func, _optimizer_func, checkpoint_config=cfg)
+    losses = _collect_losses(t, _reader())
+    assert len(losses) == 8
+    assert losses[-1] < losses[0]
+    serials = trainer_mod._serial_dirs(ckpt)
+    # scroll-delete kept at most max_num_checkpoints
+    assert 0 < len(serials) <= 2
+    for _, name in serials:
+        assert os.path.exists(os.path.join(ckpt, name, "_SUCCESS"))
+
+
+def test_kill_and_resume_recovers_trajectory(tmp_path):
+    """The VERDICT item-4 oracle: killed-and-resumed training must produce
+    the identical loss trajectory as the uninterrupted run."""
+    reader = _reader(n_batches=8)
+
+    # uninterrupted reference run (no checkpointing)
+    t = fluid.Trainer(_train_func, _optimizer_func)
+    full = _collect_losses(t, reader)
+    assert len(full) == 8
+
+    # run A: checkpoint every step, "die" after step 4 via trainer.stop()
+    _fresh()
+    ckpt = str(tmp_path / "ckpt2")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, step_interval=1)
+    ta = fluid.Trainer(_train_func, _optimizer_func, checkpoint_config=cfg)
+    part_a = []
+
+    def handler_a(event):
+        if isinstance(event, fluid.EndStepEvent):
+            part_a.append(float(np.asarray(event.metrics[0]).reshape(-1)[0]))
+            if event.step == 3:  # SIGKILL stand-in: abandon mid-epoch
+                ta.stop()
+
+    ta.train(num_epochs=1, event_handler=handler_a, reader=reader,
+             feed_order=["x", "y"])
+    assert len(part_a) == 4
+
+    # run B: fresh "process", same funcs — must resume at step 4
+    _fresh()
+    cfg2 = fluid.CheckpointConfig(checkpoint_dir=ckpt, step_interval=1)
+    tb = fluid.Trainer(_train_func, _optimizer_func, checkpoint_config=cfg2)
+    part_b = _collect_losses(tb, reader)
+    assert len(part_b) == 4  # steps 4..7 only — no replay
+
+    np.testing.assert_allclose(part_a + part_b, full, rtol=1e-6, atol=1e-6)
+
+
+def test_incomplete_checkpoint_is_ignored(tmp_path):
+    """A dir without _SUCCESS (kill mid-save) must not be restored."""
+    ckpt = str(tmp_path / "ckpt3")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, step_interval=2)
+    t = fluid.Trainer(_train_func, _optimizer_func, checkpoint_config=cfg)
+    _collect_losses(t, _reader())
+    serials = trainer_mod._serial_dirs(ckpt)
+    newest = serials[-1][1]
+    os.remove(os.path.join(ckpt, newest, "_SUCCESS"))
+    assert trainer_mod._latest_complete_serial(ckpt) == serials[-2][0]
+
+
+def test_sharded_checkpoint_roundtrip():
+    """save_sharded/load_sharded over the 8-device mesh: ZeRO-1-sharded
+    accumulators and replicated params survive the roundtrip with their
+    shardings reapplied."""
+    import tempfile
+
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import multihost as mh
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.spmd import ShardedTrainStep
+
+    fluid.default_main_program().random_seed = 2
+    fluid.default_startup_program().random_seed = 2
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    mesh = make_mesh(8, tp=2)
+    step = ShardedTrainStep(fluid.default_main_program(), ["x", "y"],
+                            [loss.name], mesh, zero1=True)
+    state = step.place_state()
+    rng = np.random.RandomState(1)
+    feed = step.place_feed({
+        "x": rng.normal(size=(16, 16)).astype(np.float32),
+        "y": rng.normal(size=(16, 1)).astype(np.float32)})
+    fetches, new_state = step(feed, state)
+    state = {**state, **new_state}
+
+    with tempfile.TemporaryDirectory() as d:
+        mh.save_sharded(state, d)
+        specs = {n: step.specs.get(n, P()) for n in state}
+        back = mh.load_sharded(d, mesh, specs)
+    assert set(back) == set(state)
+    for n in state:
+        np.testing.assert_allclose(np.asarray(state[n]), np.asarray(back[n]),
+                                   rtol=1e-6, atol=1e-6, err_msg=n)
+        assert back[n].sharding.spec == (step.specs.get(n) or P()), n
