@@ -1,0 +1,200 @@
+"""Unified benchmark driver with the reference CLI surface.
+
+ref: benchmark/fluid/fluid_benchmark.py (:137 train_parallel) + args.py —
+same flags (``--model --device --batch_size --iterations --pass_num
+--learning_rate --update_method --use_fake_data --skip_batch_num``), same
+model set (mnist, resnet, vgg, se_resnext, stacked_dynamic_lstm,
+machine_translation), TPU-native execution:
+
+ - ``--device TPU`` (or GPU, which resolves to whatever accelerator PJRT
+   exposes) runs the whole train step as one XLA program;
+ - ``--update_method local`` = single-chip Executor;
+ - ``--update_method nccl2`` = the pod-SPMD path: the global device mesh
+   replaces the NCCL ring (PADDLE_TRAINER_ID / PADDLE_TRAINERS /
+   PADDLE_COORDINATOR_ADDR env contract, ref fluid_benchmark.py:34-82);
+ - ``--update_method pserver`` is rejected with guidance — async parameter
+   serving has no SPMD equivalent by design (SURVEY.md hard part #4;
+   transpiler/distribute_transpiler.py documents the redesign).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODELS = ["mnist", "resnet", "vgg", "se_resnext", "stacked_dynamic_lstm",
+          "machine_translation"]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("fluid_benchmark")
+    p.add_argument("--model", choices=MODELS, default="resnet")
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--learning_rate", type=float, default=0.001)
+    p.add_argument("--skip_batch_num", type=int, default=2,
+                   help="warmup batches excluded from timing")
+    p.add_argument("--iterations", type=int, default=10)
+    p.add_argument("--pass_num", type=int, default=1)
+    p.add_argument("--device", choices=["CPU", "GPU", "TPU"], default="TPU")
+    p.add_argument("--gpus", type=int, default=1,
+                   help="accepted for parity; chips come from the mesh")
+    p.add_argument("--data_format", default="NCHW")
+    p.add_argument("--use_fake_data", action="store_true", default=True,
+                   help="synthetic data (default: no dataset download env)")
+    p.add_argument("--use_reader_op", action="store_true")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--update_method", default="local",
+                   choices=["local", "pserver", "nccl2"])
+    p.add_argument("--no_test", action="store_true")
+    return p.parse_args(argv)
+
+
+def _build(args):
+    """Returns (feed_fn, loss, extra) — feed_fn(rng) -> feed dict for one
+    batch; extra carries per-model unit info."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    bs, lr = args.batch_size, args.learning_rate
+    if args.model == "mnist":
+        img, label, pred, loss, acc = models.mnist.mlp()
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+        feed = lambda rng: {
+            "img": rng.normal(size=(bs, 784)).astype(np.float32),
+            "label": rng.randint(0, 10, size=(bs, 1)).astype(np.int64)}
+        return feed, loss, ("mnist", "images/sec", bs)
+    if args.model == "resnet":
+        hw = 224 if args.device != "CPU" else 64
+        cd = 1000 if args.device != "CPU" else 100
+        img, label, pred, loss, acc = models.resnet.build(
+            class_dim=cd, depth=50, image_shape=(3, hw, hw), lr=lr)
+        feed = lambda rng: {
+            "img": rng.normal(size=(bs, 3, hw, hw)).astype(np.float32),
+            "label": rng.randint(0, cd, size=(bs, 1)).astype(np.int64)}
+        return feed, loss, ("resnet50", "images/sec", bs)
+    if args.model == "vgg":
+        img, label, pred, loss, acc = models.vgg.build(
+            class_dim=10, image_shape=(3, 32, 32), lr=lr)
+        feed = lambda rng: {
+            "img": rng.normal(size=(bs, 3, 32, 32)).astype(np.float32),
+            "label": rng.randint(0, 10, size=(bs, 1)).astype(np.int64)}
+        return feed, loss, ("vgg16", "images/sec", bs)
+    if args.model == "se_resnext":
+        hw = 224 if args.device != "CPU" else 64
+        cd = 1000 if args.device != "CPU" else 100
+        img, label, pred, loss, acc = models.se_resnext.build(
+            class_dim=cd, depth=50, image_shape=(3, hw, hw), lr=lr)
+        feed = lambda rng: {
+            "img": rng.normal(size=(bs, 3, hw, hw)).astype(np.float32),
+            "label": rng.randint(0, cd, size=(bs, 1)).astype(np.int64)}
+        return feed, loss, ("se_resnext50", "images/sec", bs)
+    if args.model == "stacked_dynamic_lstm":
+        seq = 64 if args.device != "CPU" else 16
+        dict_dim, hid = 5147, (512 if args.device != "CPU" else 64)
+        data, label, pred, loss, acc = models.stacked_lstm.build(
+            dict_dim=dict_dim, emb_dim=hid, hid_dim=hid, lr=lr)
+
+        def feed(rng):
+            lens = [seq] * bs  # fixed bucket: one compiled shape
+            total = sum(lens)
+            words = fluid.create_lod_tensor(
+                rng.randint(0, dict_dim, size=(total, 1)).astype(np.int64),
+                [lens], fluid.CPUPlace())
+            return {"words": words,
+                    "label": rng.randint(0, 2, size=(bs, 1)).astype(np.int64)}
+        return feed, loss, ("stacked_dynamic_lstm", "words/sec", bs * seq)
+    if args.model == "machine_translation":
+        from paddle_tpu.models import transformer as trf
+
+        seq = 256 if args.device != "CPU" else 32
+        cfg = trf.base_config() if args.device != "CPU" else trf.tiny_config()
+        src, tgt, lbl, loss = trf.build(cfg, src_len=seq, tgt_len=seq, lr=lr)
+        feed = lambda rng: {
+            "src_word": rng.randint(1, cfg.src_vocab_size,
+                                    size=(bs, seq)).astype(np.int64),
+            "tgt_word": rng.randint(1, cfg.tgt_vocab_size,
+                                    size=(bs, seq)).astype(np.int64),
+            "lbl_word": rng.randint(1, cfg.tgt_vocab_size,
+                                    size=(bs, seq, 1)).astype(np.int64)}
+        return feed, loss, ("transformer", "tokens/sec", bs * seq)
+    raise ValueError(args.model)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.update_method == "pserver":
+        print(json.dumps({
+            "metric": "pserver_unsupported", "value": 0, "unit": "none",
+            "vs_baseline": 0,
+            "error": "async parameter serving is replaced by pod-SPMD here; "
+                     "use --update_method nccl2 (see "
+                     "fluid/transpiler/distribute_transpiler.py)"}))
+        return 2
+
+    import jax
+
+    if args.device == "CPU":
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+
+    on_accel = args.device != "CPU"
+    if on_accel and os.environ.get("BENCH_AMP", "1") != "0":
+        fluid.amp.enable("bfloat16")
+
+    if args.update_method == "nccl2":
+        from paddle_tpu.parallel import multihost
+
+        multihost.init()  # PADDLE_* env contract; no-op for 1 process
+
+    feed_fn, loss, (name, unit, items_per_batch) = _build(args)
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+
+    rng = np.random.RandomState(0)
+    feed = feed_fn(rng)
+    if on_accel:
+        from paddle_tpu.fluid import core as _core
+
+        dev = _core.get_jax_device(place)
+        feed = {k: (jax.device_put(np.asarray(v), dev)
+                    if not isinstance(v, fluid.LoDTensor) else v)
+                for k, v in feed.items()}
+
+    if args.profile:
+        fluid.profiler.start_profiler("All")
+    for _ in range(args.skip_batch_num):
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    out = None
+    iters = args.iterations * args.pass_num
+    for _ in range(iters):
+        (out,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                         return_numpy=False)
+    last = float(np.asarray(out).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    if args.profile:
+        fluid.profiler.stop_profiler("total", "/tmp/fluid_benchmark_profile")
+
+    rate = items_per_batch * iters / dt
+    print(json.dumps({
+        "metric": f"{name}_bs{args.batch_size}_{args.device.lower()}"
+                  f"_{args.update_method}",
+        "value": round(rate, 2), "unit": unit + "/chip",
+        "vs_baseline": 0.0, "final_loss": round(last, 4)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,8 @@ generator with the real shapes/dtypes/cardinalities when the cached copy is
 absent — enough for the train-loop, checkpoint, and benchmark harnesses.
 """
 
-from . import mnist, cifar, uci_housing, imdb, common
+from . import (cifar, common, flowers, imdb, imikolov, mnist, movielens,
+               uci_housing, wmt16)
 
-__all__ = ["mnist", "cifar", "uci_housing", "imdb", "common"]
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
+           "wmt16", "flowers", "common"]

@@ -43,7 +43,13 @@ class _ScopeTensor:
         self._name = name
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._scope._values[self._name])
+        v = self._scope._values[self._name]
+        if v is _UNINIT:
+            raise ValueError(
+                f"Variable '{self._name}' exists in the scope but holds no "
+                f"tensor yet (created via Scope.var but never set — the "
+                f"reference faults the same way on an uninitialized var)")
+        a = np.asarray(v)
         return a.astype(dtype) if dtype is not None else a
 
     def set(self, array, place=None):
@@ -51,7 +57,11 @@ class _ScopeTensor:
 
     @property
     def shape(self):
-        return tuple(self._scope._values[self._name].shape)
+        v = self._scope._values[self._name]
+        if v is _UNINIT:
+            raise ValueError(
+                f"Variable '{self._name}' holds no tensor yet")
+        return tuple(v.shape)
 
     def recursive_sequence_lengths(self):
         # scope._lods stores offsets form; convert at the API surface
@@ -93,8 +103,11 @@ class Scope:
         self._kids: List[Scope] = []
 
     def var(self, name) -> _ScopeVar:
+        # creation API (ref scope.h Scope::Var creates an UNINITIALIZED
+        # Variable): the slot exists but reads fault until set() — a
+        # misspelled var name must not silently read zeros
         if name not in self._values:
-            self._values[name] = np.zeros((), np.float32)
+            self._values[name] = _UNINIT
         return _ScopeVar(self, name)
 
     def find_var(self, name) -> Optional[_ScopeVar]:
@@ -118,7 +131,8 @@ class Scope:
         s = self
         while s is not None:
             if name in s._values:
-                return s._values[name]
+                v = s._values[name]
+                return default if v is _UNINIT else v
             s = s._parent
         return default
 
@@ -133,6 +147,7 @@ class Scope:
 
 
 _MISSING = object()
+_UNINIT = object()
 _global_scope = Scope()
 
 
@@ -203,23 +218,43 @@ class BlockPlan:
         self.needs_rng = False
         self.needs_eager = False
 
-        def _scan_special(op):
-            """stateful (rng) / eager flags, recursing into sub-blocks."""
-            from ..ops.array_ops import EAGER_OPS
-
+        def _scan_rng(op):
             d = _resolve_opdef(op.type)
             if d is not None and d.stateful:
                 self.needs_rng = True
-            base = op.type[:-5] if op.type.endswith("_grad") else op.type
-            if base in EAGER_OPS:
-                self.needs_eager = True
             sub = op.attr("sub_block") if hasattr(op, "attr") else None
             if isinstance(sub, int):
                 for bop in program.block(sub).ops:
-                    _scan_special(bop)
+                    _scan_rng(bop)
+
+        def _op_is_eager(op) -> bool:
+            """Data-dependent op (or control flow containing one) — must run
+            outside jit."""
+            from ..ops.array_ops import EAGER_OPS
+
+            base = op.type[:-5] if op.type.endswith("_grad") else op.type
+            if base in EAGER_OPS:
+                return True
+            sub = op.attr("sub_block") if hasattr(op, "attr") else None
+            if isinstance(sub, int):
+                return any(_op_is_eager(b) for b in program.block(sub).ops)
+            return False
 
         for op in self.ops:
-            _scan_special(op)
+            _scan_rng(op)
+
+        # eager-island segmentation (SURVEY.md §7 hard part #1): contiguous
+        # runs of traceable ops become jittable segments; only the
+        # data-dependent islands between them run eagerly.  A beam-search
+        # decode program keeps its whole encoder in one compiled segment.
+        self.segments: List[Tuple[str, list]] = []
+        for op in self.ops:
+            kind = "eager" if _op_is_eager(op) else "jit"
+            if self.segments and self.segments[-1][0] == kind:
+                self.segments[-1][1].append(op)
+            else:
+                self.segments.append((kind, [op]))
+        self.needs_eager = any(k == "eager" for k, _ in self.segments)
         for op in self.ops:
             for name in op.input_arg_names:
                 if not name:
@@ -480,7 +515,19 @@ class Executor:
         mut_state = {k: v for k, v in state_vals.items() if k in mut_names}
         const_state = {k: v for k, v in state_vals.items()
                        if k not in mut_names}
-        fetches, new_state = fn(feed_dev, const_state, mut_state)
+        from . import profiler as _prof
+
+        if _prof.is_profiling():
+            import time as _time
+
+            t = _time.perf_counter()
+            fetches, new_state = fn(feed_dev, const_state, mut_state)
+            jax.block_until_ready(fetches)
+            _prof.record_event(
+                f"executor_run[{len(plan.ops)}ops]",
+                _time.perf_counter() - t, start=t)
+        else:
+            fetches, new_state = fn(feed_dev, const_state, mut_state)
         for name, val in new_state.items():
             scope.set(name, val)
             if name in lod_box:
@@ -555,11 +602,171 @@ class Executor:
                                static_env=static_env, lod_box=lod_box)
 
         if plan.needs_eager:
-            # programs with data-dependent ops (beam search, mask split)
-            # run op-by-op eagerly — the two-tier executor fallback
-            # (SURVEY.md §7 hard part #2)
-            return fn
+            # programs with data-dependent ops (beam search, mask split):
+            # eager-ISLAND execution — contiguous traceable runs compile as
+            # cached jit segments, only the islands run op-by-op
+            # (SURVEY.md §7 hard part #1/#2)
+            return self._build_segmented(plan, static_env, lod_box)
         return jax.jit(fn, donate_argnums=donate)
+
+    def _build_segmented(self, plan, static_env, lod_box):
+        seg_cache: Dict[tuple, tuple] = {}
+
+        def _classify(v):
+            return "arr" if isinstance(v, jax.Array) else "host"
+
+        def run_segments(feed_vals, const_state, mut_state):
+            env: Dict[str, object] = {}
+            env.update(static_env)
+            env.update(const_state)
+            env.update(mut_state)
+            env.update(feed_vals)
+            rng_box = [env[RNG_STATE_VAR]] if plan.needs_rng else None
+            from . import profiler as _prof
+
+            for si, (kind, ops) in enumerate(plan.segments):
+                if kind == "eager":
+                    for op in ops:
+                        if _prof.is_profiling():
+                            import time as _time
+
+                            t = _time.perf_counter()
+                            run_op(op, env, rng_box)
+                            _prof.record_event(
+                                f"eager:{op.type}",
+                                _time.perf_counter() - t, start=t)
+                        else:
+                            run_op(op, env, rng_box)
+                    continue
+                if _prof.is_profiling():
+                    import time as _time
+
+                    t = _time.perf_counter()
+                    self._run_jit_segment(si, ops, env, rng_box, seg_cache)
+                    _prof.record_event(
+                        f"jit_segment[{si}:{len(ops)}ops]",
+                        _time.perf_counter() - t, start=t)
+                else:
+                    self._run_jit_segment(si, ops, env, rng_box, seg_cache)
+            fetches = [env[n] for n in plan.fetch_names]
+            new_state = {n: env[n] for n in plan.state_out if n in env}
+            if rng_box is not None:
+                new_state[RNG_STATE_VAR] = rng_box[0]
+            if lod_box is not None:
+                for n in list(plan.fetch_names) + list(plan.state_out):
+                    lod = env.get(n + LOD_SUFFIX)
+                    if lod is not None:
+                        lod_box[n] = lod
+            return fetches, new_state
+
+        return run_segments
+
+    def _run_jit_segment(self, si, ops, env, rng_box, seg_cache):
+        """Run one traceable segment through a cached jitted function.
+
+        Device (jax) values in the env become traced arguments; host values
+        (numpy counters, LoD tuples, forward-host stashes) are trace-time
+        constants keyed into the cache, so a host change retraces while the
+        steady state (e.g. the encoder prefix of a decode program) reuses
+        one compiled executable.  Host values PRODUCED at trace time are
+        replayed from the cache — they are deterministic functions of the
+        host inputs."""
+        import hashlib
+
+        from ..ops.array_ops import TensorArray
+
+        def _is_traceable(v):
+            if isinstance(v, jax.Array):
+                return True
+            if isinstance(v, TensorArray):
+                return any(isinstance(x, (jax.Array, jax.core.Tracer))
+                           for x in v.vals if x is not None)
+            return False
+
+        arr_in: Dict[str, object] = {}
+        host_env: Dict[str, object] = {}
+        for name, val in env.items():
+            if _is_traceable(val):
+                arr_in[name] = val
+            else:
+                host_env[name] = val
+
+        from ..ops.array_ops import RankTable
+
+        def _host_key(v):
+            if isinstance(v, np.ndarray):
+                return (v.shape, str(v.dtype),
+                        hashlib.blake2b(v.tobytes(), digest_size=8).hexdigest())
+            if isinstance(v, dict):
+                return tuple(sorted((str(k), _host_key(x))
+                                    for k, x in v.items()))
+            if isinstance(v, (list, tuple)):
+                return tuple(_host_key(x) for x in v)
+            if isinstance(v, RankTable):
+                return ("ranktable", tuple(map(tuple, v.items)))
+            if isinstance(v, TensorArray):  # host-valued array
+                return ("ta", tuple(_host_key(x) for x in v.vals),
+                        _host_key(v.lods))
+            if v is None or isinstance(v, (bool, int, float, str, bytes)):
+                return v
+            # unknown host object: key by content so equal values hit the
+            # cache and changed values retrace (identity keying would either
+            # never hit or replay stale trace-time constants)
+            import pickle
+
+            try:
+                return ("pickled", hashlib.blake2b(
+                    pickle.dumps(v), digest_size=8).hexdigest())
+            except Exception:
+                return ("id", id(v))
+
+        def _arr_sig(v):
+            if isinstance(v, jax.Array):
+                return (tuple(v.shape), str(v.dtype))
+            # TensorArray: per-element shape signature
+            return tuple((tuple(x.shape), str(x.dtype)) if x is not None
+                         else None for x in v.vals)
+
+        # '@'-prefixed entries (forward-host stashes) ARE part of the key:
+        # they get baked into the trace as constants, so a changed stash
+        # must miss the cache, not silently replay into grad ops
+        key = (si,
+               tuple(sorted((n, _arr_sig(v)) for n, v in arr_in.items())),
+               _host_key(host_env))
+        entry = seg_cache.get(key)
+        if entry is None:
+            side = {}
+            captured_host = dict(host_env)
+
+            def traced(arrs, rng_key):
+                env2: Dict[str, object] = dict(captured_host)
+                env2.update(arrs)
+                before = {n: id(v) for n, v in env2.items()}
+                box = [rng_key] if rng_key is not None else None
+                for op in ops:
+                    run_op(op, env2, box)
+                from ..ops.array_ops import TensorArray as _TA
+
+                arr_out, host_out = {}, {}
+                for n, v in env2.items():
+                    if before.get(n) == id(v):
+                        continue
+                    if isinstance(v, (jax.Array, jax.core.Tracer, _TA)):
+                        arr_out[n] = v
+                    else:
+                        host_out[n] = v
+                side["host"] = host_out
+                return arr_out, (box[0] if box is not None else None)
+
+            jitted = jax.jit(traced)
+            entry = (jitted, side)
+            seg_cache[key] = entry
+        jitted, side = entry
+        arr_out, new_key = jitted(arr_in, rng_box[0] if rng_box else None)
+        env.update(arr_out)
+        env.update(side.get("host", {}))
+        if rng_box is not None and new_key is not None:
+            rng_box[0] = new_key
 
     def _gather_state(self, program, plan, scope):
         state = {}

@@ -17,4 +17,6 @@ from . import (  # noqa: F401
     rnn_ops,
     array_ops,
     struct_loss_ops,
+    detection_ops,
+    quant_ops,
 )

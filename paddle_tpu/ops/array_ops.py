@@ -28,11 +28,31 @@ from .registry import register_op, register_grad
 EAGER_OPS = {
     "split_lod_tensor", "merge_lod_tensor", "beam_search",
     "beam_search_decode", "is_empty",
+    # data-dependent output count (LoD out) — host postprocessing, like the
+    # reference's CPU-pinned kernel (multiclass_nms_op.cc)
+    "multiclass_nms",
 }
 
 
+import jax as _jax
+
+
+@_jax.tree_util.register_pytree_node_class
 class TensorArray:
-    """LoDTensorArray value (ref: var_type LOD_TENSOR_ARRAY)."""
+    """LoDTensorArray value (ref: var_type LOD_TENSOR_ARRAY).
+
+    Registered as a jax pytree (vals are children, lods are aux) so arrays
+    can cross jit-segment boundaries in the eager-island executor."""
+
+    def tree_flatten(self):
+        aux = tuple(tuple(map(tuple, l)) if l is not None else None
+                    for l in self.lods)
+        return tuple(self.vals), aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children), [tuple(l) if l is not None else None
+                                    for l in aux])
 
     def __init__(self, vals: Optional[List] = None,
                  lods: Optional[List] = None):

@@ -66,19 +66,48 @@ def depthwise_conv2d(ctx):
     return {"Output": amp.restore_astype(out, back)}
 
 
+def _transpose_pad(w_spatial, paddings, dilations):
+    """Paddle conv_transpose padding -> jax conv_transpose padding.
+
+    Paddle: out = (in-1)*stride + (k-1)*dilation + 1 - 2*pad.  jax's
+    ``padding`` pairs pad the stride-dilated input directly, so the full
+    transpose of a VALID region needs (k_eff - 1 - p) on each side."""
+    return [((k - 1) * d + 1 - 1 - p, (k - 1) * d + 1 - 1 - p)
+            for k, p, d in zip(w_spatial, paddings, dilations)]
+
+
+def _grouped_conv_transpose(x, w, strides, pad, dilations, dn, groups):
+    """jax.lax.conv_transpose has no feature_group_count; grouped transpose
+    convs split channels (static group count, so XLA still sees G parallel
+    convs it can fuse)."""
+    if groups <= 1:
+        return jax.lax.conv_transpose(
+            x, w, strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, transpose_kernel=True)
+    outs = [
+        jax.lax.conv_transpose(
+            xg, wg, strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, transpose_kernel=True)
+        for xg, wg in zip(jnp.split(x, groups, axis=1),
+                          jnp.split(w, groups, axis=0))]
+    return jnp.concatenate(outs, axis=1)
+
+
 @register_op("conv2d_transpose")
 def conv2d_transpose(ctx):
     x, w = ctx.input("Input"), ctx.input("Filter")  # w: [C_in, C_out/g, kH, kW]
     strides = _pair(ctx.attr("strides", [1, 1]))
     paddings = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
-    pad = [(p, p) for p in paddings]
+    groups = ctx.attr("groups", 1) or 1
+    pad = _transpose_pad(w.shape[2:], paddings, dilations)
     from ..fluid import amp
 
     x, w, back = amp.cast_operands(x, w)
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=pad, rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"), transpose_kernel=True)
+    # transpose_kernel=True flips the kernel and swaps its I/O, so the spec
+    # labels the kernel post-swap: OIHW for a [C_in, C_out, kH, kW] layout
+    out = _grouped_conv_transpose(x, w, strides, pad, dilations,
+                                  ("NCHW", "OIHW", "NCHW"), groups)
     return {"Output": amp.restore_astype(out, back)}
 
 
@@ -287,3 +316,174 @@ def spp(ctx):
         o = _pool2d_impl(x, ptype, [kh, kw], [sh, sw], [ph, pw], False, False)
         outs.append(o.reshape(n, -1))
     return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# 3-D / indexed pooling, unpool, conv3d_transpose (ref: pool_op.* Pool3D,
+# pool_with_index_op.*, unpool_op.*, conv_transpose_op.* Conv3DTranspose)
+# ---------------------------------------------------------------------------
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+@register_op("pool3d")
+def pool3d(ctx):
+    x = ctx.input("X")  # NCDHW
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _tuple_n(ctx.attr("ksize"), 3)
+    strides = _tuple_n(ctx.attr("strides", [1, 1, 1]), 3)
+    paddings = _tuple_n(ctx.attr("paddings", [0, 0, 0]), 3)
+    if ctx.attr("global_pooling", False):
+        axis = (2, 3, 4)
+        out = jnp.max(x, axis, keepdims=True) if ptype == "max" \
+            else jnp.mean(x, axis, keepdims=True)
+        return {"Out": out}
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        return {"Out": jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                             window, strides_, pad)}
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_, pad)
+    if ctx.attr("exclusive", True) and any(paddings):
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    window, strides_, pad)
+        return {"Out": s / cnt}
+    return {"Out": s / float(np.prod(ksize))}
+
+
+def _pool_with_index(x, ksize, strides, paddings):
+    """Max pool that also returns the argmax's flat position in the input
+    plane (ref pool_with_index_op.h: mask index = h * W + w)."""
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    # flat index grid of the input plane, same spatial shape as x — int32
+    # (exact for any realistic plane; float would corrupt indices > 2^24)
+    flat = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
+    flat = jnp.broadcast_to(flat, x.shape)
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = jax.lax.reduce_window(
+        (x, flat),
+        (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1, jnp.int32)),
+        lambda a, b: sel(a, b), window, strides_, pad)
+    return out, idx.astype(jnp.int64)
+
+
+@register_op("max_pool2d_with_index", no_grad_inputs=())
+def max_pool2d_with_index(ctx):
+    x = ctx.input("X")
+    out, idx = _pool_with_index(
+        x, _tuple_n(ctx.attr("ksize"), 2),
+        _tuple_n(ctx.attr("strides", [1, 1]), 2),
+        _tuple_n(ctx.attr("paddings", [0, 0]), 2))
+    return {"Out": out, "Mask": idx}
+
+
+@register_op("max_pool3d_with_index", no_grad_inputs=())
+def max_pool3d_with_index(ctx):
+    x = ctx.input("X")
+    out, idx = _pool_with_index(
+        x, _tuple_n(ctx.attr("ksize"), 3),
+        _tuple_n(ctx.attr("strides", [1, 1, 1]), 3),
+        _tuple_n(ctx.attr("paddings", [0, 0, 0]), 3))
+    return {"Out": out, "Mask": idx}
+
+
+@register_grad("max_pool2d_with_index")
+def max_pool2d_with_index_grad(ctx):
+    x = ctx.input("X")
+    idx = ctx.input("Mask")
+    dout = ctx.input("Out@GRAD")
+    n, c, h, w = x.shape
+    dx = jnp.zeros((n, c, h * w), x.dtype)
+    flat_idx = idx.reshape(n, c, -1).astype(jnp.int64)
+    dx = dx.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+               flat_idx].add(dout.reshape(n, c, -1))
+    return {"X@GRAD": dx.reshape(x.shape)}
+
+
+@register_op("unpool", no_grad_inputs=("Indices",))
+def unpool(ctx):
+    """ref: unpool_op.* (max unpooling): scatter each pooled value back to
+    the position its max came from."""
+    x = ctx.input("X")             # [N, C, h, w]
+    indices = ctx.input("Indices")  # same shape, flat positions in H*W
+    out_h, out_w = ctx.attr("unpooled_height"), ctx.attr("unpooled_width")
+    if not out_h or not out_w:
+        ksize = _tuple_n(ctx.attr("ksize"), 2)
+        strides = _tuple_n(ctx.attr("strides", [2, 2]), 2)
+        out_h = (x.shape[2] - 1) * strides[0] + ksize[0]
+        out_w = (x.shape[3] - 1) * strides[1] + ksize[1]
+    n, c = x.shape[:2]
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    flat_idx = indices.reshape(n, c, -1).astype(jnp.int64)
+    out = out.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+                 flat_idx].add(x.reshape(n, c, -1))
+    return {"Out": out.reshape(n, c, out_h, out_w)}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [C_in, C_out, kD, kH, kW]
+    strides = _tuple_n(ctx.attr("strides", [1, 1, 1]), 3)
+    paddings = _tuple_n(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _tuple_n(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = ctx.attr("groups", 1) or 1
+    pad = _transpose_pad(w.shape[2:], paddings, dilations)
+    from ..fluid import amp
+
+    x, w, back = amp.cast_operands(x, w)
+    # kernel layout [C_in, C_out, kD, kH, kW]; with transpose_kernel=True
+    # the spec labels the kernel AFTER its I/O swap, hence OIDHW
+    out = _grouped_conv_transpose(x, w, strides, pad, dilations,
+                                  ("NCDHW", "OIDHW", "NCDHW"), groups)
+    return {"Output": amp.restore_astype(out, back)}
+
+
+# ---------------------------------------------------------------------------
+# print op (ref: print_op.cc — debugging passthrough with host logging)
+# ---------------------------------------------------------------------------
+
+
+@register_op("print")
+def print_op(ctx):
+    x = ctx.input("In")
+    message = ctx.attr("message", "") or ""
+    first_n = ctx.attr("first_n", -1)
+    fmt = []
+    if ctx.attr("print_tensor_name", True):
+        fmt.append(message)
+    if ctx.attr("print_tensor_shape", True):
+        fmt.append(f"shape={tuple(x.shape)}")
+    if ctx.attr("print_tensor_dtype", True):
+        fmt.append(f"dtype={x.dtype}")
+    prefix = " ".join(fmt)
+    # jax.debug.callback survives jit: the host callback fires per
+    # execution.  The first_n counter must outlive one op invocation (eager
+    # islands re-run the impl every step), so it keys off the op's attr
+    # dict, which is one stable object per Program op.
+    counter = _PRINT_COUNTS.setdefault(id(ctx.attrs), [0])
+
+    def _cb(arr, transforms=None):
+        if first_n is None or first_n < 0 or counter[0] < first_n:
+            counter[0] += 1
+            print(f"{prefix} values={np.asarray(arr).reshape(-1)[:20]}")
+
+    jax.debug.callback(_cb, x)
+    return {"Out": x}
+
+
+_PRINT_COUNTS: dict = {}

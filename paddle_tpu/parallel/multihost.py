@@ -197,23 +197,35 @@ def load_sharded(ckpt_dir: str, mesh: Mesh, specs: dict) -> dict:
     count than the save ran with."""
     import json
 
+    # process 0's manifest is canonical for the world size: stale higher-
+    # index shard dirs from an older, larger-world save in the same
+    # directory must be ignored, not merged over fresh weights
+    mf0 = os.path.join(ckpt_dir, "shard_0", "manifest.json")
+    if not os.path.exists(mf0):
+        raise IOError(
+            f"sharded checkpoint {ckpt_dir}: shard_0/manifest.json missing "
+            f"— no complete checkpoint here")
+    with open(mf0) as f:
+        expected_procs = int(json.load(f).get("process_count", 1))
+
     assembled: dict = {}
     covered: dict = {}
-    expected_procs = None
     found_procs = set()
     for sub in sorted(os.listdir(ckpt_dir)):
         sd = os.path.join(ckpt_dir, sub)
         mf = os.path.join(sd, "manifest.json")
         if not sub.startswith("shard_"):
             continue
+        pid = int(sub.split("_", 1)[1])
+        if pid >= expected_procs:
+            continue  # stale dir from an older save with more processes
         if not os.path.exists(mf):
             raise IOError(
                 f"sharded checkpoint {ckpt_dir}: {sub} has no manifest — "
                 f"its writer was interrupted; checkpoint is incomplete")
         with open(mf) as f:
             payload = json.load(f)
-        found_procs.add(int(sub.split("_", 1)[1]))
-        expected_procs = int(payload.get("process_count", 1))
+        found_procs.add(pid)
         for name, entry in payload["vars"].items():
             shape = tuple(entry["shape"])
             if name not in assembled:

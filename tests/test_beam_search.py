@@ -148,3 +148,36 @@ def test_beam_search_decode_backtrack():
     # two hypotheses: [3,5] and [4,6]
     np.testing.assert_array_equal(ids_out, [3, 5, 4, 6])
     assert lens[-1] == [2, 2]
+
+
+def test_eager_island_segmentation_and_cache():
+    """SURVEY.md §7 hard part #1: a decode-style program with a data-
+    dependent op keeps its traceable prefix in a compiled segment; repeated
+    runs reuse the compiled executable (cache stays at one entry per
+    segment)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import BlockPlan
+
+    fluid.default_startup_program().random_seed = 9
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")   # "encoder" prefix
+    h2 = fluid.layers.fc(input=h, size=4, act="softmax")
+    cond = fluid.layers.is_empty(x=h2)                  # eager island
+    out = fluid.layers.fc(input=h2, size=2, act=None)   # jittable suffix
+
+    plan = BlockPlan(fluid.default_main_program(), 0, ["x"],
+                     [out.name, cond.name])
+    kinds = [k for k, _ in plan.segments]
+    assert "eager" in kinds and kinds[0] == "jit", kinds
+    # prefix segment holds the two-fc encoder (mul/add/act ops)
+    assert len(plan.segments[0][1]) >= 4
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((3, 8), np.float32)}
+    r1 = exe.run(fluid.default_main_program(), feed=feed,
+                 fetch_list=[out, cond])
+    r2 = exe.run(fluid.default_main_program(), feed=feed,
+                 fetch_list=[out, cond])
+    np.testing.assert_allclose(np.asarray(r1[0]), np.asarray(r2[0]),
+                               rtol=1e-6)

@@ -1,0 +1,78 @@
+"""New benchmark models (ref: benchmark/fluid/se_resnext.py,
+stacked_dynamic_lstm.py) + the fluid_benchmark CLI surface
+(ref: benchmark/fluid/fluid_benchmark.py, args.py)."""
+
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_se_resnext_builds_and_groups():
+    from paddle_tpu.models import se_resnext
+
+    img, label, pred, loss, acc = se_resnext.build(
+        class_dim=10, depth=50, image_shape=(3, 64, 64))
+    # cardinality-32 grouped convs must be present in the program
+    groups = [op.attr("groups") for op in
+              fluid.default_main_program().global_block().ops
+              if op.type == "conv2d"]
+    assert 32 in groups
+    assert pred.shape[-1] == 10
+
+
+def test_stacked_lstm_trains():
+    from paddle_tpu.models import stacked_lstm
+
+    fluid.default_main_program().random_seed = 4
+    fluid.default_startup_program().random_seed = 4
+    data, label, pred, loss, acc = stacked_lstm.build(
+        dict_dim=80, emb_dim=24, hid_dim=24, stacked_num=2, lr=1e-2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    words = fluid.create_lod_tensor(
+        rng.randint(0, 80, size=(13, 1)).astype(np.int64), [[6, 7]],
+        fluid.CPUPlace())
+    feed = {"words": words,
+            "label": rng.randint(0, 2, size=(2, 1)).astype(np.int64)}
+    losses = []
+    for _ in range(6):
+        (l,) = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("argv,expect_metric", [
+    (["--model", "mnist", "--device", "CPU", "--batch_size", "32",
+      "--iterations", "3"], "mnist_bs32_cpu_local"),
+])
+def test_fluid_benchmark_cli(argv, expect_metric):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "fluid_benchmark.py")]
+        + argv,
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == expect_metric, out.stdout + out.stderr
+    assert rec["value"] > 0
+
+
+def test_fluid_benchmark_cli_rejects_pserver():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "fluid_benchmark.py"),
+         "--model", "mnist", "--update_method", "pserver"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "pserver_unsupported"
+    assert out.returncode == 2

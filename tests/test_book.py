@@ -1,0 +1,157 @@
+"""Book-style end-to-end chapters (ref: python/paddle/fluid/tests/book/ —
+each chapter trains to a loss threshold, saves with save_inference_model,
+reloads in a fresh scope, and infers; test_fit_a_line.py,
+test_word2vec.py, test_machine_translation.py)."""
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+
+
+def _fresh_scope():
+    _executor._global_scope = _executor.Scope()
+
+
+def _infer_roundtrip(tmp_path, exe, feed_names, targets, feed, ref_out):
+    d = str(tmp_path / "model")
+    fluid.save_inference_model(d, feed_names, targets, exe)
+    _fresh_scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog, feeds, fetches = fluid.load_inference_model(d, exe2)
+    assert feeds == feed_names
+    out = exe2.run(prog, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fit_a_line(tmp_path):
+    """Linear regression on uci_housing (ref book chapter 1)."""
+    fluid.default_startup_program().random_seed = 1
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=y_pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    reader = paddle_tpu.batch(paddle_tpu.dataset.uci_housing.train(), 32)
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for epoch in range(4):
+        for batch in reader():
+            (l,) = exe.run(fluid.default_main_program(),
+                           feed=feeder.feed(batch), fetch_list=[loss])
+            last = float(np.asarray(l).reshape(-1)[0])
+            if first is None:
+                first = last
+    assert last < first * 0.5, (first, last)
+
+    probe = {"x": np.zeros((4, 13), np.float32)}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[y_pred])
+    _infer_roundtrip(tmp_path, exe, ["x"], [y_pred], probe, ref)
+
+
+def test_word2vec(tmp_path):
+    """N-gram word embedding model on imikolov (ref book chapter 4)."""
+    from paddle_tpu.dataset import imikolov
+
+    fluid.default_startup_program().random_seed = 2
+    word_dict = imikolov.build_dict()
+    dict_size = len(word_dict)
+    N = 5
+    emb_dim = 16
+
+    words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+             for i in range(N - 1)]
+    target = fluid.layers.data(name="target", shape=[1], dtype="int64")
+    embs = [fluid.layers.embedding(
+        input=w, size=[dict_size, emb_dim],
+        param_attr=fluid.ParamAttr(name="shared_emb"), is_sparse=True)
+        for w in words]
+    concat = fluid.layers.concat(input=embs, axis=1)
+    hidden = fluid.layers.fc(input=concat, size=64, act="sigmoid")
+    predict = fluid.layers.fc(input=hidden, size=dict_size, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=target))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    reader = paddle_tpu.batch(imikolov.train(word_dict, N), 64)
+    feeder = fluid.DataFeeder(feed_list=words + [target],
+                              place=fluid.CPUPlace())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(2):
+        for batch in reader():
+            (l,) = exe.run(fluid.default_main_program(),
+                           feed=feeder.feed(batch), fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+            if len(losses) >= 150:
+                break
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+    probe = {f"w{i}": np.array([[i + 1]], np.int64) for i in range(N - 1)}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[predict])
+    _infer_roundtrip(tmp_path, exe, [f"w{i}" for i in range(N - 1)],
+                     [predict], probe, ref)
+
+
+def test_machine_translation(tmp_path):
+    """Tiny transformer on the wmt16 synthetic parallel corpus (ref book
+    chapter 7 / machine_translation.py): the deterministic source->target
+    mapping must be learnable, then save/reload/infer."""
+    from paddle_tpu.dataset import wmt16
+    from paddle_tpu.models import transformer
+
+    fluid.default_main_program().random_seed = 3
+    fluid.default_startup_program().random_seed = 3
+    dict_size = 40
+    cfg = transformer.tiny_config()
+    cfg.src_vocab_size = dict_size + 3
+    cfg.tgt_vocab_size = dict_size + 3
+    cfg.dropout = 0.0
+    seq = 14
+    src_w, tgt_w, lbl_w, avg_cost, logits = transformer.forward(
+        cfg, src_len=seq, tgt_len=seq)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    def pad(ids, n):
+        return (ids + [0] * n)[:n]
+
+    batches = []
+    reader = wmt16.train(dict_size + 3, dict_size + 3)
+    buf = []
+    for src, trg, trg_next in reader():
+        buf.append((pad(src, seq), pad(trg, seq),
+                    [[w] for w in pad(trg_next, seq)]))
+        if len(buf) == 16:
+            batches.append((
+                np.array([b[0] for b in buf], np.int64),
+                np.array([b[1] for b in buf], np.int64),
+                np.array([b[2] for b in buf], np.int64)))
+            buf = []
+        if len(batches) >= 40:
+            break
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for s, t, l in batches:
+        (lv,) = exe.run(fluid.default_main_program(),
+                        feed={"src_word": s, "tgt_word": t, "lbl_word": l},
+                        fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+    s, t, l = batches[0]
+    probe = {"src_word": s[:2], "tgt_word": t[:2]}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[logits])
+    _infer_roundtrip(tmp_path, exe, ["src_word", "tgt_word"], [logits],
+                     probe, ref)

@@ -1,0 +1,326 @@
+"""Detection, quantization, and indexed-pooling op families (VERDICT item 7;
+ref: operators/detection/, fake_quantize_op.*, pool_with_index_op.*,
+unpool_op.*, conv_transpose_op.* Conv3DTranspose, print_op.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from op_test import OpTest
+
+
+def _run_layer(build, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed, fetch_list=fetch)
+
+
+# ---------------------------------------------------------------------------
+# prior_box / box_coder / iou
+# ---------------------------------------------------------------------------
+
+
+def test_prior_box_values():
+    feat = fluid.layers.data(name="feat", shape=[8, 4, 4], dtype="float32")
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    boxes, var = fluid.layers.prior_box(
+        feat, img, min_sizes=[8.0], max_sizes=[16.0],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    b, v = _run_layer(None, {
+        "feat": np.zeros((1, 8, 4, 4), np.float32),
+        "img": np.zeros((1, 3, 32, 32), np.float32)}, [boxes, var])
+    b, v = np.asarray(b), np.asarray(v)
+    # priors per cell: ar {1, 2, 1/2} x 1 min_size + 1 max_size = 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    # cell (0,0): center (0.5*8, 0.5*8) = (4, 4); min-size box half=4
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 8 / 32, 8 / 32],
+                               atol=1e-6)
+    # max-size prior: sqrt(8*16)/2 = 5.657
+    h = np.sqrt(8 * 16.0) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 3], [max(0, (4 - h) / 32), max(0, (4 - h) / 32),
+                     (4 + h) / 32, (4 + h) / 32], atol=1e-5)
+    assert (b >= 0).all() and (b <= 1).all()  # clip
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-7)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.sort(rng.uniform(0.1, 0.9, size=(5, 2, 2)), axis=1) \
+        .reshape(5, 4).astype(np.float32)  # rows: (x0, y0, x1, y1)
+    pvar = np.full((5, 4), 0.1, np.float32)
+    target = np.sort(rng.uniform(0.1, 0.9, size=(3, 2, 2)), axis=1) \
+        .reshape(3, 4).astype(np.float32)
+
+    pb = fluid.layers.data(name="pb", shape=[4], dtype="float32")
+    pv = fluid.layers.data(name="pv", shape=[4], dtype="float32")
+    tb = fluid.layers.data(name="tb", shape=[4], dtype="float32")
+    enc = fluid.layers.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec = fluid.layers.box_coder(pb, pv, enc, code_type="decode_center_size")
+    e, d = _run_layer(None, {"pb": prior, "pv": pvar, "tb": target},
+                      [enc, dec])
+    assert np.asarray(e).shape == (3, 5, 4)
+    # decode(encode(t)) == t for every prior column
+    for j in range(5):
+        np.testing.assert_allclose(np.asarray(d)[:, j, :], target, atol=1e-4)
+
+
+def test_iou_similarity_known_values():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+    out = fluid.layers.iou_similarity(x, y)
+    a = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    b = np.array([[0.0, 0.0, 1.0, 1.0], [0.5, 0.5, 1.5, 1.5],
+                  [2.0, 2.0, 3.0, 3.0]], np.float32)
+    (o,) = _run_layer(None, {"x": a, "y": b}, [out])
+    np.testing.assert_allclose(np.asarray(o)[0], [1.0, 0.25 / 1.75, 0.0],
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match / target_assign / multiclass_nms / roi_pool
+# ---------------------------------------------------------------------------
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.2, 0.1],
+                     [0.8, 0.7, 0.3]], np.float32)
+    d = fluid.layers.data(name="d", shape=[3], dtype="float32")
+    idx, mdist = fluid.layers.bipartite_match(d)
+    i, m = _run_layer(None, {"d": dist}, [idx, mdist])
+    i, m = np.asarray(i)[0], np.asarray(m)[0]
+    # greedy global max: (0,0)=0.9 then (1,1)=0.7; col 2 unmatched
+    np.testing.assert_array_equal(i, [0, 1, -1])
+    np.testing.assert_allclose(m, [0.9, 0.7, 0.0], atol=1e-6)
+
+
+def test_target_assign():
+    # X LoD rows: image0 has 2 gt rows, image1 has 1
+    x = np.arange(3 * 1 * 2, dtype=np.float32).reshape(3, 1, 2)
+    match = np.array([[0, 1, -1], [0, -1, 0]], np.int32)
+    xv = fluid.layers.data(name="x", shape=[1, 2], dtype="float32",
+                           lod_level=1)
+    mv = fluid.layers.data(name="m", shape=[3], dtype="int32")
+    out, wt = fluid.layers.target_assign(xv, mv, mismatch_value=7)
+    lod_x = fluid.create_lod_tensor(x, [[2, 1]], fluid.CPUPlace())
+    o, w = _run_layer(None, {"x": lod_x, "m": match}, [out, wt])
+    o, w = np.asarray(o), np.asarray(w)
+    np.testing.assert_allclose(o[0, 0], [0, 1])     # image0 row 0
+    np.testing.assert_allclose(o[0, 1], [2, 3])     # image0 row 1
+    np.testing.assert_allclose(o[0, 2], [7, 7])     # mismatch
+    np.testing.assert_allclose(o[1, 0], [4, 5])     # image1 row 0
+    np.testing.assert_allclose(w[:, :, 0] if w.ndim == 3 else w,
+                               [[1, 1, 0], [1, 0, 1]])
+
+
+def test_multiclass_nms_eager():
+    bboxes = np.array([[[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]]],
+                      np.float32)
+    scores = np.array([[[0.1, 0.2, 0.3],      # class 0 = background
+                        [0.9, 0.85, 0.1],     # class 1
+                        [0.05, 0.05, 0.8]]], np.float32)  # class 2
+    bv = fluid.layers.data(name="b", shape=[3, 4], dtype="float32")
+    sv = fluid.layers.data(name="s", shape=[3, 3], dtype="float32")
+    out = fluid.layers.multiclass_nms(bv, sv, score_threshold=0.5,
+                                      nms_threshold=0.4)
+    (o,) = _run_layer(None, {"b": bboxes, "s": scores}, [out])
+    o = np.asarray(o)
+    # identical boxes suppress to one class-1 det; class-2 box survives
+    assert o.shape == (2, 6)
+    labels = sorted(o[:, 0].tolist())
+    assert labels == [1.0, 2.0]
+    best = o[o[:, 0] == 1.0][0]
+    np.testing.assert_allclose(best[1], 0.9, atol=1e-6)
+    np.testing.assert_allclose(best[2:], [0, 0, 1, 1], atol=1e-6)
+
+
+def test_roi_pool_known_values():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3], [0, 0, 1, 1]], np.float32)
+    xv = fluid.layers.data(name="x", shape=[1, 4, 4], dtype="float32")
+    rv = fluid.layers.data(name="r", shape=[4], dtype="float32", lod_level=1)
+    out = fluid.layers.roi_pool(xv, rv, pooled_height=2, pooled_width=2)
+    lod_rois = fluid.create_lod_tensor(rois, [[2]], fluid.CPUPlace())
+    (o,) = _run_layer(None, {"x": x, "r": lod_rois}, [out])
+    o = np.asarray(o)
+    assert o.shape == (2, 1, 2, 2)
+    np.testing.assert_allclose(o[0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(o[1, 0], [[0, 1], [4, 5]])
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+
+class TestFakeQuantizeAbsMax(OpTest):
+    op_type = "fake_quantize_abs_max"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-4, 4, size=(6, 5)).astype(np.float32)
+        scale = np.abs(x).max()
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": np.round(x / scale * 127.0),
+                        "OutScale": np.array([scale], np.float32)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestFakeDequantizeMaxAbs(OpTest):
+    op_type = "fake_dequantize_max_abs"
+
+    def setup(self):
+        rng = np.random.RandomState(4)
+        x = np.round(rng.uniform(-127, 127, size=(4, 7))).astype(np.float32)
+        scale = np.array([3.7], np.float32)
+        self.inputs = {"X": x, "Scale": scale}
+        self.attrs = {"max_range": 127.0}
+        self.outputs = {"Out": x * 3.7 / 127.0}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+def test_fake_quantize_straight_through_grad():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    x.stop_gradient = False
+    helper_out = fluid.layers.fc(input=x, size=3, act=None)
+    loss = fluid.layers.mean(helper_out)
+    # quantize between fc and mean via raw op on the program
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (l,) = exe.run(fluid.default_main_program(),
+                   feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[loss])
+    assert np.isfinite(np.asarray(l)).all()
+
+
+# ---------------------------------------------------------------------------
+# pool3d / max_pool_with_index / unpool / conv3d_transpose
+# ---------------------------------------------------------------------------
+
+
+class TestPool3D(OpTest):
+    op_type = "pool3d"
+
+    def setup(self):
+        rng = np.random.RandomState(5)
+        x = rng.normal(size=(2, 3, 4, 4, 4)).astype(np.float32)
+        out = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).transpose(
+            0, 1, 2, 4, 6, 3, 5, 7).reshape(2, 3, 2, 2, 2, 8).max(-1)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(6)
+    x = rng.permutation(16).reshape(1, 1, 4, 4).astype(np.float32)
+    from paddle_tpu.ops.registry import REGISTRY, ExecContext
+    import jax.numpy as jnp
+
+    ctx = ExecContext("max_pool2d_with_index",
+                      {"X": [jnp.asarray(x)]}, {"Out": ["o"], "Mask": ["m"]},
+                      {"ksize": [2, 2], "strides": [2, 2],
+                       "paddings": [0, 0]})
+    r = REGISTRY["max_pool2d_with_index"].fn(ctx)
+    out, mask = np.asarray(r["Out"]), np.asarray(r["Mask"])
+    assert out.shape == (1, 1, 2, 2)
+    # each index points at the element equal to the max
+    flat = x.reshape(-1)
+    np.testing.assert_allclose(flat[mask.reshape(-1)], out.reshape(-1))
+
+    ctx2 = ExecContext("unpool",
+                       {"X": [jnp.asarray(out)],
+                        "Indices": [jnp.asarray(mask)]},
+                       {"Out": ["o"]},
+                       {"unpooled_height": 4, "unpooled_width": 4,
+                        "ksize": [2, 2], "strides": [2, 2]})
+    up = np.asarray(REGISTRY["unpool"].fn(ctx2)["Out"])
+    assert up.shape == (1, 1, 4, 4)
+    # unpooled map has the maxes at their original positions, zeros elsewhere
+    assert up.sum() == out.sum()
+    for v, i in zip(out.reshape(-1), mask.reshape(-1)):
+        assert up.reshape(-1)[i] == v
+
+
+class TestConv3DTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        x = rng.normal(size=(1, 2, 3, 3, 3)).astype(np.float32)
+        w = rng.normal(size=(2, 3, 2, 2, 2)).astype(np.float32)
+        # numpy oracle: scatter-accumulate each input voxel x kernel
+        out = np.zeros((1, 3, 4, 4, 4), np.float32)
+        for ci in range(2):
+            for d in range(3):
+                for i in range(3):
+                    for j in range(3):
+                        out[0, :, d:d+2, i:i+2, j:j+2] += \
+                            x[0, ci, d, i, j] * w[ci]
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-3)
+        self.check_grad(["input", "filter"], "output",
+                        max_relative_error=0.02)
+
+
+def test_print_op_passthrough(capsys):
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    helper = fluid.layers.nn.LayerHelper("print", **{})
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="print", inputs={"In": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"message": "dbg", "print_tensor_name": True})
+    loss = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (l,) = exe.run(fluid.default_main_program(),
+                   feed={"x": np.ones((2, 3), np.float32)},
+                   fetch_list=[loss])
+    np.testing.assert_allclose(np.asarray(l), [1.0], atol=1e-6)
+    assert "dbg" in capsys.readouterr().out
+
+
+class TestConv2DTranspose(OpTest):
+    """Pins the fixed conv2d_transpose semantics (out = (in-1)*s + k - 2p)
+    with distinct in/out channel counts — the old IOHW spec only ever
+    accepted square channels and computed a forward conv for p=0."""
+
+    op_type = "conv2d_transpose"
+
+    def setup(self):
+        rng = np.random.RandomState(8)
+        x = rng.normal(size=(1, 2, 3, 3)).astype(np.float32)
+        w = rng.normal(size=(2, 3, 2, 2)).astype(np.float32)
+        out = np.zeros((1, 3, 4, 4), np.float32)
+        for ci in range(2):
+            for i in range(3):
+                for j in range(3):
+                    out[0, :, i:i+2, j:j+2] += x[0, ci, i, j] * w[ci]
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0]}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-3)
+        self.check_grad(["input", "filter"], "output",
+                        max_relative_error=0.02)

@@ -70,3 +70,50 @@ def test_program_clone_for_test_strips_backward():
     assert "sgd" not in types
     assert not any(t.endswith("_grad") for t in types)
     assert "mul" in types
+
+
+def test_scope_var_uninitialized_faults():
+    """Scope.var creates an UNINITIALIZED slot (ref scope.h Scope::Var);
+    reading before set must fault instead of silently yielding zeros."""
+    import pytest
+
+    scope = fluid.Scope()
+    v = scope.var("fresh")
+    with pytest.raises(ValueError, match="holds no tensor"):
+        np.asarray(v.get_tensor())
+    v.get_tensor().set(np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(v.get_tensor()), [1, 1])
+
+
+def test_profiler_aggregates_and_timeline(tmp_path, capsys):
+    """Profiler prints the per-event aggregate table (ref
+    platform/profiler.h:116 EnableProfiler tables) and tools/timeline.py
+    converts the event log to a chrome trace."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ppath = str(tmp_path / "profile.json")
+    with fluid.profiler.profiler("All", "total", ppath):
+        for _ in range(3):
+            exe.run(fluid.default_main_program(),
+                    feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[y])
+    out = capsys.readouterr().out
+    assert "executor_run" in out and "Calls" in out
+
+    log = json.loads(open(ppath).read())
+    assert len(log["events"]) >= 3
+    tpath = str(tmp_path / "timeline.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable,
+                        os.path.join(repo, "tools", "timeline.py"),
+                        "--profile_path", ppath, "--timeline_path", tpath],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    trace = json.loads(open(tpath).read())
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])

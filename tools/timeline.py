@@ -1,0 +1,72 @@
+"""Convert a paddle_tpu profile event log to a chrome://tracing JSON file.
+
+ref: tools/timeline.py (_ChromeTraceFormatter :36, Timeline :115) — the
+reference converts its profiler proto into the Chrome trace-event format;
+this converts the JSON event log written by
+``fluid.profiler.stop_profiler(profile_path=...)``.  The device-side trace
+(XLA ops) lives in the jax trace_dir referenced by the log and opens in
+TensorBoard/perfetto directly.
+
+Usage: python tools/timeline.py --profile_path /tmp/profile \
+                                --timeline_path /tmp/timeline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+class ChromeTraceFormatter:
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def emit_pid(self, name, pid):
+        self._metadata.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "args": {"name": name}})
+
+    def emit_region(self, timestamp, duration, pid, tid, category, name,
+                    args=None):
+        self._events.append({"ph": "X", "cat": category, "ts": timestamp,
+                             "dur": duration, "pid": pid, "tid": tid,
+                             "name": name, "args": args or {}})
+
+    def format_to_string(self, pretty=False):
+        trace = {"traceEvents": self._metadata + self._events}
+        return json.dumps(trace, indent=4 if pretty else None,
+                          separators=None if pretty else (",", ":"))
+
+
+class Timeline:
+    def __init__(self, events):
+        self._events = events
+        self._chrome = ChromeTraceFormatter()
+
+    def generate_chrome_trace(self) -> str:
+        self._chrome.emit_pid("paddle_tpu:host", 0)
+        for ev in self._events:
+            self._chrome.emit_region(ev["ts"], ev["dur"], 0, 0, "Op",
+                                     ev["name"])
+        return self._chrome.format_to_string()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--profile_path", required=True,
+                   help="JSON written by fluid.profiler.stop_profiler")
+    p.add_argument("--timeline_path", required=True,
+                   help="chrome://tracing output file")
+    args = p.parse_args()
+    with open(args.profile_path) as f:
+        log = json.load(f)
+    tl = Timeline(log.get("events", []))
+    with open(args.timeline_path, "w") as f:
+        f.write(tl.generate_chrome_trace())
+    if log.get("trace_dir"):
+        print(f"device trace (open in TensorBoard/perfetto): "
+              f"{log['trace_dir']}")
+
+
+if __name__ == "__main__":
+    main()
