@@ -33,7 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 MODELS = ["mnist", "resnet", "vgg", "se_resnext", "stacked_dynamic_lstm",
-          "machine_translation"]
+          "machine_translation", "deepfm", "bert"]
 
 
 def parse_args(argv=None):
@@ -113,6 +113,31 @@ def _build(args):
             return {"words": words,
                     "label": rng.randint(0, 2, size=(bs, 1)).astype(np.int64)}
         return feed, loss, ("stacked_dynamic_lstm", "words/sec", bs * seq)
+    if args.model == "deepfm":
+        # BASELINE config #4: sparse CTR
+        fields, vocab = 26, (100000 if args.device != "CPU" else 500)
+        feats, label, predict, loss = models.deepfm.build(
+            num_fields=fields, vocab_size=vocab,
+            embed_dim=16 if args.device != "CPU" else 8, lr=lr)
+        feed = lambda rng: {
+            "feats": rng.randint(0, vocab,
+                                 size=(bs, fields)).astype(np.int64),
+            "label": (rng.uniform(size=(bs, 1)) < 0.3).astype(np.float32)}
+        return feed, loss, ("deepfm_ctr", "examples/sec", bs)
+    if args.model == "bert":
+        # BASELINE config #5: BERT-style pretraining
+        from paddle_tpu.models import bert as bert_m
+
+        cfg = (bert_m.base_config() if args.device != "CPU"
+               else bert_m.tiny_config())
+        seq = 128 if args.device != "CPU" else 32
+        n_mask = max(1, seq // 8)
+        outs = bert_m.build(cfg, seq_len=seq, n_mask=n_mask, lr=lr)
+        loss = outs[5]
+
+        def feed(rng):
+            return bert_m.synthetic_batch(cfg, bs, seq, n_mask, rng)
+        return feed, loss, (f"bert_{cfg.name}", "tokens/sec", bs * seq)
     if args.model == "machine_translation":
         from paddle_tpu.models import transformer as trf
 

@@ -6,8 +6,9 @@ generator with the real shapes/dtypes/cardinalities when the cached copy is
 absent — enough for the train-loop, checkpoint, and benchmark harnesses.
 """
 
-from . import (cifar, common, flowers, imdb, imikolov, mnist, movielens,
-               uci_housing, wmt16)
+from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt16)
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
-           "wmt16", "flowers", "common"]
+           "wmt16", "flowers", "conll05", "sentiment", "voc2012", "mq2007",
+           "common"]

@@ -45,6 +45,8 @@ from .lod_tensor import (LoDTensor, create_lod_tensor,
 from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)
+from . import evaluator
+from . import debugger
 
 Tensor = framework.Variable
 

@@ -5,7 +5,7 @@ from __future__ import annotations
 from ..initializer import ConstantInitializer
 from ..layer_helper import LayerHelper
 
-__all__ = ["accuracy", "auc"]
+__all__ = ["accuracy", "auc", "chunk_eval"]
 
 
 def accuracy(input, label, k=1, correct=None, total=None):
@@ -50,3 +50,27 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1):
                  "StatNegOut": [stat_neg]},
         attrs={"curve": curve, "num_thresholds": num_thresholds})
     return auc_out, [stat_pos, stat_neg]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """ref: layers/nn.py chunk_eval — per-batch chunk P/R/F1 + raw counts
+    for a running evaluator."""
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    num_infer = helper.create_variable_for_type_inference("int64")
+    num_label = helper.create_variable_for_type_inference("int64")
+    num_correct = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [num_infer],
+                 "NumLabelChunks": [num_label],
+                 "NumCorrectChunks": [num_correct]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, num_infer, num_label, num_correct

@@ -29,6 +29,7 @@ __all__ = [
     "im2sequence", "maxout", "relu", "log", "crop", "mean_iou",
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
+    "ring_attention",
     "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
     "edit_distance", "ctc_greedy_decoder",
 ]
@@ -1150,3 +1151,21 @@ def ctc_greedy_decoder(input, blank, name=None):
         outputs={"Output": [ctc_out]},
         attrs={"merge_repeated": True, "blank": blank})
     return ctc_out
+
+
+def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
+                   name=None):
+    """Sequence-parallel attention (TPU-native capability beyond the
+    reference — see parallel/ring_attention.py).  q, k, v: [B, H, T, D].
+    Under a mesh with an `sp` axis the sequence dim shards across devices
+    and K/V rotate the ICI ring; single-device it equals full softmax
+    attention."""
+    helper = LayerHelper("ring_attention", **locals())
+    out = helper.create_variable_for_type_inference(helper.input_dtype("q"))
+    out.shape = tuple(q.shape)
+    helper.append_op(
+        type="ring_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": float(scale or 0.0),
+               "sp_axis": sp_axis})
+    return out

@@ -19,4 +19,5 @@ from . import (  # noqa: F401
     struct_loss_ops,
     detection_ops,
     quant_ops,
+    attention_ops,
 )

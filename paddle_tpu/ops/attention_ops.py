@@ -1,0 +1,35 @@
+"""Attention ops — including sequence-parallel ring attention, a
+first-class TPU capability the reference lacks (SURVEY.md §5.7: SP/CP
+"Absent"; its sequence story is LoD packing on one device).
+
+``ring_attention`` is mesh-aware: traced under a ShardedTrainStep whose
+mesh has an "sp" axis, it runs the ppermute ring (parallel/ring_attention
+.py) over ICI; traced single-device (plain Executor) it degrades to the
+mathematically identical full-softmax attention, so programs are portable
+across places — the same portability contract the reference gives ops via
+per-place kernels (op_registry.h OpKernelType).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("ring_attention")
+def ring_attention_op(ctx):
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")  # [B, H, T, D]
+    causal = ctx.attr("causal", False)
+    sp_axis = ctx.attr("sp_axis", "sp")
+    scale = ctx.attr("scale", 0.0) or None
+    from ..parallel import ring_attention as ra
+    from ..parallel import spmd
+
+    mesh = spmd.active_mesh()
+    if mesh is not None and sp_axis in mesh.axis_names \
+            and mesh.shape[sp_axis] > 1:
+        out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale)
+    else:
+        out = ra.full_attention(q, k, v, causal, scale)
+    return {"Out": out}

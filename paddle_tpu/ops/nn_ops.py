@@ -402,17 +402,24 @@ def max_pool3d_with_index(ctx):
     return {"Out": out, "Mask": idx}
 
 
-@register_grad("max_pool2d_with_index")
-def max_pool2d_with_index_grad(ctx):
+def _pool_with_index_grad(ctx):
+    """Scatter dOut back to each window's argmax position (works for any
+    spatial rank — the Mask holds flat plane indices).  Explicit because
+    the tuple-carrying reduce_window in the forward has no generic vjp."""
     x = ctx.input("X")
     idx = ctx.input("Mask")
     dout = ctx.input("Out@GRAD")
-    n, c, h, w = x.shape
-    dx = jnp.zeros((n, c, h * w), x.dtype)
+    n, c = x.shape[:2]
+    plane = int(np.prod(x.shape[2:]))
+    dx = jnp.zeros((n, c, plane), x.dtype)
     flat_idx = idx.reshape(n, c, -1).astype(jnp.int64)
     dx = dx.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
                flat_idx].add(dout.reshape(n, c, -1))
     return {"X@GRAD": dx.reshape(x.shape)}
+
+
+register_grad("max_pool2d_with_index")(_pool_with_index_grad)
+register_grad("max_pool3d_with_index")(_pool_with_index_grad)
 
 
 @register_op("unpool", no_grad_inputs=("Indices",))

@@ -33,6 +33,28 @@ def batch_spec(mesh: Mesh) -> P:
     return P("dp") if "dp" in mesh.axis_names else P(mesh.axis_names[0])
 
 
+# -- active-mesh context: ops whose implementation is mesh-aware (ring
+# attention) discover the mesh their trace is being partitioned over --
+_ACTIVE_MESH: List[Mesh] = []
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH[-1] if _ACTIVE_MESH else None
+
+
+class mesh_scope:
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _ACTIVE_MESH.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _ACTIVE_MESH.pop()
+        return False
+
+
 def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
                       tp_axis: str = "mp", zero1: bool = False,
                       dp_axis: str = "dp") -> Dict[str, P]:
@@ -147,7 +169,8 @@ class ShardedTrainStep:
         plan = self.plan
 
         def fn(feed_vals, state_vals):
-            return trace_block(program, 0, plan, feed_vals, state_vals)
+            with mesh_scope(mesh):
+                return trace_block(program, 0, plan, feed_vals, state_vals)
 
         # input shardings are carried by the placed arrays (place_feed /
         # place_state); pin the output state so updated params keep their
@@ -165,7 +188,11 @@ class ShardedTrainStep:
             out_shardings=out_shardings,
             donate_argnums=(1,) if donate else ())
 
-    def _place(self, val, sh: NamedSharding):
+    def _place(self, val, sh: NamedSharding, from_full: bool = False):
+        """from_full=True: ``val`` is the FULL global value on every host
+        (state vars after identical init) — sharded specs slice it.
+        from_full=False: ``val`` is this process's LOCAL piece (feeds) —
+        sharded specs concatenate across processes."""
         if isinstance(val, jax.Array) and getattr(val, "sharding", None) == sh:
             return val
         if self.multihost:
@@ -174,13 +201,19 @@ class ShardedTrainStep:
             from . import multihost as mh
 
             arr = np.asarray(val)
-            if sh.spec == P():
-                # Replicated state must be bit-identical across hosts;
-                # broadcast process 0's value rather than trusting per-host
-                # init (ref: parallel_executor.cc:234 BCastParamsToDevices).
+            if sh.spec == P() or from_full:
+                # State must be bit-identical across hosts; broadcast
+                # process 0's value rather than trusting per-host init
+                # (ref: parallel_executor.cc:234 BCastParamsToDevices).
                 from jax.experimental import multihost_utils as mhu
 
                 arr = np.asarray(mhu.broadcast_one_to_all(arr))
+            if from_full and sh.spec != P():
+                # full value everywhere + sharded spec (ZeRO-1 accumulators,
+                # mp weights): each device takes ITS SLICE of the full
+                # array — host_local concatenation would inflate the shape
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
             return mh.host_local_to_global(arr, self.mesh, sh.spec)
         return jax.device_put(jnp.asarray(val), sh)
 
@@ -193,13 +226,13 @@ class ShardedTrainStep:
             if val is _MISSING:
                 raise RuntimeError(f"state var {name} missing from scope")
             sh = NamedSharding(self.mesh, self.specs.get(name, P()))
-            state[name] = self._place(val, sh)
+            state[name] = self._place(val, sh, from_full=True)
         if self.plan.needs_rng:
             rk = scope.get(RNG_STATE_VAR, _MISSING)
             if rk is _MISSING:
                 rk = jax.random.PRNGKey(self.program.random_seed or 0)
-            state[RNG_STATE_VAR] = self._place(rk,
-                                               NamedSharding(self.mesh, P()))
+            state[RNG_STATE_VAR] = self._place(
+                rk, NamedSharding(self.mesh, P()), from_full=True)
         return state
 
     def place_feed(self, feed: Dict[str, np.ndarray]):

@@ -324,3 +324,33 @@ class TestConv2DTranspose(OpTest):
         self.check_output(atol=1e-4, rtol=1e-3)
         self.check_grad(["input", "filter"], "output",
                         max_relative_error=0.02)
+
+
+def test_max_pool3d_with_index_grad():
+    """3-D indexed pooling must be differentiable (its 2-D twin regressed
+    without an explicit grad — the tuple reduce_window has no generic vjp)."""
+    from paddle_tpu.fluid.framework import Program, program_guard
+    from paddle_tpu.fluid.backward import calc_gradient
+    import paddle_tpu.fluid as fl
+
+    main, start = Program(), Program()
+    with program_guard(main, start):
+        b = main.global_block()
+        b.create_var(name="x3", shape=(1, 1, 4, 4, 4), dtype="float32")
+        xv = b.var("x3"); xv.is_data = True; xv.stop_gradient = False
+        out = b.create_var(name="o3", shape=(1, 1, 2, 2, 2), dtype="float32")
+        msk = b.create_var(name="m3", shape=(1, 1, 2, 2, 2), dtype="int64")
+        b.append_op(type="max_pool3d_with_index",
+                    inputs={"X": ["x3"]},
+                    outputs={"Out": ["o3"], "Mask": ["m3"]},
+                    attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                           "paddings": [0, 0, 0]})
+        calc_gradient(b.var("o3"), [b.var("x3")])
+        exe = fl.Executor(fl.CPUPlace())
+        rng = np.random.RandomState(0)
+        x = rng.permutation(64).reshape(1, 1, 4, 4, 4).astype(np.float32)
+        (dx,) = exe.run(main, feed={"x3": x}, fetch_list=["x3@GRAD"])
+        dx = np.asarray(dx)
+        # exactly one 1 per pooling window, at the max position
+        assert dx.sum() == 8 and set(np.unique(dx)) == {0.0, 1.0}
+        assert (dx.reshape(-1)[np.argsort(x.reshape(-1))[-1]]) == 1.0

@@ -1,0 +1,82 @@
+"""In-graph evaluators (ref: python/paddle/fluid/evaluator.py:44,126,217 —
+running counters live as program state, reset/eval run tiny aux programs)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import evaluator
+
+
+def test_accuracy_evaluator_accumulates_and_resets():
+    img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(input=img, size=3, act="softmax")
+    ev = evaluator.Accuracy(input=pred, label=label)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    correct, total = 0, 0
+    for _ in range(3):
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = rng.randint(0, 3, size=(16, 1)).astype(np.int64)
+        (acc_b,) = exe.run(fluid.default_main_program(),
+                           feed={"img": x, "label": y},
+                           fetch_list=[ev.metrics[0]])
+        correct += float(np.asarray(acc_b).reshape(-1)[0]) * 16
+        total += 16
+    run_acc = float(np.asarray(ev.eval(exe)).reshape(-1)[0])
+    np.testing.assert_allclose(run_acc, correct / total, rtol=1e-5)
+    ev.reset(exe)
+    assert float(np.asarray(
+        fluid.global_scope().get(ev.total.name)).reshape(-1)[0]) == 0.0
+
+
+def test_chunk_evaluator_running_f1():
+    # IOB scheme, 1 chunk type: tags B=0, I=1, O=2
+    seq = fluid.layers.data(name="seq", shape=[1], dtype="int64",
+                            lod_level=1)
+    lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                            lod_level=1)
+    ev = evaluator.ChunkEvaluator(input=seq, label=lab,
+                                  chunk_scheme="IOB", num_chunk_types=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # seq:  B I O B  -> chunks {(0,0-1),(0,3)}
+    # lab:  B I O O  -> chunks {(0,0-1)}         => correct 1
+    inf = np.array([[0], [1], [2], [0]], np.int64)
+    ref = np.array([[0], [1], [2], [2]], np.int64)
+    lod = [[4]]
+    exe.run(fluid.default_main_program(),
+            feed={"seq": fluid.create_lod_tensor(inf, lod, fluid.CPUPlace()),
+                  "lab": fluid.create_lod_tensor(ref, lod, fluid.CPUPlace())},
+            fetch_list=[])
+    p, r, f1 = ev.eval(exe)
+    np.testing.assert_allclose(float(np.asarray(p).reshape(-1)[0]), 0.5,
+                               atol=1e-6)   # 1 correct of 2 inferred
+    np.testing.assert_allclose(float(np.asarray(r).reshape(-1)[0]), 1.0,
+                               atol=1e-6)   # 1 correct of 1 labeled
+    np.testing.assert_allclose(float(np.asarray(f1).reshape(-1)[0]), 2/3,
+                               atol=1e-5)
+
+
+def test_edit_distance_evaluator():
+    hyp = fluid.layers.data(name="hyp", shape=[1], dtype="int64",
+                            lod_level=1)
+    ref = fluid.layers.data(name="ref", shape=[1], dtype="int64",
+                            lod_level=1)
+    ev = evaluator.EditDistance(input=hyp, label=ref)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    h = np.array([[1], [2], [3], [1], [2]], np.int64)   # seqs: [1,2,3],[1,2]
+    r = np.array([[1], [2], [4], [1], [2]], np.int64)   # seqs: [1,2,4],[1,2]
+    lod = [[3, 2]]
+    exe.run(fluid.default_main_program(),
+            feed={"hyp": fluid.create_lod_tensor(h, lod, fluid.CPUPlace()),
+                  "ref": fluid.create_lod_tensor(r, lod, fluid.CPUPlace())},
+            fetch_list=[])
+    avg, err_ratio = ev.eval(exe)
+    # distances normalized by ref len: [1/3, 0]; avg = 1/6; 1 of 2 errored
+    np.testing.assert_allclose(float(np.asarray(avg).reshape(-1)[0]), 1/6,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        float(np.asarray(err_ratio).reshape(-1)[0]), 0.5, atol=1e-6)

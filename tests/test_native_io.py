@@ -207,3 +207,28 @@ def test_recordio_dataset_pipeline(tmp_path):
             reader.reset()
             break
     assert batches == 4  # 20 samples / bs 5
+
+
+def test_dataset_breadth_shapes():
+    """Every dataset module yields the reference's tuple shapes (synthetic
+    fallbacks; ref python/paddle/dataset/)."""
+    from paddle_tpu import dataset as D
+
+    w, v, l = D.conll05.get_dict()
+    s = next(D.conll05.test()())
+    assert len(s) == 9 and len(s[0]) == len(s[8])
+    ids, lab = next(D.sentiment.train()())
+    assert lab in (0, 1) and all(0 <= i < len(D.sentiment.get_word_dict())
+                                 for i in ids)
+    img, mask = next(D.voc2012.train()())
+    assert img.shape[0] == 3 and mask.shape == img.shape[1:]
+    hi, lo = next(D.mq2007.train("pairwise")())
+    assert hi.shape == lo.shape == (46,)
+    f, sc = next(D.mq2007.train("pointwise")())
+    assert f.shape == (46,)
+    u, g, a, j, m, cats, title, score = next(D.movielens.train()())
+    assert 1 <= u <= D.movielens.max_user_id() and 1.0 <= score <= 5.0
+    src, trg, nxt = next(D.wmt16.train(50, 50)())
+    assert src[0] == D.wmt16.START_ID and len(trg) == len(nxt)
+    img, lab2 = next(D.flowers.train()())
+    assert img.shape == (3 * 64 * 64,)
