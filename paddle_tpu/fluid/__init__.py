@@ -47,6 +47,7 @@ from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)
 from . import evaluator
 from . import debugger
+from . import ir
 
 Tensor = framework.Variable
 

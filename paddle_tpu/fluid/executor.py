@@ -17,6 +17,7 @@ so XLA can alias their buffers (true in-place update on TPU HBM).
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -489,7 +490,9 @@ class Executor:
                tuple(sorted(feed_lods.items())),
                tuple(sorted(state_lods.items())),
                self.place.device_type,
-               _amp.compute_dtype())  # amp toggle invalidates compiled fns
+               # execution-mode toggles invalidate compiled fns
+               _amp.compute_dtype(),
+               os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)

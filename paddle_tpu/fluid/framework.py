@@ -392,6 +392,30 @@ class Program:
         p = self.clone(for_test=True)
         return p
 
+    # ---- serialization (ref: ProgramDesc proto round-trip —
+    # framework.proto:190; the on-wire format here is a versioned pickle,
+    # which save/load_inference_model already uses for __model__) ----
+    SERIAL_VERSION = 1
+
+    def serialize_to_string(self) -> bytes:
+        import pickle
+
+        return pickle.dumps({"version": self.SERIAL_VERSION,
+                             "program": self})
+
+    @staticmethod
+    def parse_from_string(data: bytes) -> "Program":
+        import pickle
+
+        payload = pickle.loads(data)
+        if isinstance(payload, Program):  # pre-versioned blobs
+            return payload
+        if payload.get("version") != Program.SERIAL_VERSION:
+            raise ValueError(
+                f"program blob version {payload.get('version')} != "
+                f"{Program.SERIAL_VERSION}")
+        return payload["program"]
+
     def to_string(self, throw_on_error=False, with_details=False):
         return "\n".join(b.to_string() for b in self.blocks)
 

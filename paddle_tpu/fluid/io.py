@@ -115,7 +115,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     inference_program = main_program.clone(for_test=True)
     inference_program = inference_program._prune(target_vars)
     payload = {
-        "program": inference_program,
+        # versioned program blob (Program.serialize_to_string) so a future
+        # format bump is detectable at load time
+        "program_blob": inference_program.serialize_to_string(),
         "feed_names": list(feeded_var_names),
         "fetch_names": [t.name for t in target_vars],
     }
@@ -131,7 +133,10 @@ def load_inference_model(dirname, executor, model_filename=None,
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "rb") as f:
         payload = pickle.load(f)
-    program: Program = payload["program"]
+    if "program_blob" in payload:
+        program = Program.parse_from_string(payload["program_blob"])
+    else:  # pre-versioned __model__ files
+        program = payload["program"]
     load_params(executor, dirname, program, params_filename)
     fetch_vars = [program.global_block()._var_recursive(n)
                   for n in payload["fetch_names"]]

@@ -30,6 +30,22 @@ def ring_attention_op(ctx):
     if mesh is not None and sp_axis in mesh.axis_names \
             and mesh.shape[sp_axis] > 1:
         out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale)
+    elif _use_flash():
+        from .pallas_flash import flash_attention
+
+        out = flash_attention(q, k, v, scale, causal)
     else:
         out = ra.full_attention(q, k, v, causal, scale)
     return {"Out": out}
+
+
+def _use_flash() -> bool:
+    """Opt-in Pallas flash-attention kernel (PADDLE_TPU_FLASH=1).
+
+    Off by default because tunneled TPU transports (axon remote-compile)
+    cannot compile Mosaic kernels; on a real TPU VM the kernel compiles
+    natively and streams K/V through VMEM (ops/pallas_flash.py)."""
+    import os
+
+    return os.environ.get("PADDLE_TPU_FLASH", "").strip().lower() \
+        in ("1", "true")

@@ -1,0 +1,143 @@
+"""IR Graph/Pass infrastructure (ref: framework/ir/ — graph.h:63 Graph,
+pass.h:32 Pass registry, conv_bn fold à la inference_transpiler.py, and
+prune.cc / ProgramDesc serialization round-trip)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import ir
+from paddle_tpu.fluid.framework import Program
+
+
+def test_graph_structure_and_roundtrip():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3, act="relu")
+    loss = fluid.layers.mean(h)
+    g = ir.Graph(fluid.default_main_program())
+    muls = g.ops("mul")
+    assert len(muls) == 1
+    # def-use edges: mul reads x and the weight, feeds the add
+    in_names = {vn.name for vn in muls[0].inputs}
+    assert "x" in in_names
+    n_ops = len(fluid.default_main_program().global_block().ops)
+    g.to_program()
+    assert len(fluid.default_main_program().global_block().ops) == n_ops
+
+
+def test_dead_op_elimination():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    live = fluid.layers.fc(input=x, size=2)
+    dead = fluid.layers.fc(input=x, size=7)  # never consumed, not fetched
+    loss = fluid.layers.mean(live)
+    prog = fluid.default_main_program()
+    # mark the dead fc's outputs non-persistable temps (they are)
+    n_before = len(prog.global_block().ops)
+    ir.apply_pass(prog, "dead_op_elimination", targets=[loss])
+    n_after = len(prog.global_block().ops)
+    assert n_after < n_before
+    remaining = [op.type for op in prog.global_block().ops]
+    # the live path survives
+    assert "mul" in remaining and "mean" in remaining
+    # the program still runs and produces the same loss
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (l,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[loss])
+    assert np.isfinite(np.asarray(l)).all()
+
+
+def test_conv_bn_fuse_preserves_outputs():
+    """InferenceTranspiler's BN fold: the rewritten program (conv with
+    rescaled weights + bias add, no batch_norm op) must produce the same
+    inference outputs."""
+    fluid.default_startup_program().random_seed = 5
+    img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+    c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                            padding=1, bias_attr=False)
+    out = fluid.layers.batch_norm(input=c, act=None)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # push running stats away from init so the fold is non-trivial
+    scope = fluid.global_scope()
+    rng = np.random.RandomState(0)
+    for op in prog.global_block().ops:
+        if op.type == "batch_norm":
+            scope.set(op.inputs["Mean"][0],
+                      rng.normal(0, 0.5, size=(4,)).astype(np.float32))
+            scope.set(op.inputs["Variance"][0],
+                      rng.uniform(0.5, 2.0, size=(4,)).astype(np.float32))
+            scope.set(op.inputs["Scale"][0],
+                      rng.uniform(0.5, 1.5, size=(4,)).astype(np.float32))
+            scope.set(op.inputs["Bias"][0],
+                      rng.normal(0, 0.2, size=(4,)).astype(np.float32))
+
+    infer = prog.clone(for_test=True)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    (ref,) = exe.run(infer, feed={"img": x}, fetch_list=[out])
+
+    t = fluid.InferenceTranspiler()
+    t.transpile(infer, fluid.CPUPlace(), scope)
+    types = [op.type for op in infer.global_block().ops]
+    assert "batch_norm" not in types, types
+    assert "elementwise_add" in types
+    (folded,) = exe.run(infer, feed={"img": x}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(folded), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_program_serialize_prune_roundtrip():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3, act="relu")
+    loss = fluid.layers.mean(h)
+    prog = fluid.default_main_program()
+
+    blob = prog.serialize_to_string()
+    back = Program.parse_from_string(blob)
+    assert [op.type for op in back.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+
+    pruned = prog._prune([h])
+    kept = [op.type for op in pruned.global_block().ops]
+    assert "mean" not in kept and "mul" in kept
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (a,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[h])
+    (b,) = exe.run(back, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[h])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_pass_registry_unknown_raises():
+    with pytest.raises(KeyError, match="no pass named"):
+        ir.get_pass("nonexistent_pass")
+
+
+def test_dead_op_elimination_requires_targets():
+    with pytest.raises(ValueError, match="requires explicit targets"):
+        ir.get_pass("dead_op_elimination")
+
+
+def test_conv_bn_fuse_skips_shared_filter():
+    """Two convs sharing one filter var: folding one BN's stats into the
+    shared weight would corrupt the sibling — the pass must skip both."""
+    fluid.default_startup_program().random_seed = 8
+    img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+    w = fluid.ParamAttr(name="shared_w")
+    c1 = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                             padding=1, bias_attr=False, param_attr=w)
+    c2 = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                             padding=1, bias_attr=False, param_attr=w)
+    b1 = fluid.layers.batch_norm(input=c1)
+    b2 = fluid.layers.batch_norm(input=c2)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    infer = prog.clone(for_test=True)
+    t = fluid.InferenceTranspiler()
+    t.transpile(infer, fluid.CPUPlace())
+    types = [op.type for op in infer.global_block().ops]
+    assert types.count("batch_norm") == 2, types  # untouched
