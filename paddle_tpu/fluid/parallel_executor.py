@@ -18,6 +18,7 @@ Loss scaling: the reference writes a 1/N constant per device
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 import jax
@@ -131,9 +132,15 @@ class ParallelExecutor:
                     arr = arr.astype(want)
             feed_arrays[k] = arr
 
+        from . import amp as _amp
+
         key = (id(self._program), self._program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
-                            for k, v in feed_arrays.items())))
+                            for k, v in feed_arrays.items())),
+               # execution-mode toggles invalidate compiled steps (same
+               # contract as Executor.run's cache key)
+               _amp.compute_dtype(),
+               os.environ.get("PADDLE_TPU_FLASH", ""))
         step = self._cache.get(key)
         if step is None:
             zero1 = (self._build_strategy.reduce_strategy ==

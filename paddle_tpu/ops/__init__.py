@@ -20,4 +20,5 @@ from . import (  # noqa: F401
     detection_ops,
     quant_ops,
     attention_ops,
+    misc_ops,
 )

@@ -31,6 +31,8 @@ EAGER_OPS = {
     # data-dependent output count (LoD out) — host postprocessing, like the
     # reference's CPU-pinned kernel (multiclass_nms_op.cc)
     "multiclass_nms",
+    # filesystem side effects need concrete values (save_op.cc etc.)
+    "save", "load", "save_combine", "load_combine", "delete_var",
 }
 
 

@@ -50,26 +50,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    logits = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)   # [bq, bk]
-    if causal:
-        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-        kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logits = jnp.where(qpos >= kpos, logits, jnp.float32(NEG_INF))
-    m = m_ref[:]
-    l = l_ref[:]
-    m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
-    p = jnp.exp(logits - m_new)
-    corr = jnp.exp(m - m_new)
-    m_ref[:] = m_new
-    l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    # under causal masking, blocks strictly above the diagonal contribute
+    # nothing — skip both MXU contractions for them (~2x FLOPs at long T)
+    live = (k_off <= q_off + jnp.int32(bq - 1)) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, bk]
+        if causal:
+            qpos = q_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                    logits.shape, 0)
+            kpos = k_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                    logits.shape, 1)
+            logits = jnp.where(qpos >= kpos, logits, jnp.float32(NEG_INF))
+        m = m_ref[:]
+        l = l_ref[:]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_k - 1)
     def _flush():

@@ -1,0 +1,169 @@
+"""Op-parity stragglers (ops/misc_ops.py; ref minus_op.cc, cos_sim_op.*,
+norm_op.*, bilinear_tensor_product_op.*, conv_shift_op.*, label_smooth_op.*,
+flatten2/squeeze2/unsqueeze2, SelectedRows utils, in-graph save/load)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops.registry import REGISTRY, ExecContext
+from op_test import OpTest
+
+
+def _run(op_type, inputs, outputs_spec, attrs=None, rng=None):
+    ctx = ExecContext(op_type, inputs, outputs_spec, attrs or {}, rng)
+    return REGISTRY[op_type].fn(ctx)
+
+
+class TestMinus(OpTest):
+    op_type = "minus"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        y = rng.normal(size=(4, 5)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestCosSim(OpTest):
+    op_type = "cos_sim"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        y = rng.normal(size=(4, 8)).astype(np.float32)
+        xn = np.linalg.norm(x, axis=1, keepdims=True)
+        yn = np.linalg.norm(y, axis=1, keepdims=True)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x * y).sum(1, keepdims=True) / (xn * yn),
+                        "XNorm": xn, "YNorm": yn}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5)
+
+
+class TestNorm(OpTest):
+    op_type = "norm"
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        n = np.sqrt((x * x).sum(1, keepdims=True) + 1e-10)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "epsilon": 1e-10}
+        self.outputs = {"Out": x / n, "Norm": n}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5)
+        self.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+class TestBilinearTensorProduct(OpTest):
+    op_type = "bilinear_tensor_product"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        y = rng.normal(size=(4, 5)).astype(np.float32)
+        w = rng.normal(size=(2, 3, 5)).astype(np.float32)
+        out = np.einsum("nm,omp,np->no", x, w, y)
+        self.inputs = {"X": x, "Y": y, "Weight": w}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+        self.check_grad(["x", "y", "weight"], "out",
+                        max_relative_error=0.02)
+
+
+def test_conv_shift_circular():
+    x = np.arange(8, dtype=np.float32).reshape(1, 8)
+    y = np.array([[1.0, 0.0, 0.0]], np.float32)  # identity at offset -1
+    out = np.asarray(_run("conv_shift",
+                          {"X": [jnp.asarray(x)], "Y": [jnp.asarray(y)]},
+                          {"Out": ["o"]})["Out"])
+    # kernel index 0 reads X[(j - 1) mod 8]
+    np.testing.assert_allclose(out[0], np.roll(x[0], 1))
+
+
+def test_label_smooth_matches_formula():
+    x = np.eye(4, dtype=np.float32)
+    out = np.asarray(_run("label_smooth", {"X": [jnp.asarray(x)],
+                                           "PriorDist": [None]},
+                          {"Out": ["o"]}, {"epsilon": 0.1})["Out"])
+    np.testing.assert_allclose(out, 0.9 * x + 0.1 / 4, atol=1e-6)
+
+
+def test_shape2_variants_emit_xshape():
+    x = jnp.zeros((2, 1, 3))
+    r = _run("squeeze2", {"X": [x]}, {"Out": ["o"], "XShape": ["xs"]},
+             {"axes": [1]})
+    assert r["Out"].shape == (2, 3) and r["XShape"].shape == (0, 2, 1, 3)
+    r = _run("unsqueeze2", {"X": [x]}, {"Out": ["o"], "XShape": ["xs"]},
+             {"axes": [0]})
+    assert r["Out"].shape == (1, 2, 1, 3)
+    r = _run("flatten2", {"X": [x]}, {"Out": ["o"], "XShape": ["xs"]},
+             {"axis": 1})
+    assert r["Out"].shape == (2, 3)
+
+
+def test_selected_rows_utils():
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+
+    sr = SelectedRows(jnp.array([1, 7, 4]),
+                      jnp.array([[1.0], [2.0], [3.0]]), height=10)
+    rows = np.asarray(_run("extract_rows", {"X": [sr]},
+                           {"Out": ["o"]})["Out"])
+    np.testing.assert_array_equal(rows.reshape(-1), [1, 7, 4])
+
+    parts = _run("split_selected_rows", {"X": [sr]},
+                 {"Out": ["a", "b"]},
+                 {"height_sections": [5, 5]})["Out"]
+    d0 = np.asarray(parts[0].to_dense())
+    d1 = np.asarray(parts[1].to_dense())
+    assert d0[1, 0] == 1.0 and d0[4, 0] == 3.0
+    assert d1[2, 0] == 2.0  # row 7 -> local row 2 of the second shard
+
+    merged = np.asarray(_run(
+        "merge_ids",
+        {"Ids": [jnp.array([3, 9, 5])],
+         "Rows": [jnp.array([3, 5]), jnp.array([9])],
+         "X": [jnp.array([[30.0], [50.0]]), jnp.array([[90.0]])]},
+        {"Out": ["o"]})["Out"])
+    np.testing.assert_allclose(merged.reshape(-1), [30, 90, 50])
+
+
+def test_save_load_ops_in_program(tmp_path):
+    """In-graph save then load round-trips through the filesystem (ref
+    save_op.cc:36/load_op.cc:24) inside the eager-island executor."""
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.scale(x, scale=2.0)
+    path = str(tmp_path / "var.npy")
+    helper = LayerHelper("save_load", **{})
+    helper.append_op(type="save", inputs={"X": [h]}, outputs={},
+                     attrs={"file_path": path})
+    loaded = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="load", inputs={}, outputs={"Out": [loaded]},
+                     attrs={"file_path": path})
+    out = fluid.layers.scale(loaded, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xa = np.arange(4, dtype=np.float32).reshape(1, 4)
+    (o,) = exe.run(fluid.default_main_program(), feed={"x": xa},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), xa * 2.0)
+    import os
+
+    assert os.path.exists(path)
