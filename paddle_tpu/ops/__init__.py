@@ -21,4 +21,5 @@ from . import (  # noqa: F401
     quant_ops,
     attention_ops,
     misc_ops,
+    rcnn_ops,
 )

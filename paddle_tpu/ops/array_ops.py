@@ -33,6 +33,10 @@ EAGER_OPS = {
     "multiclass_nms",
     # filesystem side effects need concrete values (save_op.cc etc.)
     "save", "load", "save_combine", "load_combine", "delete_var",
+    # Faster-RCNN sampling/proposal ops: data-dependent counts + host RNG
+    # (the reference pins them to CPUPlace too)
+    "generate_proposals", "rpn_target_assign", "generate_proposal_labels",
+    "detection_map",
 }
 
 

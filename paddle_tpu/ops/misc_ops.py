@@ -114,17 +114,19 @@ def random_crop(ctx):
     maxs = jnp.asarray([x.shape[lead + i] - shape[i] for i in range(nd)],
                        jnp.int32)
 
-    def crop_one(xi, k):
+    def crop_nd(xi, k, n_lead):
+        """Crop the trailing nd dims of xi (rank n_lead + nd)."""
         offs = jax.random.randint(k, (nd,), 0, maxs + 1, jnp.int32)
-        starts = [jnp.int32(0)] * (lead - 1) + [offs[i] for i in range(nd)]
-        sizes = list(xi.shape[: lead - 1]) + shape
+        starts = [jnp.int32(0)] * n_lead + [offs[i] for i in range(nd)]
+        sizes = list(xi.shape[:n_lead]) + shape
         return jax.lax.dynamic_slice(xi, starts, sizes)
 
     if lead >= 1:
+        # per-INSTANCE windows over dim 0
         keys = jax.random.split(key, x.shape[0])
-        out = jax.vmap(crop_one)(x, keys)
+        out = jax.vmap(lambda xi, k: crop_nd(xi, k, lead - 1))(x, keys)
     else:
-        out = crop_one(x[None], key)[0]
+        out = crop_nd(x, key, 0)
     return {"Out": out, "SeedOut": jnp.zeros((1,), jnp.int64)}
 
 
@@ -149,7 +151,7 @@ def flatten2(ctx):
 @register_op("squeeze2")
 def squeeze2(ctx):
     x = ctx.input("X")
-    axes = ctx.attr("axes", [])
+    axes = [a % x.ndim for a in (ctx.attr("axes", []) or [])]
     if axes:
         shape = [s for i, s in enumerate(x.shape)
                  if not (i in axes and s == 1)]
@@ -301,7 +303,15 @@ def get_places(ctx):
 
 @register_op("depthwise_conv2d_transpose")
 def depthwise_conv2d_transpose(ctx):
-    # grouped transpose conv with groups == channels; reuse the grouped path
+    # grouped transpose conv with groups defaulting to the CHANNEL count
+    # (matching depthwise_conv2d's default), not 1
+    from .registry import ExecContext
     from .nn_ops import conv2d_transpose
 
-    return conv2d_transpose(ctx)
+    x = ctx.input("Input")
+    attrs = dict(ctx.attrs)
+    if not attrs.get("groups"):
+        attrs["groups"] = int(x.shape[1])
+    sub = ExecContext(ctx.op_type, ctx.inputs, ctx.outputs_spec, attrs,
+                      ctx._rng_box)
+    return conv2d_transpose(sub)

@@ -1,0 +1,151 @@
+"""Faster-RCNN op family (ops/rcnn_ops.py; ref detection/
+generate_proposals_op.cc, rpn_target_assign_op.cc,
+generate_proposal_labels_op.cc, detection_map_op.*)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import REGISTRY, ExecContext
+
+
+def _run(op_type, inputs, outputs_spec, attrs=None):
+    ctx = ExecContext(op_type, inputs, outputs_spec, attrs or {})
+    return REGISTRY[op_type].fn(ctx)
+
+
+def test_generate_proposals_decodes_and_nms():
+    # 1 image, 2x2 feature map, 1 anchor type => 4 anchors
+    anchors = np.array([[0, 0, 15, 15], [16, 0, 31, 15],
+                        [0, 16, 15, 31], [16, 16, 31, 31]], np.float32)
+    scores = np.array([0.9, 0.8, 0.1, 0.7], np.float32) \
+        .reshape(1, 1, 2, 2)
+    deltas = np.zeros((1, 4, 2, 2), np.float32)  # identity decode
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    r = _run("generate_proposals",
+             {"Scores": [jnp.asarray(scores)],
+              "BboxDeltas": [jnp.asarray(deltas)],
+              "ImInfo": [jnp.asarray(im_info)],
+              "Anchors": [jnp.asarray(anchors.reshape(2, 2, 1, 4))],
+              "Variances": [None]},
+             {"RpnRois": ["r"], "RpnRoiProbs": ["p"]},
+             {"pre_nms_topN": 10, "post_nms_topN": 3, "nms_thresh": 0.5,
+              "min_size": 1.0})
+    rois, probs = np.asarray(r["RpnRois"]), np.asarray(r["RpnRoiProbs"])
+    # disjoint anchors -> nothing suppressed; top-3 by score kept
+    assert rois.shape == (3, 4)
+    np.testing.assert_allclose(probs.reshape(-1), [0.9, 0.8, 0.7], atol=1e-6)
+    np.testing.assert_allclose(rois[0], anchors[0], atol=1e-4)
+
+
+def test_rpn_target_assign_sampling():
+    anchors = np.array([[0, 0, 9, 9], [10, 0, 19, 9],
+                        [0, 10, 9, 19], [10, 10, 19, 19],
+                        [30, 30, 39, 39]], np.float32)
+    gt = np.array([[0, 0, 9, 9]], np.float32)  # exactly anchor 0
+    r = _run("rpn_target_assign",
+             {"Anchor": [jnp.asarray(anchors)],
+              "GtBoxes": [jnp.asarray(gt)],
+              "IsCrowd": [None], "ImInfo": [None], "DistMat": [None]},
+             {"LocationIndex": ["l"], "ScoreIndex": ["s"],
+              "TargetLabel": ["t"], "TargetBBox": ["b"]},
+             {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+              "rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+              "use_random": False})
+    loc = np.asarray(r["LocationIndex"])
+    lab = np.asarray(r["TargetLabel"]).reshape(-1)
+    tb = np.asarray(r["TargetBBox"])
+    assert 0 in loc                     # the matching anchor is positive
+    assert set(np.unique(lab)) <= {0, 1}
+    np.testing.assert_allclose(tb[list(loc).index(0)], 0.0, atol=1e-6)
+
+
+def test_generate_proposal_labels_targets():
+    rois = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], np.float32)
+    gt = np.array([[0, 0, 9, 9]], np.float32)
+    gt_cls = np.array([3], np.int64)
+    r = _run("generate_proposal_labels",
+             {"RpnRois": [jnp.asarray(rois)],
+              "GtClasses": [jnp.asarray(gt_cls)],
+              "IsCrowd": [None],
+              "GtBoxes": [jnp.asarray(gt)],
+              "ImInfo": [None]},
+             {"Rois": ["r"], "LabelsInt32": ["l"], "BboxTargets": ["t"],
+              "BboxInsideWeights": ["wi"], "BboxOutsideWeights": ["wo"]},
+             {"batch_size_per_im": 4, "fg_fraction": 0.5, "fg_thresh": 0.5,
+              "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 5,
+              "use_random": False})
+    labels = np.asarray(r["LabelsInt32"]).reshape(-1)
+    t = np.asarray(r["BboxTargets"])
+    wi = np.asarray(r["BboxInsideWeights"])
+    assert 3 in labels  # fg roi got the gt class
+    fg_row = list(labels).index(3)
+    # the fg row's targets live in the class-3 slot and are ~0 (exact match)
+    assert wi[fg_row, 12:16].sum() == 4
+    np.testing.assert_allclose(t[fg_row, 12:16], 0.0, atol=1e-5)
+    # bg rows keep zero weights
+    for j, c in enumerate(labels):
+        if c == 0:
+            assert wi[j].sum() == 0
+
+
+def test_detection_map_perfect_and_half():
+    # image: 2 gt boxes of class 1; detections hit one, miss one
+    gt = np.array([[1, 0, 0, 0, 9, 9], [1, 0, 20, 20, 29, 29]], np.float32)
+    det = np.array([[1, 0.9, 0, 0, 9, 9],       # TP
+                    [1, 0.8, 40, 40, 49, 49]],  # FP
+                   np.float32)
+    r = _run("detection_map",
+             {"DetectRes": [jnp.asarray(det)], "Label": [jnp.asarray(gt)],
+              "HasState": [None], "PosCount": [None], "TruePos": [None],
+              "FalsePos": [None]},
+             {"MAP": ["m"], "AccumPosCount": ["a"], "AccumTruePos": ["b"],
+              "AccumFalsePos": ["c"]},
+             {"overlap_threshold": 0.5, "ap_type": "integral"})
+    m = float(np.asarray(r["MAP"])[0])
+    # AP: precision 1 at recall 0.5, then no more TPs -> integral = 0.5
+    np.testing.assert_allclose(m, 0.5, atol=1e-6)
+
+    det2 = np.array([[1, 0.9, 0, 0, 9, 9],
+                     [1, 0.8, 20, 20, 29, 29]], np.float32)
+    r2 = _run("detection_map",
+              {"DetectRes": [jnp.asarray(det2)], "Label": [jnp.asarray(gt)],
+               "HasState": [None], "PosCount": [None], "TruePos": [None],
+               "FalsePos": [None]},
+              {"MAP": ["m"], "AccumPosCount": ["a"], "AccumTruePos": ["b"],
+               "AccumFalsePos": ["c"]},
+              {"overlap_threshold": 0.5, "ap_type": "integral"})
+    np.testing.assert_allclose(float(np.asarray(r2["MAP"])[0]), 1.0,
+                               atol=1e-6)
+
+
+def test_detection_map_accumulator_chaining():
+    """Dataset-level mAP via state feedback: two batches chained must equal
+    one combined evaluation (ref detection_map_op.h accumulator inputs)."""
+    gt1 = np.array([[1, 0, 0, 0, 9, 9]], np.float32)
+    det1 = np.array([[1, 0.9, 0, 0, 9, 9]], np.float32)     # TP
+    gt2 = np.array([[1, 0, 20, 20, 29, 29]], np.float32)
+    det2 = np.array([[1, 0.8, 40, 40, 49, 49]], np.float32)  # FP
+
+    def run(det, gt, pos=None, tp=None):
+        return _run("detection_map",
+                    {"DetectRes": [jnp.asarray(det)],
+                     "Label": [jnp.asarray(gt)],
+                     "HasState": [None],
+                     "PosCount": [jnp.asarray(pos)] if pos is not None
+                     else [None],
+                     "TruePos": [jnp.asarray(tp)] if tp is not None
+                     else [None],
+                     "FalsePos": [None]},
+                    {"MAP": ["m"], "AccumPosCount": ["a"],
+                     "AccumTruePos": ["b"], "AccumFalsePos": ["c"]},
+                    {"overlap_threshold": 0.5, "ap_type": "integral"})
+
+    r1 = run(det1, gt1)
+    r2 = run(det2, gt2, np.asarray(r1["AccumPosCount"]),
+             np.asarray(r1["AccumTruePos"]))
+    chained = float(np.asarray(r2["MAP"])[0])
+
+    both_gt = np.concatenate([gt1, gt2])
+    both_det = np.concatenate([det1, det2])
+    ref = float(np.asarray(run(both_det, both_gt)["MAP"])[0])
+    np.testing.assert_allclose(chained, ref, atol=1e-6)
