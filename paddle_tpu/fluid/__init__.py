@@ -48,6 +48,7 @@ from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
 from . import evaluator
 from . import debugger
 from . import ir
+from . import contrib
 
 Tensor = framework.Variable
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 import numpy as np
 
 from .registry import register_op
-from .detection_ops import iou_matrix
 
 LOG_MAX_RATIO = float(np.log(1000.0 / 16.0))
 
@@ -127,22 +126,78 @@ def generate_proposals(ctx):
             "RpnRois@LOD": the_lod, "RpnRoiProbs@LOD": the_lod}
 
 
+_SAMPLER_CALLS = [0]
+
+
+def _op_rng(ctx):
+    """Fresh randomness per execution (ref rpn_target_assign_op.cc:346
+    seeds from std::random_device each run).  An explicit nonzero ``seed``
+    attr gives a reproducible-but-still-varying stream (seed + call#)."""
+    _SAMPLER_CALLS[0] += 1
+    seed = ctx.attr("seed", 0)
+    if seed:
+        return np.random.RandomState(int(seed) + _SAMPLER_CALLS[0])
+    return np.random.RandomState()  # OS entropy
+
+
+def _segments(lod, total):
+    """Per-image (start, end) pairs from a LoD, or one segment."""
+    if lod:
+        off = lod[-1]
+        return [(int(off[i]), int(off[i + 1])) for i in range(len(off) - 1)]
+    return [(0, total)]
+
+
+def _drop_crowd(gt, crowd_flags, seg):
+    s, e = seg
+    g = gt[s:e]
+    if crowd_flags is None:
+        return g
+    c = np.asarray(crowd_flags).reshape(-1)[s:e].astype(bool)
+    return g[~c]
+
+
 @register_op("rpn_target_assign",
              no_grad_inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo",
                              "DistMat"))
 def rpn_target_assign(ctx):
     """Sample anchors for RPN training (ref rpn_target_assign_op.cc):
-    positives = best-per-gt + IoU >= pos_thresh; negatives = IoU <
-    neg_thresh; subsample to rpn_batch_size_per_im with fg_fraction."""
+    per IMAGE (GtBoxes LoD, ref :327 batch loop; crowd boxes excluded,
+    ref generate_proposal_labels_op.cc:111): positives = best-per-gt +
+    IoU >= pos_thresh; negatives = IoU < neg_thresh; subsample to
+    rpn_batch_size_per_im with fg_fraction.  Output indices are flat into
+    [n_images * n_anchors]."""
     anchors = np.asarray(ctx.input("Anchor")).reshape(-1, 4)
-    gt = np.asarray(ctx.input("GtBoxes")).reshape(-1, 4)
+    gt_all = np.asarray(ctx.input("GtBoxes")).reshape(-1, 4)
+    crowd = ctx.input("IsCrowd")
     batch = ctx.attr("rpn_batch_size_per_im", 256)
     fg_frac = ctx.attr("rpn_fg_fraction", 0.5)
     pos_t = ctx.attr("rpn_positive_overlap", 0.7)
     neg_t = ctx.attr("rpn_negative_overlap", 0.3)
     use_random = ctx.attr("use_random", True)
-    rng = np.random.RandomState(ctx.attr("seed", 0) or 0)
+    rng = _op_rng(ctx)
+    segs = _segments(ctx.in_lod("GtBoxes"), len(gt_all))
+    n_anchor = len(anchors)
 
+    locs, scores, slabels, tbs = [], [], [], []
+    for i, seg in enumerate(segs):
+        gt = _drop_crowd(gt_all, crowd, seg)
+        fg_idx, bg_idx, tb = _rpn_assign_one(
+            anchors, gt, batch, fg_frac, pos_t, neg_t, use_random, rng)
+        locs.append(fg_idx + i * n_anchor)
+        scores.append(np.concatenate([fg_idx, bg_idx]) + i * n_anchor)
+        slabels.append(np.concatenate([np.ones(len(fg_idx)),
+                                       np.zeros(len(bg_idx))]))
+        tbs.append(tb)
+    return {"LocationIndex": np.concatenate(locs).astype(np.int64),
+            "ScoreIndex": np.concatenate(scores).astype(np.int64),
+            "TargetLabel": np.concatenate(slabels)
+            .astype(np.int64).reshape(-1, 1),
+            "TargetBBox": np.concatenate(tbs).astype(np.float32)}
+
+
+def _rpn_assign_one(anchors, gt, batch, fg_frac, pos_t, neg_t, use_random,
+                    rng):
     iou = _np_iou(gt, anchors) if len(gt) else \
         np.zeros((0, len(anchors)), np.float32)
     max_per_anchor = iou.max(0) if len(gt) else \
@@ -172,11 +227,6 @@ def rpn_target_assign(ctx):
         labels[drop] = -1
         bg_idx = np.where(labels == 0)[0]
 
-    loc_index = fg_idx.astype(np.int64)
-    score_index = np.concatenate([fg_idx, bg_idx]).astype(np.int64)
-    score_label = np.concatenate([np.ones(len(fg_idx)),
-                                  np.zeros(len(bg_idx))]) \
-        .astype(np.int64).reshape(-1, 1)
     if len(gt) and len(fg_idx):
         match_gt = iou[:, fg_idx].argmax(0)
         tgt = gt[match_gt]
@@ -193,9 +243,7 @@ def rpn_target_assign(ctx):
                        np.log(gw / aw), np.log(gh / ah)], 1)
     else:
         tb = np.zeros((0, 4), np.float32)
-    return {"LocationIndex": loc_index, "ScoreIndex": score_index,
-            "TargetLabel": score_label,
-            "TargetBBox": tb.astype(np.float32)}
+    return fg_idx, bg_idx, tb
 
 
 @register_op("generate_proposal_labels",
@@ -203,20 +251,56 @@ def rpn_target_assign(ctx):
                              "ImInfo"))
 def generate_proposal_labels(ctx):
     """Sample RoIs + assign classification/regression targets for the
-    RCNN head (ref generate_proposal_labels_op.cc SampleRoisForOneImage,
-    single-image LoD simplified to the whole batch-of-rois)."""
-    rois = np.asarray(ctx.input("RpnRois")).reshape(-1, 4)
-    gt_cls = np.asarray(ctx.input("GtClasses")).reshape(-1).astype(np.int64)
-    gt = np.asarray(ctx.input("GtBoxes")).reshape(-1, 4)
-    batch = ctx.attr("batch_size_per_im", 256)
-    fg_frac = ctx.attr("fg_fraction", 0.25)
-    fg_t = ctx.attr("fg_thresh", 0.5)
-    bg_hi = ctx.attr("bg_thresh_hi", 0.5)
-    bg_lo = ctx.attr("bg_thresh_lo", 0.0)
-    n_class = ctx.attr("class_nums", 81)
-    use_random = ctx.attr("use_random", True)
-    rng = np.random.RandomState(ctx.attr("seed", 0) or 0)
+    RCNN head, per IMAGE over the RpnRois/GtBoxes LoDs with crowd gt
+    excluded (ref generate_proposal_labels_op.cc SampleRoisForOneImage,
+    crowd filter :111)."""
+    rois_all = np.asarray(ctx.input("RpnRois")).reshape(-1, 4)
+    gt_cls_all = np.asarray(ctx.input("GtClasses")).reshape(-1) \
+        .astype(np.int64)
+    gt_all = np.asarray(ctx.input("GtBoxes")).reshape(-1, 4)
+    crowd = ctx.input("IsCrowd")
+    attrs = dict(
+        batch=ctx.attr("batch_size_per_im", 256),
+        fg_frac=ctx.attr("fg_fraction", 0.25),
+        fg_t=ctx.attr("fg_thresh", 0.5),
+        bg_hi=ctx.attr("bg_thresh_hi", 0.5),
+        bg_lo=ctx.attr("bg_thresh_lo", 0.0),
+        n_class=ctx.attr("class_nums", 81),
+        use_random=ctx.attr("use_random", True))
+    rng = _op_rng(ctx)
+    roi_segs = _segments(ctx.in_lod("RpnRois"), len(rois_all))
+    gt_segs = _segments(ctx.in_lod("GtBoxes"), len(gt_all))
+    if len(gt_segs) != len(roi_segs):
+        gt_segs = [(0, len(gt_all))] * len(roi_segs)
 
+    outs = {"rois": [], "labels": [], "tgt": [], "w_in": []}
+    lod = [0]
+    for seg_r, seg_g in zip(roi_segs, gt_segs):
+        rois = rois_all[seg_r[0]: seg_r[1]]
+        gt = _drop_crowd(gt_all, crowd, seg_g)
+        keep = np.ones(seg_g[1] - seg_g[0], bool)
+        if crowd is not None:
+            keep = ~np.asarray(crowd).reshape(-1)[seg_g[0]: seg_g[1]] \
+                .astype(bool)
+        gt_cls = gt_cls_all[seg_g[0]: seg_g[1]][keep]
+        r, l, t, w = _sample_rois_one(rois, gt, gt_cls, rng, **attrs)
+        outs["rois"].append(r)
+        outs["labels"].append(l)
+        outs["tgt"].append(t)
+        outs["w_in"].append(w)
+        lod.append(lod[-1] + len(r))
+    out_rois = np.concatenate(outs["rois"], 0).astype(np.float32)
+    labels = np.concatenate(outs["labels"], 0)
+    tgt = np.concatenate(outs["tgt"], 0)
+    w_in = np.concatenate(outs["w_in"], 0)
+    return {"Rois": out_rois, "LabelsInt32": labels.astype(np.int32),
+            "BboxTargets": tgt, "BboxInsideWeights": w_in,
+            "BboxOutsideWeights": (w_in > 0).astype(np.float32),
+            "Rois@LOD": [(tuple(lod),)]}
+
+
+def _sample_rois_one(rois, gt, gt_cls, rng, batch, fg_frac, fg_t, bg_hi,
+                     bg_lo, n_class, use_random):
     cand = np.concatenate([rois, gt], 0) if len(gt) else rois
     iou = _np_iou(gt, cand) if len(gt) else \
         np.zeros((0, len(cand)), np.float32)
@@ -254,11 +338,7 @@ def generate_proposal_labels(ctx):
         for j, (row, cls) in enumerate(zip(deltas, labels[:len(fg), 0])):
             tgt[j, 4 * cls: 4 * cls + 4] = row
             w_in[j, 4 * cls: 4 * cls + 4] = 1.0
-    lod = [(0, len(sel))]
-    return {"Rois": out_rois, "LabelsInt32": labels.astype(np.int32),
-            "BboxTargets": tgt, "BboxInsideWeights": w_in,
-            "BboxOutsideWeights": (w_in > 0).astype(np.float32),
-            "Rois@LOD": [(tuple([0, len(sel)]),)]}
+    return out_rois, labels, tgt, w_in
 
 
 @register_op("detection_map",

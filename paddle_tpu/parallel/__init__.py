@@ -8,6 +8,8 @@ TPU-native replacement for the reference's distributed stack (SURVEY.md §2.5,
 from .mesh import make_mesh, local_device_count
 from .spmd import (batch_spec, infer_param_specs, shard_program_step,
                    ShardedTrainStep)
+from .master import Task, TaskDispatcher, task_reader
 
 __all__ = ["make_mesh", "local_device_count", "batch_spec",
-           "infer_param_specs", "shard_program_step", "ShardedTrainStep"]
+           "infer_param_specs", "shard_program_step", "ShardedTrainStep",
+           "Task", "TaskDispatcher", "task_reader"]

@@ -167,3 +167,14 @@ def test_save_load_ops_in_program(tmp_path):
     import os
 
     assert os.path.exists(path)
+
+
+def test_memory_usage_estimate():
+    from paddle_tpu.fluid.contrib import memory_usage
+
+    x = fluid.layers.data(name="xm", shape=[784], dtype="float32")
+    h = fluid.layers.fc(input=x, size=100)
+    lo, hi = memory_usage(fluid.default_main_program(), batch_size=64)
+    assert 0 < lo < hi
+    # params alone: 784*100*4 + 100*4 ~ 0.3MB; activations add more
+    assert hi > 0.3

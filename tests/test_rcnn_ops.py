@@ -149,3 +149,32 @@ def test_detection_map_accumulator_chaining():
     both_det = np.concatenate([det1, det2])
     ref = float(np.asarray(run(both_det, both_gt)["MAP"])[0])
     np.testing.assert_allclose(chained, ref, atol=1e-6)
+
+
+def test_rpn_target_assign_multi_image_lod():
+    """Batch of 2 images (GtBoxes LoD): indices must offset per image and
+    gt boxes must NOT cross-match between images; crowd boxes excluded."""
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], np.float32)
+    # image 0: gt matches anchor 0; image 1: gt matches anchor 1 + a crowd
+    gt = np.array([[0, 0, 9, 9], [20, 20, 29, 29], [0, 0, 9, 9]],
+                  np.float32)
+    crowd = np.array([[0], [0], [1]], np.int32)  # 3rd (img1) is crowd
+    ctx = ExecContext(
+        "rpn_target_assign",
+        {"Anchor": [jnp.asarray(anchors)],
+         "GtBoxes": [jnp.asarray(gt)],
+         "GtBoxes@LOD": [((0, 1, 3),)],
+         "IsCrowd": [jnp.asarray(crowd)],
+         "IsCrowd@LOD": [((0, 1, 3),)],
+         "ImInfo": [None], "DistMat": [None]},
+        {"LocationIndex": ["l"], "ScoreIndex": ["s"],
+         "TargetLabel": ["t"], "TargetBBox": ["b"]},
+        {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+         "rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+         "use_random": False})
+    r = REGISTRY["rpn_target_assign"].fn(ctx)
+    loc = sorted(np.asarray(r["LocationIndex"]).tolist())
+    # image 0 positive = flat anchor 0; image 1 positive = flat 2 + 1 = 3.
+    # the crowd gt (identical to anchor 0's box) must NOT make flat index 2
+    # (image 1's anchor 0) positive.
+    assert loc == [0, 3], loc

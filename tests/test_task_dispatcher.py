@@ -1,0 +1,90 @@
+"""Fault-tolerant task dispatch (parallel/master.py; ref go/master/
+service.go — timeout requeue :341, failure cap :313, snapshot/recover
+:207/:166, stateless-consumer elasticity)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel.master import Task, TaskDispatcher, task_reader
+
+
+def test_dispatch_and_finish_covers_all_chunks():
+    m = TaskDispatcher(list(range(10)), chunks_per_task=3)
+    seen = []
+    while not m.pass_finished():
+        t = m.get_task()
+        assert t is not None
+        seen.extend(t.chunks)
+        m.task_finished(t.task_id)
+    assert sorted(seen) == list(range(10))
+    assert len(m.done) == 4  # ceil(10/3)
+
+
+def test_timeout_requeues_task(monkeypatch):
+    import paddle_tpu.parallel.master as mm
+
+    now = [1000.0]
+    monkeypatch.setattr(mm.time, "time", lambda: now[0])
+    m = TaskDispatcher(list(range(4)), chunks_per_task=2, timeout=5.0)
+    t1 = m.get_task()
+    t2 = m.get_task()
+    assert m.get_task() is None and not m.pass_finished()  # stragglers out
+    now[0] += 10.0  # t1/t2 die silently
+    t1b = m.get_task()
+    assert t1b is not None and t1b.num_failure == 1
+    # a late finish report from the dead consumer is ignored
+    m.task_finished(t2.task_id)  # t2 was reclaimed too...
+    t2b = m.get_task()
+    assert t2b is not None
+    m.task_finished(t1b.task_id)
+    m.task_finished(t2b.task_id)
+    assert m.pass_finished()
+
+
+def test_failure_cap_discards_task():
+    m = TaskDispatcher(list(range(2)), chunks_per_task=2, failure_max=2)
+    for _ in range(3):  # fail 3 times > cap 2
+        t = m.get_task()
+        m.task_failed(t.task_id)
+    assert m.get_task() is None
+    assert len(m.failed) == 1 and m.failed[0].num_failure == 3
+
+
+def test_snapshot_recover_requeues_pending(tmp_path):
+    snap = str(tmp_path / "master.json")
+    m = TaskDispatcher(list(range(6)), chunks_per_task=2,
+                       snapshot_path=snap)
+    t = m.get_task()
+    m.task_finished(t.task_id)
+    t2 = m.get_task()  # in flight when the master "dies"
+    del m
+
+    m2 = TaskDispatcher([], snapshot_path=snap)  # recover
+    remaining = []
+    while True:
+        t = m2.get_task()
+        if t is None:
+            break
+        remaining.extend(t.chunks)
+        m2.task_finished(t.task_id)
+    # the finished task stays finished; the in-flight one was requeued
+    assert sorted(remaining) == sorted(set(range(6)) - set(
+        [0, 1]))  # first task's chunks are done
+    assert len(m2.done) == 2 + 1  # recovered done + the two just finished
+
+
+def test_task_reader_elastic_consumer():
+    """Two consumers share one dispatcher; one dies mid-task — the task
+    requeues and the surviving consumer still sees every sample."""
+    m = TaskDispatcher(list(range(6)), chunks_per_task=2, timeout=0.0)
+
+    def chunk_reader(c):
+        yield c
+
+    # consumer A pulls a task and dies before finishing (timeout=0 means
+    # the next get_task reclaims instantly)
+    dead = m.get_task()
+    assert dead is not None
+
+    seen = list(task_reader(m, chunk_reader)())
+    assert sorted(seen) == list(range(6))
