@@ -29,7 +29,7 @@ __all__ = [
     "im2sequence", "maxout", "relu", "log", "crop", "mean_iou",
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
-    "ring_attention",
+    "ring_attention", "moe_ffn",
     "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
     "edit_distance", "ctc_greedy_decoder",
 ]
@@ -1151,6 +1151,61 @@ def ctc_greedy_decoder(input, blank, name=None):
         outputs={"Output": [ctc_out]},
         attrs={"merge_repeated": True, "blank": blank})
     return ctc_out
+
+
+def moe_ffn(input, num_experts, hidden_size, top_k=2, capacity_factor=1.25,
+            activation="relu", param_attr=None, name=None):
+    """Mixture-of-experts feed-forward with expert parallelism (TPU-native
+    capability beyond the reference — SURVEY.md §2.6 lists MoE/EP "Absent";
+    see parallel/moe.py).  input: [..., D].  Returns (out [..., D],
+    aux_loss scalar) — callers add the Switch load-balancing ``aux_loss``
+    (weighted ~1e-2) to their training loss and usually wrap ``out`` in a
+    residual connection (dropped-overflow tokens output zero).
+
+    Expert weights carry ``dist_hint="ep"``: under a mesh with an "ep" axis
+    the expert dimension shards across it and GSPMD lowers the dispatch
+    einsums to all-to-alls over ICI."""
+    if top_k > num_experts:
+        raise ValueError(
+            f"moe_ffn: top_k={top_k} exceeds num_experts={num_experts}")
+    from ..initializer import XavierInitializer
+
+    helper = LayerHelper("moe_ffn", **locals())
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    gate_w = helper.create_parameter(attr=param_attr, shape=[d, num_experts],
+                                     dtype=dtype)
+    # stacked expert weights need PER-EXPERT fans — the default fan
+    # convention would read the expert dim as part of the receptive field
+    w1 = helper.create_parameter(attr=param_attr,
+                                 shape=[num_experts, d, hidden_size],
+                                 dtype=dtype,
+                                 default_initializer=XavierInitializer(
+                                     fan_in=d, fan_out=hidden_size))
+    b1 = helper.create_parameter(attr=param_attr,
+                                 shape=[num_experts, hidden_size],
+                                 dtype=dtype, is_bias=True)
+    w2 = helper.create_parameter(attr=param_attr,
+                                 shape=[num_experts, hidden_size, d],
+                                 dtype=dtype,
+                                 default_initializer=XavierInitializer(
+                                     fan_in=hidden_size, fan_out=d))
+    b2 = helper.create_parameter(attr=param_attr, shape=[num_experts, d],
+                                 dtype=dtype, is_bias=True)
+    for p in (w1, b1, w2, b2):
+        p.dist_hint = "ep"
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(input.shape)
+    aux = helper.create_variable_for_type_inference(dtype)
+    aux.shape = ()
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [input], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"top_k": int(top_k), "capacity_factor": float(capacity_factor),
+               "activation": activation})
+    return out, aux
 
 
 def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",

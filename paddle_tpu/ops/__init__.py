@@ -22,4 +22,5 @@ from . import (  # noqa: F401
     attention_ops,
     misc_ops,
     rcnn_ops,
+    moe_ops,
 )

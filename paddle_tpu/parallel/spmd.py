@@ -73,7 +73,24 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
     """
     has_tp = tp_axis in mesh.axis_names
     has_dp = zero1 and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
-    if not has_tp and not has_dp:
+
+    def hint_spec(v) -> Optional[P]:
+        """Params created with a ``dist_hint`` axis name (expert weights →
+        "ep", pipeline-stacked weights → "pp") shard dim 0 on that axis."""
+        axis = getattr(v, "dist_hint", None)
+        if axis is None or axis not in mesh.axis_names \
+                or mesh.shape[axis] <= 1:
+            return None
+        shape = v.shape
+        if not shape or shape[0] is None or shape[0] % mesh.shape[axis] != 0:
+            return None
+        return P(*([axis] + [None] * (len(shape) - 1)))
+
+    has_hints = any(
+        getattr(v, "dist_hint", None) in mesh.axis_names
+        for v in program.global_block().vars.values()
+        if isinstance(v, Parameter))
+    if not has_tp and not has_dp and not has_hints:
         return {n: P() for n in set(plan.state_in) | set(plan.state_out)}
     tp_size = mesh.shape[tp_axis] if has_tp else 1
     dp_size = mesh.shape[dp_axis] if has_dp else 1
@@ -110,6 +127,11 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
             continue
         if gb._has_var_recursive(name):
             v = gb._var_recursive(name)
+            hs = hint_spec(v) if isinstance(v, Parameter) else None
+            if hs is not None:
+                specs[name] = hs
+                param_shapes[name] = tuple(v.shape)
+                continue
             if isinstance(v, Parameter) and v.shape is not None \
                     and len(v.shape) == 2:
                 specs[name] = spec_for_shape(v.shape)

@@ -1,0 +1,145 @@
+"""Mixture-of-experts / expert parallelism tests.
+
+MoE/EP is a TPU-native capability beyond the reference (SURVEY.md §2.6 lists
+MoE/EP "Absent"; its nearest analogue is the pserver-sharded lookup table,
+ref distribute_transpiler.py:379-382).  The parallel-mode bar is the same as
+for DP/TP (SURVEY.md §4.4): loss-equivalence vs the single-device run.
+"""
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+from paddle_tpu.fluid.executor import BlockPlan
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.spmd import ShardedTrainStep, infer_param_specs
+
+
+def test_gating_invariants():
+    """Per-token combine weights sum to 1 with ample capacity; dispatch is
+    0/1 with at most top_k slots per token; perfect-balance aux loss == 1."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import moe
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    gate_w = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    combine, dispatch, aux = moe.top_k_gating(x, gate_w, top_k=2,
+                                              capacity_factor=4.0)
+    per_token = np.asarray(combine.sum(axis=(1, 2)))
+    np.testing.assert_allclose(per_token, np.ones(32), rtol=1e-5)
+    d = np.asarray(dispatch)
+    assert set(np.unique(d)) <= {0.0, 1.0}
+    assert (d.sum(axis=(1, 2)) <= 2).all()
+    assert float(aux) > 0.99  # >= 1 by Cauchy-Schwarz; 1 at perfect balance
+
+
+def test_capacity_drops_overflow():
+    from paddle_tpu.parallel import moe
+
+    # all 16 tokens want expert 0 (gate heavily biased)
+    import jax.numpy as jnp
+
+    x = jnp.ones((16, 4), jnp.float32)
+    gate_w = jnp.zeros((4, 2), jnp.float32).at[:, 0].set(10.0)
+    combine, dispatch, _ = moe.top_k_gating(x, gate_w, top_k=1,
+                                            capacity_factor=1.0)
+    # capacity = ceil(16*1/2*1.0) = 8 -> exactly 8 tokens kept
+    assert float(dispatch.sum()) == 8.0
+    assert float(dispatch[:, 1].sum()) == 0.0  # nothing routed to expert 1
+
+
+def _build_moe_model(seed=7):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=32, act="relu")
+    moe_out, aux = fluid.layers.moe_ffn(h, num_experts=4, hidden_size=32,
+                                        top_k=2, capacity_factor=2.0)
+    h2 = fluid.layers.elementwise_add(h, moe_out)  # residual
+    pred = fluid.layers.fc(input=h2, size=10, act="softmax")
+    ce = fluid.layers.mean(fluid.layers.cross_entropy(input=pred,
+                                                      label=label))
+    loss = fluid.layers.elementwise_add(
+        ce, fluid.layers.scale(aux, scale=0.01))
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    return loss
+
+
+def test_moe_trains_single_device():
+    loss = _build_moe_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    losses = []
+    for _ in range(8):
+        x = rng.normal(size=(32, 16)).astype(np.float32)
+        y = (x[:, :1] > 0).astype(np.int64)
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": x, "label": y}, fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_moe_ep_matches_executor():
+    """dp2 x ep4: expert weights shard over "ep", loss curve must equal the
+    single-device executor's (the SURVEY.md §4.4 oracle)."""
+    loss = _build_moe_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+
+    rng = np.random.RandomState(2)
+    data = []
+    for _ in range(5):
+        x = rng.normal(size=(16, 16)).astype(np.float32)
+        data.append((x, (x[:, :1] > 0).astype(np.int64)))
+
+    base = []
+    for x, y in data:
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": x, "label": y}, fetch_list=[loss])
+        base.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(base).all()
+
+    for k, v in init.items():
+        scope.set(k, v)
+    mesh = make_mesh(8, tp=4, axis_names=("dp", "ep"))
+    step = ShardedTrainStep(fluid.default_main_program(), ["img", "label"],
+                            [loss.name], mesh)
+    ep_sharded = [n for n, s in step.specs.items()
+                  if s is not None and "ep" in tuple(s)]
+    assert ep_sharded, f"no var got ep-sharded; specs={step.specs}"
+    # the w1/w2 expert weights AND their Adam moments must be ep-sharded
+    assert sum(1 for n in ep_sharded if "moment" in n) >= 2, ep_sharded
+
+    state = step.place_state()
+    out = []
+    for x, y in data:
+        placed = step.place_feed({"img": x, "label": y})
+        fetches, new_state = step(placed, state)
+        state = {**state, **new_state}
+        out.append(float(np.asarray(fetches[0]).reshape(-1)[0]))
+    np.testing.assert_allclose(base, out, rtol=1e-3, atol=1e-3)
+
+
+def test_moe_expert_param_specs():
+    """infer_param_specs honors dist_hint="ep" for expert params and their
+    accumulators; gate weight stays replicated (it is not an expert param)."""
+    loss = _build_moe_model()
+    prog = fluid.default_main_program()
+    mesh = make_mesh(8, tp=4, axis_names=("dp", "ep"))
+    plan = BlockPlan(prog, 0, ["img", "label"], [loss.name])
+    specs = infer_param_specs(prog, plan, mesh)
+    gb = prog.global_block()
+    expert_params = [v.name for v in gb.vars.values()
+                     if getattr(v, "dist_hint", None) == "ep"]
+    assert len(expert_params) == 4  # w1, b1, w2, b2
+    for n in expert_params:
+        assert specs[n] is not None and tuple(specs[n])[0] == "ep", \
+            (n, specs[n])
