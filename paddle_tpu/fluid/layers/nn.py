@@ -7,6 +7,8 @@ reference's compile-time InferShape.
 
 from __future__ import annotations
 
+import copy
+
 import numpy as np
 
 from .. import core
@@ -29,7 +31,7 @@ __all__ = [
     "im2sequence", "maxout", "relu", "log", "crop", "mean_iou",
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
-    "ring_attention", "moe_ffn",
+    "ring_attention", "moe_ffn", "gpipe_mlp_stack",
     "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
     "edit_distance", "ctc_greedy_decoder",
 ]
@@ -256,11 +258,18 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                                     default_initializer=ConstantInitializer(1.0))
     bias = helper.create_parameter(attr=helper.bias_attr, shape=param_shape,
                                    dtype=dtype, is_bias=True)
+    from .. import unique_name
+    # moving stats must have stable saveable names — an anonymous @TEMP@
+    # persistable cannot round-trip through save/load_inference_model
     mean = helper.create_global_variable(
-        name=moving_mean_name, dtype=dtype, shape=param_shape, persistable=True)
+        name=moving_mean_name or unique_name.generate(
+            helper.name + ".w_mean"),
+        dtype=dtype, shape=param_shape, persistable=True)
     helper.set_variable_initializer(mean, ConstantInitializer(0.0))
     variance = helper.create_global_variable(
-        name=moving_variance_name, dtype=dtype, shape=param_shape,
+        name=moving_variance_name or unique_name.generate(
+            helper.name + ".w_variance"),
+        dtype=dtype, shape=param_shape,
         persistable=True)
     helper.set_variable_initializer(variance, ConstantInitializer(1.0))
 
@@ -1173,24 +1182,27 @@ def moe_ffn(input, num_experts, hidden_size, top_k=2, capacity_factor=1.25,
     helper = LayerHelper("moe_ffn", **locals())
     dtype = helper.input_dtype()
     d = int(input.shape[-1])
-    gate_w = helper.create_parameter(attr=param_attr, shape=[d, num_experts],
+    # each create_parameter mutates attr.name — every param needs its own
+    # copy or they all collapse onto one var
+    _pa = lambda: copy.deepcopy(param_attr)
+    gate_w = helper.create_parameter(attr=_pa(), shape=[d, num_experts],
                                      dtype=dtype)
     # stacked expert weights need PER-EXPERT fans — the default fan
     # convention would read the expert dim as part of the receptive field
-    w1 = helper.create_parameter(attr=param_attr,
+    w1 = helper.create_parameter(attr=_pa(),
                                  shape=[num_experts, d, hidden_size],
                                  dtype=dtype,
                                  default_initializer=XavierInitializer(
                                      fan_in=d, fan_out=hidden_size))
-    b1 = helper.create_parameter(attr=param_attr,
+    b1 = helper.create_parameter(attr=_pa(),
                                  shape=[num_experts, hidden_size],
                                  dtype=dtype, is_bias=True)
-    w2 = helper.create_parameter(attr=param_attr,
+    w2 = helper.create_parameter(attr=_pa(),
                                  shape=[num_experts, hidden_size, d],
                                  dtype=dtype,
                                  default_initializer=XavierInitializer(
                                      fan_in=hidden_size, fan_out=d))
-    b2 = helper.create_parameter(attr=param_attr, shape=[num_experts, d],
+    b2 = helper.create_parameter(attr=_pa(), shape=[num_experts, d],
                                  dtype=dtype, is_bias=True)
     for p in (w1, b1, w2, b2):
         p.dist_hint = "ep"
@@ -1206,6 +1218,40 @@ def moe_ffn(input, num_experts, hidden_size, top_k=2, capacity_factor=1.25,
         attrs={"top_k": int(top_k), "capacity_factor": float(capacity_factor),
                "activation": activation})
     return out, aux
+
+
+def gpipe_mlp_stack(input, n_layers, act="relu", n_microbatches=4,
+                    pp_axis="pp", param_attr=None, name=None):
+    """A stack of ``n_layers`` equal-width fc layers run as a GPipe
+    pipeline when the active mesh has a "pp" axis (TPU-native capability —
+    SURVEY.md §2.6 lists PP "Absent in Fluid"; see parallel/pipeline.py).
+    Single-device the layers apply sequentially: identical math, portable
+    programs.  input: [N, D]; weights are stacked [L, D, D] with
+    ``dist_hint="pp"`` so each pipeline stage holds only its own layers."""
+    from ..initializer import XavierInitializer
+
+    helper = LayerHelper("gpipe_mlp_stack", **locals())
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    w = helper.create_parameter(attr=copy.deepcopy(param_attr),
+                                shape=[n_layers, d, d],
+                                dtype=dtype,
+                                default_initializer=XavierInitializer(
+                                    fan_in=d, fan_out=d))
+    b = helper.create_parameter(attr=copy.deepcopy(param_attr),
+                                shape=[n_layers, d],
+                                dtype=dtype, is_bias=True)
+    w.dist_hint = "pp"
+    b.dist_hint = "pp"
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(input.shape)
+    helper.append_op(
+        type="gpipe_mlp_stack",
+        inputs={"X": [input], "W": [w], "B": [b]},
+        outputs={"Out": [out]},
+        attrs={"act": act, "n_microbatches": int(n_microbatches),
+               "pp_axis": pp_axis})
+    return out
 
 
 def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",

@@ -23,4 +23,5 @@ from . import (  # noqa: F401
     misc_ops,
     rcnn_ops,
     moe_ops,
+    pipeline_ops,
 )

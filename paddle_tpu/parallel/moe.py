@@ -89,12 +89,11 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k: int = 2,
     xt = x.reshape((-1, d))
     combine, dispatch, aux = top_k_gating(xt, gate_w, top_k, capacity_factor)
     dtype = x.dtype
+    from .pipeline import _apply_act
+
     expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype), xt)
-    h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :]
-    if activation == "relu":
-        h = jax.nn.relu(h)
-    elif activation == "gelu":
-        h = jax.nn.gelu(h)
+    h = _apply_act(jnp.einsum("ecd,edh->ech", expert_in, w1)
+                   + b1[:, None, :], activation)
     expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
     y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), expert_out)
     return y.reshape(orig_shape), aux.astype(dtype)

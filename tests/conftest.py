@@ -8,8 +8,16 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in flags:
+    # The concurrency-optimized CPU thunk scheduler can start independent
+    # collectives in different orders on different virtual devices, which
+    # deadlocks the in-process rendezvous (seen with shard_map ppermute
+    # pipelines + GSPMD grad all-reduces in one program).  Program-order
+    # scheduling is deterministic; real TPUs sequence collectives anyway.
+    flags = (flags
+             + " --xla_cpu_enable_concurrency_optimized_scheduler=false")
+os.environ["XLA_FLAGS"] = flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
