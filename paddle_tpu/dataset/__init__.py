@@ -7,8 +7,9 @@ absent — enough for the train-loop, checkpoint, and benchmark harnesses.
 """
 
 from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
-               movielens, mq2007, sentiment, uci_housing, voc2012, wmt16)
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
+               wmt16)
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
-           "wmt16", "flowers", "conll05", "sentiment", "voc2012", "mq2007",
+           "wmt14", "wmt16", "flowers", "conll05", "sentiment", "voc2012", "mq2007",
            "common"]

@@ -71,10 +71,10 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
-              filename=None):
+              filename=None, scope=None):
     predicate = predicate or is_persistable
     var_list = _resolve_vars(main_program, predicate, vars)
-    scope = global_scope()
+    scope = scope or global_scope()
     if filename is not None:
         with np.load(os.path.join(dirname, filename)) as data:
             for v in var_list:
@@ -89,12 +89,16 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             scope.set(v.name, np.load(f, allow_pickle=False))
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename,
+              scope=scope)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename,
+              scope=scope)
 
 
 def get_inference_program(target_vars, main_program=None):
@@ -124,12 +128,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "wb") as f:
         pickle.dump(payload, f)
-    save_params(executor, dirname, inference_program, params_filename)
+    # persistables, not just Parameters: batch-norm moving stats and other
+    # persistable state the pruned program reads must round-trip
+    # (ref: io.py:561 save_inference_model → save_persistables)
+    save_persistables(executor, dirname, inference_program, params_filename)
     return [t.name for t in target_vars]
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, scope=None):
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "rb") as f:
         payload = pickle.load(f)
@@ -137,7 +144,8 @@ def load_inference_model(dirname, executor, model_filename=None,
         program = Program.parse_from_string(payload["program_blob"])
     else:  # pre-versioned __model__ files
         program = payload["program"]
-    load_params(executor, dirname, program, params_filename)
+    load_persistables(executor, dirname, program, params_filename,
+                      scope=scope)
     fetch_vars = [program.global_block()._var_recursive(n)
                   for n in payload["fetch_names"]]
     return program, payload["feed_names"], fetch_vars

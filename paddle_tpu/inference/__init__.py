@@ -1,0 +1,130 @@
+"""Inference API: the TPU-native equivalent of the reference's C++
+predictor surface (ref: inference/api/paddle_inference_api.h —
+PaddleTensor :67, PaddlePredictor :90, NativeConfig :119, AnalysisConfig
+:156; impl api_impl.cc).
+
+Redesign notes (SURVEY.md §2.9): the reference's analysis pipeline
+(fluid→DFG→TensorRT-subgraph→fluid) exists to hand subgraphs to a separate
+engine; under XLA the *whole* program is already one compiled engine, so
+``AnalysisConfig`` maps to program-level rewrites that still pay off before
+XLA sees the graph (is_test flips + conv+BN folding via
+transpiler.InferenceTranspiler) and the jit cache plays the role of the
+engine cache.  Each predictor owns a private Scope, so multiple predictors
+coexist in one process exactly like the reference's independent predictors
+(paddle_inference_api.h:90 contract: Run() is thread-compatible per clone).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class PaddleTensor:
+    """Named ndarray crossing the predictor boundary
+    (ref: paddle_inference_api.h:67 — name/shape/data/dtype/lod)."""
+    name: str = ""
+    data: Optional[np.ndarray] = None
+    lod: Sequence[Sequence[int]] = field(default_factory=list)
+
+    @property
+    def shape(self):
+        return tuple(self.data.shape) if self.data is not None else ()
+
+    @property
+    def dtype(self):
+        return self.data.dtype if self.data is not None else None
+
+
+@dataclass
+class NativeConfig:
+    """ref: paddle_inference_api.h:119 (model_dir or prog/param files,
+    device selection).  use_tpu=False pins CPU like the reference's
+    use_gpu=False."""
+    model_dir: str = ""
+    prog_file: str = ""
+    param_file: str = ""
+    use_tpu: bool = True
+    device: int = 0
+
+
+@dataclass
+class AnalysisConfig(NativeConfig):
+    """ref: paddle_inference_api.h:156.  enable_ir_optim runs the program
+    rewrites that matter pre-XLA: is_test flips + conv+BN weight folding
+    (transpiler.InferenceTranspiler ≈ the reference's analysis passes +
+    inference_transpiler)."""
+    enable_ir_optim: bool = True
+
+
+class PaddlePredictor:
+    """ref: paddle_inference_api.h:90 / api_impl.cc NativePaddlePredictor.
+
+    Loads the saved inference model into a private scope; Run() feeds
+    PaddleTensors, executes the (jit-cached) program, returns fetches.
+    """
+
+    def __init__(self, config: NativeConfig):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.executor import Scope
+
+        self._config = config
+        self._scope = Scope()
+        place = fluid.TPUPlace(config.device) if config.use_tpu \
+            else fluid.CPUPlace()
+        self._exe = fluid.Executor(place)
+        dirname = config.model_dir
+        model_filename = os.path.basename(config.prog_file) or None
+        params_filename = os.path.basename(config.param_file) or None
+        if not dirname and config.prog_file:
+            dirname = os.path.dirname(config.prog_file)
+        self._program, self._feed_names, self._fetch_vars = \
+            fluid.io.load_inference_model(dirname, self._exe,
+                                          model_filename=model_filename,
+                                          params_filename=params_filename,
+                                          scope=self._scope)
+        if isinstance(config, AnalysisConfig) and config.enable_ir_optim:
+            from paddle_tpu.fluid.transpiler import InferenceTranspiler
+
+            InferenceTranspiler().transpile(self._program, place,
+                                            scope=self._scope)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return [v.name for v in self._fetch_vars]
+
+    def run(self, inputs: List[PaddleTensor],
+            batch_size: int = -1) -> List[PaddleTensor]:
+        feed = {}
+        for i, t in enumerate(inputs):
+            name = t.name or self._feed_names[i]
+            feed[name] = t.data
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=[v.name for v in self._fetch_vars],
+                             scope=self._scope)
+        return [PaddleTensor(name=v.name, data=np.asarray(o))
+                for v, o in zip(self._fetch_vars, outs)]
+
+    # the reference's C++ clone shares weights via the scope; here a clone
+    # shares the scope (arrays are immutable jax values, so concurrent
+    # Run()s never alias mutable state)
+    def clone(self) -> "PaddlePredictor":
+        c = object.__new__(PaddlePredictor)
+        c._config = self._config
+        c._scope = self._scope
+        c._exe = self._exe
+        c._program = self._program
+        c._feed_names = list(self._feed_names)
+        c._fetch_vars = list(self._fetch_vars)
+        return c
+
+
+def create_paddle_predictor(config: NativeConfig) -> PaddlePredictor:
+    """ref: paddle_inference_api.h:179 CreatePaddlePredictor."""
+    return PaddlePredictor(config)

@@ -150,9 +150,17 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
     are written by process 0 only — once, not once per host."""
     import json
 
+    from ..fluid.transpiler.ps_dispatcher import assign_writer
+
     pid = process_index()
     d = os.path.join(ckpt_dir, f"shard_{pid}")
     os.makedirs(d, exist_ok=True)
+    # balance replicated-var writes across hosts (the pserver-shard write
+    # layout, ref go/pserver/service.go:346) instead of serializing them
+    # all through process 0; every process derives the identical map
+    replicated = [n for n, a in state.items()
+                  if not isinstance(a, jax.Array) or a.is_fully_addressable]
+    writer_of = assign_writer(replicated, max(1, process_count()))
     manifest = {}
     for name, arr in state.items():
         if not isinstance(arr, jax.Array):
@@ -160,9 +168,9 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
         entry = {"shape": [int(s) for s in arr.shape],
                  "dtype": str(np.dtype(arr.dtype)), "shards": []}
         if arr.is_fully_addressable:
-            # whole value visible on this host (replicated, or a single-host
-            # run): one blob, written by process 0 only
-            if pid == 0 or not _initialized:
+            # whole value visible on this host (replicated, or a single-
+            # host run): one blob, written by its assigned process
+            if writer_of.get(name, 0) == pid or not _initialized:
                 fn = f"{_safe_name(name)}.full.npy"
                 np.save(os.path.join(d, fn), np.asarray(arr))
                 entry["shards"].append({"file": fn, "index": None})

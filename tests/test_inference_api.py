@@ -1,0 +1,77 @@
+"""Predictor inference API tests (ref: inference/api/paddle_inference_api.h
+PaddleTensor :67 / PaddlePredictor :90 / NativeConfig :119 /
+AnalysisConfig :156, api_impl.cc NativePaddlePredictor)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+
+
+def _train_and_save(tmpdir):
+    fluid.default_main_program().random_seed = 21
+    fluid.default_startup_program().random_seed = 21
+    img = fluid.layers.data(name="img", shape=[1, 8, 8], dtype="float32")
+    conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                               padding=1, bias_attr=False)
+    bn = fluid.layers.batch_norm(input=conv)
+    pool = fluid.layers.pool2d(input=bn, pool_size=2, pool_stride=2)
+    pred = fluid.layers.fc(input=pool, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # one train-mode fwd (updates BN moving stats), then the oracle runs the
+    # for_test clone — inference semantics, same as the predictor
+    x = np.random.RandomState(0).normal(size=(2, 1, 8, 8)).astype(np.float32)
+    exe.run(fluid.default_main_program(), feed={"img": x},
+            fetch_list=[pred])
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref_out,) = exe.run(infer_prog, feed={"img": x}, fetch_list=[pred])
+    fluid.io.save_inference_model(str(tmpdir), ["img"], [pred], exe)
+    return x, np.asarray(ref_out)
+
+
+def test_native_predictor_matches_executor(tmp_path):
+    from paddle_tpu.inference import (NativeConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    x, ref = _train_and_save(tmp_path)
+    # fresh scope: the predictor must be self-contained
+    _executor._global_scope = _executor.Scope()
+    cfg = NativeConfig(model_dir=str(tmp_path), use_tpu=False)
+    pred = create_paddle_predictor(cfg)
+    assert pred.get_input_names() == ["img"]
+    (out,) = pred.run([PaddleTensor(name="img", data=x)])
+    np.testing.assert_allclose(out.data, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_analysis_predictor_bn_fold(tmp_path):
+    """AnalysisConfig folds conv+BN; outputs must stay numerically equal."""
+    from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    x, ref = _train_and_save(tmp_path)
+    _executor._global_scope = _executor.Scope()
+    cfg = AnalysisConfig(model_dir=str(tmp_path), use_tpu=False)
+    pred = create_paddle_predictor(cfg)
+    (out,) = pred.run([PaddleTensor(name="img", data=x)])
+    np.testing.assert_allclose(out.data, ref, rtol=1e-4, atol=1e-5)
+    # the fold really happened: no batch_norm op left in the program
+    assert not any(op.type == "batch_norm"
+                   for op in pred._program.global_block().ops)
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    from paddle_tpu.inference import (NativeConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    x, ref = _train_and_save(tmp_path)
+    _executor._global_scope = _executor.Scope()
+    pred = create_paddle_predictor(
+        NativeConfig(model_dir=str(tmp_path), use_tpu=False))
+    c = pred.clone()
+    (o1,) = pred.run([PaddleTensor(name="img", data=x)])
+    (o2,) = c.run([PaddleTensor(name="img", data=x)])
+    np.testing.assert_allclose(o1.data, o2.data, rtol=1e-6)
+    # positional feeding (unnamed tensors) also works
+    (o3,) = c.run([PaddleTensor(data=x)])
+    np.testing.assert_allclose(o3.data, o1.data, rtol=1e-6)

@@ -171,3 +171,24 @@ def test_sharded_checkpoint_roundtrip():
         np.testing.assert_allclose(np.asarray(state[n]), np.asarray(back[n]),
                                    rtol=1e-6, atol=1e-6, err_msg=n)
         assert back[n].sharding.spec == (step.specs.get(n) or P()), n
+
+
+def test_assign_writer_deterministic_and_balanced():
+    """Replicated-var checkpoint writes spread across processes via the PS
+    dispatchers (ref ps_dispatcher.py), with a process-stable hash (builtin
+    hash() is salted per interpreter and must not be used)."""
+    from paddle_tpu.fluid.transpiler.ps_dispatcher import (HashName,
+                                                           assign_writer)
+
+    names = [f"w_{i}" for i in range(10)]
+    rr = assign_writer(names, 4)
+    assert rr == {n: i % 4 for i, n in enumerate(names)}
+    h1 = assign_writer(names, 4, kind="hash")
+    h2 = assign_writer(names, 4, kind="hash")
+    assert h1 == h2
+    assert set(h1.values()) <= set(range(4))
+    # crc32 is stable across interpreters — pin one value
+    import zlib
+    assert h1["w_0"] == zlib.crc32(b"w_0") % 4
+    d = HashName(["ep0", "ep1"])
+    assert d.dispatch(["a", "b", "a"])[0] == d.dispatch(["a"])[0]
