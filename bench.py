@@ -105,14 +105,24 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     device-resident jax arrays and passes them through) — the equivalent of
     the reference's `--use_reader_op` path where data is already resident
     rather than re-fed from numpy every step (ref:
-    benchmark/fluid/fluid_benchmark.py:149).  Training steps then measure
-    compute, not host->device re-transfer of identical bytes.
+    benchmark/fluid/fluid_benchmark.py:149).
 
-    Returns (seconds, executor) for `steps` timed executions."""
+    BENCH_SPD=K>1 opts into Executor.run_steps (lax.scan, K steps per
+    dispatch).  Measured 2026-07-30 over the tunneled TPU: NOT the default
+    because the executor's per-step async dispatches already pipeline on
+    device (~0.14 s/step ResNet-50 bs256), while the scanned loop runs
+    ~2-3x slower per step (scan carry overhead dominates once dispatch
+    latency is hidden) plus a 10x compile. run_steps pays off when the
+    host must SYNC every step (per-step metrics/logging) — there the
+    ~7ms/dispatch floor applies per step; the bench's deferred-fetch loop
+    does not.
+
+    Returns (seconds, steps_actually_timed, executor)."""
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
     prog = fluid.default_main_program()
+    spd = int(os.environ.get("BENCH_SPD", "0"))
     if on_accel:
         import jax
 
@@ -120,6 +130,20 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
 
         dev = _core.get_jax_device(place)
         feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+    spd = max(1, min(spd, steps)) if spd > 0 else 1
+    if spd > 1:
+        n_chunks = max(1, steps // spd)
+        steps = n_chunks * spd
+        exe.run_steps(prog, feed=feed, fetch_list=[loss], n_steps=spd)
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_chunks):
+            (out,) = exe.run_steps(prog, feed=feed, fetch_list=[loss],
+                                   n_steps=spd)
+        last = float(np.asarray(out).reshape(-1)[0])
+        dt = time.perf_counter() - t0
+        assert np.isfinite(last), f"non-finite loss {last}"
+        return dt, steps, exe
     for _ in range(warmup):
         exe.run(prog, feed=feed, fetch_list=[loss])
     # fetch device-resident losses per step (return_numpy=False defers the
@@ -134,7 +158,7 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     last = float(np.asarray(out).reshape(-1)[0])
     dt = time.perf_counter() - t0
     assert np.isfinite(last), f"non-finite loss {last}"
-    return dt, exe
+    return dt, steps, exe
 
 
 def result_line(name, value, unit, baseline_key, **extra):
@@ -165,7 +189,7 @@ def bench_resnet(fluid, platform, on_accel):
     rng = np.random.RandomState(0)
     feed = {"img": rng.normal(size=(batch, 3, image_hw, image_hw)).astype(np.float32),
             "label": rng.randint(0, class_dim, size=(batch, 1)).astype(np.int64)}
-    dt, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
 
     ips = batch * steps / dt
     # MFU input: ResNet-50 fwd ~3.86 GFLOP/img at 224px (scales ~(hw/224)^2);
@@ -199,7 +223,7 @@ def bench_transformer(fluid, platform, on_accel):
     feed = {"src_word": rng.randint(1, cfg.src_vocab_size, size=(batch, seq_len)).astype(np.int64),
             "tgt_word": rng.randint(1, cfg.tgt_vocab_size, size=(batch, seq_len)).astype(np.int64),
             "lbl_word": rng.randint(1, cfg.tgt_vocab_size, size=(batch, seq_len, 1)).astype(np.int64)}
-    dt, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
 
     tps = batch * seq_len * steps / dt  # target tokens/sec
     return result_line(
@@ -219,7 +243,7 @@ def bench_mnist(fluid, platform, on_accel):
     rng = np.random.RandomState(0)
     feed = {"img": rng.normal(size=(batch, 784)).astype(np.float32),
             "label": rng.randint(0, 10, size=(batch, 1)).astype(np.int64)}
-    dt, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
     ips = batch * steps / dt
     return result_line(f"mnist_mlp_bs{batch}_train_{platform}",
                        ips, "images/sec/chip", "mnist")

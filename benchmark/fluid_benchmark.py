@@ -33,7 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 MODELS = ["mnist", "resnet", "vgg", "se_resnext", "stacked_dynamic_lstm",
-          "machine_translation", "deepfm", "bert"]
+          "machine_translation", "moe_transformer", "deepfm", "bert"]
 
 
 def parse_args(argv=None):
@@ -138,11 +138,16 @@ def _build(args):
         def feed(rng):
             return bert_m.synthetic_batch(cfg, bs, seq, n_mask, rng)
         return feed, loss, (f"bert_{cfg.name}", "tokens/sec", bs * seq)
-    if args.model == "machine_translation":
+    if args.model in ("machine_translation", "moe_transformer"):
         from paddle_tpu.models import transformer as trf
 
         seq = 256 if args.device != "CPU" else 32
         cfg = trf.base_config() if args.device != "CPU" else trf.tiny_config()
+        if args.model == "moe_transformer":
+            # Switch-style MoE FFNs (expert parallelism over an "ep" mesh
+            # axis under ParallelExecutor; dense dispatch single-device)
+            cfg.name = f"moe_{cfg.name}"
+            cfg.moe_experts = 8 if args.device != "CPU" else 4
         src, tgt, lbl, loss = trf.build(cfg, src_len=seq, tgt_len=seq, lr=lr)
         feed = lambda rng: {
             "src_word": rng.randint(1, cfg.src_vocab_size,
@@ -151,7 +156,8 @@ def _build(args):
                                     size=(bs, seq)).astype(np.int64),
             "lbl_word": rng.randint(1, cfg.tgt_vocab_size,
                                     size=(bs, seq, 1)).astype(np.int64)}
-        return feed, loss, ("transformer", "tokens/sec", bs * seq)
+        return feed, loss, (cfg.name if args.model == "moe_transformer"
+                            else "transformer", "tokens/sec", bs * seq)
     raise ValueError(args.model)
 
 

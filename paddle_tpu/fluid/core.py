@@ -188,15 +188,47 @@ def get_device_count(kind: str = None) -> int:
 
 
 # gflags-style runtime flags (ref: python/paddle/fluid/__init__.py:121-140
-# imports gflags from env via core.init_gflags).  We keep a plain dict bridged
-# from the environment.
+# imports gflags from env via core.init_gflags, pybind.cc:517 InitGflags).
+# A plain dict; init_gflags supports the reference's two arg forms:
+# "--tryfromenv=a,b,c" (import FLAGS_<name> from the environment) and
+# direct "--name=value" assignment.
+def _flag_value(raw):
+    if isinstance(raw, bool):
+        return raw
+    s = str(raw).strip()
+    if s.lower() in ("1", "true", "yes", "on"):
+        return True
+    if s.lower() in ("0", "false", "no", "off", ""):
+        return False
+    try:
+        return float(s) if "." in s or "e" in s.lower() else int(s)
+    except ValueError:
+        return s
+
+
 GLOBAL_FLAGS = {
-    "check_nan_inf": os.environ.get("FLAGS_check_nan_inf", "0") in ("1", "true", "True"),
-    "benchmark": os.environ.get("FLAGS_benchmark", "0") in ("1", "true", "True"),
+    "check_nan_inf": _flag_value(os.environ.get("FLAGS_check_nan_inf", "0")),
+    "benchmark": _flag_value(os.environ.get("FLAGS_benchmark", "0")),
 }
 
 
 def init_gflags(args=None):
+    """ref: platform/init.cc:36 InitGflags via pybind.cc:517."""
+    for arg in (args or []):
+        if not isinstance(arg, str) or not arg.startswith("--"):
+            continue
+        body = arg[2:]
+        if body.startswith("tryfromenv="):
+            for name in body[len("tryfromenv="):].split(","):
+                name = name.strip()
+                if not name:
+                    continue
+                env = os.environ.get(f"FLAGS_{name}")
+                if env is not None:
+                    GLOBAL_FLAGS[name] = _flag_value(env)
+        elif "=" in body:
+            name, _, val = body.partition("=")
+            GLOBAL_FLAGS[name.strip()] = _flag_value(val)
     return True
 
 

@@ -446,6 +446,108 @@ class Executor:
         self._cache.clear()
         self._feed_cache.clear()
 
+    def run_steps(self, program, feed, fetch_list, n_steps,
+                  scope=None, feed_per_step=False):
+        """Run ``n_steps`` training steps inside ONE device dispatch.
+
+        A ``lax.scan`` over the traced step with the mutable state as the
+        (donated) carry — the standard TPU host-loop amortization: per-step
+        dispatch latency vanishes, parameters never leave the device, and
+        XLA pipelines step k+1's compute behind step k.  On a tunneled
+        transport with a multi-ms per-dispatch floor this is the difference
+        between dispatch-bound and compute-bound training (the analogue of
+        the reference's `--use_reader_op` in-graph data loop, ref
+        benchmark/fluid/fluid_benchmark.py:149 + read op).
+
+        ``feed_per_step=False``: every step consumes the same feed dict
+        (synthetic-data benchmarking, ref --use_fake_data).
+        ``feed_per_step=True``: each feed array carries a leading
+        ``n_steps`` dim and step i consumes slice i.
+
+        Returns the fetches of the LAST step (host numpy).  Programs with
+        data-dependent eager islands cannot be scanned and raise.
+        """
+        import jax.numpy as _jnp
+        from jax import lax as _lax
+
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list or []]
+        feed_arrays = {}
+        for k, v in dict(feed or {}).items():
+            arr, _lod = self._coerce_feed(program, k, v)
+            if _lod:
+                raise RuntimeError(
+                    "run_steps: LoD feeds are not supported in the "
+                    "scanned loop; use Executor.run per step")
+            feed_arrays[k] = arr
+        plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+        if plan.needs_eager:
+            raise RuntimeError(
+                "run_steps: program contains data-dependent eager ops; "
+                "use Executor.run per step")
+        from . import amp as _amp
+
+        key = ("run_steps", id(program), program._version,
+               tuple(fetch_names), int(n_steps), bool(feed_per_step),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               self.place.device_type,
+               # execution-mode toggles invalidate compiled fns (same
+               # contract as Executor.run's cache key)
+               _amp.compute_dtype(),
+               os.environ.get("PADDLE_TPU_FLASH", ""))
+        entry = self._cache.get(key)
+        if entry is None:
+            def kfn(feed_vals, const_state, mut_state):
+                def body(carry, xs):
+                    mut, _prev_fetch = carry
+                    step_feed = xs if feed_per_step else feed_vals
+                    state = dict(const_state)
+                    state.update(mut)
+                    fetches, new_state = trace_block(
+                        program, 0, plan, step_feed, state)
+                    # fetches ride the carry: only the LAST step's values
+                    # survive, with no (n_steps, ...) stacking buffer
+                    return ({**mut, **new_state}, fetches), None
+
+                first_feed = (
+                    {k: v[0] for k, v in feed_vals.items()}
+                    if feed_per_step else feed_vals)
+                fetch0 = jax.eval_shape(
+                    lambda st: trace_block(program, 0, plan, first_feed,
+                                           {**const_state, **st})[0],
+                    mut_state)
+                fetch0 = [_jnp.zeros(t.shape, t.dtype) for t in fetch0]
+                xs = feed_vals if feed_per_step else None
+                (mut_final, last), _ = _lax.scan(
+                    body, (mut_state, fetch0), xs, length=n_steps)
+                return last, mut_final
+
+            device = core.get_jax_device(self.place)
+            donate = (2,) if device.platform == "tpu" else ()
+            entry = (plan, jax.jit(kfn, donate_argnums=donate))
+            self._cache[key] = entry
+        plan, fn = entry
+
+        state_vals = self._gather_state(program, plan, scope)
+        mut_names = set(plan.state_out)
+        if plan.needs_rng:
+            mut_names.add(RNG_STATE_VAR)
+        mut_state = {k: v for k, v in state_vals.items() if k in mut_names}
+        const_state = {k: v for k, v in state_vals.items()
+                       if k not in mut_names}
+        device = core.get_jax_device(self.place)
+        feed_dev = {k: self._put_feed(k, v, device)
+                    for k, v in feed_arrays.items()}
+        fetches, new_state = fn(feed_dev, const_state, mut_state)
+        for name, val in new_state.items():
+            scope.set(name, val)
+        self._check_nan_inf(list(new_state.items())
+                            + list(zip(plan.fetch_names, fetches)))
+        return [np.asarray(v) for v in fetches]
+
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True):
@@ -535,6 +637,8 @@ class Executor:
             scope.set(name, val)
             if name in lod_box:
                 scope._lods[name] = lod_box[name]
+        self._check_nan_inf(list(new_state.items())
+                            + list(zip(plan.fetch_names, fetches)))
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         from .lod_tensor import LoDTensor
@@ -555,6 +659,21 @@ class Executor:
         return out
 
     # -- helpers --
+    @staticmethod
+    def _check_nan_inf(named_vals):
+        """Debug mode (ref FLAGS_check_nan_inf, operator.cc:643): fault
+        with the variable NAME on the first non-finite value.  Host-side
+        materialization forces a sync per step — debug only."""
+        if not core.GLOBAL_FLAGS.get("check_nan_inf"):
+            return
+        for name, val in named_vals:
+            arr = np.asarray(val)
+            if np.issubdtype(arr.dtype, np.floating) \
+                    and not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    f"check_nan_inf: variable '{name}' contains "
+                    f"NaN/Inf after op block execution")
+
     def _put_feed(self, name, arr, device):
         """H2D-transfer a feed value, skipping the copy when the bytes are
         identical to what this feed name already holds on device.

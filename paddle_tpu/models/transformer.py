@@ -25,7 +25,8 @@ _NEG_INF = -1e9
 
 class Config:
     def __init__(self, name, src_vocab_size, tgt_vocab_size, d_model,
-                 d_inner, n_head, n_layer, dropout=0.1, label_smooth=0.1):
+                 d_inner, n_head, n_layer, dropout=0.1, label_smooth=0.1,
+                 moe_experts=0, moe_top_k=2, moe_aux_weight=1e-2):
         self.name = name
         self.src_vocab_size = src_vocab_size
         self.tgt_vocab_size = tgt_vocab_size
@@ -35,6 +36,11 @@ class Config:
         self.n_layer = n_layer
         self.dropout = dropout
         self.label_smooth = label_smooth
+        # moe_experts > 0 replaces every FFN with an expert-parallel MoE
+        # layer (Switch-style; experts shard over an "ep" mesh axis)
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_aux_weight = moe_aux_weight
 
 
 def base_config():
@@ -101,7 +107,14 @@ def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
                      param_attr=ParamAttr(name=f"{prefix}_o_w"))
 
 
-def _ffn(x, d_inner, d_model, prefix):
+def _ffn(x, d_inner, d_model, prefix, cfg=None, aux_losses=None):
+    if cfg is not None and cfg.moe_experts:
+        out, aux = layers.moe_ffn(x, num_experts=cfg.moe_experts,
+                                  hidden_size=d_inner,
+                                  top_k=cfg.moe_top_k)
+        if aux_losses is not None:
+            aux_losses.append(aux)
+        return out
     h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu",
                   param_attr=ParamAttr(name=f"{prefix}_ffn1_w"))
     return layers.fc(h, d_model, num_flatten_dims=2,
@@ -137,7 +150,16 @@ def _padding_bias(word, seq_len):
     return layers.reshape(bias, [-1, 1, 1, seq_len])
 
 
-def encoder(src_word, cfg, src_len):
+def moe_config():
+    """Switch-Transformer-style MoE variant of the tiny config (expert
+    parallelism demo/test model; SURVEY.md §2.6: MoE/EP beyond-reference)."""
+    c = tiny_config()
+    c.name = "moe_tiny"
+    c.moe_experts = 4
+    return c
+
+
+def encoder(src_word, cfg, src_len, aux_losses=None):
     enc = _embed(src_word, cfg.src_vocab_size, src_len, cfg, "src")
     src_bias = _padding_bias(src_word, src_len)
     for i in range(cfg.n_layer):
@@ -145,12 +167,13 @@ def encoder(src_word, cfg, src_len):
             enc, enc, enc, src_bias, cfg.d_model, cfg.n_head, cfg.dropout,
             prefix=f"enc{i}_self")
         enc = _postprocess(enc, attn, cfg.dropout)
-        ff = _ffn(enc, cfg.d_inner, cfg.d_model, prefix=f"enc{i}")
+        ff = _ffn(enc, cfg.d_inner, cfg.d_model, prefix=f"enc{i}",
+                  cfg=cfg, aux_losses=aux_losses)
         enc = _postprocess(enc, ff, cfg.dropout)
     return enc, src_bias
 
 
-def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len):
+def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses=None):
     dec = _embed(tgt_word, cfg.tgt_vocab_size, tgt_len, cfg, "tgt")
     causal = np.triu(np.full((tgt_len, tgt_len), _NEG_INF, np.float32), k=1)
     causal_bias = layers.assign(causal)
@@ -163,7 +186,8 @@ def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len):
             dec, enc_out, enc_out, src_bias, cfg.d_model, cfg.n_head,
             cfg.dropout, prefix=f"dec{i}_cross")
         dec = _postprocess(dec, cross, cfg.dropout)
-        ff = _ffn(dec, cfg.d_inner, cfg.d_model, prefix=f"dec{i}")
+        ff = _ffn(dec, cfg.d_inner, cfg.d_model, prefix=f"dec{i}",
+                  cfg=cfg, aux_losses=aux_losses)
         dec = _postprocess(dec, ff, cfg.dropout)
     return layers.fc(dec, cfg.tgt_vocab_size, num_flatten_dims=2,
                      param_attr=ParamAttr(name="out_proj_w"))
@@ -176,8 +200,9 @@ def forward(cfg, src_len, tgt_len):
     tgt_word = layers.data(name="tgt_word", shape=[tgt_len], dtype="int64")
     lbl_word = layers.data(name="lbl_word", shape=[tgt_len, 1], dtype="int64")
 
-    enc_out, src_bias = encoder(src_word, cfg, src_len)
-    logits = decoder(tgt_word, enc_out, src_bias, cfg, tgt_len)
+    aux_losses = []
+    enc_out, src_bias = encoder(src_word, cfg, src_len, aux_losses)
+    logits = decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses)
 
     if cfg.label_smooth:
         hot = layers.one_hot(lbl_word, cfg.tgt_vocab_size)
@@ -196,6 +221,9 @@ def forward(cfg, src_len, tgt_len):
         layers.reduce_sum(cost),
         layers.elementwise_add(layers.reduce_sum(non_pad),
                                layers.fill_constant([1], "float32", 1e-8)))
+    for aux in aux_losses:  # Switch load-balancing losses (MoE configs)
+        avg_cost = layers.elementwise_add(
+            avg_cost, layers.scale(aux, scale=cfg.moe_aux_weight))
     return src_word, tgt_word, lbl_word, avg_cost, logits
 
 

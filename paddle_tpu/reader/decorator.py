@@ -7,7 +7,7 @@ import random
 from queue import Queue
 from threading import Thread
 
-__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+__all__ = ["PipeReader", "map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache"]
 
 
@@ -165,3 +165,63 @@ def cache(reader):
             yield d
 
     return cache_reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (ref:
+    python/paddle/reader/decorator.py:438 — used to read sharded datasets
+    from `hadoop fs -cat` style pipes).  ``get_line`` yields decoded lines
+    split on ``line_break``; callers parse each into a sample."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("PipeReader command must be a string")
+        import subprocess
+
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+        if file_type == "gzip":
+            import zlib
+
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        elif file_type != "plain":
+            raise TypeError(f"file_type {file_type} is not allowed")
+
+    def close(self):
+        if self.process.poll() is None:
+            self.process.terminate()
+        if self.process.stdout and not self.process.stdout.closed:
+            self.process.stdout.close()
+        self.process.wait()
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
+
+        # incremental decoder: a multibyte UTF-8 char split across the
+        # bufsize boundary must not be dropped
+        decoder = codecs.getincrementaldecoder("utf-8")("ignore")
+        remained = ""
+        try:
+            while True:
+                buff = self.process.stdout.read(self.bufsize)
+                if not buff:
+                    break
+                if self.file_type == "gzip":
+                    buff = self.dec.decompress(buff)
+                decomp_buff = decoder.decode(buff)
+                if not cut_lines:
+                    yield decomp_buff
+                    continue
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            remained += decoder.decode(b"", final=True)
+            if remained:
+                yield remained
+        finally:
+            # consumers that stop early (firstn) must not leak the child
+            self.close()

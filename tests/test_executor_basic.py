@@ -117,3 +117,44 @@ def test_profiler_aggregates_and_timeline(tmp_path, capsys):
     assert r.returncode == 0, r.stderr
     trace = json.loads(open(tpath).read())
     assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+def test_check_nan_inf_flag_names_the_bad_var():
+    """FLAGS_check_nan_inf (ref operator.cc:643): executor faults with the
+    variable name on the first non-finite value."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.log(x)  # log(-1) -> NaN
+    loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.core.init_gflags(["--check_nan_inf=1"])
+    try:
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            exe.run(fluid.default_main_program(),
+                    feed={"x": -np.ones((2, 4), np.float32)},
+                    fetch_list=[loss])
+    finally:
+        fluid.core.GLOBAL_FLAGS["check_nan_inf"] = False
+
+
+def test_init_gflags_tryfromenv_and_direct():
+    import os
+
+    import paddle_tpu.fluid as fluid
+
+    os.environ["FLAGS_fraction_of_gpu_memory_to_use"] = "0.3"
+    try:
+        fluid.core.init_gflags(
+            ["--tryfromenv=fraction_of_gpu_memory_to_use,missing_flag",
+             "--rpc_deadline=5000"])
+        assert fluid.core.GLOBAL_FLAGS[
+            "fraction_of_gpu_memory_to_use"] == 0.3
+        assert fluid.core.GLOBAL_FLAGS["rpc_deadline"] == 5000
+        assert "missing_flag" not in fluid.core.GLOBAL_FLAGS
+    finally:
+        del os.environ["FLAGS_fraction_of_gpu_memory_to_use"]

@@ -1,0 +1,78 @@
+"""Executor.run_steps: K training steps in one dispatch (lax.scan over the
+traced step, donated state carry) must reproduce K sequential Executor.run
+calls exactly — the TPU host-loop amortization behind the bench."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+
+
+def _build(seed=13):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=32, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    return loss
+
+
+def test_run_steps_same_feed_matches_sequential():
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = rng.randint(0, 10, size=(8, 1)).astype(np.int64)
+
+    seq_losses = []
+    for _ in range(5):
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": x, "label": y}, fetch_list=[loss])
+        seq_losses.append(float(np.asarray(l).reshape(-1)[0]))
+    seq_params = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+
+    for k, v in init.items():
+        scope.set(k, v)
+    (l,) = exe.run_steps(fluid.default_main_program(),
+                         feed={"img": x, "label": y}, fetch_list=[loss],
+                         n_steps=5)
+    np.testing.assert_allclose(float(np.asarray(l).reshape(-1)[0]),
+                               seq_losses[-1], rtol=1e-5, atol=1e-6)
+    for k, v in seq_params.items():
+        np.testing.assert_allclose(np.asarray(scope.get(k)), v,
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_run_steps_stacked_feed_matches_sequential():
+    loss = _build(seed=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+
+    rng = np.random.RandomState(1)
+    xs = rng.normal(size=(4, 8, 16)).astype(np.float32)
+    ys = rng.randint(0, 10, size=(4, 8, 1)).astype(np.int64)
+
+    seq = []
+    for i in range(4):
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": xs[i], "label": ys[i]},
+                       fetch_list=[loss])
+        seq.append(float(np.asarray(l).reshape(-1)[0]))
+
+    for k, v in init.items():
+        scope.set(k, v)
+    (l,) = exe.run_steps(fluid.default_main_program(),
+                         feed={"img": xs, "label": ys}, fetch_list=[loss],
+                         n_steps=4, feed_per_step=True)
+    np.testing.assert_allclose(float(np.asarray(l).reshape(-1)[0]), seq[-1],
+                               rtol=1e-5, atol=1e-6)
