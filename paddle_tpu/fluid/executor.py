@@ -482,11 +482,6 @@ class Executor:
                     "run_steps: LoD feeds are not supported in the "
                     "scanned loop; use Executor.run per step")
             feed_arrays[k] = arr
-        plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
-        if plan.needs_eager:
-            raise RuntimeError(
-                "run_steps: program contains data-dependent eager ops; "
-                "use Executor.run per step")
         from . import amp as _amp
 
         key = ("run_steps", id(program), program._version,
@@ -500,6 +495,12 @@ class Executor:
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key)
         if entry is None:
+            plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+            if plan.needs_eager:
+                raise RuntimeError(
+                    "run_steps: program contains data-dependent eager "
+                    "ops; use Executor.run per step")
+
             def kfn(feed_vals, const_state, mut_state):
                 def body(carry, xs):
                     mut, _prev_fetch = carry
@@ -515,11 +516,19 @@ class Executor:
                 first_feed = (
                     {k: v[0] for k, v in feed_vals.items()}
                     if feed_per_step else feed_vals)
-                fetch0 = jax.eval_shape(
+                fetch0, state0 = jax.eval_shape(
                     lambda st: trace_block(program, 0, plan, first_feed,
-                                           {**const_state, **st})[0],
+                                           {**const_state, **st}),
                     mut_state)
                 fetch0 = [_jnp.zeros(t.shape, t.dtype) for t in fetch0]
+                # write-only persistables (written before first read, e.g.
+                # a decayed lr var) appear in new_state but not in
+                # _gather_state's mut_state — seed them so the carry
+                # structure is stable across scan iterations
+                mut_state = dict(mut_state)
+                for k, t in state0.items():
+                    if k not in mut_state:
+                        mut_state[k] = _jnp.zeros(t.shape, t.dtype)
                 xs = feed_vals if feed_per_step else None
                 (mut_final, last), _ = _lax.scan(
                     body, (mut_state, fetch0), xs, length=n_steps)

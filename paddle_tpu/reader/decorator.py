@@ -199,6 +199,7 @@ class PipeReader:
 
     def get_line(self, cut_lines=True, line_break="\n"):
         import codecs
+        import zlib
 
         # incremental decoder: a multibyte UTF-8 char split across the
         # bufsize boundary must not be dropped
@@ -210,7 +211,14 @@ class PipeReader:
                 if not buff:
                     break
                 if self.file_type == "gzip":
-                    buff = self.dec.decompress(buff)
+                    out = [self.dec.decompress(buff)]
+                    # concatenated members (one per shard in `cat *.gz`
+                    # pipes): restart the decompressor on leftover bytes
+                    while self.dec.eof and self.dec.unused_data:
+                        rest = self.dec.unused_data
+                        self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                        out.append(self.dec.decompress(rest))
+                    buff = b"".join(out)
                 decomp_buff = decoder.decode(buff)
                 if not cut_lines:
                     yield decomp_buff

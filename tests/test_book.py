@@ -155,3 +155,257 @@ def test_machine_translation(tmp_path):
     (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[logits])
     _infer_roundtrip(tmp_path, exe, ["src_word", "tgt_word"], [logits],
                      probe, ref)
+
+
+def test_recognize_digits_conv(tmp_path):
+    """LeNet-style conv net on mnist (ref book chapter 2,
+    test_recognize_digits.py conv variant)."""
+    from paddle_tpu.fluid.nets import simple_img_conv_pool
+
+    fluid.default_startup_program().random_seed = 5
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c1 = simple_img_conv_pool(img, num_filters=8, filter_size=5,
+                              pool_size=2, pool_stride=2, act="relu")
+    c2 = simple_img_conv_pool(c1, num_filters=16, filter_size=5,
+                              pool_size=2, pool_stride=2, act="relu")
+    predict = fluid.layers.fc(input=c2, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    reader = paddle_tpu.batch(paddle_tpu.dataset.mnist.train(), 64)
+    feeder = fluid.DataFeeder(feed_list=[img, label],
+                              place=fluid.CPUPlace())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses, accs = [], []
+    for batch in reader():
+        l, a = exe.run(fluid.default_main_program(),
+                       feed=feeder.feed(batch), fetch_list=[loss, acc])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+        if len(losses) >= 40:
+            break
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    assert accs[-1] > accs[0]
+
+    probe = {"img": np.zeros((2, 1, 28, 28), np.float32)}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[predict])
+    _infer_roundtrip(tmp_path, exe, ["img"], [predict], probe, ref)
+
+
+def test_image_classification(tmp_path):
+    """Small VGG-style conv net on cifar10 (ref book chapter 3,
+    test_image_classification.py)."""
+    fluid.default_startup_program().random_seed = 6
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.conv2d(input=img, num_filters=16, filter_size=3,
+                            padding=1, act="relu", bias_attr=False)
+    h = fluid.layers.batch_norm(input=h)
+    h = fluid.layers.pool2d(input=h, pool_size=2, pool_stride=2)
+    h = fluid.layers.conv2d(input=h, num_filters=32, filter_size=3,
+                            padding=1, act="relu", bias_attr=False)
+    h = fluid.layers.batch_norm(input=h)
+    h = fluid.layers.pool2d(input=h, pool_size=2, pool_stride=2)
+    predict = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    reader = paddle_tpu.batch(paddle_tpu.dataset.cifar.train10(), 64)
+    feeder = fluid.DataFeeder(feed_list=[img, label],
+                              place=fluid.CPUPlace())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for batch in reader():
+        batch = [(np.asarray(x, np.float32).reshape(3, 32, 32), y)
+                 for x, y in batch]
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed=feeder.feed(batch), fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+        if len(losses) >= 30:
+            break
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    probe = {"img": np.zeros((2, 3, 32, 32), np.float32)}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[predict])
+    _infer_roundtrip(tmp_path, exe, ["img"], [predict], probe, ref)
+
+
+def test_understand_sentiment(tmp_path):
+    """Sentiment classification on imdb (ref book chapter 6,
+    test_understand_sentiment.py) — static-shape variant: reviews padded/
+    truncated to a fixed length, mean-pooled embeddings + fc."""
+    from paddle_tpu.dataset import imdb
+
+    fluid.default_startup_program().random_seed = 7
+    word_idx = imdb.word_dict()
+    dict_size = len(word_idx) + 2
+    seq_len = 64
+
+    words = fluid.layers.data(name="words", shape=[seq_len], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(input=words, size=[dict_size, 32])
+    pooled = fluid.layers.reduce_mean(emb, dim=1)
+    h = fluid.layers.fc(input=pooled, size=32, act="relu")
+    predict = fluid.layers.fc(input=h, size=2, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    def pad(ids):
+        ids = list(ids)[:seq_len]
+        return np.array(ids + [0] * (seq_len - len(ids)), np.int64)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    batch_w, batch_y = [], []
+    for ids, y in imdb.train(word_idx)():
+        batch_w.append(pad(ids))
+        batch_y.append([y])
+        if len(batch_w) == 32:
+            (l,) = exe.run(fluid.default_main_program(),
+                           feed={"words": np.stack(batch_w),
+                                 "label": np.array(batch_y, np.int64)},
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+            batch_w, batch_y = [], []
+            if len(losses) >= 40:
+                break
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    probe = {"words": np.zeros((2, seq_len), np.int64)}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[predict])
+    _infer_roundtrip(tmp_path, exe, ["words"], [predict], probe, ref)
+
+
+def test_recommender_system(tmp_path):
+    """Embedding-tower rating regression on movielens (ref book chapter 5,
+    test_recommender_system.py, scalar-feature variant)."""
+    from paddle_tpu.dataset import movielens
+
+    fluid.default_startup_program().random_seed = 8
+    uid = fluid.layers.data(name="uid", shape=[1], dtype="int64")
+    gender = fluid.layers.data(name="gender", shape=[1], dtype="int64")
+    age = fluid.layers.data(name="age", shape=[1], dtype="int64")
+    job = fluid.layers.data(name="job", shape=[1], dtype="int64")
+    mid = fluid.layers.data(name="mid", shape=[1], dtype="int64")
+    score = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+    def tower(feats, sizes, emb_dim=8):
+        embs = [fluid.layers.embedding(input=f, size=[s, emb_dim])
+                for f, s in zip(feats, sizes)]
+        cat = fluid.layers.concat(input=embs, axis=1)
+        return fluid.layers.fc(input=cat, size=32, act="relu")
+
+    usr = tower([uid, gender, age, job], [6100, 2, 8, 25])
+    mov = tower([mid], [4000])
+    both = fluid.layers.concat(input=[usr, mov], axis=1)
+    pred_score = fluid.layers.fc(input=both, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred_score, label=score))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses, batch = [], []
+    for s in movielens.train()():
+        batch.append(s)
+        if len(batch) == 64:
+            feed = {
+                "uid": np.array([[b[0]] for b in batch], np.int64),
+                "gender": np.array([[b[1]] for b in batch], np.int64),
+                "age": np.array([[b[2]] for b in batch], np.int64),
+                "job": np.array([[b[3]] for b in batch], np.int64),
+                "mid": np.array([[b[4]] for b in batch], np.int64),
+                "score": np.array([[b[7]] for b in batch], np.float32)}
+            (l,) = exe.run(fluid.default_main_program(), feed=feed,
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+            batch = []
+            if len(losses) >= 40:
+                break
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    probe = {"uid": np.array([[1]], np.int64),
+             "gender": np.array([[0]], np.int64),
+             "age": np.array([[3]], np.int64),
+             "job": np.array([[2]], np.int64),
+             "mid": np.array([[7]], np.int64)}
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=probe, fetch_list=[pred_score])
+    _infer_roundtrip(tmp_path, exe, list(probe), [pred_score], probe, ref)
+
+
+def test_label_semantic_roles(tmp_path):
+    """SRL tagging on conll05 with a linear-chain CRF (ref book chapter 7,
+    test_label_semantic_roles.py) — word+predicate+mark embeddings, fc
+    emission, CRF loss, viterbi decode after training."""
+    from paddle_tpu.dataset import conll05
+
+    fluid.default_startup_program().random_seed = 9
+    word_d, verb_d, label_d = conll05.get_dict()
+
+    word = fluid.layers.data(name="word", shape=[1], dtype="int64",
+                             lod_level=1)
+    verb = fluid.layers.data(name="verb", shape=[1], dtype="int64",
+                             lod_level=1)
+    mark = fluid.layers.data(name="mark", shape=[1], dtype="int64",
+                             lod_level=1)
+    target = fluid.layers.data(name="target", shape=[1], dtype="int64",
+                               lod_level=1)
+    embs = [fluid.layers.embedding(input=word, size=[len(word_d), 16]),
+            fluid.layers.embedding(input=verb, size=[len(verb_d), 16]),
+            fluid.layers.embedding(input=mark, size=[2, 16])]
+    feat = fluid.layers.concat(input=embs, axis=1)
+    h = fluid.layers.fc(input=feat, size=32, act="tanh")
+    emission = fluid.layers.fc(input=h, size=len(label_d))
+    crf_cost = fluid.layers.linear_chain_crf(
+        emission, target, param_attr=fluid.ParamAttr(name="crfw"))
+    loss = fluid.layers.mean(crf_cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def lod_feed(samples):
+        lens = [len(s[0]) for s in samples]
+        cat = lambda idx: (np.concatenate(
+            [np.asarray(s[idx], np.int64) for s in samples]
+        ).reshape(-1, 1), [lens])
+        return {"word": cat(0), "verb": cat(6), "mark": cat(7),
+                "target": cat(8)}
+
+    losses, batch = [], []
+    for s in conll05.test()():
+        batch.append(s)
+        if len(batch) == 8:
+            (l,) = exe.run(fluid.default_main_program(),
+                           feed=lod_feed(batch), fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+            batch = []
+            if len(losses) >= 25:
+                break
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    # viterbi decode runs on the trained weights
+    decode = fluid.layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(name="crfw"))
+    samples = []
+    for s in conll05.test()():
+        samples.append(s)
+        if len(samples) == 2:
+            break
+    (path,) = exe.run(fluid.default_main_program(),
+                      feed=lod_feed(samples), fetch_list=[decode])
+    path = np.asarray(path).ravel()
+    assert path.shape[0] == sum(len(s[0]) for s in samples)
+    assert ((0 <= path) & (path < len(label_d))).all()

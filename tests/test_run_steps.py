@@ -76,3 +76,29 @@ def test_run_steps_stacked_feed_matches_sequential():
                          n_steps=4, feed_per_step=True)
     np.testing.assert_allclose(float(np.asarray(l).reshape(-1)[0]), seq[-1],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_with_lr_decay_write_only_state():
+    """A decayed-lr program has a persistable lr var that is written before
+    it is read (write-only in state_in terms) — the scan carry must stay
+    structurally stable (review regression)."""
+    fluid.default_main_program().random_seed = 2
+    fluid.default_startup_program().random_seed = 2
+    img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(input=img, size=4, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    lr = fluid.layers.learning_rate_scheduler.exponential_decay(
+        learning_rate=0.1, decay_steps=2, decay_rate=0.9)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    y = rng.randint(0, 4, size=(8, 1)).astype(np.int64)
+    (l,) = exe.run_steps(fluid.default_main_program(),
+                         feed={"img": x, "label": y}, fetch_list=[loss],
+                         n_steps=5)
+    assert np.isfinite(float(np.asarray(l).reshape(-1)[0]))
