@@ -495,6 +495,9 @@ class Executor:
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key)
         if entry is None:
+            from .log import VLOG
+
+            VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan")
             plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
             if plan.needs_eager:
                 raise RuntimeError(
@@ -606,6 +609,11 @@ class Executor:
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            from .log import VLOG
+
+            VLOG(1, f"Executor: compiling block "
+                    f"({len(program.global_block().ops)} ops, "
+                    f"fetches={fetch_names})")
             plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
             lod_box = {}
             all_lods = dict(state_lods)

@@ -61,6 +61,10 @@ def init(coordinator_addr: Optional[str] = None,
             "multihost.init: trainers > 1 but no coordinator address; set "
             "PADDLE_COORDINATOR_ADDR (or PADDLE_PSERVER_EPS) or pass "
             "coordinator_addr")
+    from ..fluid.log import VLOG
+
+    VLOG(1, f"multihost: jax.distributed.initialize coordinator="
+            f"{coordinator_addr} procs={num_processes} id={process_id}")
     try:
         jax.distributed.initialize(coordinator_addr, num_processes,
                                    process_id, local_device_ids)

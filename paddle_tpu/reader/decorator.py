@@ -213,8 +213,12 @@ class PipeReader:
                 if self.file_type == "gzip":
                     out = [self.dec.decompress(buff)]
                     # concatenated members (one per shard in `cat *.gz`
-                    # pipes): restart the decompressor on leftover bytes
-                    while self.dec.eof and self.dec.unused_data:
+                    # pipes): restart the decompressor on leftover bytes —
+                    # but only when they start a real member; gzip(1)
+                    # tolerates trailing garbage (block padding) and so
+                    # must we
+                    while self.dec.eof and \
+                            self.dec.unused_data.startswith(b"\x1f\x8b"):
                         rest = self.dec.unused_data
                         self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
                         out.append(self.dec.decompress(rest))

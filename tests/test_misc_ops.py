@@ -178,3 +178,25 @@ def test_memory_usage_estimate():
     assert 0 < lo < hi
     # params alone: 784*100*4 + 100*4 ~ 0.3MB; activations add more
     assert hi > 0.3
+
+
+def test_vlog_levels(capsys):
+    """glog-style VLOG (ref: GLOG_v env contract, test_dist_base.py:237)."""
+    import os
+
+    from paddle_tpu.fluid.log import VLOG, vlog_is_on
+
+    old = os.environ.get("GLOG_v")
+    try:
+        os.environ["GLOG_v"] = "2"
+        assert vlog_is_on(2) and not vlog_is_on(3)
+        VLOG(2, "visible")
+        VLOG(3, "hidden")
+        err = capsys.readouterr().err
+        assert "visible" in err and "hidden" not in err
+        assert "paddle_tpu]" in err
+    finally:
+        if old is None:
+            os.environ.pop("GLOG_v", None)
+        else:
+            os.environ["GLOG_v"] = old
