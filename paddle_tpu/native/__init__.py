@@ -18,7 +18,8 @@ import threading
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libpaddle_tpu_native.so")
 _SRC = [os.path.join(_HERE, "recordio.cc"),
-        os.path.join(_HERE, "blocking_queue.cc")]
+        os.path.join(_HERE, "blocking_queue.cc"),
+        os.path.join(_HERE, "prefetch.cc")]
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -83,6 +84,15 @@ def get_lib():
         lib.pt_queue_is_closed.argtypes = [ctypes.c_void_p]
         lib.pt_queue_size.restype = ctypes.c_long
         lib.pt_queue_size.argtypes = [ctypes.c_void_p]
+        lib.pt_prefetch_create.restype = ctypes.c_void_p
+        lib.pt_prefetch_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_long]
+        lib.pt_prefetch_next.restype = ctypes.c_long
+        lib.pt_prefetch_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_double]
+        lib.pt_prefetch_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -325,5 +335,101 @@ class BlockingQueue:
             if self._lib and self._h:
                 self._lib.pt_queue_destroy(self._h)
                 self._h = None
+        except Exception:
+            pass
+
+
+class PrefetchReader:
+    """Multi-threaded prefetching reader over recordio shards (ref: the
+    reference's open_files + double_buffer native reader stack,
+    operators/reader/open_files_op.cc, create_double_buffer_reader_op.cc).
+    N C++ threads scan the files and stage records in a bounded buffer;
+    iteration yields raw record bytes.  An unopenable or corrupt shard
+    raises IOError (after already-buffered records drain) rather than
+    silently truncating the dataset.  Pure-Python thread fallback (over
+    the module's BlockingQueue) when no native toolchain is available."""
+
+    def __init__(self, paths, n_threads: int = 2, capacity: int = 256):
+        self._paths = [os.fspath(p) for p in paths]
+        self._lib = get_lib()
+        self._h = None
+        self._done = False
+        if self._lib is not None:
+            arr = (ctypes.c_char_p * len(self._paths))(
+                *[p.encode() for p in self._paths])
+            self._h = ctypes.c_void_p(self._lib.pt_prefetch_create(
+                arr, len(self._paths), int(n_threads), int(capacity)))
+            return
+        # fallback: worker threads over the (pure-Python) BlockingQueue;
+        # q.push returning False after close() stops abandoned workers
+        self._q = BlockingQueue(capacity)
+        self._errors: list = []
+        n = max(1, min(int(n_threads), len(self._paths) or 1))
+        self._live_left = n
+        self._live_lock = threading.Lock()
+
+        def work(start):
+            try:
+                for i in range(start, len(self._paths), n):
+                    for rec in RecordIOScanner(self._paths[i]):
+                        if not self._q.push(rec):
+                            return  # reader closed early
+            except Exception as exc:  # surfaced to the consumer
+                self._errors.append(exc)
+            finally:
+                with self._live_lock:
+                    self._live_left -= 1
+                    if self._live_left == 0:
+                        self._q.close()
+
+        for t in range(n):
+            threading.Thread(target=work, args=(t,), daemon=True).start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._done:
+            raise StopIteration
+        if self._lib is not None:
+            out = ctypes.c_char_p()
+            n = self._lib.pt_prefetch_next(
+                self._h, ctypes.byref(out), ctypes.c_double(-1.0))
+            if n == -3:
+                self.close()
+                raise IOError(
+                    "PrefetchReader: a shard was unreadable or corrupt")
+            if n < 0:
+                self.close()
+                raise StopIteration
+            data = ctypes.string_at(out, n)
+            self._lib.pt_free(out)
+            return data
+        rec = self._q.pop()
+        if rec is None:  # closed + drained
+            self._done = True
+            if self._errors:
+                raise IOError(
+                    f"PrefetchReader: shard failed: {self._errors[0]!r}")
+            raise StopIteration
+        return rec
+
+    def close(self):
+        self._done = True
+        if self._h is not None:
+            self._lib.pt_prefetch_destroy(self._h)
+            self._h = None
+        elif self._lib is None and hasattr(self, "_q"):
+            self._q.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:
             pass

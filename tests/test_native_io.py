@@ -232,3 +232,60 @@ def test_dataset_breadth_shapes():
     assert src[0] == D.wmt16.START_ID and len(trg) == len(nxt)
     img, lab2 = next(D.flowers.train()())
     assert img.shape == (3 * 64 * 64,)
+
+
+def test_prefetch_reader_native_and_fallback(tmp_path):
+    """Multi-threaded shard prefetcher (ref: open_files + double_buffer
+    native reader stack) — native C++ and pure-Python paths yield the same
+    record multiset."""
+    import unittest.mock as mock
+
+    from paddle_tpu import native
+
+    paths = []
+    expected = set()
+    for s in range(3):
+        p = str(tmp_path / f"shard_{s}.ptr")
+        with native.RecordIOWriter(p) as w:
+            for i in range(40):
+                rec = f"s{s}r{i}".encode()
+                w.write(rec)
+                expected.add(rec)
+        paths.append(p)
+
+    got = sorted(native.PrefetchReader(paths, n_threads=3, capacity=8))
+    assert set(got) == expected and len(got) == 120
+
+    with mock.patch.object(native, "get_lib", lambda: None):
+        got_py = sorted(native.PrefetchReader(paths, n_threads=2))
+    assert got_py == got
+
+
+def test_prefetch_reader_error_and_exhaustion(tmp_path):
+    """A missing/corrupt shard raises IOError on both paths; an exhausted
+    reader keeps raising StopIteration (iterator protocol)."""
+    import unittest.mock as mock
+
+    import pytest
+
+    from paddle_tpu import native
+
+    p = str(tmp_path / "ok.ptr")
+    with native.RecordIOWriter(p) as w:
+        for i in range(5):
+            w.write(f"r{i}".encode())
+    missing = str(tmp_path / "missing.ptr")
+
+    r = native.PrefetchReader([p])
+    assert len(list(r)) == 5
+    with pytest.raises(StopIteration):
+        next(r)
+    with pytest.raises(StopIteration):
+        next(r)
+
+    if native.native_available():
+        with pytest.raises(IOError):
+            list(native.PrefetchReader([p, missing]))
+    with mock.patch.object(native, "get_lib", lambda: None):
+        with pytest.raises(IOError):
+            list(native.PrefetchReader([p, missing]))
