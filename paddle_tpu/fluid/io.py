@@ -54,12 +54,26 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         with open(os.path.join(dirname, filename), "wb") as f:
             np.savez(f, **blob)
         return
+    write_var_files(dirname, snapshot_vars(scope, var_list))
+
+
+def snapshot_vars(scope, var_list) -> dict:
+    """Host-side {name: ndarray} snapshot of the vars present in scope
+    (one D2H sync; shared by the sync and async checkpoint writers)."""
+    snap = {}
     for v in var_list:
         val = scope.get(v.name)
-        if val is None:
-            continue
-        with open(os.path.join(dirname, v.name), "wb") as f:
-            np.save(f, np.asarray(val), allow_pickle=False)
+        if val is not None:
+            snap[v.name] = np.asarray(val)
+    return snap
+
+
+def write_var_files(dirname, snapshot: dict) -> None:
+    """One file per var, np.save format — the single place that encodes
+    the per-var on-disk layout (load_vars is its reader)."""
+    for name, arr in snapshot.items():
+        with open(os.path.join(dirname, name), "wb") as f:
+            np.save(f, arr, allow_pickle=False)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):

@@ -17,6 +17,7 @@ load_sharded) under the same serial-dir protocol.
 from __future__ import annotations
 
 import json
+import threading
 import os
 import shutil
 
@@ -74,12 +75,18 @@ TRAINER_ARGS_FILE = "trainer_args.json"
 
 class CheckpointConfig:
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10, async_save=False):
         self.checkpoint_dir = checkpoint_dir or os.path.join(
             os.getcwd(), "checkpoint")
         self.max_num_checkpoints = int(max_num_checkpoints)
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
+        # async_save: snapshot device state synchronously (cheap D2H),
+        # write files in a background thread so the train loop never
+        # blocks on checkpoint IO — the orbax-style async checkpoint,
+        # and the TPU answer to the reference pserver's background
+        # checkpoint thread (ref go/pserver/service.go:346)
+        self.async_save = bool(async_save)
         # filled on restore
         self.epoch_id = 0
         self.step_id = 0
@@ -108,24 +115,95 @@ def _latest_complete_serial(root):
     return -1
 
 
+_ckpt_threads = []
+_ckpt_errors = []
+_ckpt_lock = threading.Lock()
+_ckpt_reserved = {}  # checkpoint_dir -> highest serial handed out
+
+
+def wait_for_checkpoints():
+    """Barrier for async saves (call before process exit / evaluation that
+    reads checkpoint files).  Re-raises the first background write error —
+    a failed checkpoint must not pass silently (the sync path raises)."""
+    with _ckpt_lock:
+        pending = list(_ckpt_threads)
+    for t in pending:
+        t.join()
+    with _ckpt_lock:
+        _ckpt_threads[:] = [t for t in _ckpt_threads if t.is_alive()]
+        if _ckpt_errors:
+            exc = _ckpt_errors[0]
+            _ckpt_errors.clear()
+            raise IOError(
+                f"async checkpoint write failed: {exc!r}") from exc
+
+
 def save_checkpoint(executor, checkpoint_dir, main_program,
-                    trainer_args=None, max_num_checkpoints=3):
+                    trainer_args=None, max_num_checkpoints=3,
+                    background=False):
     """Write serial dir -> persistables -> trainer args -> _SUCCESS, then
-    scroll-delete old serials (ref: trainer.py:663,1190)."""
-    serial = _latest_complete_serial(checkpoint_dir) + 1
+    scroll-delete old serials (ref: trainer.py:663,1190).
+
+    background=True snapshots the persistables to host memory NOW (one
+    D2H sync) and does the file IO in a daemon thread; _SUCCESS is still
+    written last, so a crash mid-write leaves an ignorable incomplete
+    dir.  wait_for_checkpoints() joins outstanding writers and re-raises
+    their errors."""
+    root = os.path.abspath(checkpoint_dir)
+    with _ckpt_lock:
+        # an in-flight async serial has no _SUCCESS yet — reserve serials
+        # so overlapping saves never share a directory
+        serial = max(_latest_complete_serial(checkpoint_dir),
+                     _ckpt_reserved.get(root, -1)) + 1
+        _ckpt_reserved[root] = serial
     cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
     os.makedirs(cur, exist_ok=True)
-    io.save_persistables(executor, cur, main_program)
+    if not background:
+        io.save_persistables(executor, cur, main_program)
+        _finish_checkpoint(checkpoint_dir, cur, trainer_args,
+                           max_num_checkpoints)
+        return serial
+    from .executor import global_scope
+    from .io import _resolve_vars, is_persistable, snapshot_vars
+
+    snapshot = snapshot_vars(
+        global_scope(), _resolve_vars(main_program, is_persistable, None))
+
+    def write():
+        try:
+            io.write_var_files(cur, snapshot)
+            _finish_checkpoint(checkpoint_dir, cur, trainer_args,
+                               max_num_checkpoints)
+        except BaseException as exc:  # surfaced by wait_for_checkpoints
+            with _ckpt_lock:
+                _ckpt_errors.append(exc)
+
+    t = threading.Thread(target=write, daemon=True)
+    with _ckpt_lock:
+        # prune finished writers so long runs don't accumulate threads
+        _ckpt_threads[:] = [x for x in _ckpt_threads if x.is_alive()]
+        _ckpt_threads.append(t)
+    t.start()
+    return serial
+
+
+def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
+                       max_num_checkpoints):
     if trainer_args is not None:
         with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
             json.dump(trainer_args, f)
     with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
         f.write("")
-    # scroll-delete: keep newest max_num_checkpoints complete serials
-    serials = _serial_dirs(checkpoint_dir)
-    for _, name in serials[:max(0, len(serials) - max_num_checkpoints)]:
-        shutil.rmtree(os.path.join(checkpoint_dir, name), ignore_errors=True)
-    return serial
+    # scroll-delete: keep newest max_num_checkpoints complete serials,
+    # only ever deleting COMPLETE ones older than the newest keepers (an
+    # in-flight async serial has no _SUCCESS yet and must survive)
+    with _ckpt_lock:
+        serials = [(n, name) for n, name in _serial_dirs(checkpoint_dir)
+                   if os.path.exists(os.path.join(
+                       checkpoint_dir, name, SUCCESS_MARK))]
+        for _, name in serials[:max(0, len(serials) - max_num_checkpoints)]:
+            shutil.rmtree(os.path.join(checkpoint_dir, name),
+                          ignore_errors=True)
 
 
 def load_checkpoint(executor, checkpoint_dir, main_program):
@@ -204,6 +282,17 @@ class Trainer:
         start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
         feeder = DataFeeder(feed_list=feed_order, place=self.place,
                             program=self.train_program)
+        try:
+            self._train_loop(start_epoch, num_epochs, event_handler, reader,
+                             feeder)
+        finally:
+            if self.checkpoint_cfg and self.checkpoint_cfg.async_save:
+                # drain background writes even on an exception mid-epoch —
+                # the newest checkpoint is exactly what a crash-resume needs
+                wait_for_checkpoints()
+
+    def _train_loop(self, start_epoch, num_epochs, event_handler, reader,
+                    feeder):
         last_epoch_saved = None
         for epoch_id in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
@@ -266,4 +355,5 @@ class Trainer:
                 "step_id": -1 if end_of_epoch else step_id}
         save_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir,
                         self.train_program, trainer_args=args,
-                        max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints)
+                        max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+                        background=self.checkpoint_cfg.async_save)

@@ -192,3 +192,56 @@ def test_assign_writer_deterministic_and_balanced():
     assert h1["w_0"] == zlib.crc32(b"w_0") % 4
     d = HashName(["ep0", "ep1"])
     assert d.dispatch(["a", "b", "a"])[0] == d.dispatch(["a"])[0]
+
+
+def test_async_checkpoint_equals_sync(tmp_path):
+    """background=True saves produce checkpoints identical to synchronous
+    ones, and wait_for_checkpoints() is a reliable barrier."""
+    from paddle_tpu.fluid import trainer as tr
+
+    fluid.default_main_program().random_seed = 3
+    fluid.default_startup_program().random_seed = 3
+    img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(input=img, size=4, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    exe.run(fluid.default_main_program(),
+            feed={"img": rng.normal(size=(8, 8)).astype(np.float32),
+                  "label": rng.randint(0, 4, size=(8, 1)).astype(np.int64)},
+            fetch_list=[loss])
+
+    d_sync = str(tmp_path / "sync")
+    d_async = str(tmp_path / "async")
+    tr.save_checkpoint(exe, d_sync, fluid.default_main_program(),
+                       trainer_args={"epoch_id": 1, "step_id": 5})
+    tr.save_checkpoint(exe, d_async, fluid.default_main_program(),
+                       trainer_args={"epoch_id": 1, "step_id": 5},
+                       background=True)
+    tr.wait_for_checkpoints()
+
+    import os
+    sdir = os.path.join(d_sync, "checkpoint_0")
+    adir = os.path.join(d_async, "checkpoint_0")
+    assert os.path.exists(os.path.join(adir, "_SUCCESS"))
+    sync_files = sorted(os.listdir(sdir))
+    assert sorted(os.listdir(adir)) == sync_files
+    for fn in sync_files:
+        if fn in ("_SUCCESS", "trainer_args.json"):
+            continue
+        a = np.load(os.path.join(sdir, fn))
+        b = np.load(os.path.join(adir, fn))
+        np.testing.assert_array_equal(a, b)
+
+    # restore from the async checkpoint round-trips
+    scope = _executor._global_scope
+    w_before = np.asarray(scope.get("fc_0.w_0"))
+    scope.set("fc_0.w_0", np.zeros_like(w_before))
+    args = tr.load_checkpoint(exe, d_async, fluid.default_main_program())
+    assert args == {"epoch_id": 1, "step_id": 5}
+    np.testing.assert_array_equal(np.asarray(scope.get("fc_0.w_0")),
+                                  w_before)

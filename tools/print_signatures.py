@@ -1,0 +1,87 @@
+"""Dump the public API signatures, one per line, for API-diff checks
+(ref: tools/print_signatures.py / tools/diff_api.py — the reference's CI
+compares this listing against a golden file to catch accidental API
+breaks).
+
+Usage: python tools/print_signatures.py [module] > API.spec
+       python tools/print_signatures.py --diff API.spec [module]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import inspect
+import os
+import sys
+
+
+def _signature_of(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def walk(module_name: str):
+    """'qualified.name sig' lines for every public callable reachable from
+    the module's __all__ (or public attrs), submodules up to 3 deep — the
+    surface the reference's tool enumerates."""
+    mod = importlib.import_module(module_name)
+    seen, out = set(), []
+
+    def emit(prefix, obj, depth=0):
+        if depth > 3:
+            return
+        names = getattr(obj, "__all__", None) or \
+            [n for n in dir(obj) if not n.startswith("_")]
+        for n in sorted(names):
+            try:
+                a = getattr(obj, n)
+            except AttributeError:
+                continue
+            q = f"{prefix}.{n}"
+            if q in seen:
+                continue
+            seen.add(q)
+            if inspect.ismodule(a):
+                if getattr(a, "__name__", "").startswith(module_name):
+                    emit(q, a, depth + 1)
+            elif inspect.isclass(a):
+                out.append(f"{q} {_signature_of(a)}")
+                for m, fn in sorted(vars(a).items()):
+                    if m.startswith("_") or not callable(fn):
+                        continue
+                    out.append(f"{q}.{m} {_signature_of(fn)}")
+            elif callable(a):
+                out.append(f"{q} {_signature_of(a)}")
+
+    emit(module_name, mod)
+    return out
+
+
+def main(argv):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if argv and argv[0] == "--diff":
+        golden = open(argv[1]).read().splitlines()
+        golden = [l for l in golden if l and not l.startswith("#")]
+        current = walk(argv[2] if len(argv) > 2 else "paddle_tpu.fluid")
+        removed = sorted(set(golden) - set(current))
+        added = sorted(set(current) - set(golden))
+        for line in removed:
+            print(f"- {line}")
+        for line in added:
+            print(f"+ {line}")
+        return 1 if removed else 0
+    module = argv[0] if argv else "paddle_tpu.fluid"
+    lines = walk(module)
+    for line in lines:
+        print(line)
+    digest = hashlib.md5("\n".join(lines).encode()).hexdigest()
+    print(f"# {len(lines)} symbols, md5 {digest}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
