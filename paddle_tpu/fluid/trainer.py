@@ -115,27 +115,42 @@ def _latest_complete_serial(root):
     return -1
 
 
-_ckpt_threads = []
-_ckpt_errors = []
 _ckpt_lock = threading.Lock()
+_ckpt_state = {}  # ckpt root -> {"threads": [...], "errors": [...]}
 _ckpt_reserved = {}  # checkpoint_dir -> highest serial handed out
 
 
-def wait_for_checkpoints():
+def _state_for(root):
+    return _ckpt_state.setdefault(root, {"threads": [], "errors": []})
+
+
+def wait_for_checkpoints(checkpoint_dir=None):
     """Barrier for async saves (call before process exit / evaluation that
     reads checkpoint files).  Re-raises the first background write error —
-    a failed checkpoint must not pass silently (the sync path raises)."""
+    a failed checkpoint must not pass silently (the sync path raises).
+    State is scoped per checkpoint dir, so two Trainers in one process
+    never join or misattribute each other's writers; no dir = all dirs."""
+    roots = ([os.path.abspath(checkpoint_dir)] if checkpoint_dir
+             else None)
     with _ckpt_lock:
-        pending = list(_ckpt_threads)
+        if roots is None:
+            roots = list(_ckpt_state)
+        pending = [t for r in roots for t in
+                   _ckpt_state.get(r, {}).get("threads", [])]
     for t in pending:
         t.join()
     with _ckpt_lock:
-        _ckpt_threads[:] = [t for t in _ckpt_threads if t.is_alive()]
-        if _ckpt_errors:
-            exc = _ckpt_errors[0]
-            _ckpt_errors.clear()
-            raise IOError(
-                f"async checkpoint write failed: {exc!r}") from exc
+        for r in roots:
+            st = _ckpt_state.get(r)
+            if st is None:
+                continue
+            st["threads"][:] = [t for t in st["threads"] if t.is_alive()]
+            if st["errors"]:
+                exc = st["errors"][0]
+                st["errors"].clear()
+                raise IOError(
+                    f"async checkpoint write failed ({r}): "
+                    f"{exc!r}") from exc
 
 
 def save_checkpoint(executor, checkpoint_dir, main_program,
@@ -175,14 +190,18 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
             _finish_checkpoint(checkpoint_dir, cur, trainer_args,
                                max_num_checkpoints)
         except BaseException as exc:  # surfaced by wait_for_checkpoints
+            # a half-written serial is junk forever (it never gets
+            # _SUCCESS and the pruner skips incomplete dirs) — remove it
+            shutil.rmtree(cur, ignore_errors=True)
             with _ckpt_lock:
-                _ckpt_errors.append(exc)
+                _state_for(root)["errors"].append(exc)
 
     t = threading.Thread(target=write, daemon=True)
     with _ckpt_lock:
+        st = _state_for(root)
         # prune finished writers so long runs don't accumulate threads
-        _ckpt_threads[:] = [x for x in _ckpt_threads if x.is_alive()]
-        _ckpt_threads.append(t)
+        st["threads"][:] = [x for x in st["threads"] if x.is_alive()]
+        st["threads"].append(t)
     t.start()
     return serial
 
@@ -285,11 +304,18 @@ class Trainer:
         try:
             self._train_loop(start_epoch, num_epochs, event_handler, reader,
                              feeder)
-        finally:
+        except BaseException:
             if self.checkpoint_cfg and self.checkpoint_cfg.async_save:
-                # drain background writes even on an exception mid-epoch —
-                # the newest checkpoint is exactly what a crash-resume needs
-                wait_for_checkpoints()
+                # drain writes so the newest checkpoint lands, but never
+                # let a checkpoint error mask the primary training failure
+                try:
+                    wait_for_checkpoints(self.checkpoint_cfg.checkpoint_dir)
+                except Exception:
+                    pass
+            raise
+        else:
+            if self.checkpoint_cfg and self.checkpoint_cfg.async_save:
+                wait_for_checkpoints(self.checkpoint_cfg.checkpoint_dir)
 
     def _train_loop(self, start_epoch, num_epochs, event_handler, reader,
                     feeder):

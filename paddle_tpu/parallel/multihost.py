@@ -150,8 +150,9 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
     """Write this process's addressable shards of every array in ``state``.
 
     Layout: ckpt_dir/shard_<pid>/<var>.<i>.npy + manifest.json recording
-    each shard's global index slices.  Replicated (fully-addressable) vars
-    are written by process 0 only — once, not once per host."""
+    each shard's global index slices.  Replicated values are written once,
+    by a deterministically assigned process (round-robin over var names),
+    so checkpoint IO spreads across hosts instead of duplicating."""
     import json
 
     from ..fluid.transpiler.ps_dispatcher import assign_writer
@@ -160,11 +161,13 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
     d = os.path.join(ckpt_dir, f"shard_{pid}")
     os.makedirs(d, exist_ok=True)
     # balance replicated-var writes across hosts (the pserver-shard write
-    # layout, ref go/pserver/service.go:346) instead of serializing them
-    # all through process 0; every process derives the identical map
-    replicated = [n for n, a in state.items()
-                  if not isinstance(a, jax.Array) or a.is_fully_addressable]
-    writer_of = assign_writer(replicated, max(1, process_count()))
+    # layout, ref go/pserver/service.go:346) instead of every process (or
+    # only process 0) writing identical full blobs; every process derives
+    # the identical name->writer map.  NOTE a replicated array in a
+    # multihost world is NOT fully_addressable (its sharding spans other
+    # processes' devices) — replication shows up as a local shard whose
+    # index covers the whole array, handled in the shard loop below.
+    writer_of = assign_writer(list(state), max(1, process_count()))
     manifest = {}
     for name, arr in state.items():
         if not isinstance(arr, jax.Array):
@@ -188,6 +191,12 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
                 if idx in seen:  # replicated across local devices
                     continue
                 seen.add(idx)
+                full_cover = all(a == 0 and b == dim for (a, b), dim
+                                 in zip(idx, arr.shape))
+                if full_cover and writer_of.get(name, 0) != pid:
+                    # replicated across processes (incl. scalars, whose
+                    # empty index is trivially full): one assigned writer
+                    continue
                 fn = f"{_safe_name(name)}.{i}.npy"
                 np.save(os.path.join(d, fn), np.asarray(sh.data))
                 entry["shards"].append({"file": fn,

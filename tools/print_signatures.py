@@ -49,8 +49,13 @@ def walk(module_name: str):
                     emit(q, a, depth + 1)
             elif inspect.isclass(a):
                 out.append(f"{q} {_signature_of(a)}")
-                for m, fn in sorted(vars(a).items()):
-                    if m.startswith("_") or not callable(fn):
+                for m in sorted(vars(a)):
+                    if m.startswith("_"):
+                        continue
+                    # getattr, not the raw descriptor: classmethods/
+                    # staticmethods only look callable once bound
+                    fn = getattr(a, m, None)
+                    if not callable(fn):
                         continue
                     out.append(f"{q}.{m} {_signature_of(fn)}")
             elif callable(a):
