@@ -54,6 +54,8 @@ BASELINES = {
                             # published seq2seq-scale figure (BASELINE.md); the
                             # reference has no Transformer number.
     "mnist": 10000.0,       # images/sec, no published figure; nominal.
+    "resnet_infer": 217.69,  # images/sec, ResNet-50 infer bs=16
+                             # (IntelOptimizedPaddle.md:85-87)
 }
 
 # Peak dense bf16 TFLOPs per chip by TPU generation, for MFU reporting.
@@ -249,8 +251,52 @@ def bench_mnist(fluid, platform, on_accel):
                        ips, "images/sec/chip", "mnist")
 
 
+def bench_resnet_infer(fluid, platform, on_accel):
+    """Inference throughput via the predictor path (ref baseline: ResNet-50
+    infer bs16 = 217.69 images/sec on 2x Xeon 6148, IntelOptimizedPaddle
+    .md:85-87).  Forward-only for_test clone, deferred fetches."""
+    from paddle_tpu.models import resnet
+
+    batch = _env_int("resnet_infer", "BS", 16)
+    steps = _env_int("resnet_infer", "STEPS", 30 if on_accel else 3)
+    image_hw = 224 if on_accel else 64
+    class_dim = 1000 if on_accel else 100
+    img, label, prediction, loss, acc = resnet.build(
+        class_dim=class_dim, depth=50, image_shape=(3, image_hw, image_hw),
+        lr=0.1)
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(size=(batch, 3, image_hw, image_hw))
+            .astype(np.float32)}
+    if on_accel:
+        import jax
+
+        from paddle_tpu.fluid import core as _core
+
+        dev = _core.get_jax_device(place)
+        feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+    for _ in range(2):
+        exe.run(infer_prog, feed=feed, fetch_list=[prediction])
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        (out,) = exe.run(infer_prog, feed=feed, fetch_list=[prediction],
+                         return_numpy=False)
+    last = np.asarray(out)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(last).all()
+    ips = batch * steps / dt
+    return result_line(f"resnet50_{image_hw}px_bs{batch}_infer_{platform}",
+                       ips, "images/sec/chip", "resnet_infer",
+                       amp=fluid.amp.compute_dtype() or "off")
+
+
 BENCHES = {"resnet": bench_resnet, "transformer": bench_transformer,
-           "mnist": bench_mnist}
+           "mnist": bench_mnist, "resnet_infer": bench_resnet_infer}
 
 
 def _run_one(model, fluid, platform, on_accel):

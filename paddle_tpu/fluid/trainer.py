@@ -310,8 +310,13 @@ class Trainer:
                 # let a checkpoint error mask the primary training failure
                 try:
                     wait_for_checkpoints(self.checkpoint_cfg.checkpoint_dir)
-                except Exception:
-                    pass
+                except Exception as ckpt_exc:
+                    # secondary failure: keep the signal without masking
+                    # the primary training exception
+                    from .log import LOG
+
+                    LOG(f"async checkpoint failed during training "
+                        f"teardown: {ckpt_exc!r}")
             raise
         else:
             if self.checkpoint_cfg and self.checkpoint_cfg.async_save:

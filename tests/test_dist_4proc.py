@@ -214,14 +214,20 @@ def test_dist_2proc_sharded_checkpoint(tmp_path):
         assert line, f"worker produced no result:\n{out[-2000:]}"
         assert json.loads(line[0].split(" ", 1)[1])["ok"]
 
-    # balanced writers: every process wrote SOME variable data (replicated
-    # vars are assigned round-robin, not all duplicated or all on proc 0)
-    counts = []
+    # balanced writers: every process wrote SOME variable data, and each
+    # REPLICATED param (fc weights stay replicated under ZeRO-1) was
+    # written by exactly ONE process — not duplicated, not all on proc 0
+    blob_sets = []
     for pid in range(2):
         d = os.path.join(ckpt, f"shard_{pid}")
-        blobs = [f for f in os.listdir(d) if f.endswith(".npy")]
+        blobs = {f for f in os.listdir(d) if f.endswith(".npy")}
         assert blobs, f"shard_{pid} wrote no variable data (unbalanced)"
-        counts.append(len(blobs))
-    # replicated params are split between writers: neither side holds
-    # everything (total vars > max single side)
-    assert max(counts) < sum(counts), counts
+        blob_sets.append(blobs)
+    for param in ("fc_0.w_0", "fc_1.w_0"):
+        holders = [pid for pid in range(2)
+                   if any(b.startswith(param + ".") for b in blob_sets[pid])]
+        assert len(holders) == 1, (param, holders)
+    # and the round-robin assignment puts replicated params on BOTH sides
+    rep_counts = [sum(1 for b in bs if b.startswith("fc_"))
+                  for bs in blob_sets]
+    assert all(c > 0 for c in rep_counts), rep_counts
