@@ -228,7 +228,9 @@ def _bipartite_match_one(dist):
         flat = jnp.argmax(masked)
         i, j = flat // cols, flat % cols
         ok = masked[i, j] > -jnp.inf
-        col_to_row = jnp.where(ok, col_to_row.at[j].set(i), col_to_row)
+        col_to_row = jnp.where(
+            ok, col_to_row.at[j].set(i.astype(col_to_row.dtype)),
+            col_to_row)
         col_dist = jnp.where(ok, col_dist.at[j].set(dist[i, j]), col_dist)
         row_used = jnp.where(ok, row_used.at[i].set(True), row_used)
         return col_to_row, col_dist, row_used

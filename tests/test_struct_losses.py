@@ -155,7 +155,7 @@ def test_crf_trains_label_semantic_roles_style():
     labels = (feats[:, :1] > 0).astype(np.int64)  # learnable tagging
     feed = {"feat": fluid.create_lod_tensor(feats, [lens]),
             "lab": fluid.create_lod_tensor(labels, [lens])}
-    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    losses = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]).reshape(-1)[0])
               for _ in range(25)]
     assert losses[-1] < losses[0] * 0.6, losses[::6]
 
@@ -179,8 +179,9 @@ def test_nce_and_hsigmoid_train():
         exe.run(startup)
         x = rng.randn(B, D).astype(np.float32)
         y = rng.randint(0, C, size=(B, 1)).astype(np.int64)
-        losses = [float(exe.run(main, feed={"x": x, "y": y},
-                                fetch_list=[loss])[0]) for _ in range(20)]
+        losses = [float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                           fetch_list=[loss])[0])
+                        .reshape(-1)[0]) for _ in range(20)]
         assert losses[-1] < losses[0], (loss_kind, losses[::5])
 
 
