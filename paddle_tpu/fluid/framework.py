@@ -477,3 +477,17 @@ def program_guard(main_program, startup_program=None):
 def name_scope(prefix=None):
     # cosmetic in the reference; kept for parity
     yield
+
+
+def fresh_session():
+    """Reset ALL build-session globals: default programs, unique-name
+    counters, global scope.  The single place that knows the full list —
+    used by the test fixture, driver entry points, and scripts that build
+    several models in one process."""
+    from . import executor as _executor
+    from . import unique_name as _unique_name
+
+    switch_main_program(Program())
+    switch_startup_program(Program())
+    _unique_name.switch()
+    _executor._global_scope = _executor.Scope()

@@ -34,13 +34,7 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs, scope and name counters."""
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.fluid import executor as _executor
     from paddle_tpu.fluid import framework as _framework
-    from paddle_tpu.fluid import unique_name as _unique_name
 
-    _framework.switch_main_program(_framework.Program())
-    _framework.switch_startup_program(_framework.Program())
-    _unique_name.switch()
-    _executor._global_scope = _executor.Scope()
+    _framework.fresh_session()
     yield
