@@ -26,6 +26,7 @@ from . import optimizer
 from . import regularizer
 from . import clip
 from . import metrics
+from . import average
 from . import profiler
 from . import unique_name
 from . import io

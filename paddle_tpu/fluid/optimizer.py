@@ -23,7 +23,10 @@ __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
            "Adadelta", "RMSProp", "Ftrl", "SGDOptimizer", "MomentumOptimizer",
            "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
            "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
-           "FtrlOptimizer", "Optimizer"]
+           "FtrlOptimizer", "Optimizer",
+    "ProximalGDOptimizer", "ProximalAdagradOptimizer", "ProximalGD",
+    "ProximalAdagrad", "ModelAverage",
+]
 
 
 class Optimizer:
@@ -403,6 +406,153 @@ class FtrlOptimizer(Optimizer):
             attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
 
 
+class ProximalGDOptimizer(Optimizer):
+    """ref: optimizer.py ProximalGDOptimizer / proximal_gd_op.*"""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "proximal_gd"
+        self._l1 = l1
+        self._l2 = l2
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0]],
+                    "Grad": [param_and_grad[1]],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]]},
+            attrs={"l1": self._l1, "l2": self._l2})
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """ref: optimizer.py ProximalAdagradOptimizer / proximal_adagrad_op.*"""
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "proximal_adagrad"
+        self._l1 = l1
+        self._l2 = l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        m = self._get_accumulator(self._moment_acc_str, param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0]],
+                    "Grad": [param_and_grad[1]], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [m]},
+            attrs={"l1": self._l1, "l2": self._l2})
+
+
+class ModelAverage(Optimizer):
+    """Running parameter averages for evaluation (ref: optimizer.py:1145
+    ModelAverage + average_accumulates_op.*).  Construct AFTER the real
+    optimizer's minimize(); it appends an average_accumulates op per
+    trainable param to the main program, so every train step accumulates.
+    ``apply()`` is a context manager that swaps averaged values into the
+    scope for evaluation; ``restore()`` puts the trained values back."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self.type = "average_accumulates"
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        from .framework import Parameter, default_main_program
+
+        # accumulators are created at construction (no minimize() call)
+        self.helper = LayerHelper(self.__class__.__name__)
+        block = default_main_program().global_block()
+        self.params_grads = [(p, None) for p in block.vars.values()
+                             if isinstance(p, Parameter) and p.trainable]
+        for p, _ in self.params_grads:
+            self._add_accumulator("sum_1", p)
+            self._add_accumulator("sum_2", p)
+            self._add_accumulator("sum_3", p)
+            self._add_accumulator("num_accumulates", p, dtype="int64",
+                                  shape=[1])
+            self._add_accumulator("old_num_accumulates", p, dtype="int64",
+                                  shape=[1])
+            self._add_accumulator("num_updates", p, dtype="int64", shape=[1])
+            self._append_average_accumulate_op(block, p)
+
+    def _append_average_accumulate_op(self, block, param):
+        accs = {n: self._get_accumulator(n, param)
+                for n in ("sum_1", "sum_2", "sum_3", "num_accumulates",
+                          "old_num_accumulates", "num_updates")}
+        block.append_op(
+            type="average_accumulates",
+            inputs={"param": [param], "in_sum_1": [accs["sum_1"]],
+                    "in_sum_2": [accs["sum_2"]], "in_sum_3": [accs["sum_3"]],
+                    "in_num_accumulates": [accs["num_accumulates"]],
+                    "in_old_num_accumulates": [accs["old_num_accumulates"]],
+                    "in_num_updates": [accs["num_updates"]]},
+            outputs={"out_sum_1": [accs["sum_1"]],
+                     "out_sum_2": [accs["sum_2"]],
+                     "out_sum_3": [accs["sum_3"]],
+                     "out_num_accumulates": [accs["num_accumulates"]],
+                     "out_old_num_accumulates":
+                         [accs["old_num_accumulates"]],
+                     "out_num_updates": [accs["num_updates"]]},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window,
+                   OpRole.KEY: OpRole.Optimize})
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: parameters hold their AVERAGED values inside
+        the with-block (ref :1204)."""
+        import contextlib
+
+        import numpy as np
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            scope = global_scope()
+            self._backup = {}
+            for p, _ in self.params_grads:
+                s1 = np.asarray(scope.get(
+                    self._get_accumulator("sum_1", p).name))
+                s2 = np.asarray(scope.get(
+                    self._get_accumulator("sum_2", p).name))
+                s3 = np.asarray(scope.get(
+                    self._get_accumulator("sum_3", p).name))
+                na = float(np.asarray(scope.get(self._get_accumulator(
+                    "num_accumulates", p).name)).reshape(-1)[0])
+                ona = float(np.asarray(scope.get(self._get_accumulator(
+                    "old_num_accumulates", p).name)).reshape(-1)[0])
+                total = na + ona
+                if total <= 0:
+                    continue
+                self._backup[p.name] = np.asarray(scope.get(p.name))
+                avg = (s1 + s2 + s3) / total
+                scope.set(p.name, avg.astype(self._backup[p.name].dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        from .executor import global_scope
+
+        scope = global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.set(name, val)
+        self._backup = {}
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer
@@ -412,3 +562,5 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -167,6 +167,38 @@ def ftrl(ctx):
     return {"ParamOut": p_out, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}
 
 
+@register_op("proximal_gd", no_grad_inputs=("Param", "Grad",
+                                             "LearningRate"))
+def proximal_gd(ctx):
+    """ref: proximal_gd_op.* — SGD step followed by the proximal operator
+    for l1/l2 regularization: soft-threshold then shrink."""
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    prox = p - lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)         / (1.0 + lr * l2)
+    return {"ParamOut": out.astype(p.dtype)}
+
+
+@register_op("proximal_adagrad", no_grad_inputs=("Param", "Grad", "Moment",
+                                                 "LearningRate"))
+def proximal_adagrad(ctx):
+    """ref: proximal_adagrad_op.* — adagrad-scaled step + proximal l1/l2."""
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_out = m + g * g
+    lr_eff = lr / jnp.sqrt(m_out + 1e-10)
+    prox = p - lr_eff * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1, 0.0)         / (1.0 + lr_eff * l2)
+    return {"ParamOut": out.astype(p.dtype), "MomentOut": m_out}
+
+
 @register_op("average_accumulates",
              no_grad_inputs=("param", "in_sum_1", "in_sum_2", "in_sum_3",
                              "in_num_accumulates", "in_old_num_accumulates",

@@ -200,3 +200,88 @@ def test_vlog_levels(capsys):
             os.environ.pop("GLOG_v", None)
         else:
             os.environ["GLOG_v"] = old
+
+
+def test_proximal_optimizers_train():
+    """proximal_gd / proximal_adagrad (ref proximal_gd_op.*,
+    proximal_adagrad_op.*): l1 drives small weights to exactly zero."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    for opt_cls in (fluid.optimizer.ProximalGD,
+                    fluid.optimizer.ProximalAdagrad):
+        from paddle_tpu.fluid import framework as _fw
+
+        _fw.fresh_session()
+        fluid.default_startup_program().random_seed = 5
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        opt_cls(learning_rate=0.05, l1=0.01, l2=0.001).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        w_true = np.zeros((8, 1), np.float32)
+        w_true[:2] = 1.0  # only 2 informative features
+        losses = []
+        for _ in range(60):
+            xa = rng.normal(size=(32, 8)).astype(np.float32)
+            ya = xa @ w_true
+            (l,) = exe.run(fluid.default_main_program(),
+                           feed={"x": xa, "y": ya}, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.5, (opt_cls.__name__, losses[::20])
+        import paddle_tpu.fluid.executor as _ex
+
+        w = np.asarray(_ex._global_scope.get("fc_0.w_0"))
+        # l1 prox: uninformative weights shrink toward zero
+        assert np.abs(w[2:]).mean() < np.abs(w[:2]).mean()
+
+
+def test_model_average_apply_restore():
+    """ModelAverage (ref optimizer.py:1145): averaged params differ from
+    the final step's params inside apply(), restore brings them back."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.fluid.executor as _ex
+
+    fluid.default_startup_program().random_seed = 2
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    ma = fluid.optimizer.ModelAverage(0.15, min_average_window=2,
+                                      max_average_window=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    for _ in range(12):
+        xa = rng.normal(size=(16, 4)).astype(np.float32)
+        exe.run(fluid.default_main_program(),
+                feed={"x": xa, "y": (xa.sum(1, keepdims=True))},
+                fetch_list=[loss])
+    trained = np.asarray(_ex._global_scope.get("fc_0.w_0")).copy()
+    with ma.apply():
+        averaged = np.asarray(_ex._global_scope.get("fc_0.w_0")).copy()
+        assert not np.allclose(averaged, trained)
+    back = np.asarray(_ex._global_scope.get("fc_0.w_0"))
+    np.testing.assert_array_equal(back, trained)
+
+
+def test_weighted_average():
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+
+    wa = fluid.average.WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(2.0, weight=1.0)
+    wa.add(4.0, weight=3.0)
+    assert abs(wa.eval() - 3.5) < 1e-9
