@@ -193,9 +193,11 @@ def proximal_adagrad(ctx):
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)
     m_out = m + g * g
-    lr_eff = lr / jnp.sqrt(m_out + 1e-10)
-    prox = p - lr_eff * g
-    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1, 0.0)         / (1.0 + lr_eff * l2)
+    prox = p - lr * g / jnp.sqrt(m_out + 1e-10)
+    # threshold/shrink with the SCALAR lr (ref proximal_adagrad_op.h) —
+    # a per-element effective lr would decay the l1 threshold to zero
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
     return {"ParamOut": out.astype(p.dtype), "MomentOut": m_out}
 
 

@@ -285,3 +285,101 @@ def test_weighted_average():
     wa.add(2.0, weight=1.0)
     wa.add(4.0, weight=3.0)
     assert abs(wa.eval() - 3.5) < 1e-9
+
+
+def test_positive_negative_pair_bruteforce():
+    """ref positive_negative_pair_op.h semantics, incl. its equal-score
+    quirk (neutral AND negative) and (w_i+w_j)/2 pair weights."""
+    import numpy as np
+
+    from tests.test_struct_losses import _run_op
+
+    rng = np.random.RandomState(0)
+    n, width = 12, 3
+    score = rng.normal(size=(n, width)).astype(np.float32)
+    score[1, 1] = score[3, 1]  # equal-score pair within query 0
+    label = rng.randint(0, 3, size=(n, 1)).astype(np.float32)
+    query = np.array([[i // 4] for i in range(n)], np.int64)
+    weight = rng.uniform(0.5, 1.5, size=(n, 1)).astype(np.float32)
+
+    outs = _run_op(
+        "positive_negative_pair",
+        inputs={"Score": ("score", score), "Label": ("lab", label),
+                "QueryID": ("qid", query), "Weight": ("wgt", weight)},
+        outputs={"PositivePair": "pp", "NegativePair": "np_",
+                 "NeutralPair": "up"},
+        attrs={"column": 1})
+    pos, neg, neu = (float(np.asarray(o).reshape(-1)[0]) for o in outs)
+
+    ep = en = eu = 0.0
+    for i in range(n):
+        for j in range(i + 1, n):
+            if query[i, 0] != query[j, 0] or label[i, 0] == label[j, 0]:
+                continue
+            w = (weight[i, 0] + weight[j, 0]) * 0.5
+            ds = score[i, 1] - score[j, 1]
+            dl = label[i, 0] - label[j, 0]
+            if ds == 0:
+                eu += w
+            if ds * dl > 0:
+                ep += w
+            else:
+                en += w
+    assert eu > 0  # the equal-score quirk path must actually fire
+    np.testing.assert_allclose([pos, neg, neu], [ep, en, eu], rtol=1e-5)
+
+
+def test_precision_recall_bruteforce():
+    """ref precision_recall_op.h: per-class TP/FP/TN/FN and macro/micro
+    metrics, with state accumulation."""
+    import numpy as np
+
+    from tests.test_struct_losses import _run_op
+
+    rng = np.random.RandomState(1)
+    n, cls = 20, 4
+    idx = rng.randint(0, cls, size=(n, 1)).astype(np.int32)
+    label = rng.randint(0, cls, size=(n, 1)).astype(np.int32)
+    prev = rng.uniform(0, 3, size=(cls, 4)).astype(np.float32)
+
+    outs = _run_op(
+        "precision_recall",
+        inputs={"Indices": ("pridx", idx), "Labels": ("prlab", label),
+                "StatesInfo": ("prstates", prev)},
+        outputs={"BatchMetrics": "bm", "AccumMetrics": "am",
+                 "AccumStatesInfo": "asi"},
+        attrs={"class_number": cls})
+    batch_m, accum_m, accum_s = (np.asarray(o) for o in outs)
+
+    states = np.zeros((cls, 4))
+    for i in range(n):
+        a, b = int(idx[i, 0]), int(label[i, 0])
+        if a == b:
+            states[a, 0] += 1
+            states[:, 2] += 1
+            states[a, 2] -= 1
+        else:
+            states[b, 3] += 1
+            states[a, 1] += 1
+            states[:, 2] += 1
+            states[a, 2] -= 1
+            states[b, 2] -= 1
+
+    def metrics(st):
+        precs, recs = [], []
+        for c in range(cls):
+            tp, fp, tn, fn = st[c]
+            p = tp / (tp + fp) if tp + fp > 0 else 1.0
+            r = tp / (tp + fn) if tp + fn > 0 else 1.0
+            precs.append(p); recs.append(r)
+        map_, mar = np.mean(precs), np.mean(recs)
+        maf = 2 * map_ * mar / (map_ + mar) if map_ + mar > 0 else 0.0
+        tp, fp, fn = st[:, 0].sum(), st[:, 1].sum(), st[:, 3].sum()
+        mp = tp / (tp + fp) if tp + fp > 0 else 1.0
+        mr = tp / (tp + fn) if tp + fn > 0 else 1.0
+        mf = 2 * mp * mr / (mp + mr) if mp + mr > 0 else 0.0
+        return [map_, mar, maf, mp, mr, mf]
+
+    np.testing.assert_allclose(batch_m, metrics(states), rtol=1e-5)
+    np.testing.assert_allclose(accum_s, states + prev, rtol=1e-5)
+    np.testing.assert_allclose(accum_m, metrics(states + prev), rtol=1e-5)
