@@ -158,3 +158,130 @@ def polygon_box_transform(input, name=None):
     helper.append_op(type="polygon_box_transform", inputs={"Input": [input]},
                      outputs={"Output": [out]})
     return out
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None,
+                  out_states=None, ap_version="integral"):
+    """mAP evaluation op wrapper (ref layers/detection.py detection_map
+    :315 — default overlap 0.3).  For dataset-level mAP pass
+    ``input_states`` (prev accumulators) and ``out_states`` (vars to
+    receive the updated accumulators), then feed out_states back in as
+    input_states next batch — the reference's chaining contract."""
+    helper = LayerHelper("detection_map", **locals())
+    m = helper.create_variable_for_type_inference("float32")
+    m.shape = (1,)
+    if out_states is not None:
+        acc_pos, acc_tp, acc_fp = out_states
+    else:
+        acc_pos = helper.create_variable_for_type_inference("float32")
+        acc_tp = helper.create_variable_for_type_inference("float32")
+        acc_fp = helper.create_variable_for_type_inference("float32")
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if input_states is not None:
+        inputs["PosCount"] = [input_states[0]]
+        inputs["TruePos"] = [input_states[1]]
+        inputs["FalsePos"] = [input_states[2]]
+    helper.append_op(
+        type="detection_map", inputs=inputs,
+        outputs={"MAP": [m], "AccumPosCount": [acc_pos],
+                 "AccumTruePos": [acc_tp], "AccumFalsePos": [acc_fp]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version})
+    if out_states is not None:
+        return m, acc_pos, acc_tp, acc_fp
+    return m
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info, rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=True):
+    """RPN training-target assignment (ref layers/detection.py
+    rpn_target_assign, operators/detection/rpn_target_assign_op.cc)."""
+    helper = LayerHelper("rpn_target_assign", **locals())
+    loc_index = helper.create_variable_for_type_inference("int64")
+    score_index = helper.create_variable_for_type_inference("int64")
+    target_label = helper.create_variable_for_type_inference("int64")
+    target_bbox = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index],
+                 "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label],
+                 "TargetBBox": [target_bbox]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "use_random": use_random})
+    # gather the predictions the assignment selected (ref :186-194)
+    from .nn import gather, reshape
+
+    cls_logits = reshape(cls_logits, shape=[-1, 1])
+    bbox_pred = reshape(bbox_pred, shape=[-1, 4])
+    predicted_cls_logits = gather(cls_logits, score_index)
+    predicted_bbox_pred = gather(bbox_pred, loc_index)
+    return (predicted_cls_logits, predicted_bbox_pred, target_label,
+            target_bbox)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0):
+    """RPN proposal generation (ref layers/detection.py generate_proposals,
+    operators/detection/generate_proposals_op.cc)."""
+    helper = LayerHelper("generate_proposals", **locals())
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    roi_probs = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [roi_probs]},
+        attrs={"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n, "nms_thresh": nms_thresh,
+               "min_size": min_size, "eta": eta})
+    return rois, roi_probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True):
+    """Sample + label RoIs for the detection head (ref layers/detection.py
+    generate_proposal_labels, generate_proposal_labels_op.cc)."""
+    helper = LayerHelper("generate_proposal_labels", **locals())
+    dtype = rpn_rois.dtype
+    rois = helper.create_variable_for_type_inference(dtype)
+    labels_int32 = helper.create_variable_for_type_inference("int32")
+    bbox_targets = helper.create_variable_for_type_inference(dtype)
+    bbox_inside = helper.create_variable_for_type_inference(dtype)
+    bbox_outside = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels_int32],
+                 "BboxTargets": [bbox_targets],
+                 "BboxInsideWeights": [bbox_inside],
+                 "BboxOutsideWeights": [bbox_outside]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums, "use_random": use_random})
+    return (rois, labels_int32, bbox_targets, bbox_inside, bbox_outside)
+

@@ -21,7 +21,8 @@ import numpy as np
 from .. import core, unique_name
 from ..framework import default_main_program
 
-__all__ = ["data", "py_reader", "read_file", "open_recordio_file", "batch",
+__all__ = ["data", "py_reader", "read_file", "open_recordio_file",
+           "open_files", "random_data_generator", "batch",
            "shuffle", "double_buffer", "create_py_reader_by_data"]
 
 
@@ -300,6 +301,51 @@ def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
             with RecordIOScanner(filename) as sc:
                 for rec in sc:
                     yield list(unpack_batch(rec))
+
+    state._source = source
+    return rd
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None,
+               thread_num=2, buffer_size=256, pass_num=1):
+    """ref: layers/io.py open_files — one reader over MANY recordio shards.
+    Backed by the native multi-threaded prefetcher (native/prefetch.cc),
+    so file IO/decompression runs in C++ worker threads like the
+    reference's open_files + multi-thread reader stack."""
+    rd = py_reader(capacity=buffer_size, shapes=shapes, dtypes=dtypes,
+                   lod_levels=lod_levels)
+    state = rd._reader_state
+
+    def source():
+        from ...native import PrefetchReader
+        from ...native.tensor_pack import unpack_batch
+
+        for _ in range(pass_num):
+            for rec in PrefetchReader(list(filenames),
+                                      n_threads=thread_num,
+                                      capacity=buffer_size):
+                yield list(unpack_batch(rec))
+
+    state._source = source
+    return rd
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=False):
+    """ref: reader/create_random_data_generator_op.cc — a reader yielding
+    uniform-random float batches forever (fixtures/benchmarks)."""
+    dtypes = ["float32"] * len(shapes)
+    rd = py_reader(capacity=16, shapes=shapes, dtypes=dtypes,
+                   lod_levels=lod_levels)
+    state = rd._reader_state
+
+    def source():
+        rng = np.random.RandomState(0)
+        while True:
+            yield [(rng.uniform(low, high, size=[max(1, d if d not in
+                    (-1, None) else 1) for d in shape])
+                    .astype(np.float32), None)
+                   for shape in shapes]
 
     state._source = source
     return rd

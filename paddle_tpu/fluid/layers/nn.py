@@ -17,7 +17,8 @@ from ..initializer import ConstantInitializer, NormalInitializer
 from ..layer_helper import LayerHelper
 
 __all__ = [
-    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "dropout", "softmax",
     "cross_entropy", "softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "mean", "mul",
@@ -31,7 +32,9 @@ __all__ = [
     "im2sequence", "maxout", "relu", "log", "crop", "mean_iou",
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
-    "ring_attention", "moe_ffn", "gpipe_mlp_stack",
+    "ring_attention", "moe_ffn", "gpipe_mlp_stack", "cos_sim",
+    "multiplex", "pool3d", "random_crop", "rank_loss",
+    "image_resize_short", "Print", "load",
     "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
     "edit_distance", "ctc_greedy_decoder",
 ]
@@ -1270,3 +1273,178 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
         attrs={"causal": causal, "scale": float(scale or 0.0),
                "sp_axis": sp_axis})
     return out
+
+def cos_sim(X, Y, name=None):
+    """Cosine similarity per row (ref: layers/nn.py cos_sim, cos_sim_op.*)."""
+    helper = LayerHelper("cos_sim", **locals())
+    dtype = helper.input_dtype("X")
+    out = helper.create_variable_for_type_inference(dtype)
+    xn = helper.create_variable_for_type_inference(dtype)
+    yn = helper.create_variable_for_type_inference(dtype)
+    out.shape = (X.shape[0], 1)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def multiplex(inputs, index):
+    """Row-wise select across candidate tensors (ref multiplex_op.*)."""
+    helper = LayerHelper("multiplex", **locals())
+    out = helper.create_variable_for_type_inference(
+        helper.input_dtype("inputs"))
+    out.shape = tuple(inputs[0].shape)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    """3-D pooling (ref pool_op.* 3-D registration)."""
+    helper = LayerHelper("pool3d", **locals())
+    pool_size = _to_list(pool_size, 3)
+    pool_stride = _to_list(pool_stride, 3)
+    pool_padding = _to_list(pool_padding, 3)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    dims = input.shape
+
+    def _po(size, k, pad, st):
+        if size in (-1, None):
+            return -1
+        if ceil_mode:
+            return (size - k + 2 * pad + st - 1) // st + 1
+        return (size - k + 2 * pad) // st + 1
+
+    if global_pooling:
+        out.shape = tuple(dims[:2]) + (1, 1, 1)
+    else:
+        out.shape = tuple(dims[:2]) + tuple(
+            _po(dims[2 + i], pool_size[i], pool_padding[i], pool_stride[i])
+            for i in range(3))
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": pool_size,
+               "strides": pool_stride, "paddings": pool_padding,
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """Per-instance random crops of the trailing dims (ref
+    random_crop_op.*)."""
+    helper = LayerHelper("random_crop", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    lead = len(x.shape) - len(shape)
+    out.shape = tuple(x.shape[:lead]) + tuple(shape)
+    seed_out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out], "SeedOut": [seed_out]},
+                     attrs={"shape": list(shape),
+                            "startup_seed": seed or 0})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (ref rank_loss_op.*)."""
+    helper = LayerHelper("rank_loss", **locals())
+    out = helper.create_variable_for_type_inference("float32")
+    out.shape = tuple(label.shape)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect
+    (ref layers/nn.py image_resize_short)."""
+    in_shape = input.shape
+    if len(in_shape) != 4:
+        raise ValueError("image_resize_short expects NCHW input")
+    h, w = in_shape[2], in_shape[3]
+    # pin the SHORT side exactly; round the long side half-up (ref
+    # layers/nn.py image_resize_short)
+    if h <= w:
+        out_shape = [out_short_len, int(w * out_short_len / h + 0.5)]
+    else:
+        out_shape = [int(h * out_short_len / w + 0.5), out_short_len]
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor during execution (ref print_op.cc; runs as a
+    host callback in the eager island path)."""
+    helper = LayerHelper("Print", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = tuple(input.shape)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"first_n": first_n, "message": message or "",
+               "summarize": summarize,
+               "print_tensor_name": print_tensor_name,
+               "print_tensor_dtype": print_tensor_type,
+               "print_tensor_shape": print_tensor_shape})
+    return out
+
+
+def load(out, file_path, load_as_fp16=False):
+    """In-graph load of one variable from disk (ref load_op.cc:24)."""
+    helper = LayerHelper("load", **locals())
+    helper.append_op(type="load", inputs={}, outputs={"Out": [out]},
+                     attrs={"file_path": file_path,
+                            "load_as_fp16": load_as_fp16})
+    return out
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """3-D transposed convolution (ref conv3d_transpose registration in
+    conv_transpose_op.*)."""
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+    stride = _to_list(stride, 3)
+    padding = _to_list(padding, 3)
+    dilation = _to_list(dilation, 3)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("need filter_size or output_size")
+        output_size = _to_list(output_size, 3)
+        dims_in = input.shape
+        filter_size = [
+            (output_size[i] - (dims_in[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1 for i in range(3)]
+    else:
+        filter_size = _to_list(filter_size, 3)
+    filter_shape = [num_channels, num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    dims = input.shape
+
+    def _out_dim(size, k, pad, st, d):
+        if size in (-1, None):
+            return -1
+        return (size - 1) * st - 2 * pad + d * (k - 1) + 1
+
+    out.shape = (dims[0], num_filters) + tuple(
+        _out_dim(dims[2 + i], filter_size[i], padding[i], stride[i],
+                 dilation[i]) for i in range(3))
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+

@@ -81,7 +81,7 @@ def positive_negative_pair(ctx):
     score = ctx.input("Score")
     label = ctx.input("Label").reshape(-1).astype(jnp.float32)
     query = ctx.input("QueryID").reshape(-1)
-    col = int(ctx.attr("column", -1))
+    col = int(ctx.attr("column", 0))  # ref default 0
     s = score[:, col].astype(jnp.float32)
     w_in = ctx.input("Weight")
     w = (w_in.reshape(-1).astype(jnp.float32) if w_in is not None

@@ -109,6 +109,11 @@ def random_crop(ctx):
     x = ctx.input("X")
     shape = list(ctx.attr("shape"))  # crop dims (trailing)
     key = ctx.rng()
+    seed = int(ctx.attr("startup_seed", 0) or 0)
+    if seed:
+        # distinct reproducible stream per user seed (on top of the
+        # program-seeded rng, which already varies per step)
+        key = jax.random.fold_in(key, seed)
     nd = len(shape)
     lead = x.ndim - nd
     maxs = jnp.asarray([x.shape[lead + i] - shape[i] for i in range(nd)],

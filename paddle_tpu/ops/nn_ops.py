@@ -484,10 +484,15 @@ def print_op(ctx):
     # dict, which is one stable object per Program op.
     counter = _PRINT_COUNTS.setdefault(id(ctx.attrs), [0])
 
+    summarize = ctx.attr("summarize", 20)
+    if summarize is None or int(summarize) <= 0:
+        summarize = 20
+
     def _cb(arr, transforms=None):
         if first_n is None or first_n < 0 or counter[0] < first_n:
             counter[0] += 1
-            print(f"{prefix} values={np.asarray(arr).reshape(-1)[:20]}")
+            print(f"{prefix} "
+                  f"values={np.asarray(arr).reshape(-1)[:int(summarize)]}")
 
     jax.debug.callback(_cb, x)
     return {"Out": x}

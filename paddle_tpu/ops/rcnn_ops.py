@@ -351,6 +351,7 @@ def detection_map(ctx):
     gt = np.asarray(ctx.input("Label"))         # [N, 6] or [N, 5]
     overlap_t = ctx.attr("overlap_threshold", 0.5)
     ap_type = ctx.attr("ap_type", "integral")
+    background = ctx.attr("background_label", 0)
     det_lod = ctx.in_lod("DetectRes")
     gt_lod = ctx.in_lod("Label")
     doff = det_lod[-1] if det_lod else (0, len(det))
@@ -375,11 +376,15 @@ def detection_map(ctx):
         g_lab = g[:, 0].astype(int)
         g_box = g[:, -4:]
         for c in np.unique(g_lab):
+            if c == background:  # ref detection_map_op.h skips background
+                continue
             npos[c] = npos.get(c, 0) + int((g_lab == c).sum())
         used = np.zeros(len(g), bool)
         order = np.argsort(-d[:, 1])
         for j in order:
             c = int(d[j, 0])
+            if c == background:
+                continue
             box = d[j, 2:6]
             cand = np.where((g_lab == c) & ~used)[0]
             tp = 0

@@ -60,3 +60,64 @@ def test_global_norm_clip_minimize():
     (l,) = exe.run(fluid.default_main_program(),
                    feed={"x": np.ones((4, 3), np.float32)}, fetch_list=[loss])
     assert np.isfinite(l).all()
+
+
+def test_new_layer_wrappers_build_and_run():
+    """Thin wrappers added for reference API parity actually execute:
+    cos_sim, multiplex, pool3d, rank_loss, random_crop, conv3d_transpose,
+    image_resize_short."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[6], dtype="float32")
+    sim = fluid.layers.cos_sim(x, y)
+
+    a = fluid.layers.data(name="a", shape=[4], dtype="float32")
+    b = fluid.layers.data(name="b", shape=[4], dtype="float32")
+    ids = fluid.layers.data(name="ids", shape=[1], dtype="int32")
+    mux = fluid.layers.multiplex([a, b], ids)
+
+    left = fluid.layers.data(name="left", shape=[1], dtype="float32")
+    right = fluid.layers.data(name="right", shape=[1], dtype="float32")
+    lbl = fluid.layers.data(name="lbl", shape=[1], dtype="float32")
+    rl = fluid.layers.rank_loss(lbl, left, right)
+
+    vol = fluid.layers.data(name="vol", shape=[2, 4, 4, 4],
+                            dtype="float32")
+    p3 = fluid.layers.pool3d(vol, pool_size=2, pool_stride=2)
+    ct3 = fluid.layers.conv3d_transpose(vol, num_filters=3, filter_size=2,
+                                        stride=2)
+
+    img = fluid.layers.data(name="img", shape=[3, 8, 12], dtype="float32")
+    short = fluid.layers.image_resize_short(img, 4)
+    crop = fluid.layers.random_crop(img, shape=[3, 6, 6])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {
+        "x": rng.normal(size=(3, 6)).astype(np.float32),
+        "y": rng.normal(size=(3, 6)).astype(np.float32),
+        "a": rng.normal(size=(3, 4)).astype(np.float32),
+        "b": rng.normal(size=(3, 4)).astype(np.float32),
+        "ids": np.array([[0], [1], [0]], np.int32),
+        "left": rng.normal(size=(3, 1)).astype(np.float32),
+        "right": rng.normal(size=(3, 1)).astype(np.float32),
+        "lbl": np.array([[1.0], [0.0], [1.0]], np.float32),
+        "vol": rng.normal(size=(2, 2, 4, 4, 4)).astype(np.float32),
+        "img": rng.normal(size=(2, 3, 8, 12)).astype(np.float32),
+    }
+    outs = exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=[sim, mux, rl, p3, ct3, short, crop])
+    sim_v, mux_v, rl_v, p3_v, ct3_v, short_v, crop_v = \
+        (np.asarray(o) for o in outs)
+    assert sim_v.shape == (3, 1) and np.abs(sim_v).max() <= 1 + 1e-5
+    np.testing.assert_allclose(mux_v[1], feed["b"][1], rtol=1e-6)
+    np.testing.assert_allclose(mux_v[0], feed["a"][0], rtol=1e-6)
+    assert rl_v.shape == (3, 1) and (rl_v >= 0).all()
+    assert p3_v.shape == (2, 2, 2, 2, 2)
+    assert ct3_v.shape == (2, 3, 8, 8, 8)
+    assert short_v.shape == (2, 3, 4, 6)
+    assert crop_v.shape == (2, 3, 6, 6)

@@ -289,3 +289,57 @@ def test_prefetch_reader_error_and_exhaustion(tmp_path):
     with mock.patch.object(native, "get_lib", lambda: None):
         with pytest.raises(IOError):
             list(native.PrefetchReader([p, missing]))
+
+
+def test_open_files_reader_layer(tmp_path):
+    """open_files: one in-graph reader over many recordio shards, backed
+    by the native prefetcher (ref layers/io.py open_files)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.native import RecordIOWriter
+    from paddle_tpu.native.tensor_pack import pack_batch
+
+    paths = []
+    for s in range(3):
+        p = str(tmp_path / f"of_{s}.ptr")
+        rng = np.random.RandomState(s)
+        with RecordIOWriter(p) as w:
+            for _ in range(5):
+                w.write(pack_batch([
+                    (rng.normal(size=(1, 4)).astype(np.float32), None),
+                    (np.array([[rng.randint(0, 3)]], np.int64), None)]))
+        paths.append(p)
+
+    rd = fluid.layers.open_files(paths, shapes=[[-1, 4], [-1, 1]],
+                                 dtypes=["float32", "int64"])
+    x, y = fluid.layers.read_file(rd)
+    loss = fluid.layers.mean(fluid.layers.fc(input=x, size=2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rd.start()
+    n = 0
+    try:
+        while True:
+            exe.run(fluid.default_main_program(), fetch_list=[loss])
+            n += 1
+    except fluid.core.EOFException:
+        pass
+    assert n == 15
+
+
+def test_random_data_generator_layer():
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    rd = fluid.layers.random_data_generator(-2.0, 2.0, shapes=[[8, 4]])
+    xr = fluid.layers.read_file(rd)
+    m = fluid.layers.reduce_mean(xr)
+    mx = fluid.layers.reduce_max(xr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rd.start()
+    (v, vm) = exe.run(fluid.default_main_program(), fetch_list=[m, mx])
+    assert abs(float(np.asarray(v).reshape(-1)[0])) < 2.0
+    assert float(np.asarray(vm).reshape(-1)[0]) <= 2.0
