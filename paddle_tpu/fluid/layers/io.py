@@ -22,7 +22,8 @@ from .. import core, unique_name
 from ..framework import default_main_program
 
 __all__ = ["data", "py_reader", "read_file", "open_recordio_file",
-           "open_files", "random_data_generator", "batch",
+           "open_files", "random_data_generator", "Preprocessor",
+           "ParallelDo", "batch",
            "shuffle", "double_buffer", "create_py_reader_by_data"]
 
 
@@ -404,3 +405,94 @@ def double_buffer(reader, place=None, name=None):
     """ref: layers/io.py:891 — on TPU, host->device overlap comes from
     jax's async dispatch; keep as a capacity hint."""
     return reader
+
+class Preprocessor:
+    """In-pipeline batch transform (ref: layers/io.py Preprocessor — a
+    sub-program applied to every batch a reader produces).  The user
+    defines the transform as IR inside the ``block()`` context; each
+    batch then runs through that (jit-cached) sub-program before
+    reaching the training program's `read` op.
+
+    Example::
+
+        pre = fluid.layers.Preprocessor(reader)
+        with pre.block():
+            img, lbl = pre.inputs()
+            img = fluid.layers.scale(img, scale=1.0 / 255.0)
+            pre.outputs(img, lbl)
+        x, y = fluid.layers.read_file(pre())
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._state = reader._reader_state
+        self._prog = None
+        self._in_vars = None
+        self._out_vars = None
+
+    def block(self):
+        import contextlib
+
+        from ..framework import Program, program_guard
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._prog = Program()
+            self._startup = Program()
+            with program_guard(self._prog, self._startup):
+                yield self
+            if self._out_vars is None:
+                raise ValueError(
+                    "Preprocessor.block() ended without outputs(...)")
+
+        return _ctx()
+
+    def inputs(self):
+        from ..framework import default_main_program
+
+        shapes = self._state.shapes
+        dtypes = self._state.dtypes
+        block = default_main_program().current_block()
+        self._in_vars = []
+        for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+            v = block.create_var(
+                name=unique_name.generate("preprocessor_in"),
+                shape=tuple(shape), dtype=dtype, is_data=True)
+            self._in_vars.append(v)
+        return self._in_vars
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def __call__(self):
+        from ..executor import Executor
+        from .. import core as _core
+
+        exe = Executor(_core.CPUPlace())
+        exe.run(self._startup)
+        prog = self._prog
+        in_names = [v.name for v in self._in_vars]
+        out_names = [v.name for v in self._out_vars]
+        inner_next = self._state.next_batch
+
+        def transformed_next():
+            batch = inner_next()  # [(arr, lod), ...]
+            feed = {n: a for n, (a, _l) in zip(in_names, batch)}
+            outs = exe.run(prog, feed=feed, fetch_list=out_names)
+            return [(np.asarray(o), None) for o in outs]
+
+        self._state.next_batch = transformed_next
+        return self._reader
+
+
+class ParallelDo:
+    """The reference's deprecated in-graph data parallelism
+    (parallel_do_op.cc).  Redesigned away: use ParallelExecutor (GSPMD
+    over the device mesh) — the same capability without per-place op
+    replication (docs/OP_PARITY.md)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "ParallelDo was replaced by ParallelExecutor (GSPMD batch "
+            "sharding over the mesh); see docs/OP_PARITY.md")
+

@@ -121,3 +121,65 @@ def test_new_layer_wrappers_build_and_run():
     assert ct3_v.shape == (2, 3, 8, 8, 8)
     assert short_v.shape == (2, 3, 4, 6)
     assert crop_v.shape == (2, 3, 6, 6)
+
+
+def test_preprocessor_transforms_reader_batches():
+    """Preprocessor (ref layers/io.py): a user sub-program transforms
+    every batch before the train program's read op."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    rd = fluid.layers.py_reader(capacity=8, shapes=[[-1, 4], [-1, 1]],
+                                dtypes=["float32", "int64"])
+    pre = fluid.layers.Preprocessor(rd)
+    with pre.block():
+        img, lbl = pre.inputs()
+        img2 = fluid.layers.scale(img, scale=0.01)
+        pre.outputs(img2, lbl)
+    x, y = fluid.layers.read_file(pre())
+    m = fluid.layers.reduce_mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    st = rd._reader_state
+    st._source = lambda: iter(
+        [[(np.full((2, 4), 100.0, np.float32), None),
+          (np.array([[1], [0]], np.int64), None)]] * 3)
+    rd.start()
+    (v,) = exe.run(fluid.default_main_program(), fetch_list=[m])
+    assert abs(float(np.asarray(v).reshape(-1)[0]) - 1.0) < 1e-5
+
+
+def test_layer_function_generator_utils():
+    import warnings
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.layers.layer_function_generator import (
+        autodoc, deprecated, generate_layer_fn, templatedoc)
+
+    softsign = generate_layer_fn("softsign")
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    out = softsign(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (v,) = exe.run(fluid.default_main_program(),
+                   feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(v), 0.5, rtol=1e-6)
+
+    @deprecated(since="0.1", instead="new_fn")
+    @autodoc("doc line")
+    def old_fn():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 7
+        assert any("deprecated" in str(x.message) for x in w)
+    assert "doc line" in old_fn.__doc__
+
+    import pytest
+    with pytest.raises(NotImplementedError):
+        fluid.layers.ParallelDo()
