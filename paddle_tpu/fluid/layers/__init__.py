@@ -1,6 +1,8 @@
 """fluid.layers namespace (ref: python/paddle/fluid/layers/__init__.py)."""
 
-from . import control_flow, detection, io, math_op_patch, metric_op, nn, ops, tensor
+from . import (control_flow, detection, io,
+               layer_function_generator, math_op_patch, metric_op, nn,
+               ops, tensor)
 from . import learning_rate_scheduler, sequence
 from .control_flow import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
@@ -11,6 +13,8 @@ from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
+from .layer_function_generator import (  # noqa: F401
+    autodoc, deprecated, generate_layer_fn, templatedoc)
 
 math_op_patch.monkey_patch_variable()
 

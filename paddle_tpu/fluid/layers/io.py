@@ -444,6 +444,16 @@ class Preprocessor:
             if self._out_vars is None:
                 raise ValueError(
                     "Preprocessor.block() ended without outputs(...)")
+            # the transform may change arity/shape/dtype: the reader's
+            # metadata must describe the TRANSFORMED batches, because
+            # read_file declares its output vars from it
+            self._state.shapes = [list(v.shape) if v.shape else [-1]
+                                  for v in self._out_vars]
+            self._state.dtypes = [str(v.dtype) for v in self._out_vars]
+            self._state.lod_levels = (
+                list(self._state.lod_levels[:len(self._out_vars)])
+                + [0] * max(0, len(self._out_vars)
+                            - len(self._state.lod_levels)))
 
         return _ctx()
 
@@ -468,6 +478,13 @@ class Preprocessor:
         from ..executor import Executor
         from .. import core as _core
 
+        if self._out_vars is None:
+            raise ValueError(
+                "Preprocessor: define the transform inside `with "
+                "pre.block():` before calling pre()")
+        if getattr(self, "_applied", False):
+            return self._reader  # idempotent: never double-transform
+        self._applied = True
         exe = Executor(_core.CPUPlace())
         exe.run(self._startup)
         prog = self._prog
@@ -476,10 +493,15 @@ class Preprocessor:
         inner_next = self._state.next_batch
 
         def transformed_next():
+            from ..lod_tensor import LoDTensor
+
             batch = inner_next()  # [(arr, lod), ...]
-            feed = {n: a for n, (a, _l) in zip(in_names, batch)}
-            outs = exe.run(prog, feed=feed, fetch_list=out_names)
-            return [(np.asarray(o), None) for o in outs]
+            feed = {n: (LoDTensor(a, lod) if lod else a)
+                    for n, (a, lod) in zip(in_names, batch)}
+            outs = exe.run(prog, feed=feed, fetch_list=out_names,
+                           return_numpy=False)
+            # fetches are LoDTensors: lods survive pass-through slots
+            return [(np.asarray(o), tuple(o.lod()) or None) for o in outs]
 
         self._state.next_batch = transformed_next
         return self._reader

@@ -23,10 +23,18 @@ def generate_layer_fn(op_type: str, input_slot: str = "X",
     if not is_registered(op_type):
         raise ValueError(f"op {op_type!r} is not registered")
 
+    from .ops import _UNARY_ATTR_OPS, _UNARY_OPS
+
+    shape_preserving = op_type in _UNARY_OPS or op_type in _UNARY_ATTR_OPS
+
     def layer(x, name=None, **attrs):
         helper = LayerHelper(op_type, name=name)
         out = helper.create_variable_for_type_inference(x.dtype)
-        out.shape = tuple(x.shape)
+        if shape_preserving:
+            # only elementwise ops provably keep the input shape; other
+            # ops leave the static shape unset rather than recording a
+            # wrong one
+            out.shape = tuple(x.shape)
         helper.append_op(type=op_type, inputs={input_slot: [x]},
                          outputs={output_slot: [out]}, attrs=attrs)
         return out
