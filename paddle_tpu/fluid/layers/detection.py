@@ -11,6 +11,8 @@ __all__ = [
     "prior_box", "box_coder", "iou_similarity", "bipartite_match",
     "target_assign", "multiclass_nms", "detection_output", "roi_pool",
     "anchor_generator", "polygon_box_transform",
+    "detection_map", "rpn_target_assign", "generate_proposals",
+    "generate_proposal_labels", "ssd_loss", "multi_box_head",
 ]
 
 
@@ -31,6 +33,10 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
                "variances": list(variance), "flip": flip, "clip": clip,
                "step_w": steps[0], "step_h": steps[1], "offset": offset,
                "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    # priors are constants of the data path (ref prior_box layer sets
+    # stop_gradient); without this, backward demands a grad no op provides
+    boxes.stop_gradient = True
+    var.stop_gradient = True
     return boxes, var
 
 
@@ -48,6 +54,8 @@ def anchor_generator(input, anchor_sizes, aspect_ratios=(1.0,),
                "aspect_ratios": list(aspect_ratios),
                "variances": list(variance), "stride": list(stride),
                "offset": offset})
+    anchors.stop_gradient = True
+    var.stop_gradient = True
     return anchors, var
 
 
@@ -285,3 +293,185 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                "class_nums": class_nums, "use_random": use_random})
     return (rois, labels_int32, bbox_targets, bbox_inside, bbox_outside)
 
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """SSD multibox loss (ref: layers/detection.py ssd_loss — match gt to
+    priors, mine hard negatives, weighted smooth-l1 + softmax CE).
+
+    location [N, Np, 4]; confidence [N, Np, C]; gt_box/gt_label LoD
+    tensors [Ng, 4]/[Ng, 1]; prior_box [Np, 4].  Returns the [N, 1]
+    per-image loss (summed over priors, optionally normalized by the
+    positive count).
+    """
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    helper = LayerHelper("ssd_loss", **locals())
+    if mining_type != "max_negative":
+        raise ValueError("Only mining_type == 'max_negative' is supported")
+    num_prior = confidence.shape[1]
+
+    def to_2d(var):
+        return _nn.flatten(var, axis=2)
+
+    # 1. match gt to priors on IoU
+    iou = iou_similarity(x=gt_box, y=prior_box)
+    matched_indices, matched_dist = bipartite_match(iou, match_type,
+                                                    overlap_threshold)
+
+    # 2. provisional confidence loss drives hard-negative mining
+    # (this build's target_assign takes X as LoD rows [Ng, P, K])
+    gt_label = _nn.reshape(gt_label, [-1, 1, 1])
+    gt_label.stop_gradient = True
+    target_label, _ = target_assign(gt_label, matched_indices,
+                                    mismatch_value=background_label)
+    conf2d = to_2d(confidence)
+    target_label_2d = _tensor.cast(to_2d(target_label), "int64")
+    target_label_2d.stop_gradient = True
+    conf_loss = _nn.softmax_with_cross_entropy(conf2d, target_label_2d)
+    conf_loss = _nn.reshape(conf_loss, [-1, num_prior])
+    conf_loss.stop_gradient = True
+
+    neg_indices = helper.create_variable_for_type_inference("int32")
+    updated_indices = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mine_hard_examples",
+        inputs={"ClsLoss": [conf_loss], "MatchIndices": [matched_indices],
+                "MatchDist": [matched_dist]},
+        outputs={"NegIndices": [neg_indices],
+                 "UpdatedMatchIndices": [updated_indices]},
+        attrs={"neg_pos_ratio": neg_pos_ratio,
+               "neg_dist_threshold": neg_overlap,
+               "mining_type": mining_type,
+               "sample_size": sample_size or 0})
+
+    # 3. regression targets: encoded gt assigned to matched priors
+    encoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=gt_box,
+                        code_type="encode_center_size")
+    target_bbox, target_loc_weight = target_assign(
+        encoded, updated_indices, mismatch_value=background_label)
+    # 4. classification targets incl. mined negatives
+    target_label, target_conf_weight = target_assign(
+        gt_label, updated_indices, negative_indices=neg_indices,
+        mismatch_value=background_label)
+
+    target_label = _tensor.cast(to_2d(target_label), "int64")
+    target_label.stop_gradient = True
+    conf_loss = _nn.softmax_with_cross_entropy(conf2d, target_label)
+    tcw = _nn.reshape(target_conf_weight, [-1, 1])
+    tcw.stop_gradient = True
+    conf_loss = _nn.elementwise_mul(conf_loss, tcw)
+
+    loc2d = to_2d(location)
+    tb = to_2d(target_bbox)
+    tb.stop_gradient = True
+    loc_loss = _nn.smooth_l1(loc2d, tb)
+    tlw = _nn.reshape(target_loc_weight, [-1, 1])
+    tlw.stop_gradient = True
+    loc_loss = _nn.elementwise_mul(loc_loss, tlw)
+
+    loss = _nn.elementwise_add(
+        _nn.scale(conf_loss, scale=float(conf_loss_weight)),
+        _nn.scale(loc_loss, scale=float(loc_loss_weight)))
+    loss = _nn.reshape(loss, [-1, num_prior])
+    loss = _nn.reduce_sum(loss, dim=1, keep_dim=True)
+    if normalize:
+        normalizer = _nn.reduce_sum(target_loc_weight)
+        loss = _nn.elementwise_div(loss, normalizer)
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (ref: layers/detection.py multi_box_head): per
+    feature map, a conv pair predicts box offsets and class scores for
+    that map's priors; priors come from prior_box.  Returns
+    (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4],
+    variances [P, 4]) concatenated over maps.
+    """
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio schedule (ref multi_box_head: min_ratio..
+        # max_ratio split across maps, first map pinned to 10%/20%);
+        # degenerate map counts fall back to an even split
+        min_sizes, max_sizes = [], []
+        if n_maps > 2:
+            step_r = int((max_ratio - min_ratio) / (n_maps - 2))
+            for r in range(min_ratio, max_ratio + 1, step_r):
+                min_sizes.append(base_size * r / 100.0)
+                max_sizes.append(base_size * (r + step_r) / 100.0)
+            min_sizes = [base_size * 0.10] + min_sizes
+            max_sizes = [base_size * 0.20] + max_sizes
+        else:
+            span = (max_ratio - min_ratio) / max(1, n_maps)
+            for i in range(n_maps):
+                lo = min_ratio + span * i
+                min_sizes.append(base_size * lo / 100.0)
+                max_sizes.append(base_size * (lo + span) / 100.0)
+        min_sizes = min_sizes[:n_maps]
+        max_sizes = max_sizes[:n_maps]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        mins_l = mins if isinstance(mins, (list, tuple)) else [mins]
+        maxs_l = (maxs if isinstance(maxs, (list, tuple))
+                  else ([maxs] if maxs else []))
+        ars = aspect_ratios[i]
+        ars_l = list(ars) if isinstance(ars, (list, tuple)) else [ars]
+        step = (steps[i] if steps else
+                ((step_w[i] if step_w else 0.0),
+                 (step_h[i] if step_h else 0.0)))
+        if not isinstance(step, (list, tuple)):
+            step = (step, step)
+        boxes, var = prior_box(feat, image, mins_l, maxs_l or None, ars_l,
+                               variance, flip, clip, step, offset,
+                               min_max_aspect_ratios_order=
+                               min_max_aspect_ratios_order)
+        # priors per location: the EXACT count the prior_box op emits
+        from ...ops.detection_ops import (_expand_aspect_ratios,
+                                          _prior_whs)
+
+        num_priors = len(_prior_whs(
+            [float(v) for v in mins_l],
+            [float(v) for v in maxs_l],
+            _expand_aspect_ratios(ars_l, flip),
+            min_max_aspect_ratios_order))
+
+        loc = _nn.conv2d(feat, num_filters=num_priors * 4,
+                         filter_size=kernel_size, padding=pad,
+                         stride=stride)
+        conf = _nn.conv2d(feat, num_filters=num_priors * num_classes,
+                          filter_size=kernel_size, padding=pad,
+                          stride=stride)
+        # NCHW -> [N, H*W*num_priors, 4 or C] (static prior count so the
+        # ssd_loss reshape chain keeps concrete shapes); spatial dims come
+        # from the CONV OUTPUT (kernel/pad/stride may shrink the map)
+        fh, fw = loc.shape[2], loc.shape[3]
+        p_i = int(fh) * int(fw) * int(num_priors)
+        loc = _nn.transpose(loc, perm=[0, 2, 3, 1])
+        conf = _nn.transpose(conf, perm=[0, 2, 3, 1])
+        locs.append(_nn.reshape(loc, [-1, p_i, 4]))
+        confs.append(_nn.reshape(conf, [-1, p_i, num_classes]))
+        boxes_all.append(_nn.reshape(boxes, [-1, 4]))
+        vars_all.append(_nn.reshape(var, [-1, 4]))
+
+    mbox_locs = _tensor.concat(locs, axis=1)
+    mbox_confs = _tensor.concat(confs, axis=1)
+    boxes = _tensor.concat(boxes_all, axis=0)
+    variances = _tensor.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

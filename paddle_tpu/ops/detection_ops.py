@@ -292,10 +292,14 @@ def target_assign(ctx):
                     jnp.asarray(mismatch_value, x.dtype))
     wt = matched.astype(jnp.float32)
     neg = ctx.input("NegIndices")
-    if neg is not None:
-        # negative samples get weight 1 (targets stay mismatch_value);
-        # NegIndices rows map to images via its LoD (ref target_assign_op.h
-        # NegTargetAssignFunctor)
+    if neg is not None and tuple(neg.shape) == tuple(wt.shape[:2]):
+        # mask form (mine_hard_examples emits a same-shape [N, M] 0/1
+        # selection): selected negatives get weight 1, targets stay
+        # mismatch_value
+        wt = jnp.where(neg.astype(bool)[..., None], 1.0, wt)
+    elif neg is not None:
+        # padded-index form with LoD (ref target_assign_op.h
+        # NegTargetAssignFunctor): rows map to images via the LoD
         neg_lod = ctx.in_lod("NegIndices")
         noff = neg_lod[-1] if neg_lod else (0, int(neg.shape[0]))
         nidx = neg.reshape(-1).astype(jnp.int32)
@@ -475,7 +479,9 @@ def mine_hard_examples(ctx):
     cls_loss = ctx.input("ClsLoss")         # [N, M]
     loc_loss = ctx.input("LocLoss")
     match = ctx.input("MatchIndices")       # [N, M]
+    match_dist = ctx.input("MatchDist")
     neg_ratio = ctx.attr("neg_pos_ratio", 1.0)
+    neg_dist_threshold = ctx.attr("neg_dist_threshold", 0.5)
     mining = ctx.attr("mining_type", "max_negative")
     if mining != "max_negative":
         raise NotImplementedError("only max_negative mining is supported")
@@ -483,6 +489,11 @@ def mine_hard_examples(ctx):
         (loc_loss if ctx.attr("sample_size", 0) else 0 * loc_loss)
     n, m = match.shape
     is_neg = match < 0
+    if match_dist is not None:
+        # ref mine_hard_examples_op.h: a prior only qualifies as a
+        # negative candidate when its best overlap is BELOW the
+        # neg_dist_threshold — semi-overlapping priors are ignored
+        is_neg = is_neg & (match_dist < neg_dist_threshold)
     num_pos = jnp.sum(match >= 0, axis=1)
     num_neg = jnp.minimum((num_pos * neg_ratio).astype(jnp.int32),
                           jnp.sum(is_neg, axis=1))
