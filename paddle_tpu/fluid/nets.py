@@ -6,6 +6,7 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
+           "sequence_conv_pool",
            "scaled_dot_product_attention"]
 
 
@@ -104,3 +105,13 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
     ctx_multiheads = layers.matmul(weights, v)
     return _combine_heads(ctx_multiheads)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    """sequence_conv + sequence_pool (ref: nets.py sequence_conv_pool —
+    the text-CNN building block the sentiment/book chapters use)."""
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)

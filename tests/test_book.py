@@ -409,3 +409,34 @@ def test_label_semantic_roles(tmp_path):
     path = np.asarray(path).ravel()
     assert path.shape[0] == sum(len(s[0]) for s in samples)
     assert ((0 <= path) & (path < len(label_d))).all()
+
+
+def test_sequence_conv_pool_net():
+    """nets.sequence_conv_pool (ref nets.py): the text-CNN block trains
+    over LoD sequence batches."""
+    words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                              lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(input=words, size=[30, 8])
+    feat = fluid.nets.sequence_conv_pool(emb, num_filters=4, filter_size=3,
+                                         act="tanh")
+    pred = fluid.layers.fc(input=feat, size=2, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(25):
+        ys = rng.randint(0, 2, size=(4, 1)).astype(np.int64)
+        lens = [4, 5, 3, 6]
+        toks = np.concatenate([
+            rng.randint(15 if ys[i, 0] else 0, 30 if ys[i, 0] else 15,
+                        size=(lens[i], 1)) for i in range(4)]) \
+            .astype(np.int64)
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"words": (toks, [lens]), "label": ys},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
