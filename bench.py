@@ -26,11 +26,15 @@ reported ~11% MFU is bounded by the tunnel transport, not by the compiled
 program.  On directly-attached TPU hardware the same XLA program has no
 such per-step floor.
 
-Hardening (round-1 postmortem): the TPU backend behind the `axon` tunnel can
-HANG on first use, not just error — so the platform is probed in a
-subprocess with a timeout, and on probe failure the bench falls back to CPU
-via jax.config.update (env vars are too late: sitecustomize pre-imports
-jax).  Every failure path still emits one JSON diagnostic line.
+Hardening (round-1/-3 postmortems): the TPU backend behind the `axon` tunnel
+can HANG on first use, not just error — and can stay wedged for many minutes
+before recovering.  The platform is probed in a subprocess with a timeout
+and, on a hang, RETRIED with long pauses until BENCH_PROBE_BUDGET (default
+25 min) is spent; only a clean 'cpu' answer or an exhausted budget concedes
+CPU (via jax.config.update — env vars are too late: sitecustomize
+pre-imports jax).  Every failure path still emits JSON diagnostic lines, and
+a CPU concession records whether it was 'no_tpu' or
+'wedged_budget_exhausted'.
 """
 
 from __future__ import annotations
@@ -50,9 +54,14 @@ sys.path.insert(0, REPO)
 # Reference numbers to compare against (see module docstring).
 BASELINES = {
     "resnet": 84.08,        # images/sec, ResNet-50 train bs=256, 2x Xeon 6148
-    "transformer": 1655.0,  # tokens/sec proxy: LSTM h=1280 bs=256 is the only
-                            # published seq2seq-scale figure (BASELINE.md); the
-                            # reference has no Transformer number.
+    "transformer": 15468.3,  # tokens/sec, derived dimensionally from the
+                             # reference's largest published seq-model figure:
+                             # LSTM 2-layer h=1280, bs=256, padded seq len 100
+                             # (reference benchmark/README.md:105,131-136) at
+                             # 1655 ms/batch -> 256*100/1.655 = 15468 tok/s.
+                             # The reference has no Transformer number; this is
+                             # the honest tokens/sec of its best seq2seq-scale
+                             # benchmark, not a ms/batch figure reused as a rate.
     "mnist": 10000.0,       # images/sec, no published figure; nominal.
     "resnet_infer": 217.69,  # images/sec, ResNet-50 infer bs=16
                              # (IntelOptimizedPaddle.md:85-87)
@@ -81,12 +90,15 @@ PROBE_SRC = (
 )
 
 
-def probe_platform(timeout: float = 180.0) -> str:
-    """Run a tiny jitted matmul in a subprocess; return its platform.
+def _probe_once(timeout: float) -> str:
+    """Run a tiny jitted matmul in a subprocess; one attempt.
 
-    Returns 'cpu' if the default backend fails to initialise or hangs
-    (the axon tunnel wedges rather than erroring, so an in-process
-    try/except cannot catch it).
+    Returns the platform string on success, 'cpu' if the backend is
+    genuinely CPU, 'wedged' if the subprocess HUNG (the axon tunnel wedges
+    rather than erroring, so an in-process try/except cannot catch it), or
+    'crashed' if it completed without a PROBE_OK (deterministic init
+    failure — a dead tunnel process / broken libtpu errors fast and
+    retrying for the full budget would just stall the bench).
     """
     try:
         out = subprocess.run(
@@ -96,8 +108,67 @@ def probe_platform(timeout: float = 180.0) -> str:
             if line.startswith("PROBE_OK"):
                 return line.split()[1]
     except (subprocess.TimeoutExpired, OSError):
-        pass
-    return "cpu"
+        return "wedged"
+    return "crashed"
+
+
+def probe_platform(timeout: float = 180.0) -> tuple:
+    """Probe the default backend, retrying through tunnel wedges.
+
+    The axon TPU tunnel is known to wedge completely after heavy use and
+    recover after minutes (docs/PERF.md); a single timed-out probe is
+    therefore NOT evidence that there is no TPU.  Policy:
+
+    - probe in a subprocess with `timeout` per attempt;
+    - a clean 'PROBE_OK cpu' means there is genuinely no accelerator:
+      concede CPU immediately (no retry);
+    - a hang/crash means 'wedged': retry with a long pause
+      (BENCH_PROBE_PAUSE, default 120 s) until a total budget
+      (BENCH_PROBE_BUDGET, default 1500 s = 25 min) is exhausted.
+
+    Emits one JSON diagnostic line per failed attempt so the log
+    distinguishes "wedged, retrying" from "no TPU".  Returns
+    (platform, probe_status) where probe_status is 'ok', 'no_tpu', or
+    'wedged_budget_exhausted'.
+    """
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "1500"))
+    pause = float(os.environ.get("BENCH_PROBE_PAUSE", "120"))
+    t_start = time.monotonic()
+    attempt = 0
+    crashes = 0
+    while True:
+        attempt += 1
+        plat = _probe_once(timeout)
+        elapsed = time.monotonic() - t_start
+        if plat == "cpu":
+            return "cpu", "no_tpu"
+        if plat not in ("wedged", "crashed"):
+            return plat, "ok"
+        if plat == "crashed":
+            # deterministic failures don't heal with waiting: allow ONE
+            # quick retry (transient flake), then concede
+            crashes += 1
+            if crashes >= 2:
+                print(json.dumps({
+                    "event": "tpu_probe_crashed", "attempts": attempt,
+                    "elapsed_sec": round(elapsed, 1),
+                    "note": "backend init fails fast (not a hang); "
+                            "falling back to CPU"}), flush=True)
+                return "cpu", "probe_crashed"
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining <= pause:
+            print(json.dumps({
+                "event": "tpu_probe_gave_up", "attempts": attempt,
+                "elapsed_sec": round(elapsed, 1),
+                "note": "accelerator wedged for the whole probe budget; "
+                        "falling back to CPU"}), flush=True)
+            return "cpu", "wedged_budget_exhausted"
+        print(json.dumps({
+            "event": "tpu_probe_wedged_retrying", "attempt": attempt,
+            "elapsed_sec": round(elapsed, 1),
+            "retry_in_sec": pause,
+            "budget_remaining_sec": round(remaining, 1)}), flush=True)
+        time.sleep(pause)
 
 
 def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
@@ -328,7 +399,7 @@ def main():
                           "error": f"BENCH_MODEL must be one of {sorted(BENCHES)}"}))
         return 1
 
-    platform = probe_platform(
+    platform, probe_status = probe_platform(
         timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
     import jax
     if platform == "cpu":
@@ -352,6 +423,8 @@ def main():
 
     if model:  # single-model mode
         result = _run_one(model, fluid, platform, on_accel)
+        if probe_status != "ok":
+            result["tpu_probe"] = probe_status
         print(json.dumps(result))
         return 0 if "error" not in result else 1
 
@@ -364,6 +437,8 @@ def main():
     print(json.dumps(trf), flush=True)
 
     combined = dict(res)
+    if probe_status != "ok":
+        combined["tpu_probe"] = probe_status
     if "error" in trf:
         combined["transformer_error"] = trf.get("error")
     else:

@@ -193,17 +193,27 @@ def get_device_count(kind: str = None) -> int:
 # "--tryfromenv=a,b,c" (import FLAGS_<name> from the environment) and
 # direct "--name=value" assignment.
 def _flag_value(raw):
+    """Parse a flag's textual value preserving its type: numerics stay
+    numeric ('1' -> 1, not True — gflags int flags like --rpc_retry_times=1
+    must survive round-trips), only true/false-style literals become bools,
+    and anything else stays a string (so a flag legitimately valued 'on'
+    would be the bool True but e.g. 'ON_DEMAND' stays text)."""
     if isinstance(raw, bool):
         return raw
     s = str(raw).strip()
-    if s.lower() in ("1", "true", "yes", "on"):
-        return True
-    if s.lower() in ("0", "false", "no", "off", ""):
-        return False
     try:
-        return float(s) if "." in s or "e" in s.lower() else int(s)
+        return int(s)
     except ValueError:
-        return s
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.lower() in ("true", "yes", "on"):
+        return True
+    if s.lower() in ("false", "no", "off", ""):
+        return False
+    return s
 
 
 GLOBAL_FLAGS = {

@@ -438,10 +438,6 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                  (step_h[i] if step_h else 0.0)))
         if not isinstance(step, (list, tuple)):
             step = (step, step)
-        boxes, var = prior_box(feat, image, mins_l, maxs_l or None, ars_l,
-                               variance, flip, clip, step, offset,
-                               min_max_aspect_ratios_order=
-                               min_max_aspect_ratios_order)
         # priors per location: the EXACT count the prior_box op emits
         from ...ops.detection_ops import (_expand_aspect_ratios,
                                           _prior_whs)
@@ -458,9 +454,18 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         conf = _nn.conv2d(feat, num_filters=num_priors * num_classes,
                           filter_size=kernel_size, padding=pad,
                           stride=stride)
+        # priors are generated from the CONV OUTPUT map, not the input
+        # feature map: with kernel_size>1/pad=0 or stride>1 the conv
+        # shrinks the map, and the prediction grid (which the priors must
+        # tile one-to-one) is the conv output.  Generating both from the
+        # same tensor keeps mbox_locs/confs and boxes counts in agreement
+        # for every kernel/pad/stride combination.
+        boxes, var = prior_box(loc, image, mins_l, maxs_l or None, ars_l,
+                               variance, flip, clip, step, offset,
+                               min_max_aspect_ratios_order=
+                               min_max_aspect_ratios_order)
         # NCHW -> [N, H*W*num_priors, 4 or C] (static prior count so the
-        # ssd_loss reshape chain keeps concrete shapes); spatial dims come
-        # from the CONV OUTPUT (kernel/pad/stride may shrink the map)
+        # ssd_loss reshape chain keeps concrete shapes)
         fh, fw = loc.shape[2], loc.shape[3]
         p_i = int(fh) * int(fw) * int(num_priors)
         loc = _nn.transpose(loc, perm=[0, 2, 3, 1])

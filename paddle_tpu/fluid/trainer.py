@@ -165,14 +165,24 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
     dir.  wait_for_checkpoints() joins outstanding writers and re-raises
     their errors."""
     root = os.path.abspath(checkpoint_dir)
+    os.makedirs(checkpoint_dir, exist_ok=True)
     with _ckpt_lock:
-        # an in-flight async serial has no _SUCCESS yet — reserve serials
-        # so overlapping saves never share a directory
+        # an in-flight async serial has no _SUCCESS yet, so
+        # _latest_complete_serial cannot see it; the serial is reserved ON
+        # DISK (exclusive mkdir, atomic at the filesystem level) so two
+        # processes — or a restarted run racing an orphaned async writer —
+        # can never pick the same directory.  The in-process map remains as
+        # a fast-path floor.
         serial = max(_latest_complete_serial(checkpoint_dir),
                      _ckpt_reserved.get(root, -1)) + 1
+        while True:
+            cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
+            try:
+                os.makedirs(cur, exist_ok=False)
+                break
+            except FileExistsError:
+                serial += 1
         _ckpt_reserved[root] = serial
-    cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
-    os.makedirs(cur, exist_ok=True)
     if not background:
         io.save_persistables(executor, cur, main_program)
         _finish_checkpoint(checkpoint_dir, cur, trainer_args,

@@ -101,15 +101,37 @@ class PaddlePredictor:
 
     def run(self, inputs: List[PaddleTensor],
             batch_size: int = -1) -> List[PaddleTensor]:
+        from paddle_tpu.fluid.lod_tensor import LoDTensor
+
         feed = {}
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
-            feed[name] = t.data
+            # the reference's PaddleTensor carries LoD alongside data
+            # (paddle_inference_api.h:67); a sequence model fed flat data
+            # without its LoD would silently see one giant sequence
+            if t.lod:
+                for level in t.lod:
+                    if (len(level) < 2 or level[0] != 0
+                            or int(level[-1]) != int(t.data.shape[0])):
+                        raise ValueError(
+                            f"PaddleTensor '{name}' lod must be offsets "
+                            f"form starting at 0 and ending at the row "
+                            f"count {t.data.shape[0]} (e.g. [[0, 2, 5]] "
+                            f"for lengths [2, 3]); got {t.lod}")
+                feed[name] = LoDTensor(t.data, t.lod)
+            else:
+                feed[name] = t.data
         outs = self._exe.run(self._program, feed=feed,
                              fetch_list=[v.name for v in self._fetch_vars],
-                             scope=self._scope)
-        return [PaddleTensor(name=v.name, data=np.asarray(o))
-                for v, o in zip(self._fetch_vars, outs)]
+                             scope=self._scope, return_numpy=False)
+        result = []
+        for v, o in zip(self._fetch_vars, outs):
+            lod = ()
+            if isinstance(o, LoDTensor):
+                lod = o.lod()
+            result.append(PaddleTensor(name=v.name, data=np.asarray(o),
+                                       lod=lod))
+        return result
 
     # the reference's C++ clone shares weights via the scope; here a clone
     # shares the scope (arrays are immutable jax values, so concurrent

@@ -158,3 +158,21 @@ def test_init_gflags_tryfromenv_and_direct():
         assert "missing_flag" not in fluid.core.GLOBAL_FLAGS
     finally:
         del os.environ["FLAGS_fraction_of_gpu_memory_to_use"]
+
+
+def test_gflags_preserve_value_types():
+    """Numeric flag values must stay numeric ('1' -> 1, not True) and
+    non-literal strings must stay strings (advisor r3: bool coercion ate
+    --rpc_retry_times=1 and any flag valued 'on')."""
+    import paddle_tpu.fluid as fluid
+
+    fluid.core.init_gflags(
+        ["--rpc_retry_times=1", "--fraction=0.5", "--mode=sync",
+         "--use_thing=true", "--no_thing=false"])
+    flags = fluid.core.GLOBAL_FLAGS
+    assert flags["rpc_retry_times"] == 1 and \
+        not isinstance(flags["rpc_retry_times"], bool)
+    assert flags["fraction"] == 0.5
+    assert flags["mode"] == "sync"
+    assert flags["use_thing"] is True
+    assert flags["no_thing"] is False

@@ -69,3 +69,31 @@ def test_ssd_loss_trains():
         losses.append(float(np.asarray(l).reshape(-1)[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_multi_box_head_nondefault_kernel_counts_agree():
+    """With kernel_size=3/pad=0 the conv output map shrinks; priors are
+    generated from the conv OUTPUT map so mbox_locs/confs and boxes counts
+    always agree (advisor r3: input-map priors diverged from output-map
+    predictions)."""
+    import paddle_tpu.fluid as fluid
+
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    feat = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                               padding=1)
+    locs, confs, boxes, variances = fluid.layers.multi_box_head(
+        inputs=[feat], image=img, base_size=32, num_classes=3,
+        aspect_ratios=[[1.0]], min_sizes=[[8.0]], max_sizes=[[16.0]],
+        flip=False, kernel_size=3, pad=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    l, c, b, v = exe.run(
+        fluid.default_main_program(),
+        feed={"img": rng.normal(size=(2, 3, 32, 32)).astype(np.float32)},
+        fetch_list=[locs, confs, boxes, variances])
+    n_pred = np.asarray(l).shape[1]
+    assert np.asarray(c).shape[1] == n_pred
+    assert np.asarray(b).shape[0] == n_pred, \
+        (np.asarray(b).shape, n_pred)
+    assert np.asarray(v).shape[0] == n_pred
