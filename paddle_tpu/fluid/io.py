@@ -98,7 +98,14 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     for v in var_list:
         path = os.path.join(dirname, v.name)
         if not os.path.exists(path):
-            continue
+            # matching the reference's load op, which faults on an absent
+            # file (load_op.cc "cannot open file"): silently skipping leaves
+            # random init in place — e.g. a program whose unique names
+            # drifted from the saved model would "load" nothing and predict
+            # noise with no error anywhere
+            raise IOError(
+                f"load_vars: no saved file for variable '{v.name}' in "
+                f"{dirname} (program/name mismatch with the checkpoint?)")
         with open(path, "rb") as f:
             scope.set(v.name, np.load(f, allow_pickle=False))
 

@@ -399,6 +399,7 @@ class DynamicRNN:
                 init_reordered = parent_block.create_var(
                     name=unique_name.generate(
                         "dynamic_rnn_mem_init_reordered"), dtype=init.dtype)
+                init_reordered.shape = getattr(init, "shape", None)
                 parent_block.append_op(
                     type="reorder_lod_tensor_by_rank",
                     inputs={"X": [init_tensor],

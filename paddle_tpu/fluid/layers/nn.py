@@ -32,7 +32,8 @@ __all__ = [
     "im2sequence", "maxout", "relu", "log", "crop", "mean_iou",
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
-    "ring_attention", "moe_ffn", "gpipe_mlp_stack", "cos_sim",
+    "ring_attention", "moe_ffn", "gpipe_mlp_stack",
+    "transformer_encoder_stack", "transformer_decoder_stack", "cos_sim",
     "multiplex", "pool3d", "random_crop", "rank_loss",
     "image_resize_short", "Print", "load",
     "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
@@ -1258,21 +1259,131 @@ def gpipe_mlp_stack(input, n_layers, act="relu", n_microbatches=4,
 
 
 def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
-                   name=None):
+                   bias=None, name=None):
     """Sequence-parallel attention (TPU-native capability beyond the
     reference — see parallel/ring_attention.py).  q, k, v: [B, H, T, D].
     Under a mesh with an `sp` axis the sequence dim shards across devices
     and K/V rotate the ICI ring; single-device it equals full softmax
-    attention."""
+    attention.  ``bias``, if given, is an additive [B, 1, 1, T] key bias
+    (padding mask) that rides the ring with K/V."""
     helper = LayerHelper("ring_attention", **locals())
     out = helper.create_variable_for_type_inference(helper.input_dtype("q"))
     out.shape = tuple(q.shape)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
     helper.append_op(
-        type="ring_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        type="ring_attention", inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": float(scale or 0.0),
                "sp_axis": sp_axis})
     return out
+
+def _stack_params(helper, dtype, n_layer, d_model, d_inner, decoder,
+                  param_attr):
+    """Create the stacked [L, ...] parameters of a transformer layer stack,
+    tagged with per-dim ``dist_spec`` mesh hints (parallel/transformer_stack
+    .dist_spec_for) so pp shards layers and mp shards the Megatron dims."""
+    from ...parallel import transformer_stack as ts
+    from ..initializer import ConstantInitializer, XavierInitializer
+
+    table = ts.DECODER_SLOTS if decoder else ts.ENCODER_SLOTS
+    shapes = {
+        "WQ": [n_layer, d_model, d_model], "WK": [n_layer, d_model, d_model],
+        "WV": [n_layer, d_model, d_model], "WO": [n_layer, d_model, d_model],
+        "FFN1W": [n_layer, d_model, d_inner], "FFN1B": [n_layer, d_inner],
+        "FFN2W": [n_layer, d_inner, d_model], "FFN2B": [n_layer, d_model],
+        "LN1S": [n_layer, d_model], "LN1B": [n_layer, d_model],
+        "LN2S": [n_layer, d_model], "LN2B": [n_layer, d_model],
+    }
+    if decoder:
+        shapes.update({
+            "CQ": [n_layer, d_model, d_model], "CK": [n_layer, d_model, d_model],
+            "CV": [n_layer, d_model, d_model], "CO": [n_layer, d_model, d_model],
+            "LN3S": [n_layer, d_model], "LN3B": [n_layer, d_model],
+        })
+    params = {}
+    for slot, shape in shapes.items():
+        if slot.endswith(("S",)) and slot.startswith("LN"):
+            init = ConstantInitializer(1.0)
+        elif slot.endswith("B") or len(shape) == 2:
+            init = ConstantInitializer(0.0)
+        else:
+            # stacked weights need PER-LAYER fans: the default fan
+            # convention would read the layer dim as receptive field
+            init = XavierInitializer(fan_in=shape[1], fan_out=shape[2])
+        p = helper.create_parameter(attr=copy.deepcopy(param_attr),
+                                    shape=shape, dtype=dtype,
+                                    default_initializer=init)
+        p.dist_spec = ts.dist_spec_for(slot, len(shape), decoder)
+        params[slot] = p
+    return params
+
+
+def transformer_encoder_stack(input, bias=None, n_layer=2, n_head=4,
+                              d_inner=None, dropout=0.0, is_test=False,
+                              n_microbatches=4, param_attr=None, name=None):
+    """A full transformer ENCODER stack as one mesh-aware op (TPU-native
+    capability — see parallel/transformer_stack.py).  input: [N, T, D];
+    bias: optional [N, 1, 1, T] additive key bias (padding mask).
+
+    Single-device this is a lax.scan over the stacked layer params; under a
+    mesh it composes pipeline ("pp"), Megatron tensor ("mp") and ring-
+    attention sequence ("sp") parallelism with data parallelism ("dp") —
+    the same program runs on every mesh shape.  Residual dropout only (see
+    transformer_stack module docstring)."""
+    helper = LayerHelper("transformer_encoder_stack", **locals())
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    params = _stack_params(helper, dtype, n_layer, d, d_inner or 4 * d,
+                           False, param_attr)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(input.shape)
+    rng_key = helper.create_variable_for_type_inference("int32")
+    rng_key.shape = (2,)
+    rng_key.stop_gradient = True
+    inputs = {"X": [input]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    inputs.update({slot: [p] for slot, p in params.items()})
+    helper.append_op(
+        type="transformer_encoder_stack", inputs=inputs,
+        outputs={"Out": [out], "RngKey": [rng_key]},
+        attrs={"n_head": int(n_head), "dropout": float(dropout),
+               "is_test": bool(is_test),
+               "n_microbatches": int(n_microbatches)})
+    return out
+
+
+def transformer_decoder_stack(input, enc_out, src_bias=None, n_layer=2,
+                              n_head=4, d_inner=None, dropout=0.0,
+                              is_test=False, n_microbatches=4,
+                              param_attr=None, name=None):
+    """A full transformer DECODER stack (causal self-attn + cross-attn +
+    FFN per layer) as one mesh-aware op; see transformer_encoder_stack.
+    input: [N, Tt, D]; enc_out: [N, Ts, D]; src_bias: [N, 1, 1, Ts]."""
+    helper = LayerHelper("transformer_decoder_stack", **locals())
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    params = _stack_params(helper, dtype, n_layer, d, d_inner or 4 * d,
+                           True, param_attr)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(input.shape)
+    rng_key = helper.create_variable_for_type_inference("int32")
+    rng_key.shape = (2,)
+    rng_key.stop_gradient = True
+    inputs = {"X": [input], "EncOut": [enc_out]}
+    if src_bias is not None:
+        inputs["Bias"] = [src_bias]
+    inputs.update({slot: [p] for slot, p in params.items()})
+    helper.append_op(
+        type="transformer_decoder_stack", inputs=inputs,
+        outputs={"Out": [out], "RngKey": [rng_key]},
+        attrs={"n_head": int(n_head), "dropout": float(dropout),
+               "is_test": bool(is_test),
+               "n_microbatches": int(n_microbatches)})
+    return out
+
 
 def cos_sim(X, Y, name=None):
     """Cosine similarity per row (ref: layers/nn.py cos_sim, cos_sim_op.*)."""

@@ -223,7 +223,10 @@ def sequence_concat(input, name=None):
 
 def sequence_expand(x, y, ref_level=-1, name=None):
     helper = LayerHelper("sequence_expand", **locals())
-    out = _out(helper, x.dtype)
+    # rows are dynamic (expansion counts come from y's LoD) but trailing
+    # dims survive — downstream fc/shape math needs them
+    out = _out(helper, x.dtype,
+               shape=((-1,) + tuple(x.shape[1:])) if x.shape else None)
     helper.append_op(type="sequence_expand", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]},
                      attrs={"ref_level": ref_level})

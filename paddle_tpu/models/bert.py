@@ -26,7 +26,7 @@ from .transformer import (Config, _ffn, _multi_head_attention, _padding_bias,
 class BertConfig:
     def __init__(self, name, vocab_size=30522, d_model=768, d_inner=3072,
                  n_head=12, n_layer=12, type_vocab_size=2, max_len=512,
-                 dropout=0.1):
+                 dropout=0.1, ring_attention=False):
         self.name = name
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -36,6 +36,10 @@ class BertConfig:
         self.type_vocab_size = type_vocab_size
         self.max_len = max_len
         self.dropout = dropout
+        # ring_attention=True routes every encoder attention through
+        # layers.ring_attention: long sequences shard over an "sp" mesh
+        # axis (models/transformer.Config.ring_attention semantics)
+        self.ring_attention = ring_attention
 
 
 def base_config():
@@ -70,7 +74,8 @@ def encoder_stack(emb, pad_bias, cfg):
     for i in range(cfg.n_layer):
         attn = _multi_head_attention(
             enc, enc, enc, pad_bias, cfg.d_model, cfg.n_head, cfg.dropout,
-            prefix=f"bert{i}_self")
+            prefix=f"bert{i}_self",
+            use_ring=getattr(cfg, "ring_attention", False))
         enc = _postprocess(enc, attn, cfg.dropout)
         ff = _ffn(enc, cfg.d_inner, cfg.d_model, prefix=f"bert{i}")
         enc = _postprocess(enc, ff, cfg.dropout)

@@ -26,7 +26,8 @@ _NEG_INF = -1e9
 class Config:
     def __init__(self, name, src_vocab_size, tgt_vocab_size, d_model,
                  d_inner, n_head, n_layer, dropout=0.1, label_smooth=0.1,
-                 moe_experts=0, moe_top_k=2, moe_aux_weight=1e-2):
+                 moe_experts=0, moe_top_k=2, moe_aux_weight=1e-2,
+                 stacked=False, ring_attention=False, n_microbatches=4):
         self.name = name
         self.src_vocab_size = src_vocab_size
         self.tgt_vocab_size = tgt_vocab_size
@@ -41,6 +42,19 @@ class Config:
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_aux_weight = moe_aux_weight
+        # stacked=True builds the encoder/decoder as ONE mesh-aware
+        # layer-stack op with [L, ...] params (layers.transformer_*_stack):
+        # pipeline-parallel over "pp", Megatron-TP over "mp", ring-
+        # attention over "sp" — the pipeline-capable flagship build.
+        # Residual dropout only in this mode (see transformer_stack).
+        self.stacked = stacked
+        # ring_attention=True keeps the per-layer graph but routes every
+        # attention through layers.ring_attention, so the UNstacked model
+        # sequence-parallelizes over an "sp" mesh axis too.  Attention-
+        # probability dropout is skipped in this mode (the [T, T] matrix
+        # never materializes under the ring).
+        self.ring_attention = ring_attention
+        self.n_microbatches = n_microbatches
 
 
 def base_config():
@@ -75,9 +89,16 @@ def _postprocess(prev, out, dropout):
 
 
 def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
-                          dropout, prefix):
+                          dropout, prefix, causal=False, use_ring=False):
     """[b, lq, d] x [b, lk, d] -> [b, lq, d]; bias broadcasts into the
-    [b, h, lq, lk] logits (None, [lq, lk] causal, or [b, 1, 1, lk] padding)."""
+    [b, h, lq, lk] logits (None, [lq, lk] causal, or [b, 1, 1, lk] padding).
+
+    use_ring=True routes the attention through layers.ring_attention
+    (sequence-parallel over an "sp" mesh axis, mathematically identical
+    single-device); the causal mask is then expressed via the op's
+    ``causal`` flag and ``bias`` must be a key-position padding bias
+    ([b, 1, 1, lk]) or None — and attention-probability dropout is skipped
+    (the ring never materializes the probability matrix)."""
     lq, lk = q_in.shape[1], k_in.shape[1]
     d_k = d_model // n_head
     q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False,
@@ -93,14 +114,22 @@ def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
                          perm=[0, 2, 1, 3])
     v = layers.transpose(layers.reshape(v, [-1, lk, n_head, d_k]),
                          perm=[0, 2, 1, 3])
-    logits = layers.matmul(layers.scale(q, scale=d_k ** -0.5), k,
-                           transpose_y=True)
-    if bias is not None:
-        logits = layers.elementwise_add(logits, bias)
-    weights = layers.softmax(logits)
-    if dropout:
-        weights = layers.dropout(weights, dropout_prob=dropout)
-    ctx = layers.matmul(weights, v)                      # [b, h, lq, d_k]
+    if use_ring:
+        ctx = layers.ring_attention(q, k, v, causal=causal,
+                                    scale=d_k ** -0.5, bias=bias)
+    else:
+        logits = layers.matmul(layers.scale(q, scale=d_k ** -0.5), k,
+                               transpose_y=True)
+        if causal:
+            causal_np = np.triu(
+                np.full((lq, lk), _NEG_INF, np.float32), k=1)
+            logits = layers.elementwise_add(logits, layers.assign(causal_np))
+        if bias is not None:
+            logits = layers.elementwise_add(logits, bias)
+        weights = layers.softmax(logits)
+        if dropout:
+            weights = layers.dropout(weights, dropout_prob=dropout)
+        ctx = layers.matmul(weights, v)                  # [b, h, lq, d_k]
     ctx = layers.reshape(layers.transpose(ctx, perm=[0, 2, 1, 3]),
                          [-1, lq, d_model])
     return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False,
@@ -162,10 +191,16 @@ def moe_config():
 def encoder(src_word, cfg, src_len, aux_losses=None):
     enc = _embed(src_word, cfg.src_vocab_size, src_len, cfg, "src")
     src_bias = _padding_bias(src_word, src_len)
+    if cfg.stacked:
+        enc = layers.transformer_encoder_stack(
+            enc, bias=src_bias, n_layer=cfg.n_layer, n_head=cfg.n_head,
+            d_inner=cfg.d_inner, dropout=cfg.dropout,
+            n_microbatches=cfg.n_microbatches)
+        return enc, src_bias
     for i in range(cfg.n_layer):
         attn = _multi_head_attention(
             enc, enc, enc, src_bias, cfg.d_model, cfg.n_head, cfg.dropout,
-            prefix=f"enc{i}_self")
+            prefix=f"enc{i}_self", use_ring=cfg.ring_attention)
         enc = _postprocess(enc, attn, cfg.dropout)
         ff = _ffn(enc, cfg.d_inner, cfg.d_model, prefix=f"enc{i}",
                   cfg=cfg, aux_losses=aux_losses)
@@ -175,16 +210,21 @@ def encoder(src_word, cfg, src_len, aux_losses=None):
 
 def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses=None):
     dec = _embed(tgt_word, cfg.tgt_vocab_size, tgt_len, cfg, "tgt")
-    causal = np.triu(np.full((tgt_len, tgt_len), _NEG_INF, np.float32), k=1)
-    causal_bias = layers.assign(causal)
+    if cfg.stacked:
+        dec = layers.transformer_decoder_stack(
+            dec, enc_out, src_bias=src_bias, n_layer=cfg.n_layer,
+            n_head=cfg.n_head, d_inner=cfg.d_inner, dropout=cfg.dropout,
+            n_microbatches=cfg.n_microbatches)
+        return layers.fc(dec, cfg.tgt_vocab_size, num_flatten_dims=2,
+                         param_attr=ParamAttr(name="out_proj_w"))
     for i in range(cfg.n_layer):
         self_attn = _multi_head_attention(
-            dec, dec, dec, causal_bias, cfg.d_model, cfg.n_head, cfg.dropout,
-            prefix=f"dec{i}_self")
+            dec, dec, dec, None, cfg.d_model, cfg.n_head, cfg.dropout,
+            prefix=f"dec{i}_self", causal=True, use_ring=cfg.ring_attention)
         dec = _postprocess(dec, self_attn, cfg.dropout)
         cross = _multi_head_attention(
             dec, enc_out, enc_out, src_bias, cfg.d_model, cfg.n_head,
-            cfg.dropout, prefix=f"dec{i}_cross")
+            cfg.dropout, prefix=f"dec{i}_cross", use_ring=cfg.ring_attention)
         dec = _postprocess(dec, cross, cfg.dropout)
         ff = _ffn(dec, cfg.d_inner, cfg.d_model, prefix=f"dec{i}",
                   cfg=cfg, aux_losses=aux_losses)

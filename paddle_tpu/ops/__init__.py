@@ -24,4 +24,5 @@ from . import (  # noqa: F401
     rcnn_ops,
     moe_ops,
     pipeline_ops,
+    transformer_ops,
 )

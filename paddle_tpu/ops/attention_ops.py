@@ -20,6 +20,7 @@ from .registry import register_op
 @register_op("ring_attention")
 def ring_attention_op(ctx):
     q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")  # [B, H, T, D]
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
     causal = ctx.attr("causal", False)
     sp_axis = ctx.attr("sp_axis", "sp")
     scale = ctx.attr("scale", 0.0) or None
@@ -29,13 +30,14 @@ def ring_attention_op(ctx):
     mesh = spmd.active_mesh()
     if mesh is not None and sp_axis in mesh.axis_names \
             and mesh.shape[sp_axis] > 1:
-        out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale)
-    elif _use_flash():
+        out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale,
+                                bias=bias)
+    elif bias is None and _use_flash():
         from .pallas_flash import flash_attention
 
         out = flash_attention(q, k, v, scale, causal)
     else:
-        out = ra.full_attention(q, k, v, causal, scale)
+        out = ra.full_attention(q, k, v, causal, scale, bias=bias)
     return {"Out": out}
 
 

@@ -30,3 +30,18 @@ def make_mesh(n_devices=None, tp=1, axis_names=("dp", "mp")) -> Mesh:
         raise ValueError(f"n_devices={n} not divisible by tp={tp}")
     arr = np.array(devs[:n]).reshape(n // tp, tp)
     return Mesh(arr, axis_names)
+
+
+def make_mesh_nd(**axes) -> Mesh:
+    """N-D mesh from named axis sizes, e.g. ``make_mesh_nd(dp=2, mp=2,
+    pp=2)``.  Axis order = keyword order (python dicts preserve it); later
+    axes map to faster-varying device indices, i.e. the innermost/most-
+    ICI-adjacent dimension — put the most communication-hungry axis last."""
+    names = tuple(axes)
+    sizes = tuple(int(s) for s in axes.values())
+    n = int(np.prod(sizes))
+    devs = jax.devices()
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} visible")
+    arr = np.array(devs[:n]).reshape(sizes)
+    return Mesh(arr, names)

@@ -33,12 +33,16 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o):
+def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o,
+                  bias=None):
     """One online-softmax accumulation step of q against a (k, v) block.
 
     q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; m/l/o are the running max,
-    denominator and (unnormalized) output."""
+    denominator and (unnormalized) output; bias, if given, is an additive
+    [B, 1, 1, Tk] key-position bias (padding mask) for THIS k block."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if bias is not None:
+        s = s + bias
     if causal:
         tq, tk = q.shape[2], k.shape[2]
         qpos = q_off + jnp.arange(tq)[:, None]
@@ -56,8 +60,10 @@ def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o):
     return m_new, l_new, o_new
 
 
-def _ring_body(q, k, v, axis_name, causal, scale):
-    """Runs inside shard_map: q/k/v are the LOCAL [B, H, T/S, D] blocks."""
+def _ring_body(q, k, v, bias, axis_name, causal, scale):
+    """Runs inside shard_map: q/k/v are the LOCAL [B, H, T/S, D] blocks;
+    bias (or None) is the LOCAL [B, 1, 1, T/S] key-bias block, which
+    rotates around the ring together with its k/v block."""
     n_dev = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_local = q.shape[2]
@@ -67,33 +73,37 @@ def _ring_body(q, k, v, axis_name, causal, scale):
     l = jnp.zeros(q.shape[:3] + (1,), q.dtype)
     o = jnp.zeros_like(q)
 
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
     def step(i, carry):
-        k_cur, v_cur, m, l, o = carry
+        k_cur, v_cur, b_cur, m, l, o = carry
         src = (my - i) % n_dev  # whose K/V block we hold at step i
         m, l, o = _block_attend(q, k_cur, v_cur, q_off, src * t_local,
-                                scale, causal, m, l, o)
-        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+                                scale, causal, m, l, o, bias=b_cur)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m, l, o
+        b_nxt = (lax.ppermute(b_cur, axis_name, perm)
+                 if b_cur is not None else None)
+        return k_nxt, v_nxt, b_nxt, m, l, o
 
-    k_cur, v_cur = k, v
-    carry = (k_cur, v_cur, m, l, o)
+    carry = (k, v, bias, m, l, o)
     # python loop: n_dev is static, XLA overlaps ppermute with the next
     # step's einsum (no scan-carried dynamic shapes)
     for i in range(n_dev):
         carry = step(i, carry)
-    _, _, m, l, o = carry
+    _, _, _, m, l, o = carry
     return o / jnp.maximum(l, jnp.finfo(l.dtype).tiny)
 
 
 def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
-                   causal: bool = False, scale=None):
+                   causal: bool = False, scale=None, bias=None):
     """Sequence-parallel attention over ``mesh[sp_axis]``.
 
     q, k, v: [B, H, T, D] global arrays (T divisible by the sp size);
     returns [B, H, T, D] with the same sharding.  Batch may additionally be
-    sharded on a "dp" axis — the spec below only constrains T."""
+    sharded on a "dp" axis — the spec below only constrains T.  bias, if
+    given, is an additive [B, 1, 1, T] key-position bias (padding mask);
+    it shards over sp on its key dim and rides the ring with k/v."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     # batch stays dp-sharded when the mesh has a dp axis — otherwise the
@@ -101,18 +111,27 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
     # would redo the full-batch attention
     b_axis = "dp" if "dp" in mesh.axis_names else None
     spec = P(b_axis, None, sp_axis, None)
+    if bias is None:
+        fn = _shard_map(
+            partial(_ring_body, bias=None, axis_name=sp_axis, causal=causal,
+                    scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+    bspec = P(b_axis, None, None, sp_axis)
     fn = _shard_map(
         partial(_ring_body, axis_name=sp_axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+        mesh=mesh, in_specs=(spec, spec, spec, bspec), out_specs=spec)
+    return fn(q, k, v, bias)
 
 
-def full_attention(q, k, v, causal: bool = False, scale=None):
+def full_attention(q, k, v, causal: bool = False, scale=None, bias=None):
     """Single-device reference (used as the oracle and as the fallback when
     no sp mesh is active)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
     if causal:
         t_q, t_k = q.shape[2], k.shape[2]
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]

@@ -75,8 +75,29 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
     has_dp = zero1 and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
 
     def hint_spec(v) -> Optional[P]:
-        """Params created with a ``dist_hint`` axis name (expert weights →
-        "ep", pipeline-stacked weights → "pp") shard dim 0 on that axis."""
+        """Params created with sharding hints.
+
+        ``dist_spec``: a per-dim tuple of mesh-axis names/None (stacked
+        transformer params — e.g. ("pp", None, "mp")); axes absent from the
+        mesh or with non-divisible dims degrade to replicated PER DIM, so
+        the same program runs on any mesh shape.  A param with a dist_spec
+        never falls through to the generic 2-D TP heuristic (a stacked
+        [L, d] layer-norm scale must NOT shard d over mp — the shard_map
+        body expects it replicated).
+
+        ``dist_hint``: a single axis name (expert weights → "ep",
+        pipeline-stacked weights → "pp") sharding dim 0 on that axis.
+        """
+        ds = getattr(v, "dist_spec", None)
+        if ds is not None:
+            shape = v.shape or ()
+            dims = []
+            for d, ax in enumerate(ds[: len(shape)]):
+                ok = (ax is not None and ax in mesh.axis_names
+                      and mesh.shape[ax] > 1 and shape[d] is not None
+                      and shape[d] % mesh.shape[ax] == 0)
+                dims.append(ax if ok else None)
+            return P(*dims)
         axis = getattr(v, "dist_hint", None)
         if axis is None or axis not in mesh.axis_names \
                 or mesh.shape[axis] <= 1:
@@ -88,6 +109,8 @@ def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
 
     has_hints = any(
         getattr(v, "dist_hint", None) in mesh.axis_names
+        or any(ax in mesh.axis_names
+               for ax in (getattr(v, "dist_spec", None) or ()) if ax)
         for v in program.global_block().vars.values()
         if isinstance(v, Parameter))
     if not has_tp and not has_dp and not has_hints:
@@ -259,8 +282,31 @@ class ShardedTrainStep:
 
     def place_feed(self, feed: Dict[str, np.ndarray]):
         """Shard feeds on the batch axis.  Multihost: each process passes its
-        LOCAL batch; the global batch is num_processes x local."""
-        sh = NamedSharding(self.mesh, self.bspec)
+        LOCAL batch; the global batch is num_processes x local.
+
+        Uneven final batches (ref: details/data_balance_op_handle.cc — the
+        reference redistributes short batches so no device sees a ragged
+        shard): a batch whose leading dim is NOT divisible by the dp size
+        cannot shard evenly, so it executes REPLICATED — every device
+        computes the full short batch, which is mathematically identical to
+        the single-device result (exact loss, exact update; no padding
+        bias).  It costs the dp speedup for that one (final) batch and one
+        extra compile for its shape — the shape change forces a recompile
+        anyway."""
+        dp_size = 1
+        for ax in self.bspec:
+            if ax is not None:
+                dp_size *= self.mesh.shape[ax]
+        divisible = all(
+            np.asarray(v).ndim > 0 and np.asarray(v).shape[0] % dp_size == 0
+            for v in feed.values())
+        if not divisible and self.multihost:
+            raise ValueError(
+                "multihost batches must be dp-divisible per process "
+                f"(dp={dp_size}); pad or drop the final short batch "
+                f"(got shapes { {k: np.asarray(v).shape for k, v in feed.items()} })")
+        sh = NamedSharding(self.mesh,
+                           self.bspec if divisible else P())
         out = {}
         gb = self.program.global_block()
         for k, v in feed.items():

@@ -1,0 +1,159 @@
+"""contrib.decoder DSL end-to-end test (ref API: contrib/decoder/
+beam_search_decoder.py — InitState/StateCell/TrainingDecoder/
+BeamSearchDecoder; usage pattern: book machine_translation decode).
+
+Task: next-token chains t_{i+1} = perm[t_i] seeded by a GO token, with a
+tiny source conditioning vector.  The SAME StateCell trains under
+TrainingDecoder (teacher forcing through DynamicRNN) and then generates
+under BeamSearchDecoder (While + beam_search); because both programs build
+their layers in the same order, parameter names line up and the trained
+weights drive the generation (the reference's own sharing convention)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.decoder import (BeamSearchDecoder, InitState,
+                                              StateCell, TrainingDecoder)
+
+V = 14          # vocab: 0 pad, 1 EOS, 2 GO, 3.. chain tokens
+D = 24
+GO, EOS = 2, 1
+CHAIN_LEN = 5
+
+
+def _perm():
+    rng = np.random.RandomState(77)
+    body = rng.permutation(np.arange(3, V))
+    return {int(a): int(b) for a, b in zip(np.arange(3, V), body)}
+
+
+def _chain(start, n):
+    p = _perm()
+    seq, w = [], start
+    for _ in range(n):
+        w = p[w]
+        seq.append(w)
+    return seq
+
+
+def _build_cell(h_boot):
+    """Shared cell: h' = tanh(W [x; h]); identical at train + decode."""
+    cell = StateCell(inputs={"x": None},
+                     states={"h": InitState(init=h_boot,
+                                            need_reorder=True)},
+                     out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        x = c.get_input("x")
+        h = c.get_state("h")
+        nh = layers.fc(input=[x, h], size=D, act="tanh")
+        c.set_state("h", nh)
+
+    return cell
+
+
+def _encoder():
+    """src token -> h0; identical layer order in train + decode builds."""
+    src = layers.data(name="src", shape=[1], dtype="int64")
+    emb = layers.embedding(src, size=[V, D])
+    h0 = layers.fc(input=emb, size=D, act="tanh")
+    return src, h0
+
+
+def test_training_decoder_then_beam_search_generation(tmp_path):
+    from paddle_tpu.fluid import unique_name
+
+    # ---------- training program ----------
+    unique_name.switch()  # deterministic names: decode build must re-derive
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        src, h0 = _encoder()
+        trg = layers.data(name="trg", shape=[1], dtype="int64",
+                          lod_level=1)
+        lbl = layers.data(name="lbl", shape=[1], dtype="int64",
+                          lod_level=1)
+        cell = _build_cell(h0)
+        trg_emb = layers.embedding(trg, size=[V, D])
+        dec = TrainingDecoder(cell)
+        with dec.block():
+            x = dec.step_input(trg_emb)
+            cell.compute_state(inputs={"x": x})
+            score = layers.fc(input=cell.out_state(), size=V,
+                              act="softmax")
+            cell.update_states()
+            dec.output(score)
+        prob = dec()
+        loss = layers.mean(layers.cross_entropy(input=prob, label=lbl))
+        fluid.optimizer.Adam(learning_rate=8e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    starts = [3, 4, 5, 6]
+    src_np = np.array([[s] for s in starts], np.int64)
+    trg_rows, lbl_rows = [], []
+    for s in starts:
+        c = _chain(s, CHAIN_LEN)
+        trg_rows += [GO] + c[:-1]
+        lbl_rows += c
+    lens = [[CHAIN_LEN] * len(starts)]
+    feed = {"src": src_np,
+            "trg": (np.array(trg_rows, np.int64).reshape(-1, 1), lens),
+            "lbl": (np.array(lbl_rows, np.int64).reshape(-1, 1), lens)}
+    losses = []
+    for _ in range(80):
+        (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < 0.15, (losses[0], losses[-1])
+    fluid.io.save_persistables(exe, str(tmp_path), main)
+
+    # ---------- decode program (same layer order => same param names) ----
+    unique_name.switch()  # restart counters so fc_*/embedding_* line up
+    dmain, dstartup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(dmain, dstartup):
+        src, h0 = _encoder()
+        cell = _build_cell(h0)
+        init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
+                               lod_level=2)
+        init_scores = layers.data(name="init_scores", shape=[1],
+                                  dtype="float32", lod_level=2)
+        bsd = BeamSearchDecoder(cell, init_ids, init_scores,
+                                target_dict_dim=V, word_dim=D,
+                                topk_size=V, sparse_emb=False,
+                                max_len=CHAIN_LEN + 2, beam_size=2,
+                                end_id=EOS)
+        bsd.decode()
+        out_ids, out_scores = bsd()
+
+    with fluid.scope_guard(_executor.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(dstartup)
+        fluid.io.load_persistables(exe2, str(tmp_path), dmain)
+
+        b = 2
+        lod2 = [[1] * b, [1] * b]
+        dfeed = {
+            "src": np.array([[3], [5]], np.int64),
+            "init_ids": fluid.create_lod_tensor(
+                np.full((b, 1), GO, np.int64), lod2),
+            "init_scores": fluid.create_lod_tensor(
+                np.zeros((b, 1), np.float32), lod2)}
+        ids, scores = exe2.run(dmain, feed=dfeed,
+                               fetch_list=[out_ids, out_scores],
+                               return_numpy=False)
+        hyp_lens = ids.recursive_sequence_lengths()[-1]
+        flat = np.asarray(ids).ravel()
+        # each source decodes beam_size hypotheses; the TOP hypothesis of
+        # each source must follow the learned chain (first tokens after GO)
+        offsets = np.cumsum([0] + list(hyp_lens))
+        hyps_per_src = len(hyp_lens) // b
+        for i, start in enumerate((3, 5)):
+            top = flat[offsets[i * hyps_per_src]:
+                       offsets[i * hyps_per_src] + hyp_lens[i * hyps_per_src]]
+            want = _chain(start, CHAIN_LEN)
+            got = [t for t in top.tolist() if t not in (GO, EOS)]
+            assert got[:3] == want[:3], (start, got, want)
