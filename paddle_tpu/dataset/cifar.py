@@ -10,7 +10,10 @@ TEST_SIZE = 512
 
 def _synthetic(n, classes, seed):
     rng = np.random.RandomState(seed)
-    means = rng.uniform(0.2, 0.8, size=(classes, 3, 1, 1)).astype(np.float32)
+    # class means from a FIXED seed so train/test share one distribution
+    # (only labels/noise vary per split), like the real dataset
+    means = np.random.RandomState(3217).uniform(
+        0.2, 0.8, size=(classes, 3, 1, 1)).astype(np.float32)
     labels = rng.randint(0, classes, size=n).astype(np.int64)
     imgs = np.clip(means[labels] +
                    rng.normal(0, 0.2, size=(n, 3, 32, 32)).astype(np.float32),

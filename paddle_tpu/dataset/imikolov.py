@@ -27,8 +27,10 @@ class DataType:
 
 def _synthetic_corpus(n_sentences, seed):
     rng = np.random.RandomState(seed)
-    # markov chain with a dominant successor per word -> learnable
-    succ = rng.permutation(VOCAB)
+    # markov chain with a dominant successor per word -> learnable; the
+    # successor table uses a FIXED seed so train/test share the language
+    # model being learned (only the sampled sentences differ per split)
+    succ = np.random.RandomState(2304).permutation(VOCAB)
     sents = []
     for _ in range(n_sentences):
         w = int(rng.randint(VOCAB))

@@ -28,7 +28,10 @@ def _synthetic_pairs(n, src_dict_size, trg_dict_size, seed):
     rng = np.random.RandomState(seed)
     v_src = max(src_dict_size - 3, 5)
     v_trg = max(trg_dict_size - 3, 5)
-    perm = rng.permutation(max(v_src, v_trg))
+    # the "translation rule" (the permutation) comes from a FIXED seed so
+    # train/test/validation teach and test the SAME mapping — only the
+    # sampled sentences differ per split, as with a real corpus
+    perm = np.random.RandomState(1604).permutation(max(v_src, v_trg))
     for _ in range(n):
         ln = int(rng.randint(3, 12))
         src = rng.randint(0, v_src, size=ln)

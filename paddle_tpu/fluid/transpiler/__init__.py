@@ -2,9 +2,10 @@
 
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from .inference_transpiler import InferenceTranspiler
+from .int8_transpiler import Int8WeightTranspiler
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .ps_dispatcher import HashName, RoundRobin
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
-           "InferenceTranspiler", "memory_optimize", "release_memory",
-           "HashName", "RoundRobin"]
+           "InferenceTranspiler", "Int8WeightTranspiler", "memory_optimize",
+           "release_memory", "HashName", "RoundRobin"]

@@ -57,8 +57,12 @@ class AnalysisConfig(NativeConfig):
     """ref: paddle_inference_api.h:156.  enable_ir_optim runs the program
     rewrites that matter pre-XLA: is_test flips + conv+BN weight folding
     (transpiler.InferenceTranspiler ≈ the reference's analysis passes +
-    inference_transpiler)."""
+    inference_transpiler).  enable_int8 additionally rewrites matmul/conv
+    weights to int8-in-HBM with per-channel scales, dequantized at the
+    consuming op (transpiler.Int8WeightTranspiler ≈ the reference's int8
+    analysis pass; weight-only, so accuracy loss stays <1%)."""
     enable_ir_optim: bool = True
+    enable_int8: bool = False
 
 
 class PaddlePredictor:
@@ -92,6 +96,11 @@ class PaddlePredictor:
 
             InferenceTranspiler().transpile(self._program, place,
                                             scope=self._scope)
+        if isinstance(config, AnalysisConfig) and config.enable_int8:
+            from paddle_tpu.fluid.transpiler import Int8WeightTranspiler
+
+            Int8WeightTranspiler().transpile(self._program, place,
+                                             scope=self._scope)
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)

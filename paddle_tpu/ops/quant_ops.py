@@ -20,6 +20,22 @@ def _bin_cnt(bits):
     return float(2 ** (bits - 1) - 1)
 
 
+@register_op("dequantize_weight", no_grad_inputs=("X", "Scale"))
+def dequantize_weight(ctx):
+    """Weight-only int8 inference (transpiler/int8_transpiler.py): X is an
+    int8 weight living in HBM at 1/4 the bytes; Out = X * scale/127 per
+    channel, in the float compute dtype.  XLA fuses the cast+multiply into
+    the consuming matmul/conv read, so this costs no extra HBM round-trip —
+    the TPU analogue of the reference's int8 analysis pass
+    (inference/analysis/, fake_dequantize_op.cc math)."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale")          # [C] float32 per-channel abs-max
+    axis = int(ctx.attr("quant_axis", 0))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return {"Out": x.astype(jnp.float32) * (scale.reshape(shape) / 127.0)}
+
+
 @register_op("fake_quantize_abs_max", no_grad_inputs=())
 def fake_quantize_abs_max(ctx):
     x = ctx.input("X")

@@ -280,6 +280,29 @@ class ShardedTrainStep:
                 rk, NamedSharding(self.mesh, P()), from_full=True)
         return state
 
+    def _batch_divisor(self) -> int:
+        """How many equal shards this process's feed must split into: the
+        whole batch-axis size single-host, but only the LOCAL extent of the
+        batch axes multihost (each process feeds its local batch; the batch
+        axis may span processes — dp over DCN — or live inside one)."""
+        axes = [ax for ax in self.bspec if ax is not None]
+        if not axes:
+            return 1
+        if not self.multihost:
+            n = 1
+            for ax in axes:
+                n *= self.mesh.shape[ax]
+            return n
+        pid = jax.process_index()
+        devs = self.mesh.devices
+        local = np.vectorize(lambda d: d.process_index == pid)(devs)
+        n = 1
+        for ax in axes:
+            ai = list(self.mesh.axis_names).index(ax)
+            n *= sum(1 for i in range(devs.shape[ai])
+                     if np.take(local, i, axis=ai).any())
+        return n
+
     def place_feed(self, feed: Dict[str, np.ndarray]):
         """Shard feeds on the batch axis.  Multihost: each process passes its
         LOCAL batch; the global batch is num_processes x local.
@@ -293,17 +316,15 @@ class ShardedTrainStep:
         bias).  It costs the dp speedup for that one (final) batch and one
         extra compile for its shape — the shape change forces a recompile
         anyway."""
-        dp_size = 1
-        for ax in self.bspec:
-            if ax is not None:
-                dp_size *= self.mesh.shape[ax]
+        dp_size = self._batch_divisor()
         divisible = all(
             np.asarray(v).ndim > 0 and np.asarray(v).shape[0] % dp_size == 0
             for v in feed.values())
         if not divisible and self.multihost:
             raise ValueError(
                 "multihost batches must be dp-divisible per process "
-                f"(dp={dp_size}); pad or drop the final short batch "
+                f"(local dp extent {dp_size}); pad or drop the final short "
+                f"batch "
                 f"(got shapes { {k: np.asarray(v).shape for k, v in feed.items()} })")
         sh = NamedSharding(self.mesh,
                            self.bspec if divisible else P())
