@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""ResNet config in the legacy trainer_config_helpers DSL, lowered onto
+the TPU Fluid substrate (ref config: benchmark/paddle/image/resnet.py —
+same bottleneck/projection structure; geometry/class-count/block-depth
+readable from config args so one file serves ImageNet runs and smoke
+tests)."""
+
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+
+height = get_config_arg("height", int, 224)
+width = get_config_arg("width", int, 224)
+num_class = get_config_arg("num_class", int, 1000)
+batch_size = get_config_arg("batch_size", int, 64)
+layer_num = get_config_arg("layer_num", int, 50)
+is_infer = get_config_arg("is_infer", bool, False)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider", obj="process", args={})
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size))
+
+
+def conv_bn_layer(name, input, filter_size, num_filters, stride, padding,
+                  channels=None, active_type=ReluActivation()):
+    tmp = img_conv_layer(name=name + "_conv", input=input,
+                         filter_size=filter_size, num_channels=channels,
+                         num_filters=num_filters, stride=stride,
+                         padding=padding, act=LinearActivation(),
+                         bias_attr=False)
+    return batch_norm_layer(name=name + "_bn", input=tmp, act=active_type,
+                            use_global_stats=is_infer)
+
+
+def bottleneck_block(name, input, num_filters1, num_filters2):
+    tmp = conv_bn_layer(name + "_branch2a", input, 1, num_filters1, 1, 0)
+    tmp = conv_bn_layer(name + "_branch2b", tmp, 3, num_filters1, 1, 1)
+    tmp = conv_bn_layer(name + "_branch2c", tmp, 1, num_filters2, 1, 0,
+                        active_type=LinearActivation())
+    return addto_layer(name=name + "_addto", input=[input, tmp],
+                       act=ReluActivation())
+
+
+def mid_projection(name, input, num_filters1, num_filters2, stride=2):
+    branch1 = conv_bn_layer(name + "_branch1", input, 1, num_filters2,
+                            stride, 0, active_type=LinearActivation())
+    tmp = conv_bn_layer(name + "_branch2a", input, 1, num_filters1,
+                        stride, 0)
+    tmp = conv_bn_layer(name + "_branch2b", tmp, 3, num_filters1, 1, 1)
+    tmp = conv_bn_layer(name + "_branch2c", tmp, 1, num_filters2, 1, 0,
+                        active_type=LinearActivation())
+    return addto_layer(name=name + "_addto", input=[branch1, tmp],
+                       act=ReluActivation())
+
+
+img = data_layer(name="image", size=height * width * 3,
+                 height=height, width=width)
+
+
+def deep_res_net(res2_num=3, res3_num=4, res4_num=6, res5_num=3):
+    tmp = conv_bn_layer("conv1", img, 7, 64, 2, 3, channels=3)
+    tmp = img_pool_layer(name="pool1", input=tmp, pool_size=3, stride=2)
+    stages = [(res2_num, 64, 256, 1), (res3_num, 128, 512, 2),
+              (res4_num, 256, 1024, 2), (res5_num, 512, 2048, 2)]
+    for si, (blocks, f1, f2, stride) in enumerate(stages, start=2):
+        tmp = mid_projection(f"res{si}_1", tmp, f1, f2, stride=stride)
+        for b in range(2, blocks + 1):
+            tmp = bottleneck_block(f"res{si}_{b}", tmp, f1, f2)
+    pool_hw = max(1, height // 32)
+    tmp = img_pool_layer(name="avgpool", input=tmp, pool_size=pool_hw,
+                         stride=1, pool_type=AvgPooling())
+    return fc_layer(input=tmp, size=num_class, act=SoftmaxActivation())
+
+
+_depths = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3),
+           # small depths for smoke tests via config args
+           14: (1, 1, 1, 1), 26: (2, 2, 2, 2)}
+resnet = deep_res_net(*_depths[layer_num])
+
+if is_infer:
+    outputs(resnet)
+else:
+    lbl = data_layer(name="label", size=num_class)
+    loss = cross_entropy(name="loss", input=resnet, label=lbl)
+    outputs(loss)

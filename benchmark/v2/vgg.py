@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""VGG config in the legacy trainer_config_helpers DSL, lowered onto the
+TPU Fluid substrate (ref config: benchmark/paddle/image/vgg.py — same
+structure and defaults; geometry/class-count readable from config args so
+the same file drives ImageNet-scale runs and small smoke tests)."""
+
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+
+height = get_config_arg("height", int, 224)
+width = get_config_arg("width", int, 224)
+num_class = get_config_arg("num_class", int, 1000)
+batch_size = get_config_arg("batch_size", int, 64)
+layer_num = get_config_arg("layer_num", int, 19)
+is_infer = get_config_arg("is_infer", bool, False)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider", obj="process", args={})
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.001 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size))
+
+img = data_layer(name="image", size=height * width * 3,
+                 height=height, width=width)
+
+
+def vgg_network(vgg_num=3):
+    tmp = img_conv_group(
+        input=img, num_channels=3, conv_padding=1,
+        conv_num_filter=[64, 64], conv_filter_size=3,
+        conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+        pool_type=MaxPooling())
+    tmp = img_conv_group(
+        input=tmp, conv_num_filter=[128, 128], conv_padding=1,
+        conv_filter_size=3, conv_act=ReluActivation(), pool_stride=2,
+        pool_type=MaxPooling(), pool_size=2)
+    for width_ in (256, 512, 512):
+        tmp = img_conv_group(
+            input=tmp, conv_num_filter=[width_] * vgg_num, conv_padding=1,
+            conv_filter_size=3, conv_act=ReluActivation(), pool_stride=2,
+            pool_type=MaxPooling(), pool_size=2)
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation(),
+                   layer_attr=ExtraAttr(drop_rate=0.5))
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation(),
+                   layer_attr=ExtraAttr(drop_rate=0.5))
+    return fc_layer(input=tmp, size=num_class, act=SoftmaxActivation())
+
+
+# 16/19 are the reference depths; 11 (vgg_num=1) is a smoke-test depth
+vgg = vgg_network({16: 3, 19: 4, 11: 1}[layer_num])
+
+if is_infer:
+    outputs(vgg)
+else:
+    lbl = data_layer(name="label", size=num_class)
+    loss = cross_entropy(name="loss", input=vgg, label=lbl)
+    outputs(loss)
