@@ -366,8 +366,75 @@ def bench_resnet_infer(fluid, platform, on_accel):
                        amp=fluid.amp.compute_dtype() or "off")
 
 
+def bench_decode(fluid, platform, on_accel):
+    """Beam-search GENERATION throughput (BENCH_MODEL=decode): the
+    contrib.decoder BeamSearchDecoder loop — data-dependent shapes, so the
+    executor runs it as eager islands (per-step dispatches; over a
+    tunneled TPU the ~ms/dispatch floor applies per op).  No reference
+    decode-throughput figure exists, so vs_baseline is reported as 0 and
+    the metric stands on its absolute tokens/sec."""
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.contrib.decoder import (BeamSearchDecoder,
+                                                  InitState, StateCell)
+
+    batch = _env_int("decode", "BS", 8)
+    rounds = _env_int("decode", "STEPS", 3)
+    v, d = 1000, 64
+    max_len, beam = 16, 4
+
+    src = layers.data(name="src", shape=[1], dtype="int64")
+    h0 = layers.fc(input=layers.embedding(src, size=[v, d]), size=d,
+                   act="tanh")
+    cell = StateCell(inputs={"x": None},
+                     states={"h": InitState(init=h0, need_reorder=True)},
+                     out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        c.set_state("h", layers.fc(input=[c.get_input("x"),
+                                          c.get_state("h")],
+                                   size=d, act="tanh"))
+
+    init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
+                           lod_level=2)
+    init_scores = layers.data(name="init_scores", shape=[1],
+                              dtype="float32", lod_level=2)
+    dec = BeamSearchDecoder(cell, init_ids, init_scores,
+                            target_dict_dim=v, word_dim=d, topk_size=50,
+                            sparse_emb=False, max_len=max_len,
+                            beam_size=beam, end_id=1)
+    dec.decode()
+    out_ids, _ = dec()
+
+    place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    lod2 = [[1] * batch, [1] * batch]
+    feed = {"src": rng.randint(2, v, size=(batch, 1)).astype(np.int64),
+            "init_ids": fluid.create_lod_tensor(
+                np.zeros((batch, 1), np.int64), lod2),
+            "init_scores": fluid.create_lod_tensor(
+                np.zeros((batch, 1), np.float32), lod2)}
+    (warm,) = exe.run(fluid.default_main_program(), feed=feed,
+                      fetch_list=[out_ids], return_numpy=False)
+    t0 = time.perf_counter()
+    n_tokens = 0
+    for _ in range(rounds):
+        (ids,) = exe.run(fluid.default_main_program(), feed=feed,
+                         fetch_list=[out_ids], return_numpy=False)
+        n_tokens += int(np.asarray(ids).size)
+    dt = time.perf_counter() - t0
+    return {"metric": f"beam_decode_b{batch}_beam{beam}_len{max_len}_{platform}",
+            "value": round(n_tokens / dt, 2), "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "note": "no published reference decode throughput; "
+                    "absolute generation rate (eager-island execution)"}
+
+
 BENCHES = {"resnet": bench_resnet, "transformer": bench_transformer,
-           "mnist": bench_mnist, "resnet_infer": bench_resnet_infer}
+           "mnist": bench_mnist, "resnet_infer": bench_resnet_infer,
+           "decode": bench_decode}
 
 
 def _run_one(model, fluid, platform, on_accel):

@@ -153,18 +153,27 @@ def _jax():
 
 
 def get_jax_device(place: Place):
-    """Resolve a Place to a concrete jax.Device (best effort)."""
+    """Resolve a Place to a concrete jax.Device (best effort).
+
+    Always a process-LOCAL device: under jax.distributed the global device
+    list starts with process 0's devices, and committing feeds to another
+    process's device would make every fetch non-addressable here (the
+    local-SGD runner hit exactly that)."""
     jax = _jax()
     kind = place.device_type
+
+    def local(k):
+        return [d for d in jax.local_devices() if d.platform == k]
+
     if kind == "cpu":
-        devs = jax.devices("cpu")
+        devs = local("cpu") or jax.devices("cpu")
     else:
         # tpu / gpu: take the default backend's devices; on a TPU host this is
         # the TPU chip, under forced-CPU tests it degrades to host devices.
         try:
-            devs = jax.devices(kind)
+            devs = local(kind) or jax.devices(kind)
         except RuntimeError:
-            devs = jax.devices()
+            devs = jax.local_devices()
     return devs[place.device_id % len(devs)]
 
 

@@ -15,8 +15,11 @@ it records the trainer topology and marks the program for SPMD execution:
    in the TPU deployment; its state-holding role maps onto sharded optimizer
    state (BuildStrategy.ReduceStrategy.Reduce ≈ ZeRO-1).
 
-Async PS semantics (RunAsyncLoop) have no SPMD equivalent and are documented
-as unsupported (SURVEY.md hard part #4).
+Async PS semantics (ref listen_and_serv_op.cc:213 RunAsyncLoop) have no
+literal SPMD equivalent; ``sync_mode=False`` maps onto the TPU-native form
+of the same staleness-for-throughput trade — local SGD with periodic
+parameter averaging (parallel.local_sgd.AsyncLocalSGDTrainer), whose
+staleness is bounded by the sync period rather than unbounded.
 """
 
 from __future__ import annotations
@@ -44,10 +47,6 @@ class DistributeTranspiler:
         (parallel.multihost.init) with the first pserver endpoint as the
         coordinator address — the TPU mapping of the reference's
         gen_nccl_id-over-gRPC bootstrap (gen_nccl_id_op.cc:31)."""
-        if not sync_mode:
-            raise NotImplementedError(
-                "async parameter-server mode has no SPMD equivalent on TPU; "
-                "use sync_mode=True (see SURVEY.md §2.6)")
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.sync_mode = sync_mode
@@ -59,7 +58,10 @@ class DistributeTranspiler:
             "trainers": trainers,
             "coordinator": (self.pserver_endpoints[0]
                             if self.pserver_endpoints else None),
-            "mode": "spmd_ici",
+            # sync_mode=False selects the async-PS replacement: local SGD
+            # with periodic averaging (parallel.local_sgd) instead of the
+            # per-step GSPMD collective program
+            "mode": "spmd_ici" if sync_mode else "async_local_sgd",
         }
         # Join the pod NOW: jax.distributed.initialize must run before any
         # JAX computation touches the backend, and in the reference flow

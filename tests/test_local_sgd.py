@@ -1,0 +1,144 @@
+"""Async-PS replacement oracle (VERDICT r3 missing #2): sync_mode=False
+maps onto local SGD with periodic parameter averaging
+(parallel.local_sgd.AsyncLocalSGDTrainer; ref async loop:
+listen_and_serv_op.cc:213 RunAsyncLoop).
+
+Exactness anchor: with plain SGD and sync_period=1, averaging post-step
+parameter copies equals averaging gradients, so the 2-process local-SGD
+trajectory must match a single-process full-batch run parameter-for-
+parameter.  A second phase raises the period (real staleness) and checks
+the copies re-converge at each sync and the loss still falls."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODEL = """
+fluid.default_main_program().random_seed = 61
+fluid.default_startup_program().random_seed = 61
+img = fluid.layers.data(name="img", shape=[12], dtype="float32")
+label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+h = fluid.layers.fc(input=img, size=24, act="relu")
+pred = fluid.layers.fc(input=h, size=5, act="softmax")
+loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+"""
+
+WORKER = ("""
+import os, sys, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+trainer_id = int(sys.argv[1])
+port = sys.argv[2]
+sys.path.insert(0, %r)
+
+import paddle_tpu.fluid as fluid
+t = None
+""" % REPO) + """
+# sync_mode=False is the async path -> local SGD (also joins the pod)
+import paddle_tpu.fluid as fluid
+""" + MODEL + """
+tr = fluid.DistributeTranspiler()
+tr.transpile(trainer_id, pservers="127.0.0.1:" + port, trainers=2,
+             sync_mode=False)
+prog = tr.get_trainer_program()
+assert prog._dist_info["mode"] == "async_local_sgd"
+
+from paddle_tpu.parallel import AsyncLocalSGDTrainer
+
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+
+# phase 1: sync_period=1 == synchronous data parallelism (SGD identity)
+runner = AsyncLocalSGDTrainer(prog, loss.name, sync_period=1)
+rng = np.random.RandomState(0)
+x = rng.normal(size=(8, 12)).astype(np.float32)
+y = rng.randint(0, 5, size=(8, 1)).astype(np.int64)
+lo, hi = trainer_id * 4, (trainer_id + 1) * 4
+for _ in range(3):
+    runner.step({"img": x[lo:hi], "label": y[lo:hi]})
+from paddle_tpu.fluid.executor import global_scope
+w_after = np.asarray(global_scope().get("fc_0.w_0"))
+
+# phase 2: sync_period=2 (real staleness); copies equal after each sync
+runner2 = AsyncLocalSGDTrainer(prog, loss.name, sync_period=2)
+losses = []
+for _ in range(4):
+    (l,) = runner2.step({"img": x[lo:hi], "label": y[lo:hi]})
+    losses.append(float(np.asarray(l).reshape(-1)[0]))
+w_sync = np.asarray(global_scope().get("fc_0.w_0"))
+print("LOCAL_SGD " + json.dumps({
+    "w1": w_after.ravel()[:6].tolist(),
+    "wsync": w_sync.ravel()[:6].tolist(),
+    "losses": losses}), flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_async_local_sgd_two_processes():
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=1 "
+        "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(i), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    payloads = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("LOCAL_SGD")]
+        assert line, f"worker produced no result:\n{out[-2500:]}"
+        payloads.append(json.loads(line[0].split(" ", 1)[1]))
+
+    # copies identical across processes after averaging (both phases)
+    np.testing.assert_allclose(payloads[0]["w1"], payloads[1]["w1"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(payloads[0]["wsync"], payloads[1]["wsync"],
+                               rtol=1e-6)
+    assert payloads[0]["losses"][-1] < payloads[0]["losses"][0]
+
+    # exactness: sync_period=1 local SGD == single-process full batch
+    import paddle_tpu.fluid as fluid
+
+    ns = {"fluid": fluid}
+    exec(MODEL, ns)
+    loss = ns["loss"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(8, 12)).astype(np.float32)
+    y = rng.randint(0, 5, size=(8, 1)).astype(np.int64)
+    for _ in range(3):
+        exe.run(fluid.default_main_program(),
+                feed={"img": x, "label": y}, fetch_list=[loss])
+    from paddle_tpu.fluid.executor import global_scope
+
+    w_ref = np.asarray(global_scope().get("fc_0.w_0")).ravel()[:6]
+    np.testing.assert_allclose(payloads[0]["w1"], w_ref, rtol=2e-5,
+                               atol=2e-6)
