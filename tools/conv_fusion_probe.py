@@ -1,0 +1,112 @@
+"""Conv/elementwise fusion isolation probe (VERDICT r3 weak #2).
+
+Round-3 isolation measured bare 3x3 conv chains at 56-125 TFLOPs through
+the tunnel but conv+relu interleaved at only ~9 TFLOPs — consistent with
+ResNet-50 training at ~21 TFLOPs (10.8%% MFU) and suspicious of unfused
+elementwise-after-conv.  This probe pins that down with one number per
+variant so the fix (layout, flag, or kernel) can be chosen from data:
+
+  conv_chain          N conv layers, no elementwise
+  conv_relu           conv -> relu
+  conv_bias_relu      conv -> +bias -> relu
+  conv_bn_relu        conv -> scale+shift (inference BN) -> relu
+  conv_relu_nhwc      same as conv_relu but NHWC layout
+  matmul_relu         control: matmul -> relu (MXU path without conv)
+
+Usage:  python tools/conv_fusion_probe.py [N_LAYERS] [HW] [CH]
+Emits one JSON line per variant: {"variant", "tflops", "ms_per_step"}.
+Each variant runs in a subprocess-friendly way (single process, sequential)
+— keep runs short; heavy benchmarking has wedged the tunnel before.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N_LAYERS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+HW = int(sys.argv[2]) if len(sys.argv) > 2 else 56
+CH = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+BATCH = 64
+STEPS = 8
+
+
+def conv(x, w, dn):
+    return lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                    dimension_numbers=dn)
+
+
+def chain(kind, nhwc=False):
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    key = jax.random.PRNGKey(0)
+    shape = (BATCH, HW, HW, CH) if nhwc else (BATCH, CH, HW, HW)
+    wshape = (3, 3, CH, CH) if nhwc else (CH, CH, 3, 3)
+    x = jax.random.normal(key, shape, jnp.bfloat16) * 0.1
+    w = jax.random.normal(key, wshape, jnp.bfloat16) * 0.05
+    b = jax.random.normal(key, (CH,), jnp.bfloat16) * 0.1
+    bshape = (1, 1, 1, CH) if nhwc else (1, CH, 1, 1)
+
+    def f(x):
+        for _ in range(N_LAYERS):
+            y = conv(x, w, dn)
+            if kind == "conv_relu":
+                y = jax.nn.relu(y)
+            elif kind == "conv_bias_relu":
+                y = jax.nn.relu(y + b.reshape(bshape))
+            elif kind == "conv_bn_relu":
+                y = jax.nn.relu(y * b.reshape(bshape) + b.reshape(bshape))
+            x = y
+        return jnp.float32(x).mean()
+
+    return jax.jit(f), x
+
+
+def matmul_relu():
+    key = jax.random.PRNGKey(1)
+    n = 4096
+    a = jax.random.normal(key, (n, n), jnp.bfloat16) * 0.05
+
+    def f(x):
+        for _ in range(N_LAYERS):
+            x = jax.nn.relu(x @ a)
+        return jnp.float32(x).mean()
+
+    return jax.jit(f), a
+
+
+def flops(kind):
+    if kind == "matmul_relu":
+        return 2 * 4096 ** 3 * N_LAYERS
+    return 2 * BATCH * HW * HW * CH * CH * 9 * N_LAYERS
+
+
+def run(kind, fn, x):
+    fn(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        r = fn(x)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / STEPS
+    print(json.dumps({"variant": kind, "tflops": round(flops(kind) / dt / 1e12, 1),
+                      "ms_per_step": round(dt * 1e3, 2),
+                      "device": jax.devices()[0].platform}), flush=True)
+
+
+def main():
+    for kind in ("conv_chain", "conv_relu", "conv_bias_relu",
+                 "conv_bn_relu"):
+        fn, x = chain(kind)
+        run(kind, fn, x)
+    fn, x = chain("conv_relu", nhwc=True)
+    run("conv_relu_nhwc", fn, x)
+    fn, x = matmul_relu()
+    run("matmul_relu", fn, x)
+
+
+if __name__ == "__main__":
+    main()
