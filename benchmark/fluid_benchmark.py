@@ -53,6 +53,12 @@ def parse_args(argv=None):
                    help="synthetic data (default: no dataset download env)")
     p.add_argument("--use_reader_op", action="store_true")
     p.add_argument("--profile", action="store_true")
+    p.add_argument("--transformer_mode", default="dense",
+                   choices=["dense", "stacked", "ring"],
+                   help="transformer build: dense per-layer graph, "
+                        "stacked (pipeline-capable layer-stack op, shards "
+                        "over pp/mp meshes), or ring (ring-attention "
+                        "sequence parallelism over an sp mesh)")
     p.add_argument("--update_method", default="local",
                    choices=["local", "pserver", "nccl2"])
     p.add_argument("--no_test", action="store_true")
@@ -148,6 +154,12 @@ def _build(args):
             # axis under ParallelExecutor; dense dispatch single-device)
             cfg.name = f"moe_{cfg.name}"
             cfg.moe_experts = 8 if args.device != "CPU" else 4
+        if args.transformer_mode == "stacked":
+            cfg.stacked = True
+            cfg.name = f"{cfg.name}_stacked"
+        elif args.transformer_mode == "ring":
+            cfg.ring_attention = True
+            cfg.name = f"{cfg.name}_ring"
         src, tgt, lbl, loss = trf.build(cfg, src_len=seq, tgt_len=seq, lr=lr)
         feed = lambda rng: {
             "src_word": rng.randint(1, cfg.src_vocab_size,

@@ -13,7 +13,7 @@ variant so the fix (layout, flag, or kernel) can be chosen from data:
   conv_relu_nhwc      same as conv_relu but NHWC layout
   matmul_relu         control: matmul -> relu (MXU path without conv)
 
-Usage:  python tools/conv_fusion_probe.py [N_LAYERS] [HW] [CH]
+Usage:  python tools/conv_fusion_probe.py [N_LAYERS] [HW] [CH] [BATCH] [MM_N]
 Emits one JSON line per variant: {"variant", "tflops", "ms_per_step"}.
 Each variant runs in a subprocess-friendly way (single process, sequential)
 — keep runs short; heavy benchmarking has wedged the tunnel before.
@@ -22,17 +22,26 @@ Each variant runs in a subprocess-friendly way (single process, sequential)
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
+
+# sitecustomize pre-imports jax pinned to the axon tunnel, so the
+# JAX_PLATFORMS env var arrives too late; PROBE_PLATFORM=cpu forces the
+# backend in-process (smoke-testing the probe without touching the TPU)
+if os.environ.get("PROBE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
+
 import jax.numpy as jnp
 from jax import lax
 
 N_LAYERS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 HW = int(sys.argv[2]) if len(sys.argv) > 2 else 56
 CH = int(sys.argv[3]) if len(sys.argv) > 3 else 256
-BATCH = 64
+BATCH = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+MM_N = int(sys.argv[5]) if len(sys.argv) > 5 else 4096
 STEPS = 8
 
 
@@ -68,7 +77,7 @@ def chain(kind, nhwc=False):
 
 def matmul_relu():
     key = jax.random.PRNGKey(1)
-    n = 4096
+    n = MM_N
     a = jax.random.normal(key, (n, n), jnp.bfloat16) * 0.05
 
     def f(x):
@@ -81,7 +90,7 @@ def matmul_relu():
 
 def flops(kind):
     if kind == "matmul_relu":
-        return 2 * 4096 ** 3 * N_LAYERS
+        return 2 * MM_N ** 3 * N_LAYERS
     return 2 * BATCH * HW * HW * CH * CH * 9 * N_LAYERS
 
 
