@@ -352,6 +352,7 @@ class DynamicRNN:
         x_reordered = parent_block.create_var(
             name=unique_name.generate("dynamic_rnn_static_input_reordered"),
             dtype=x.dtype)
+        x_reordered.shape = getattr(x, "shape", None)
         parent_block.append_op(
             type="reorder_lod_tensor_by_rank",
             inputs={"X": [x], "RankTable": [self.lod_rank_table]},
