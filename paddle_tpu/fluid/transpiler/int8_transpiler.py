@@ -13,9 +13,11 @@ int8 counterpart, specialized to the part that pays off under XLA:
    activations and accumulation stay float — "weight-only" quantization,
    the standard accuracy-safe recipe (<1%% drop without calibration data).
 
-Scales come either from the weights themselves (abs-max, default) or from
-QAT observers if the program carries fake_quantize ops (their OutScale is
-honored and the fake ops are stripped).
+Scales come from the weights themselves (per-channel abs-max): weight-only
+quantization needs no calibration data or QAT observers — the fake_quantize
+ops (ops/quant_ops.py) remain the training-time QAT surface, and a QAT'd
+model's weights quantize here losslessly since training already pinned them
+to the quantization grid.
 """
 
 from __future__ import annotations

@@ -119,14 +119,25 @@ class PaddlePredictor:
             # (paddle_inference_api.h:67); a sequence model fed flat data
             # without its LoD would silently see one giant sequence
             if t.lod:
-                for level in t.lod:
-                    if (len(level) < 2 or level[0] != 0
-                            or int(level[-1]) != int(t.data.shape[0])):
+                # offsets-form sanity: every level starts at 0 and is
+                # non-decreasing; the FINEST level ends at the row count,
+                # and each coarser level indexes into the next level's
+                # sequence count (standard nested-LoD invariants —
+                # lengths-form input would fail these loudly instead of
+                # silently mis-slicing)
+                for li, level in enumerate(t.lod):
+                    ok = (len(level) >= 2 and level[0] == 0
+                          and all(a <= b for a, b in zip(level, level[1:])))
+                    if ok:
+                        end = (int(t.data.shape[0]) if li == len(t.lod) - 1
+                               else len(t.lod[li + 1]) - 1)
+                        ok = int(level[-1]) == end
+                    if not ok:
                         raise ValueError(
                             f"PaddleTensor '{name}' lod must be offsets "
-                            f"form starting at 0 and ending at the row "
-                            f"count {t.data.shape[0]} (e.g. [[0, 2, 5]] "
-                            f"for lengths [2, 3]); got {t.lod}")
+                            f"form (e.g. [[0, 2, 5]] for lengths [2, 3]); "
+                            f"level {li} of {t.lod} is inconsistent with "
+                            f"{t.data.shape[0]} rows")
                 feed[name] = LoDTensor(t.data, t.lod)
             else:
                 feed[name] = t.data

@@ -80,6 +80,22 @@ def _position_encoding(max_len, d_model):
     return table
 
 
+def _shared_causal_bias(lq, lk):
+    """One additive triu causal mask per (program, shape) — every decoder
+    layer shares the same constant var instead of re-materializing it."""
+    from .. import fluid as _fluid
+
+    prog = _fluid.default_main_program()
+    cache = getattr(prog, "_causal_bias_cache", None)
+    if cache is None:
+        cache = prog._causal_bias_cache = {}
+    var = cache.get((lq, lk))
+    if var is None:
+        causal_np = np.triu(np.full((lq, lk), _NEG_INF, np.float32), k=1)
+        var = cache[(lq, lk)] = layers.assign(causal_np)
+    return var
+
+
 def _postprocess(prev, out, dropout):
     """Residual add + layer norm (+ dropout on the sublayer output)."""
     if dropout:
@@ -121,9 +137,10 @@ def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
         logits = layers.matmul(layers.scale(q, scale=d_k ** -0.5), k,
                                transpose_y=True)
         if causal:
-            causal_np = np.triu(
-                np.full((lq, lk), _NEG_INF, np.float32), k=1)
-            logits = layers.elementwise_add(logits, layers.assign(causal_np))
+            # one shared [lq, lk] mask var per program+shape: layers would
+            # otherwise each carry their own identical triu constant
+            logits = layers.elementwise_add(logits,
+                                            _shared_causal_bias(lq, lk))
         if bias is not None:
             logits = layers.elementwise_add(logits, bias)
         weights = layers.softmax(logits)

@@ -210,6 +210,7 @@ class ShardedTrainStep:
         self.specs = infer_param_specs(program, self.plan, mesh, tp_axis,
                                        zero1=zero1)
         self.bspec = batch_spec(mesh)
+        self._bdiv = None  # lazy: jax.process_index needs initialized dist
 
         plan = self.plan
 
@@ -316,27 +317,31 @@ class ShardedTrainStep:
         bias).  It costs the dp speedup for that one (final) batch and one
         extra compile for its shape — the shape change forces a recompile
         anyway."""
-        dp_size = self._batch_divisor()
-        divisible = all(
-            np.asarray(v).ndim > 0 and np.asarray(v).shape[0] % dp_size == 0
-            for v in feed.values())
+        if self._bdiv is None:
+            self._bdiv = self._batch_divisor()
+        dp_size = self._bdiv
+        arrays = {k: np.asarray(v) for k, v in feed.items()}
+        # 0-d feeds (scalars like a fed learning rate) have no batch dim to
+        # shard; they replicate regardless and must not veto dp sharding
+        batched = {k: a for k, a in arrays.items() if a.ndim > 0}
+        divisible = all(a.shape[0] % dp_size == 0 for a in batched.values())
         if not divisible and self.multihost:
             raise ValueError(
                 "multihost batches must be dp-divisible per process "
                 f"(local dp extent {dp_size}); pad or drop the final short "
                 f"batch "
-                f"(got shapes { {k: np.asarray(v).shape for k, v in feed.items()} })")
+                f"(got shapes { {k: a.shape for k, a in batched.items()} })")
         sh = NamedSharding(self.mesh,
                            self.bspec if divisible else P())
+        rep = NamedSharding(self.mesh, P())
         out = {}
         gb = self.program.global_block()
-        for k, v in feed.items():
-            arr = np.asarray(v)
+        for k, arr in arrays.items():
             if gb._has_var_recursive(k):
                 want = core.np_dtype(gb._var_recursive(k).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            out[k] = self._place(arr, sh)
+            out[k] = self._place(arr, sh if arr.ndim > 0 else rep)
         return out
 
     def fetch_to_host(self, val) -> np.ndarray:

@@ -116,52 +116,78 @@ def _attend(q, k, v, bias, causal, local_heads, sp_axis):
     return ctx.transpose(0, 2, 1, 3).reshape(b, tq, dh)
 
 
-def _encoder_layer(p: Dict[str, jnp.ndarray], x, bias, key, *, local_heads,
-                   dropout, is_test, mp_axis, sp_axis):
-    """One post-norm encoder layer.  p holds THIS layer's (possibly
-    mp-local) param slices; x: [b, t, d]; bias: [b, 1, 1, t] or None."""
-    q, k, v = x @ p["WQ"], x @ p["WK"], x @ p["WV"]
-    ctx = _attend(q, k, v, bias, False, local_heads, sp_axis)
-    attn = ctx @ p["WO"]
+def _attend_in_shard_map(local_heads, sp_axis):
+    """Attention callable for code already INSIDE a shard_map body."""
+    def go(q, k, v, bias, causal):
+        return _attend(q, k, v, bias, causal, local_heads, sp_axis)
+
+    return go
+
+
+def _attend_gspmd_ring(n_head, mesh, sp_axis):
+    """Attention callable for the scan path with an sp axis: the ring runs
+    via the mesh-aware wrapper (its own shard_map); GSPMD owns the rest."""
+    def go(q, k, v, bias, causal):
+        b, tq, dh = q.shape
+        tk = k.shape[1]
+        dk = dh // n_head
+
+        def to4(a, t):
+            return a.reshape(b, t, n_head, dk).transpose(0, 2, 1, 3)
+
+        ctx = ra.ring_attention(to4(q, tq), to4(k, tk), to4(v, tk), mesh,
+                                sp_axis, causal=causal, bias=bias)
+        return ctx.transpose(0, 2, 1, 3).reshape(b, tq, dh)
+
+    return go
+
+
+def _mha(p, prefix, x, kv, bias, causal, attend, mp_axis):
+    """Projections + attention + output projection for one attention
+    sublayer; prefix selects self ("W") or cross ("C") weights."""
+    q = x @ p[prefix + "Q"]
+    k = kv @ p[prefix + "K"]
+    v = kv @ p[prefix + "V"]
+    out = attend(q, k, v, bias, causal) @ p[prefix + "O"]
     if mp_axis is not None:
-        attn = lax.psum(attn, mp_axis)
+        out = lax.psum(out, mp_axis)
+    return out
+
+
+def _ffn_sublayer(p, x, key, dropout, is_test, mp_axis, ln):
+    h = jax.nn.relu(x @ p["FFN1W"] + p["FFN1B"])
+    ff = h @ p["FFN2W"]
+    if mp_axis is not None:
+        ff = lax.psum(ff, mp_axis)
+    ff = ff + p["FFN2B"]
+    return _layer_norm(x + _dropout(ff, key, dropout, is_test),
+                       p[ln + "S"], p[ln + "B"])
+
+
+def _encoder_layer(p: Dict[str, jnp.ndarray], x, bias, key, *, attend,
+                   dropout, is_test, mp_axis):
+    """One post-norm encoder layer.  p holds THIS layer's (possibly
+    mp-local) param slices; x: [b, t, d]; bias: [b, 1, 1, t] or None.
+    ``attend`` is the attention callable (full softmax / in-shard_map ring
+    / GSPMD ring) — the single layer body serves every mesh layout."""
     k1, k2 = jax.random.split(key)
+    attn = _mha(p, "W", x, x, bias, False, attend, mp_axis)
     x = _layer_norm(x + _dropout(attn, k1, dropout, is_test),
                     p["LN1S"], p["LN1B"])
-    h = jax.nn.relu(x @ p["FFN1W"] + p["FFN1B"])
-    ff = h @ p["FFN2W"]
-    if mp_axis is not None:
-        ff = lax.psum(ff, mp_axis)
-    ff = ff + p["FFN2B"]
-    return _layer_norm(x + _dropout(ff, k2, dropout, is_test),
-                       p["LN2S"], p["LN2B"])
+    return _ffn_sublayer(p, x, k2, dropout, is_test, mp_axis, "LN2")
 
 
-def _decoder_layer(p, x, enc, src_bias, key, *, local_heads, dropout,
-                   is_test, mp_axis, sp_axis):
+def _decoder_layer(p, x, enc, src_bias, key, *, attend, dropout, is_test,
+                   mp_axis):
     """One post-norm decoder layer: causal self-attn, cross-attn, FFN."""
-    q, k, v = x @ p["WQ"], x @ p["WK"], x @ p["WV"]
-    sa = _attend(q, k, v, None, True, local_heads, sp_axis)
-    sa = sa @ p["WO"]
-    if mp_axis is not None:
-        sa = lax.psum(sa, mp_axis)
     k1, k2, k3 = jax.random.split(key, 3)
+    sa = _mha(p, "W", x, x, None, True, attend, mp_axis)
     x = _layer_norm(x + _dropout(sa, k1, dropout, is_test),
                     p["LN1S"], p["LN1B"])
-    cq, ck, cv = x @ p["CQ"], enc @ p["CK"], enc @ p["CV"]
-    ca = _attend(cq, ck, cv, src_bias, False, local_heads, sp_axis)
-    ca = ca @ p["CO"]
-    if mp_axis is not None:
-        ca = lax.psum(ca, mp_axis)
+    ca = _mha(p, "C", x, enc, src_bias, False, attend, mp_axis)
     x = _layer_norm(x + _dropout(ca, k2, dropout, is_test),
                     p["LN2S"], p["LN2B"])
-    h = jax.nn.relu(x @ p["FFN1W"] + p["FFN1B"])
-    ff = h @ p["FFN2W"]
-    if mp_axis is not None:
-        ff = lax.psum(ff, mp_axis)
-    ff = ff + p["FFN2B"]
-    return _layer_norm(x + _dropout(ff, k3, dropout, is_test),
-                       p["LN3S"], p["LN3B"])
+    return _ffn_sublayer(p, x, k3, dropout, is_test, mp_axis, "LN3")
 
 
 def _scan_layers(layer_fn, params, carry_x, key, n_layer):
@@ -270,20 +296,18 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
 
     if pp is None:
         # scan path; mp (GSPMD) and sp (mesh-aware ring op) still apply
+        attend = (_attend_in_shard_map(n_head, None) if sp is None
+                  else _attend_gspmd_ring(n_head, mesh, sp))
         if decoder:
             def layer_fn(p, xx, kk):
-                return _decoder_layer(
-                    p, xx, enc, bias, kk, local_heads=n_head,
-                    dropout=dropout, is_test=is_test, mp_axis=None,
-                    sp_axis=None) if sp is None else _decoder_layer_sp(
-                    p, xx, enc, bias, kk, n_head, dropout, is_test, mesh, sp)
+                return _decoder_layer(p, xx, enc, bias, kk, attend=attend,
+                                      dropout=dropout, is_test=is_test,
+                                      mp_axis=None)
         else:
             def layer_fn(p, xx, kk):
-                return _encoder_layer(
-                    p, xx, bias, kk, local_heads=n_head, dropout=dropout,
-                    is_test=is_test, mp_axis=None,
-                    sp_axis=None) if sp is None else _encoder_layer_sp(
-                    p, xx, bias, kk, n_head, dropout, is_test, mesh, sp)
+                return _encoder_layer(p, xx, bias, kk, attend=attend,
+                                      dropout=dropout, is_test=is_test,
+                                      mp_axis=None)
         return _scan_layers(layer_fn, params, x, key, n_layer)
 
     # pp path: one shard_map over the whole mesh; stages hold L/S layers
@@ -301,6 +325,8 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
     if bias is not None:
         xs["bias"] = bias
 
+    attend = _attend_in_shard_map(local_heads, sp)
+
     def stage_fn(local_params, tree, t):
         # local_params leaves: [L/S, ...] (this stage's layers)
         xx = tree["x"]
@@ -314,12 +340,12 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
             if decoder:
                 xx = _decoder_layer(
                     p_i, xx, tree.get("enc"), tree.get("bias"), kk,
-                    local_heads=local_heads, dropout=dropout,
-                    is_test=is_test, mp_axis=mp, sp_axis=sp)
+                    attend=attend, dropout=dropout, is_test=is_test,
+                    mp_axis=mp)
             else:
                 xx = _encoder_layer(
-                    p_i, xx, tree.get("bias"), kk, local_heads=local_heads,
-                    dropout=dropout, is_test=is_test, mp_axis=mp, sp_axis=sp)
+                    p_i, xx, tree.get("bias"), kk, attend=attend,
+                    dropout=dropout, is_test=is_test, mp_axis=mp)
         return {**tree, "x": xx}
 
     in_specs = (
@@ -333,50 +359,3 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
                 n_micro=n_micro, out_slot="x"),
         mesh=mesh, in_specs=in_specs, out_specs=out_spec)
     return fn(params, xs)
-
-
-def _encoder_layer_sp(p, x, bias, key, n_head, dropout, is_test, mesh, sp):
-    """scan-path encoder layer with ring attention (no pp, sp present):
-    the matmuls/layernorm run under GSPMD; only attention needs the
-    explicit ring, via the mesh-aware module."""
-    q, k, v = x @ p["WQ"], x @ p["WK"], x @ p["WV"]
-    b, t, dh = q.shape
-    dk = dh // n_head
-    to4 = lambda a: a.reshape(b, t, n_head, dk).transpose(0, 2, 1, 3)
-    ctx = ra.ring_attention(to4(q), to4(k), to4(v), mesh, sp,
-                            causal=False, bias=bias)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, dh)
-    attn = ctx @ p["WO"]
-    k1, k2 = jax.random.split(key)
-    x = _layer_norm(x + _dropout(attn, k1, dropout, is_test),
-                    p["LN1S"], p["LN1B"])
-    h = jax.nn.relu(x @ p["FFN1W"] + p["FFN1B"])
-    ff = h @ p["FFN2W"] + p["FFN2B"]
-    return _layer_norm(x + _dropout(ff, k2, dropout, is_test),
-                       p["LN2S"], p["LN2B"])
-
-
-def _decoder_layer_sp(p, x, enc, src_bias, key, n_head, dropout, is_test,
-                      mesh, sp):
-    b, t, dh = x.shape
-    dk = dh // n_head
-    to4 = lambda a, tt: a.reshape(b, tt, n_head, dk).transpose(0, 2, 1, 3)
-    un4 = lambda a, tt: a.transpose(0, 2, 1, 3).reshape(b, tt, dh)
-    q, k, v = x @ p["WQ"], x @ p["WK"], x @ p["WV"]
-    sa = ra.ring_attention(to4(q, t), to4(k, t), to4(v, t), mesh, sp,
-                           causal=True)
-    sa = un4(sa, t) @ p["WO"]
-    k1, k2, k3 = jax.random.split(key, 3)
-    x = _layer_norm(x + _dropout(sa, k1, dropout, is_test),
-                    p["LN1S"], p["LN1B"])
-    ts = enc.shape[1]
-    cq, ck, cv = x @ p["CQ"], enc @ p["CK"], enc @ p["CV"]
-    ca = ra.ring_attention(to4(cq, t), to4(ck, ts), to4(cv, ts), mesh, sp,
-                           causal=False, bias=src_bias)
-    ca = un4(ca, t) @ p["CO"]
-    x = _layer_norm(x + _dropout(ca, k2, dropout, is_test),
-                    p["LN2S"], p["LN2B"])
-    h = jax.nn.relu(x @ p["FFN1W"] + p["FFN1B"])
-    ff = h @ p["FFN2W"] + p["FFN2B"]
-    return _layer_norm(x + _dropout(ff, k3, dropout, is_test),
-                       p["LN3S"], p["LN3B"])

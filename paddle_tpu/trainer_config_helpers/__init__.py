@@ -51,7 +51,13 @@ def get_config_arg(name, type_, default=None):
     v = _config_args.get(name, default)
     if v is None:
         return None
-    return type_(v) if not isinstance(v, type_) else v
+    if isinstance(v, type_):
+        return v
+    if type_ is bool and isinstance(v, str):
+        # the reference DSL parses bool config args numerically;
+        # bool("0")/bool("False") == True would silently flip flags
+        return v.strip().lower() not in ("", "0", "false", "no", "off")
+    return type_(v)
 
 
 # --- activations / pooling markers (ref: activations.py, poolings.py) ----

@@ -54,7 +54,9 @@ class SGD:
             vals = [np.asarray(row[col]) for row in data_batch]
             arr = np.stack(vals)
             if v.dtype is not None and "int" in str(v.dtype):
-                arr = arr.astype(np.int64).reshape(len(vals), -1)[:, :1]
+                # scalar class labels become [N, 1]; integer SEQUENCES
+                # (n-gram windows etc.) keep all their columns
+                arr = arr.astype(np.int64).reshape(len(vals), -1)
             else:
                 arr = arr.astype(np.float32).reshape(len(vals), -1)
             feed[v.name] = arr
