@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""AlexNet config in the legacy trainer_config_helpers DSL (ref config:
+benchmark/paddle/image/alexnet.py — same conv/LRN/pool chain; geometry and
+class count readable from config args; BASELINE.md rows: 334 ms/batch
+bs128 GPU-era, 399-626 images/sec CPU train)."""
+
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+
+height = get_config_arg("height", int, 227)
+width = get_config_arg("width", int, 227)
+num_class = get_config_arg("num_class", int, 1000)
+batch_size = get_config_arg("batch_size", int, 128)
+gp = get_config_arg("layer_num", int, 1)  # conv groups, as the ref config
+is_infer = get_config_arg("is_infer", bool, False)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider", obj="process", args={})
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size))
+
+net = data_layer("data", size=height * width * 3, height=height,
+                 width=width)
+# conv1 (implicit relu via the DSL's wrap_act_default semantics)
+net = img_conv_layer(input=net, filter_size=11, num_channels=3,
+                     num_filters=96, stride=4, padding=1)
+net = img_cmrnorm_layer(input=net, size=5, scale=0.0001, power=0.75)
+net = img_pool_layer(input=net, pool_size=3, stride=2)
+# conv2
+net = img_conv_layer(input=net, filter_size=5, num_filters=256, stride=1,
+                     padding=2, groups=gp)
+net = img_cmrnorm_layer(input=net, size=5, scale=0.0001, power=0.75)
+net = img_pool_layer(input=net, pool_size=3, stride=2)
+# conv3-5
+net = img_conv_layer(input=net, filter_size=3, num_filters=384, stride=1,
+                     padding=1)
+net = img_conv_layer(input=net, filter_size=3, num_filters=384, stride=1,
+                     padding=1, groups=gp)
+net = img_conv_layer(input=net, filter_size=3, num_filters=256, stride=1,
+                     padding=1, groups=gp)
+net = img_pool_layer(input=net, pool_size=3, stride=2)
+
+net = fc_layer(input=net, size=4096, act=ReluActivation(),
+               layer_attr=ExtraAttr(drop_rate=0.5))
+net = fc_layer(input=net, size=4096, act=ReluActivation(),
+               layer_attr=ExtraAttr(drop_rate=0.5))
+out = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+
+if is_infer:
+    outputs(out)
+else:
+    lbl = data_layer(name="label", size=num_class)
+    loss = cross_entropy(name="loss", input=out, label=lbl)
+    outputs(loss)

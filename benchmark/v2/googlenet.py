@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""GoogleNet-v1 config in the legacy trainer_config_helpers DSL (ref
+config: benchmark/paddle/image/googlenet.py — same inception
+(1x1 / 3x3r+3x3 / 5x5r+5x5 / pool+proj -> concat) structure; BASELINE.md
+rows: 1149 ms/batch bs128 GPU-era, 250-270 images/sec CPU train)."""
+
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+
+height = get_config_arg("height", int, 224)
+width = get_config_arg("width", int, 224)
+num_class = get_config_arg("num_class", int, 1000)
+batch_size = get_config_arg("batch_size", int, 128)
+is_infer = get_config_arg("is_infer", bool, False)
+
+define_py_data_sources2(
+    "train.list" if not is_infer else None,
+    "test.list" if is_infer else None,
+    module="provider", obj="process", args={})
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size))
+
+
+def inception(name, input, channels, f1, f3r, f3, f5r, f5, proj):
+    cov1 = img_conv_layer(name=name + "_1", input=input, filter_size=1,
+                          num_channels=channels, num_filters=f1, stride=1,
+                          padding=0)
+    cov3r = img_conv_layer(name=name + "_3r", input=input, filter_size=1,
+                           num_channels=channels, num_filters=f3r,
+                           stride=1, padding=0)
+    cov3 = img_conv_layer(name=name + "_3", input=cov3r, filter_size=3,
+                          num_filters=f3, stride=1, padding=1)
+    cov5r = img_conv_layer(name=name + "_5r", input=input, filter_size=1,
+                           num_channels=channels, num_filters=f5r,
+                           stride=1, padding=0)
+    cov5 = img_conv_layer(name=name + "_5", input=cov5r, filter_size=5,
+                          num_filters=f5, stride=1, padding=2)
+    pool = img_pool_layer(name=name + "_max", input=input, pool_size=3,
+                          num_channels=channels, stride=1, padding=1)
+    covprj = img_conv_layer(name=name + "_proj", input=pool,
+                            filter_size=1, num_filters=proj, stride=1,
+                            padding=0)
+    return concat_layer(name=name, input=[cov1, cov3, cov5, covprj])
+
+
+img = data_layer("data", size=height * width * 3, height=height,
+                 width=width)
+conv1 = img_conv_layer(name="conv1", input=img, filter_size=7,
+                       num_channels=3, num_filters=64, stride=2, padding=3)
+pool1 = img_pool_layer(name="pool1", input=conv1, pool_size=3, stride=2)
+norm1 = img_cmrnorm_layer(input=pool1, size=5, scale=0.0001, power=0.75)
+conv2r = img_conv_layer(name="conv2r", input=norm1, filter_size=1,
+                        num_filters=64, stride=1, padding=0)
+conv2 = img_conv_layer(name="conv2", input=conv2r, filter_size=3,
+                       num_filters=192, stride=1, padding=1)
+norm2 = img_cmrnorm_layer(input=conv2, size=5, scale=0.0001, power=0.75)
+pool2 = img_pool_layer(name="pool2", input=norm2, pool_size=3, stride=2)
+
+ince3a = inception("ince3a", pool2, 192, 64, 96, 128, 16, 32, 32)
+ince3b = inception("ince3b", ince3a, 256, 128, 128, 192, 32, 96, 64)
+pool3 = img_pool_layer(name="pool3", input=ince3b, pool_size=3, stride=2)
+ince4a = inception("ince4a", pool3, 480, 192, 96, 208, 16, 48, 64)
+ince4b = inception("ince4b", ince4a, 512, 160, 112, 224, 24, 64, 64)
+ince4c = inception("ince4c", ince4b, 512, 128, 128, 256, 24, 64, 64)
+ince4d = inception("ince4d", ince4c, 512, 112, 144, 288, 32, 64, 64)
+ince4e = inception("ince4e", ince4d, 528, 256, 160, 320, 32, 128, 128)
+pool4 = img_pool_layer(name="pool4", input=ince4e, pool_size=3, stride=2)
+ince5a = inception("ince5a", pool4, 832, 256, 160, 320, 32, 128, 128)
+ince5b = inception("ince5b", ince5a, 832, 384, 192, 384, 48, 128, 128)
+
+# global average pool: size from the actual surviving spatial extent so
+# the same config serves 224px runs and small smoke geometries
+pool5 = img_pool_layer(name="pool5", input=ince5b,
+                       pool_size=int(ince5b.shape[2]), stride=1,
+                       pool_type=AvgPooling())
+drop = dropout_layer(input=pool5, dropout_rate=0.4)
+out = fc_layer(input=drop, size=num_class, act=SoftmaxActivation())
+
+if is_infer:
+    outputs(out)
+else:
+    lbl = data_layer(name="label", size=num_class)
+    loss = cross_entropy(name="loss", input=out, label=lbl)
+    outputs(loss)

@@ -29,7 +29,8 @@ __all__ = [
     "get_config_arg", "set_config_args", "settings", "outputs",
     "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
     "batch_norm_layer", "addto_layer", "img_conv_group", "dropout_layer",
-    "embedding_layer", "cross_entropy", "classification_cost",
+    "embedding_layer", "img_cmrnorm_layer", "concat_layer",
+    "cross_entropy", "classification_cost",
     "LinearActivation", "ReluActivation", "SoftmaxActivation",
     "TanhActivation", "SigmoidActivation", "MaxPooling", "AvgPooling",
     "MomentumOptimizer", "AdamOptimizer", "L2Regularization", "ExtraAttr",
@@ -228,8 +229,15 @@ def _to_nchw(input, num_channels):
     return layers.reshape(input, [-1, num_channels, h, w]), num_channels
 
 
+# the reference DSL wraps every layer in @wrap_act_default; configs rely
+# on these implicit activations (fc->tanh, conv/bn->relu, addto->linear)
+def _default_act(act, default):
+    return default if act is None else act
+
+
 def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, layer_attr=None):
+    act = _default_act(act, TanhActivation())
     out = layers.fc(input=input, size=int(size), act=_act_name(act),
                     name=name)
     if layer_attr is not None and getattr(layer_attr, "drop_rate", 0):
@@ -241,6 +249,7 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
                    num_channels=None, act=None, groups=1, stride=1,
                    padding=0, bias_attr=None, param_attr=None,
                    trans=False, layer_attr=None):
+    act = _default_act(act, ReluActivation())
     x, _ = _to_nchw(input, num_channels)
     return layers.conv2d(input=x, num_filters=int(num_filters),
                          filter_size=filter_size, stride=stride,
@@ -261,6 +270,7 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
 def batch_norm_layer(input, act=None, name=None, num_channels=None,
                      use_global_stats=None, moving_average_fraction=0.9,
                      layer_attr=None, **kwargs):
+    act = _default_act(act, ReluActivation())
     x, _ = _to_nchw(input, num_channels)
     return layers.batch_norm(input=x, act=_act_name(act),
                              is_test=bool(use_global_stats),
@@ -273,6 +283,25 @@ def addto_layer(input, act=None, name=None, bias_attr=None):
     out = input[0]
     for other in input[1:]:
         out = layers.elementwise_add(out, other)
+    a = _act_name(act)  # reference default: LinearActivation
+    if a:
+        out = getattr(layers, a)(out)
+    return out
+
+
+def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75, name=None,
+                      num_channels=None, layer_attr=None):
+    """Cross-map response normalization (ref layers.py:3199; AlexNet's
+    LRN).  The v2 ``scale`` is the per-window alpha of the fluid lrn op."""
+    x, _ = _to_nchw(input, num_channels)
+    return layers.lrn(x, n=int(size), k=1.0, alpha=scale, beta=power,
+                      name=name)
+
+
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
+    """Channel concat (ref layers.py:3527; default IdentityActivation)."""
+    out = layers.concat(list(input), axis=1)
     a = _act_name(act)
     if a:
         out = getattr(layers, a)(out)

@@ -77,7 +77,8 @@ def test_v2_sgd_event_loop_trains_mnist():
         np.testing.assert_allclose(parameters[parameters.names()[0]], w0)
 
 
-def _run_config(path, config_args, batches=6, batch=8):
+def _run_config(path, config_args, batches=6, batch=8,
+                data_name="image"):
     from paddle_tpu.trainer_config_helpers import (
         build_settings_optimizer, get_outputs, set_config_args)
 
@@ -103,7 +104,7 @@ def _run_config(path, config_args, batches=6, batch=8):
             y = rng.randint(0, n_cls, size=(batch, 1)).astype(np.int64)
             x = means[y[:, 0]] + rng.normal(
                 0, 0.3, size=(batch, 3 * h * h)).astype(np.float32)
-            (l,) = exe.run(main, feed={"image": x, "label": y},
+            (l,) = exe.run(main, feed={data_name: x, "label": y},
                            fetch_list=[loss])
             losses.append(float(np.asarray(l).reshape(-1)[0]))
         return losses
@@ -132,3 +133,25 @@ def test_v2_config_vgg_trains():
          "layer_num": 11}, batches=25, batch=16)
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_v2_config_alexnet_trains():
+    """The reference's v2-era AlexNet config shape (benchmark/paddle/image/
+    alexnet.py: conv11/4 + LRN chain), smoke geometry via config args."""
+    losses = _run_config(
+        os.path.join(REPO, "benchmark", "v2", "alexnet.py"),
+        {"height": 67, "width": 67, "num_class": 5, "batch_size": 2,
+         "layer_num": 1}, batches=25, batch=16, data_name="data")
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-6:]) < np.mean(losses[:6]), losses
+
+
+def test_v2_config_googlenet_trains():
+    """The reference's v2-era GoogleNet config (benchmark/paddle/image/
+    googlenet.py: nine inception blocks with concat), smoke geometry."""
+    losses = _run_config(
+        os.path.join(REPO, "benchmark", "v2", "googlenet.py"),
+        {"height": 64, "width": 64, "num_class": 5, "batch_size": 1},
+        batches=10, batch=8, data_name="data")
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
