@@ -65,6 +65,8 @@ BASELINES = {
     "mnist": 10000.0,       # images/sec, no published figure; nominal.
     "resnet_infer": 217.69,  # images/sec, ResNet-50 infer bs=16
                              # (IntelOptimizedPaddle.md:85-87)
+    "vgg": 28.46,            # images/sec, VGG-19 train bs=64, 2x Xeon 6148
+                             # (IntelOptimizedPaddle.md:33-35)
 }
 
 # Peak dense bf16 TFLOPs per chip by TPU generation, for MFU reporting.
@@ -305,6 +307,30 @@ def bench_transformer(fluid, platform, on_accel):
         amp=fluid.amp.compute_dtype() or "off")
 
 
+def bench_vgg(fluid, platform, on_accel):
+    """VGG-19 training (BENCH_MODEL=vgg; baseline: the reference's
+    published 28.46 images/sec at bs=64 on 2x Xeon 6148)."""
+    from paddle_tpu.models import vgg
+
+    batch = _env_int("vgg", "BS", 64 if on_accel else 4)
+    steps = _env_int("vgg", "STEPS", 10 if on_accel else 3)
+    image_hw = 224 if on_accel else 32
+    class_dim = 1000 if on_accel else 10
+    img, label, prediction, loss, acc = vgg.build(
+        class_dim=class_dim, image_shape=(3, image_hw, image_hw), lr=0.01,
+        depth=19)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(size=(batch, 3, image_hw, image_hw))
+            .astype(np.float32),
+            "label": rng.randint(0, class_dim,
+                                 size=(batch, 1)).astype(np.int64)}
+    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    ips = batch * steps / dt
+    return result_line(f"vgg19_{image_hw}px_bs{batch}_train_{platform}",
+                       ips, "images/sec/chip", "vgg",
+                       amp=fluid.amp.compute_dtype() or "off")
+
+
 def bench_mnist(fluid, platform, on_accel):
     from paddle_tpu.models import mnist
 
@@ -434,7 +460,7 @@ def bench_decode(fluid, platform, on_accel):
 
 BENCHES = {"resnet": bench_resnet, "transformer": bench_transformer,
            "mnist": bench_mnist, "resnet_infer": bench_resnet_infer,
-           "decode": bench_decode}
+           "decode": bench_decode, "vgg": bench_vgg}
 
 
 def _run_one(model, fluid, platform, on_accel):
