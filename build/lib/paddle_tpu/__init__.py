@@ -1,0 +1,29 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the PaddlePaddle
+Fluid API surface (reference: Operater9/Paddle @ Fluid 0.15).
+
+Compute path: programs built through ``paddle_tpu.fluid`` trace into XLA
+computations (jit/pjit); parallelism is SPMD over a ``jax.sharding.Mesh``
+with collectives over ICI.  See SURVEY.md for the layer-by-layer mapping.
+"""
+
+__version__ = "0.1.0"
+
+# Fluid's dtype contract is 64-bit-heavy (labels/ids are int64, VarDesc
+# promises int64/float64 kinds — ref framework.proto:104), and jax's default
+# 32-bit mode silently truncates int64 to int32 with a UserWarning per op.
+# Enable x64 so ops emit what their VarDesc promises.  NOTE: this is a
+# process-global jax config change, the same stance the reference takes with
+# its own global flag init at import (ref python/paddle/fluid/__init__.py:
+# 121-140 init_gflags) — other jax code in the process will see 64-bit
+# defaults for dtype-less constructors.  Inside this package, float ctors
+# pin their dtype explicitly (f32 stays f32); int ctors intentionally
+# produce int64, matching the VarDesc contract.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+
+batch = reader.batch

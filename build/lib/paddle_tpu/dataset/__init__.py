@@ -1,0 +1,15 @@
+"""Datasets (ref: python/paddle/dataset/ — mnist, cifar, uci_housing, ...).
+
+The reference auto-downloads into ~/.cache/paddle.  This environment has no
+network egress, so each dataset falls back to a deterministic synthetic
+generator with the real shapes/dtypes/cardinalities when the cached copy is
+absent — enough for the train-loop, checkpoint, and benchmark harnesses.
+"""
+
+from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
+               wmt16)
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
+           "wmt14", "wmt16", "flowers", "conll05", "sentiment", "voc2012", "mq2007",
+           "common"]

@@ -1,0 +1,16 @@
+"""Dataset cache helpers (ref: python/paddle/dataset/common.py)."""
+
+from __future__ import annotations
+
+import os
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def cached_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+    return path

@@ -1,0 +1,69 @@
+"""paddle_tpu.fluid — the Fluid-compatible user API, executing on XLA.
+
+ref: python/paddle/fluid/__init__.py.  ``fluid.TPUPlace()`` is the north-star
+addition (BASELINE.json): Executor(TPUPlace()) traces Programs into XLA
+computations on TPU HBM instead of dispatching CUDA kernels.
+"""
+
+# ops must register before any program executes
+from .. import ops as _ops  # noqa: F401
+
+from . import core
+from .core import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace
+from . import amp
+from . import framework
+from .framework import (Program, Operator, Parameter, Variable,
+                        default_main_program, default_startup_program,
+                        program_guard, name_scope)
+from . import executor
+from .executor import Executor, Scope, global_scope, scope_guard
+from . import backward
+from .backward import append_backward, calc_gradient
+from . import initializer
+from . import layers
+from . import nets
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import metrics
+from . import average
+from . import profiler
+from . import unique_name
+from . import io
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model, get_inference_program)
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .data_feeder import DataFeeder
+from . import parallel_executor
+from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
+from . import transpiler
+from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimize, release_memory
+
+from . import lod_tensor
+from .lod_tensor import (LoDTensor, create_lod_tensor,
+                         create_random_int_lodtensor)
+from . import trainer
+from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
+from . import evaluator
+from . import debugger
+from . import ir
+from . import contrib
+
+Tensor = framework.Variable
+
+__all__ = [
+    "io", "initializer", "layers", "nets", "optimizer", "backward", "amp",
+    "regularizer", "metrics", "clip", "profiler", "unique_name",
+    "Program", "Operator", "Parameter", "Variable",
+    "default_main_program", "default_startup_program", "program_guard",
+    "name_scope", "Executor", "Scope", "global_scope", "scope_guard",
+    "append_backward", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "TPUPlace",
+    "ParamAttr", "WeightNormParamAttr", "DataFeeder", "ParallelExecutor",
+    "ExecutionStrategy", "BuildStrategy", "DistributeTranspiler",
+    "InferenceTranspiler", "memory_optimize", "release_memory",
+    "LoDTensor", "create_lod_tensor", "create_random_int_lodtensor",
+    "Trainer", "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
+    "BeginStepEvent", "EndStepEvent",
+]

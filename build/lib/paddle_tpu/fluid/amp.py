@@ -1,0 +1,106 @@
+"""Mixed-precision compute mode: bf16 matmuls/convs with fp32 master weights.
+
+TPU-native equivalent of the reference's float16 transpiler
+(ref: paddle/contrib/float16/float16_transpiler.py, which rewrites a program
+so inference runs in fp16).  The reference rewrites the *program* because its
+kernels are dtype-monomorphic; here the op library itself is polymorphic, so
+mixed precision is an execution mode: when enabled, the matmul-class ops
+(mul/matmul/fc, conv2d/3d and friends) cast fp32 operands to the compute
+dtype and accumulate in fp32 via ``preferred_element_type``.
+
+This is exactly the TPU-idiomatic recipe: parameters, optimizer state,
+normalizations and reductions stay fp32 (master weights), while the
+MXU-bound contractions run in the low dtype.  The contraction itself
+executes entirely in that dtype (the MXU accumulates bf16 products in fp32
+*in hardware*; there is no explicit preferred_element_type — its vjp rules
+reject mixed cotangent/operand dtypes for convs).  Consequences:
+
+ - "bfloat16" (recommended, the default): same exponent range as fp32, no
+   loss scaling needed; hardware fp32 accumulation makes operand rounding
+   the only precision loss.
+ - "float16": the contraction accumulates in fp16 with fp16's narrow
+   exponent range and NO loss scaling — experimental, can overflow on
+   real models.  The reference's fp16 transpiler targets *inference*
+   (float16_benchmark.md) for the same reason.
+
+Enable programmatically::
+
+    import paddle_tpu.fluid as fluid
+    fluid.amp.enable("bfloat16")          # or fluid.amp.amp_guard(...)
+
+or via the environment: ``PADDLE_TPU_AMP=bfloat16``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_SUPPORTED = ("bfloat16", "float16")
+
+_state = {"dtype": None}
+
+
+def enable(dtype: str = "bfloat16") -> None:
+    if dtype not in _SUPPORTED:
+        raise ValueError(f"amp dtype must be one of {_SUPPORTED}, got {dtype!r}")
+    _state["dtype"] = dtype
+
+
+def disable() -> None:
+    _state["dtype"] = None
+
+
+def is_enabled() -> bool:
+    return _state["dtype"] is not None
+
+
+def compute_dtype():
+    """The active low-precision compute dtype name, or None."""
+    return _state["dtype"]
+
+
+@contextlib.contextmanager
+def amp_guard(dtype: str = "bfloat16"):
+    prev = _state["dtype"]
+    enable(dtype)
+    try:
+        yield
+    finally:
+        _state["dtype"] = prev
+
+
+def cast_operands(*arrays):
+    """Cast fp32 contraction operands to the AMP dtype.
+
+    Returns ``(arrays..., restore_dtype)``.  When AMP is off (or any operand
+    is not fp32) the operands pass through unchanged and restore_dtype is
+    None.  Otherwise the caller computes the contraction in the low dtype
+    and casts its result back with ``restore_astype`` — NOT via
+    ``preferred_element_type``, whose vjp rules reject mixed
+    cotangent/operand dtypes for convs.  On the MXU this costs nothing:
+    bf16 matmuls accumulate in fp32 internally; the explicit cast just
+    restores the fp32 activation contract for the rest of the graph.
+    """
+    import jax.numpy as jnp
+
+    d = _state["dtype"]
+    if d is None or any(a is None or a.dtype != jnp.float32 for a in arrays):
+        return (*arrays, None)
+    cd = jnp.bfloat16 if d == "bfloat16" else jnp.float16
+    return (*(a.astype(cd) for a in arrays), jnp.float32)
+
+
+def restore_astype(out, restore_dtype):
+    """Cast a contraction result back to the pre-AMP dtype (no-op when
+    cast_operands passed through)."""
+    return out if restore_dtype is None else out.astype(restore_dtype)
+
+
+# environment bridge (ref: python/paddle/fluid/__init__.py:121-140 reads
+# FLAGS from env at import time)
+_env = os.environ.get("PADDLE_TPU_AMP", "").strip().lower()
+if _env in ("bf16", "bfloat16", "1", "true"):
+    enable("bfloat16")
+elif _env in ("fp16", "float16"):
+    enable("float16")

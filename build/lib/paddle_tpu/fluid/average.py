@@ -1,0 +1,31 @@
+"""Weighted running average (ref: python/paddle/fluid/average.py —
+WeightedAverage used by train loops to smooth per-batch metrics)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight=1.0):
+        # elementwise accumulation, like the reference: arrays stay arrays
+        self.numerator = self.numerator + np.asarray(value,
+                                                     dtype=np.float64) \
+            * weight
+        self.denominator += weight
+
+    def eval(self):
+        if self.denominator == 0.0:
+            raise ValueError(
+                "WeightedAverage: there is no data to be averaged")
+        out = self.numerator / self.denominator
+        return float(out) if np.ndim(out) == 0 else out

@@ -1,0 +1,6 @@
+"""contrib utilities (ref: python/paddle/fluid/contrib/)."""
+
+from . import decoder, memory_usage_calc
+from .memory_usage_calc import memory_usage
+
+__all__ = ["decoder", "memory_usage_calc", "memory_usage"]

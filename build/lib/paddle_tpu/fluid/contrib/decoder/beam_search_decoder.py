@@ -1,0 +1,396 @@
+"""User-facing seq2seq decoder DSL: InitState / StateCell / TrainingDecoder /
+BeamSearchDecoder (ref: python/paddle/fluid/contrib/decoder/
+beam_search_decoder.py:43,159,384,523 — same public API).
+
+A StateCell describes an RNN cell abstractly: named step inputs, named
+hidden states with their initializers, and a user-supplied updater that maps
+(inputs, states) -> new states.  The SAME cell then drives two execution
+harnesses:
+
+ - TrainingDecoder: teacher-forced unrolling over a LoD step input, backed
+   by layers.DynamicRNN (states live in rnn memories, outputs become a
+   packed LoDTensor);
+ - BeamSearchDecoder: a While generation loop, where states live in tensor
+   arrays indexed by the step counter and each step expands hypotheses with
+   layers.beam_search, terminating early once every beam emits end_id.
+
+TPU note: the generation loop is data-dependent (live beam widths change
+shape), so the executor runs it as eager islands between jitted segments
+(fluid/executor.py) — correctness first; the batch/beam dims inside each
+step still compile.  The reference runs the same structure as host-side
+while/array ops around device kernels.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ... import layers, unique_name
+from ...framework import Variable
+from ...layer_helper import LayerHelper
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+_TRAINING, _BEAM = "training", "beam_search"
+
+
+def _loop_array(helper, init, zero_idx):
+    """Create a tensor array holding ``init`` at index 0, with BOTH the
+    create and the init write placed in the block ENCLOSING the current
+    (While-body) block: loop-carried arrays must exist before the first
+    iteration reads them."""
+    from ... import core
+
+    program = helper.main_program
+    parent_idx = program.current_block().parent_idx
+    block = program.block(parent_idx) if parent_idx >= 0 \
+        else program.current_block()
+    array = block.create_var(
+        name=unique_name.generate("beam_decoder_array"),
+        dtype=init.dtype, type=core.VarType.LOD_TENSOR_ARRAY)
+    if getattr(init, "shape", None) is not None:
+        array.shape = tuple(init.shape)
+    block.append_op(type="write_to_array",
+                    inputs={"X": [init], "I": [zero_idx]},
+                    outputs={"Out": [array]})
+    return array
+
+
+class InitState:
+    """Initial value of one hidden state (ref :43).  Either an explicit
+    ``init`` Variable, or a constant tensor shaped like ``init_boot``."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is not None:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        else:
+            raise ValueError(
+                "InitState needs `init` or `init_boot` to determine shape")
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class _RnnMemoryBacking:
+    """State storage inside a TrainingDecoder: a DynamicRNN memory."""
+
+    def __init__(self, rnn, init_state: InitState):
+        self._rnn = rnn
+        self._mem = rnn.memory(init=init_state.value,
+                               need_reorder=init_state.need_reorder)
+
+    def current(self):
+        return self._mem
+
+    def commit(self, new_value):
+        self._rnn.update_memory(self._mem, new_value)
+
+
+class _ArrayBacking:
+    """State storage inside a BeamSearchDecoder: a tensor array indexed by
+    the decoder's own step counter (written at counter+1 each step)."""
+
+    def __init__(self, decoder, init_state: InitState):
+        self._decoder = decoder
+        self._array = _loop_array(decoder._helper, init_state.value,
+                                  decoder._zero_idx)
+
+    def current(self):
+        return layers.array_read(array=self._array,
+                                 i=self._decoder._counter)
+
+    def commit(self, new_value):
+        self._decoder._deferred_writes.append((new_value, self._array))
+
+
+class StateCell:
+    """Abstract RNN cell: named inputs + named states + an updater
+    (ref :159).  ``out_state`` names the state whose value scores tokens."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._helper = LayerHelper("state_cell", name=name)
+        for v in states.values():
+            if not isinstance(v, InitState):
+                raise ValueError("every state must be an InitState")
+        if out_state not in states:
+            raise ValueError(f"out_state {out_state!r} not among states")
+        self._init_states = dict(states)
+        self._inputs = dict(inputs)
+        self._out_state = out_state
+        self._updater = None
+        self._decoder = None
+        self._backings = {}
+        self._cur = {}
+
+    # -- decoder attach/detach (TrainingDecoder/BeamSearchDecoder call these)
+    def _enter_decoder(self, decoder):
+        if self._decoder is not None:
+            raise ValueError("StateCell is already attached to a decoder")
+        self._decoder = decoder
+        self._backings = {}
+        self._cur = {}
+
+    def _leave_decoder(self, decoder):
+        if self._decoder is not decoder:
+            raise ValueError("StateCell attached to a different decoder")
+        self._decoder = None
+
+    def _materialize(self):
+        """Lazily create per-decoder state storage and read current values."""
+        if self._backings or self._decoder is None:
+            return
+        for name, init in self._init_states.items():
+            if self._decoder.type == _TRAINING:
+                b = _RnnMemoryBacking(self._decoder.dynamic_rnn, init)
+            else:
+                b = _ArrayBacking(self._decoder, init)
+            self._backings[name] = b
+            self._cur[name] = b.current()
+
+    # -- user surface
+    def get_state(self, state_name):
+        self._materialize()
+        if state_name not in self._cur:
+            raise ValueError(f"unknown state {state_name!r}")
+        return self._cur[state_name]
+
+    def get_input(self, input_name):
+        v = self._inputs.get(input_name)
+        if v is None:
+            raise ValueError(f"input {input_name!r} has not been provided")
+        return v
+
+    def set_state(self, state_name, state_value):
+        self._cur[state_name] = state_value
+
+    def state_updater(self, updater):
+        """Decorator registering fn(state_cell) that computes new states via
+        get_input/get_state + set_state."""
+        self._updater = updater
+        return updater
+
+    def compute_state(self, inputs):
+        self._materialize()
+        for name, value in inputs.items():
+            if name not in self._inputs:
+                raise ValueError(f"unknown input {name!r}")
+            self._inputs[name] = value
+        if self._updater is None:
+            raise ValueError("no state_updater registered")
+        self._updater(self)
+
+    def update_states(self):
+        for name, backing in self._backings.items():
+            backing.commit(self._cur[name])
+
+    def out_state(self):
+        return self._cur[self._out_state]
+
+
+class TrainingDecoder:
+    """Teacher-forced decoder over a LoD target sequence (ref :384);
+    a thin harness around layers.DynamicRNN driven by a StateCell."""
+
+    def __init__(self, state_cell, name=None):
+        self._helper = LayerHelper("training_decoder", name=name)
+        self._rnn = layers.DynamicRNN()
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._done = False
+
+    type = _TRAINING
+
+    @property
+    def dynamic_rnn(self):
+        return self._rnn
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.block():
+            yield
+        self._done = True
+        self._state_cell._leave_decoder(self)
+
+    def step_input(self, x):
+        return self._rnn.step_input(x)
+
+    def static_input(self, x):
+        return self._rnn.static_input(x)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def __call__(self, *args, **kwargs):
+        if not self._done:
+            raise ValueError("visit TrainingDecoder output after block()")
+        return self._rnn(*args, **kwargs)
+
+
+class BeamSearchDecoder:
+    """Generation-time beam search harness (ref :523).
+
+    ``decode()`` builds the canonical loop: read back last step's live
+    hypotheses, expand cell states to the live beam width
+    (sequence_expand over the scores' LoD), advance the cell one step,
+    project ``out_state`` to vocab scores, pick beam_size survivors with
+    layers.beam_search, and stop early when every beam has ended.  Override
+    decode() for a custom loop; __call__ backtracks the full hypotheses
+    with layers.beam_search_decode."""
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        self._helper = LayerHelper("beam_search_decoder", name=name)
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = topk_size
+        self._sparse_emb = sparse_emb
+        self._beam_size = beam_size
+        self._end_id = end_id
+
+        self._counter = layers.zeros(shape=[1], dtype="int64")
+        self._counter.stop_gradient = True
+        self._zero_idx = layers.fill_constant(shape=[1], dtype="int64",
+                                              value=0, force_cpu=True)
+        self._max_len = layers.fill_constant(shape=[1], dtype="int64",
+                                             value=max_len)
+        self._cond = layers.less_than(x=self._counter, y=self._max_len)
+        self._while = layers.While(self._cond)
+        self._deferred_writes = []
+        self._tracked = {}     # read-value name -> backing array
+        self._ids_array = None
+        self._scores_array = None
+        self._done = False
+        self._state_cell._enter_decoder(self)
+
+    type = _BEAM
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    @contextlib.contextmanager
+    def block(self):
+        """One While iteration; deferred array writes land at counter+1 so
+        the next iteration reads this step's survivors."""
+        with self._while.block():
+            yield
+            with layers.Switch() as switch:
+                with switch.case(self._cond):
+                    layers.increment(x=self._counter, value=1,
+                                     in_place=True)
+                    for value, array in self._deferred_writes:
+                        layers.array_write(x=value, i=self._counter,
+                                           array=array)
+                    layers.less_than(x=self._counter, y=self._max_len,
+                                     cond=self._cond)
+        self._done = True
+        self._state_cell._leave_decoder(self)
+
+    def early_stop(self):
+        layers.fill_constant(shape=[1], value=0, dtype="bool",
+                             force_cpu=True, out=self._cond)
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        """Array-backed loop variable: initialized before the loop, read at
+        the counter, rewritten via update_array each live step."""
+        if is_ids and is_scores:
+            raise ValueError("an array is either ids or scores, not both")
+        if not isinstance(init, Variable):
+            raise TypeError("read_array init must be a Variable")
+        array = _loop_array(self._helper, init, self._zero_idx)
+        if is_ids:
+            self._ids_array = array
+        elif is_scores:
+            self._scores_array = array
+        value = layers.array_read(array=array, i=self._counter)
+        self._tracked[value.name] = array
+        return value
+
+    def update_array(self, array, value):
+        backing = self._tracked.get(array.name)
+        if backing is None:
+            raise ValueError("update_array target was not read_array'd")
+        self._deferred_writes.append((value, backing))
+
+    def decode(self):
+        cell = self._state_cell
+        with self.block():
+            prev_ids = self.read_array(init=self._init_ids, is_ids=True)
+            prev_scores = self.read_array(init=self._init_scores,
+                                          is_scores=True)
+            prev_emb = layers.embedding(
+                prev_ids, size=[self._target_dict_dim, self._word_dim],
+                dtype="float32", is_sparse=self._sparse_emb)
+
+            feeds = {}
+            tracked_inputs = {}
+            for name, var in self._input_var_dict.items():
+                if name not in cell._inputs:
+                    raise ValueError(
+                        f"input_var_dict key {name!r} unknown to the cell")
+                stored = self.read_array(init=var)
+                tracked_inputs[name] = stored
+                feeds[name] = layers.sequence_expand(stored, prev_scores)
+            for name in cell._inputs:
+                if name not in feeds:
+                    feeds[name] = prev_emb
+            # live beam width changes step to step: stretch every state
+            # over the current hypotheses (parents repeat per child)
+            for sname in cell._init_states:
+                cell.set_state(
+                    sname,
+                    layers.sequence_expand(cell.get_state(sname),
+                                           prev_scores))
+
+            cell.compute_state(inputs=feeds)
+            out = layers.lod_reset(x=cell.out_state(), y=prev_scores)
+            scores = layers.fc(input=out, size=self._target_dict_dim,
+                               act="softmax")
+            topk_scores, topk_indices = layers.topk(scores,
+                                                    k=self._topk_size)
+            accu = layers.elementwise_add(
+                x=layers.log(topk_scores),
+                y=layers.reshape(prev_scores, shape=[-1]), axis=0)
+            sel_ids, sel_scores = layers.beam_search(
+                prev_ids, prev_scores, topk_indices, accu,
+                self._beam_size, end_id=self._end_id, level=0)
+
+            with layers.Switch() as switch:
+                with switch.case(layers.is_empty(sel_ids)):
+                    self.early_stop()
+                with switch.default():
+                    cell.update_states()
+                    self.update_array(prev_ids, sel_ids)
+                    self.update_array(prev_scores, sel_scores)
+                    for name, stored in tracked_inputs.items():
+                        self.update_array(stored, feeds[name])
+
+    def __call__(self):
+        if not self._done:
+            raise ValueError("run decode() (or block()) before calling")
+        return layers.beam_search_decode(ids=self._ids_array,
+                                         scores=self._scores_array,
+                                         beam_size=self._beam_size,
+                                         end_id=self._end_id)

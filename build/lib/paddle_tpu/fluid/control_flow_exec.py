@@ -1,0 +1,264 @@
+"""Trace-time execution of IR control-flow ops (while / conditional_block).
+
+ref: paddle/fluid/operators/while_op.cc:36 (grad :101),
+conditional_block_op.cc.  The reference interprets the sub-block per
+iteration in a kid scope.  Here the sub-block is *unrolled into the trace*:
+the loop condition must be concrete at trace time (a counter chain rooted in
+fill_constant / static lod — the DynamicRNN & StaticRNN pattern), each
+iteration's ops are traced into the same XLA program, and XLA schedules the
+unrolled graph.  Data-dependent conditions require eager mode (see
+executor.BlockPlan.needs_eager), where every value is concrete and the same
+unrolling works unchanged.
+
+while_grad is jax.vjp over a replay of the unrolled loop from the stashed
+pre-loop state — the trace-time analogue of the reference's reversed
+sub-block execution with saved step scopes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+WHILE_STASH = "@WHILE_STASH@"
+MAX_WHILE_ITERS = 100_000
+
+
+def _concrete_scalar(v, what):
+    if v is None:
+        raise RuntimeError(f"{what}: condition variable is undefined")
+    if isinstance(v, jax.core.Tracer):
+        raise NotImplementedError(
+            f"{what}: the loop/branch condition is a traced (data-dependent) "
+            f"value.  Supported conditions are counter/lod-derived and "
+            f"concrete at trace time; for data-dependent control flow run "
+            f"the program in eager mode (it contains no such ops, so the "
+            f"executor chose jit — restructure the condition or fetch "
+            f"through an eager op)")
+    return bool(np.asarray(v).reshape(-1)[0])
+
+
+def _snap(v):
+    """Snapshot a value for replay: TensorArrays are mutable, so clone."""
+    from ..ops.array_ops import TensorArray
+
+    if isinstance(v, TensorArray):
+        return v.clone()
+    return v
+
+
+def run_while(op, env: Dict[str, object], rng_box, run_op):
+    body = op.block.program.block(op.attr("sub_block"))
+    cond_name = op.inputs["Condition"][0]
+    # stash pre-loop values of X for while_grad's replay
+    stash = env.setdefault(WHILE_STASH, {})
+    stash[op.attr("sub_block")] = {
+        n: _snap(env.get(n)) for n in op.inputs.get("X", []) if n}
+    it = 0
+    while _concrete_scalar(env.get(cond_name), "while"):
+        for bop in body.ops:
+            run_op(bop, env, rng_box)
+        it += 1
+        if it > MAX_WHILE_ITERS:
+            raise RuntimeError("while: exceeded max iterations "
+                               f"({MAX_WHILE_ITERS}); non-terminating loop?")
+
+
+def _is_float_array(v):
+    return hasattr(v, "dtype") and jnp.issubdtype(jnp.asarray(v).dtype,
+                                                  jnp.inexact)
+
+
+def _is_float_tarray(v):
+    from ..ops.array_ops import TensorArray
+
+    return isinstance(v, TensorArray) and v.vals and \
+        all(_is_float_array(x) for x in v.vals)
+
+
+def _to_tree(v):
+    """Differentiable pytree view: TensorArray -> list of its arrays."""
+    from ..ops.array_ops import TensorArray
+
+    return list(v.vals) if isinstance(v, TensorArray) else v
+
+
+def _from_tree(orig, tree):
+    from ..ops.array_ops import TensorArray
+
+    if isinstance(orig, TensorArray):
+        return TensorArray(vals=list(tree), lods=list(orig.lods))
+    return tree
+
+
+def run_while_grad(op, env: Dict[str, object], rng_box, run_op):
+    """Replay the loop from stashed pre-loop state under jax.vjp.
+
+    Gradients flow through plain arrays AND TensorArray contents (a tensor
+    array's grad is a tensor array — matching ref while_grad semantics where
+    step-scope arrays get grad arrays)."""
+    sub_idx = op.attr("sub_block")
+    body = op.block.program.block(sub_idx)
+    pre = env.get(WHILE_STASH, {}).get(sub_idx)
+    if pre is None:
+        raise RuntimeError("while_grad: forward while was never executed")
+
+    from ..ops import registry as _reg
+    from ..ops.array_ops import TensorArray
+
+    for bop in body.ops:
+        d = _reg.REGISTRY.get(bop.type)
+        if d is not None and d.stateful:
+            raise NotImplementedError(
+                f"while_grad: stateful op '{bop.type}' inside the loop body "
+                f"cannot be replayed for gradients (rng would diverge); "
+                f"move it outside the loop")
+
+    x_names = [n for n in op.inputs.get("X", []) if n]
+    xg_names = op.outputs.get("X@GRAD", [])
+    want = {x: g for x, g in zip(x_names, xg_names) if g}
+    out_names = [n for n in op.inputs.get("Out", []) if n]
+    og_names = op.inputs.get("Out@GRAD", [])
+    out_grads = {}
+    for i, n in enumerate(op.inputs.get("Out", [])):
+        if n and i < len(og_names) and og_names[i]:
+            g = env.get(og_names[i])
+            if g is not None:
+                out_grads[n] = g
+
+    diff = {}
+    for n in want:
+        v = pre.get(n)
+        if _is_float_array(v) or _is_float_tarray(v):
+            diff[n] = _to_tree(v)
+    if not diff:
+        return
+    cond_name = op.inputs["Condition"][0]
+
+    def f(xtrees):
+        env2 = {k: _snap(v) for k, v in env.items() if k != WHILE_STASH}
+        env2.update({k: _snap(v) for k, v in pre.items()})  # rewind
+        for k, t in xtrees.items():
+            env2[k] = _from_tree(pre[k], t)
+        it = 0
+        while _concrete_scalar(env2.get(cond_name), "while_grad replay"):
+            for bop in body.ops:
+                run_op(bop, env2, None)
+            it += 1
+            if it > MAX_WHILE_ITERS:
+                raise RuntimeError("while_grad: runaway replay")
+        outs = {}
+        for n in out_names:
+            v = env2.get(n)
+            if n in out_grads and (_is_float_array(v) or
+                                   _is_float_tarray(v)):
+                outs[n] = _to_tree(v)
+        return outs
+
+    primals, vjp_fn = jax.vjp(f, diff)
+    cots = {}
+    for n, p in primals.items():
+        g = out_grads[n]
+        if isinstance(p, list):
+            gvals = list(g.vals) if isinstance(g, TensorArray) else []
+            cots[n] = [
+                jnp.asarray(gvals[i], p[i].dtype) if i < len(gvals)
+                and gvals[i] is not None else jnp.zeros_like(p[i])
+                for i in range(len(p))]
+        else:
+            cots[n] = jnp.asarray(g, p.dtype)
+    (grads,) = vjp_fn(cots)
+    for x, gname in want.items():
+        g = grads.get(x)
+        if g is None:
+            continue
+        g = _from_tree(pre[x], g) if isinstance(g, list) else g
+        prev = env.get(gname)
+        if prev is None or isinstance(g, TensorArray):
+            env[gname] = g
+        else:
+            env[gname] = prev + g
+
+
+def run_conditional_block(op, env: Dict[str, object], rng_box, run_op):
+    body = op.block.program.block(op.attr("sub_block"))
+    cond_vals = [env.get(n) for n in op.inputs.get("Cond", []) if n]
+    if bool(ctx_all(cond_vals, op)):
+        stash = env.setdefault(WHILE_STASH, {})
+        stash[op.attr("sub_block")] = {
+            n: env.get(n) for n in op.inputs.get("Input", []) if n}
+        stash[("taken", op.attr("sub_block"))] = True
+        for bop in body.ops:
+            run_op(bop, env, rng_box)
+    else:
+        env.setdefault(WHILE_STASH, {})[("taken", op.attr("sub_block"))] = \
+            False
+
+
+def ctx_all(cond_vals, op):
+    if not cond_vals:
+        raise RuntimeError("conditional_block: missing Cond input")
+    if bool(op.attr("is_scalar_condition", False)):
+        return _concrete_scalar(cond_vals[0], "conditional_block")
+    vals = []
+    for v in cond_vals:
+        if isinstance(v, jax.core.Tracer):
+            _concrete_scalar(v, "conditional_block")  # raises with guidance
+        vals.append(bool(np.asarray(v).all()))
+    return all(vals)
+
+
+def run_conditional_block_grad(op, env, rng_box, run_op):
+    sub_idx = op.attr("sub_block")
+    taken = env.get(WHILE_STASH, {}).get(("taken", sub_idx))
+    in_names = [n for n in op.inputs.get("Input", []) if n]
+    ig_names = op.outputs.get("Input@GRAD", [])
+    want = {x: g for x, g in zip(in_names, ig_names) if g}
+    if not taken:
+        for x, gname in want.items():
+            v = env.get(x)
+            if v is not None and _is_float_array(v):
+                env[gname] = jnp.zeros_like(jnp.asarray(v))
+        return
+    body = op.block.program.block(sub_idx)
+    pre = env.get(WHILE_STASH, {}).get(sub_idx, {})
+    out_names = [n for n in op.inputs.get("Out", []) if n]
+    og_names = op.inputs.get("Out@GRAD", [])
+    out_grads = {}
+    for i, n in enumerate(op.inputs.get("Out", [])):
+        if n and i < len(og_names) and og_names[i]:
+            g = env.get(og_names[i])
+            if g is not None:
+                out_grads[n] = g
+    diff = {n: pre[n] for n in want if n in pre and _is_float_array(pre[n])}
+    if not diff:
+        return
+
+    def f(xvals):
+        env2 = {k: v for k, v in env.items() if k != WHILE_STASH}
+        env2.update(pre)
+        env2.update(xvals)
+        for bop in body.ops:
+            run_op(bop, env2, None)
+        return {n: env2[n] for n in out_names
+                if n in out_grads and _is_float_array(env2.get(n))}
+
+    primals, vjp_fn = jax.vjp(f, diff)
+    cots = {n: jnp.asarray(out_grads[n], primals[n].dtype) for n in primals}
+    (grads,) = vjp_fn(cots)
+    for x, gname in want.items():
+        g = grads.get(x)
+        if g is not None:
+            env[gname] = g
+
+
+HANDLERS = {
+    "while": run_while,
+    "while_grad": run_while_grad,
+    "conditional_block": run_conditional_block,
+    "conditional_block_grad": run_conditional_block_grad,
+}

@@ -1,0 +1,104 @@
+"""DataFeeder: minibatch list -> feed dict (ref: python/paddle/fluid/
+data_feeder.py:83 — numpy conversion; LoD handling is host-side here)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import core
+from .framework import Variable, default_main_program
+
+__all__ = ["DataFeeder"]
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [d for d in shape]
+        self.dtype = core.np_dtype(dtype)
+        self.data = []
+        self.lod = [[0] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(lod[0][-1] + len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            shape = [-1 if d in (-1, None) else d for d in self.shape]
+            try:
+                arr = arr.reshape(shape)
+            except ValueError:
+                pass
+            return arr
+        from .lod_tensor import LoDTensor
+
+        flat = np.array(self.data, dtype=self.dtype)
+        if flat.ndim == 1:
+            flat = flat.reshape(
+                [-1] + [d for d in self.shape if d not in (-1, None)])
+        return LoDTensor(flat, self.lod)
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block()._var_recursive(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should contain Variables or names")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(self.place, lod_level, shape, dtype)
+            for lod_level, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes)
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), \
+                "sample width != number of feed variables"
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {name: conv.done()
+                for name, conv in zip(self.feed_names, converters)}
+
+    def feed_parallel(self, iterable, num_places=None):
+        # ParallelExecutor accepts a merged global batch; just concatenate.
+        from .lod_tensor import LoDTensor
+
+        batches = [self.feed(batch) for batch in iterable]
+        if len(batches) == 1:
+            return batches[0]
+        out = {}
+        for k in batches[0]:
+            vals = [b[k] for b in batches]
+            if isinstance(vals[0], LoDTensor):
+                data = np.concatenate([np.asarray(v) for v in vals], axis=0)
+                lens = [v.recursive_sequence_lengths() for v in vals]
+                merged = [sum((l[i] for l in lens), [])
+                          for i in range(len(lens[0]))]
+                t = LoDTensor(data)
+                t.set_recursive_sequence_lengths(merged)
+                out[k] = t
+            else:
+                out[k] = np.concatenate(vals, axis=0)
+        return out

@@ -1,0 +1,90 @@
+"""Program debugging / visualization (ref: python/paddle/fluid/debugger.py
+— repr_var :98, pprint_program_codes :105, pprint_block_codes :114, and
+graphviz.py's dot writer used by draw_block_graphviz).
+
+Renders a Program as pseudo-code (one line per op: outs = op(ins) {attrs})
+and emits GraphViz .dot for a block's op/var dataflow."""
+
+from __future__ import annotations
+
+from .framework import Program
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def _repr_var(var) -> str:
+    shape = "x".join(str(s) for s in (var.shape or ()))
+    return f"{var.name}[{var.dtype or '?'}:{shape}]"
+
+
+def _repr_op(op) -> str:
+    ins = ", ".join(f"{slot}={list(names)}"
+                    for slot, names in sorted(op.inputs.items()) if names)
+    outs = ", ".join(n for names in op.outputs.values() for n in names if n)
+    keep = {k: v for k, v in op.attrs.items()
+            if not k.startswith("__") and k != "op_role"}
+    attrs = ", ".join(f"{k}={v!r}" for k, v in sorted(keep.items())
+                      if not isinstance(v, (list, tuple)) or len(v) <= 6)
+    s = f"{outs or '()'} = {op.type}({ins})"
+    if attrs:
+        s += " {" + attrs + "}"
+    return s
+
+
+def pprint_block_codes(block, show_vars=False) -> str:
+    lines = [f"# block {block.idx} (parent {block.parent_idx})"]
+    if show_vars:
+        for name in sorted(block.vars):
+            lines.append("  var  " + _repr_var(block.vars[name]))
+    for op in block.ops:
+        lines.append("  " + _repr_op(op))
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program: Program, show_vars=False) -> str:
+    out = []
+    for block in program.blocks:
+        out.append(pprint_block_codes(block, show_vars))
+    text = "\n".join(out)
+    print(text)
+    return text
+
+
+def draw_block_graphviz(block, path="block.dot", highlights=None) -> str:
+    """Write a .dot graph: op nodes (boxes) wired through their in/out vars
+    (ellipses).  Render with `dot -Tpng block.dot` (ref: debugger.py
+    draw_block_graphviz + graphviz.py)."""
+    highlights = set(highlights or [])
+
+    def q(s):
+        return '"' + str(s).replace('"', '\\"') + '"'
+
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}"
+        color = "lightsalmon" if op.type in highlights else "lightblue"
+        lines.append(f"  {op_id} [label={q(op.type)} shape=box "
+                     f"style=filled fillcolor={color}];")
+        for names in op.inputs.values():
+            for n in names:
+                if not n:
+                    continue
+                if n not in seen_vars:
+                    seen_vars.add(n)
+                    lines.append(f"  {q(n)} [shape=ellipse];")
+                lines.append(f"  {q(n)} -> {op_id};")
+        for names in op.outputs.values():
+            for n in names:
+                if not n:
+                    continue
+                if n not in seen_vars:
+                    seen_vars.add(n)
+                    lines.append(f"  {q(n)} [shape=ellipse];")
+                lines.append(f"  {op_id} -> {q(n)};")
+    lines.append("}")
+    text = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(text)
+    return path

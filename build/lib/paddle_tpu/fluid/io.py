@@ -1,0 +1,172 @@
+"""Checkpoint / model save-load (ref: python/paddle/fluid/io.py:89-677).
+
+Serialization format: one file per variable inside ``dirname`` (same layout
+contract as the reference's save/load ops) with numpy's .npy encoding inside;
+``save_inference_model`` writes a pickled pruned Program as ``__model__``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .executor import Executor, global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _resolve_vars(main_program, predicate, vars):
+    main_program = main_program or default_main_program()
+    if vars is not None:
+        return [main_program.global_block()._var_recursive(v)
+                if isinstance(v, str) else v for v in vars]
+    return [v for v in main_program.list_vars() if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    predicate = predicate or is_persistable
+    var_list = _resolve_vars(main_program, predicate, vars)
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        blob = {}
+        for v in var_list:
+            val = scope.get(v.name)
+            if val is None:
+                continue
+            blob[v.name] = np.asarray(val)
+        with open(os.path.join(dirname, filename), "wb") as f:
+            np.savez(f, **blob)
+        return
+    write_var_files(dirname, snapshot_vars(scope, var_list))
+
+
+def snapshot_vars(scope, var_list) -> dict:
+    """Host-side {name: ndarray} snapshot of the vars present in scope
+    (one D2H sync; shared by the sync and async checkpoint writers)."""
+    snap = {}
+    for v in var_list:
+        val = scope.get(v.name)
+        if val is not None:
+            snap[v.name] = np.asarray(val)
+    return snap
+
+
+def write_var_files(dirname, snapshot: dict) -> None:
+    """One file per var, np.save format — the single place that encodes
+    the per-var on-disk layout (load_vars is its reader)."""
+    for name, arr in snapshot.items():
+        with open(os.path.join(dirname, name), "wb") as f:
+            np.save(f, arr, allow_pickle=False)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    predicate = predicate or is_persistable
+    var_list = _resolve_vars(main_program, predicate, vars)
+    scope = scope or global_scope()
+    if filename is not None:
+        with np.load(os.path.join(dirname, filename)) as data:
+            for v in var_list:
+                if v.name in data:
+                    scope.set(v.name, data[v.name])
+        return
+    for v in var_list:
+        path = os.path.join(dirname, v.name)
+        if not os.path.exists(path):
+            # matching the reference's load op, which faults on an absent
+            # file (load_op.cc "cannot open file"): silently skipping leaves
+            # random init in place — e.g. a program whose unique names
+            # drifted from the saved model would "load" nothing and predict
+            # noise with no error anywhere
+            raise IOError(
+                f"load_vars: no saved file for variable '{v.name}' in "
+                f"{dirname} (program/name mismatch with the checkpoint?)")
+        with open(path, "rb") as f:
+            scope.set(v.name, np.load(f, allow_pickle=False))
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename,
+              scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename,
+              scope=scope)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program._prune(target_vars)
+    return pruned.inference_optimize()
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    main_program = main_program or default_main_program()
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    inference_program = main_program.clone(for_test=True)
+    inference_program = inference_program._prune(target_vars)
+    payload = {
+        # versioned program blob (Program.serialize_to_string) so a future
+        # format bump is detectable at load time
+        "program_blob": inference_program.serialize_to_string(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [t.name for t in target_vars],
+    }
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        pickle.dump(payload, f)
+    # persistables, not just Parameters: batch-norm moving stats and other
+    # persistable state the pruned program reads must round-trip
+    # (ref: io.py:561 save_inference_model → save_persistables)
+    save_persistables(executor, dirname, inference_program, params_filename)
+    return [t.name for t in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        payload = pickle.load(f)
+    if "program_blob" in payload:
+        program = Program.parse_from_string(payload["program_blob"])
+    else:  # pre-versioned __model__ files
+        program = payload["program"]
+    load_persistables(executor, dirname, program, params_filename,
+                      scope=scope)
+    fetch_vars = [program.global_block()._var_recursive(n)
+                  for n in payload["fetch_names"]]
+    return program, payload["feed_names"], fetch_vars

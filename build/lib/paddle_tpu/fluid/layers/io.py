@@ -1,0 +1,520 @@
+"""IO layers: data declarations + reader pipeline (ref: python/paddle/
+fluid/layers/io.py — data :38, py_reader :474, open_recordio_file :345,
+double_buffer :891).
+
+TPU design: the reference's reader ops pull from a LoDTensorBlockingQueue
+inside the C++ executor loop.  Here the queue hand-off happens on the host
+*before* the jitted step (host infeed): the Executor sees a ``read`` op,
+pops a packed batch from the reader's native blocking queue
+(paddle_tpu/native), and injects it as the step's feed — the device-side
+program stays a pure static-shape XLA computation.  double_buffer is a
+queue-capacity hint (host->device overlap comes from jax's async dispatch).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import core, unique_name
+from ..framework import default_main_program
+
+__all__ = ["data", "py_reader", "read_file", "open_recordio_file",
+           "open_files", "random_data_generator", "Preprocessor",
+           "ParallelDo", "batch",
+           "shuffle", "double_buffer", "create_py_reader_by_data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().current_block()
+    return block.create_var(
+        name=name, shape=shape, dtype=core.convert_dtype(dtype),
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
+
+
+# ---------------------------------------------------------------------------
+# reader runtime state (host side)
+# ---------------------------------------------------------------------------
+
+_READERS: Dict[str, "ReaderState"] = {}
+
+
+def _reader_state(name: str) -> "ReaderState":
+    try:
+        return _READERS[name]
+    except KeyError:
+        raise RuntimeError(f"reader '{name}' has no runtime state — was it "
+                           f"created by py_reader/open_recordio_file?") \
+            from None
+
+
+class ReaderState:
+    """Host-side state of one reader var: a native blocking queue plus an
+    optional producer thread (ref: reader/create_py_reader_op.cc +
+    lod_tensor_blocking_queue.h, as a host-infeed design).
+
+    Sources yield *item lists* ([(np array, lod offsets), ...], one item
+    per slot); the producer thread applies the shuffle/batch decorators,
+    packs, and pushes.  Producer exceptions re-raise at next_batch (not
+    silently as EOF)."""
+
+    def __init__(self, name: str, capacity: int, shapes, dtypes, lod_levels,
+                 batch_size: Optional[int] = None):
+        from ...native import BlockingQueue
+
+        self.name = name
+        self.queue = BlockingQueue(capacity)
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.lod_levels = lod_levels
+        self.batch_size = batch_size
+        self.shuffle_buf = 0
+        self._producer = None
+        self._source = None          # callable -> iterable of item lists
+        self._started = False
+        self._error = None
+
+    # -- user surface (mirrors ref py_reader methods) --
+    def _minibatch_items(self, minibatch):
+        """list of sample tuples -> item list, via the DataFeeder
+        converters (one converter per slot, fed every sample)."""
+        from ..data_feeder import DataToLoDTensorConverter
+        from ..lod_tensor import LoDTensor
+
+        convs = []
+        for shape, dtype, lod_level in zip(self.shapes, self.dtypes,
+                                           self.lod_levels):
+            # full declared shape (incl. -1 batch dim): the converter
+            # reshapes the stacked samples to it
+            convs.append(DataToLoDTensorConverter(None, lod_level, shape,
+                                                  dtype))
+        for sample in minibatch:
+            for conv, slot in zip(convs, sample):
+                conv.feed(slot)
+        items = []
+        for conv in convs:
+            done = conv.done()
+            if isinstance(done, LoDTensor):
+                items.append((np.asarray(done), done.lod()))
+            else:
+                items.append((np.asarray(done), ()))
+        return items
+
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader: callable -> iterable of MINIBATCHES (lists of sample
+        tuples — i.e. the output of paddle.batch(...)), the reference
+        decorate_paddle_reader contract."""
+
+        def source():
+            for minibatch in reader():
+                yield self._minibatch_items(minibatch)
+
+        self._source = source
+
+    def decorate_sample_reader(self, reader, places=None):
+        """reader yields single sample tuples; combine with
+        layers.batch(reader_var, n) to form minibatches."""
+
+        def source():
+            for sample in reader():
+                yield self._minibatch_items([sample])
+
+        self._source = source
+
+    def decorate_tensor_provider(self, provider):
+        """provider: callable -> iterable of batches: lists of arrays,
+        LoDTensors, or (array, recursive_seq_lens) tuples."""
+
+        def source():
+            from ..lod_tensor import LoDTensor, _lengths_to_offsets
+
+            for batch in provider():
+                items = []
+                for v in batch:
+                    if isinstance(v, LoDTensor):
+                        items.append((np.asarray(v), v.lod()))
+                    elif isinstance(v, tuple) and len(v) == 2:
+                        arr, lens = v
+                        lod = tuple(tuple(_lengths_to_offsets(l))
+                                    for l in lens)
+                        items.append((np.asarray(arr), lod))
+                    else:
+                        items.append((np.asarray(v), ()))
+                yield items
+
+        self._source = source
+
+    def _decorated(self):
+        """Apply shuffle/batch decorators over the source's item lists."""
+        import random
+
+        merger = _BatchMerger(self.batch_size) if self.batch_size else None
+        buf = []
+
+        def emit(items):
+            if merger is None:
+                return items
+            return merger.add(items)
+
+        for items in self._source():
+            if self.shuffle_buf:
+                buf.append(items)
+                if len(buf) < self.shuffle_buf:
+                    continue
+                items = buf.pop(random.randrange(len(buf)))
+            out = emit(items)
+            if out is not None:
+                yield out
+        while buf:
+            out = emit(buf.pop(random.randrange(len(buf))))
+            if out is not None:
+                yield out
+        if merger is not None:
+            rest = merger.flush()
+            if rest is not None:
+                yield rest
+
+    def start(self):
+        if self._source is None:
+            raise RuntimeError("reader has no data source; call "
+                               "decorate_paddle_reader/tensor_provider")
+        if self._started:
+            return
+        self.queue.reopen()
+        self._started = True
+        self._error = None
+
+        def run():
+            from ...native.tensor_pack import pack_batch
+
+            try:
+                for items in self._decorated():
+                    if not self.queue.push(pack_batch(items)):
+                        return           # closed under us (reset)
+            except BaseException as e:   # surfaces at next_batch
+                self._error = e
+            finally:
+                self.queue.close()
+
+        self._producer = threading.Thread(target=run, daemon=True)
+        self._producer.start()
+
+    def reset(self):
+        self.queue.close()
+        if self._producer is not None:
+            self._producer.join(timeout=5)
+        self._producer = None
+        self._started = False
+
+    # -- executor surface --
+    def next_batch(self):
+        """list of (np array, lod offsets) — raises EOFException."""
+        from ...native.tensor_pack import unpack_batch
+
+        packed = self.queue.pop()
+        if packed is None:
+            self._started = False
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    f"reader {self.name}: producer thread failed") from err
+            raise core.EOFException(f"reader {self.name} exhausted")
+        return unpack_batch(packed)
+
+
+class _ReaderVar:
+    """The Variable facade with reader controls attached."""
+
+    def __new__(cls, var, state):
+        var._reader_state = state
+        var.start = state.start
+        var.reset = state.reset
+        var.decorate_paddle_reader = state.decorate_paddle_reader
+        var.decorate_tensor_provider = state.decorate_tensor_provider
+        return var
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """ref: layers/io.py:474 — returns a reader variable; feed it with
+    decorate_paddle_reader()/decorate_tensor_provider(), then start()."""
+    block = default_main_program().current_block()
+    name = name or unique_name.generate("py_reader")
+    shapes = [list(s) for s in shapes]
+    dtypes = [core.convert_dtype(d) for d in dtypes]
+    lod_levels = list(lod_levels or [0] * len(shapes))
+    reader = block.create_var(name=name, type=core.VarType.READER)
+    state = ReaderState(name, capacity, shapes, dtypes, lod_levels)
+    _READERS[name] = state
+    block.append_op(type="create_py_reader", inputs={},
+                    outputs={"Out": [reader]},
+                    attrs={"shape_concat": [d for s in shapes for d in s],
+                           "lod_levels": lod_levels,
+                           "capacity": capacity})
+    return _ReaderVar(reader, state)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    shapes = [list(v.shape) for v in feed_list]
+    dtypes = [v.dtype for v in feed_list]
+    lod_levels = [v.lod_level for v in feed_list]
+    return py_reader(capacity, shapes, dtypes, lod_levels, name,
+                     use_double_buffer)
+
+
+def read_file(reader):
+    """ref: layers/io.py read_file — materialize the reader's outputs as
+    data variables fed by the executor's host-infeed pop."""
+    state = _reader_state(reader.name)
+    block = default_main_program().current_block()
+    outs = []
+    for i, (shape, dtype, lod_level) in enumerate(
+            zip(state.shapes, state.dtypes, state.lod_levels)):
+        v = block.create_var(name=f"{reader.name}__out_{i}", shape=shape,
+                             dtype=dtype, lod_level=lod_level,
+                             stop_gradient=True, is_data=True)
+        outs.append(v)
+    block.append_op(type="read", inputs={"Reader": [reader]},
+                    outputs={"Out": [v.name for v in outs]})
+    return outs[0] if len(outs) == 1 else outs
+
+
+def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
+                       pass_num=1, for_parallel=False):
+    """ref: layers/io.py:345 — a reader over a recordio dataset file
+    written by fluid.recordio_writer (each record = one packed sample)."""
+    rd = py_reader(capacity=64, shapes=shapes, dtypes=dtypes,
+                   lod_levels=lod_levels)
+    state = rd._reader_state
+
+    def source():
+        from ...native import RecordIOScanner
+        from ...native.tensor_pack import unpack_batch
+
+        for _ in range(pass_num):
+            with RecordIOScanner(filename) as sc:
+                for rec in sc:
+                    yield list(unpack_batch(rec))
+
+    state._source = source
+    return rd
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None,
+               thread_num=2, buffer_size=256, pass_num=1):
+    """ref: layers/io.py open_files — one reader over MANY recordio shards.
+    Backed by the native multi-threaded prefetcher (native/prefetch.cc),
+    so file IO/decompression runs in C++ worker threads like the
+    reference's open_files + multi-thread reader stack."""
+    rd = py_reader(capacity=buffer_size, shapes=shapes, dtypes=dtypes,
+                   lod_levels=lod_levels)
+    state = rd._reader_state
+
+    def source():
+        from ...native import PrefetchReader
+        from ...native.tensor_pack import unpack_batch
+
+        for _ in range(pass_num):
+            for rec in PrefetchReader(list(filenames),
+                                      n_threads=thread_num,
+                                      capacity=buffer_size):
+                yield list(unpack_batch(rec))
+
+    state._source = source
+    return rd
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=False):
+    """ref: reader/create_random_data_generator_op.cc — a reader yielding
+    uniform-random float batches forever (fixtures/benchmarks)."""
+    dtypes = ["float32"] * len(shapes)
+    rd = py_reader(capacity=16, shapes=shapes, dtypes=dtypes,
+                   lod_levels=lod_levels)
+    state = rd._reader_state
+
+    def source():
+        rng = np.random.RandomState(0)
+        while True:
+            yield [(rng.uniform(low, high, size=[max(1, d if d not in
+                    (-1, None) else 1) for d in shape])
+                    .astype(np.float32), None)
+                   for shape in shapes]
+
+    state._source = source
+    return rd
+
+
+class _BatchMerger:
+    """Merge per-sample records into batches (concat dim 0 + lod merge)."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self.samples: List = []
+
+    def add(self, items):
+        self.samples.append(items)
+        if len(self.samples) >= self.batch_size:
+            return self.flush()
+        return None
+
+    def flush(self):
+        if not self.samples:
+            return None
+        n_slots = len(self.samples[0])
+        merged = []
+        for i in range(n_slots):
+            arrs = [s[i][0] for s in self.samples]
+            lods = [s[i][1] for s in self.samples]
+            data = np.concatenate(arrs, axis=0)
+            if lods[0]:
+                levels = []
+                for lv in range(len(lods[0])):
+                    off = [0]
+                    for l in lods:
+                        base = off[-1]
+                        off.extend(base + int(x) for x in l[lv][1:])
+                    levels.append(tuple(off))
+                merged.append((data, tuple(levels)))
+            else:
+                merged.append((data, ()))
+        self.samples = []
+        return merged
+
+
+def batch(reader, batch_size):
+    """ref: layers/io.py batch — group per-sample records into batches."""
+    _reader_state(reader.name).batch_size = batch_size
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """ref: layers/io.py shuffle — bounded-buffer shuffling."""
+    _reader_state(reader.name).shuffle_buf = buffer_size
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    """ref: layers/io.py:891 — on TPU, host->device overlap comes from
+    jax's async dispatch; keep as a capacity hint."""
+    return reader
+
+class Preprocessor:
+    """In-pipeline batch transform (ref: layers/io.py Preprocessor — a
+    sub-program applied to every batch a reader produces).  The user
+    defines the transform as IR inside the ``block()`` context; each
+    batch then runs through that (jit-cached) sub-program before
+    reaching the training program's `read` op.
+
+    Example::
+
+        pre = fluid.layers.Preprocessor(reader)
+        with pre.block():
+            img, lbl = pre.inputs()
+            img = fluid.layers.scale(img, scale=1.0 / 255.0)
+            pre.outputs(img, lbl)
+        x, y = fluid.layers.read_file(pre())
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._state = reader._reader_state
+        self._prog = None
+        self._in_vars = None
+        self._out_vars = None
+
+    def block(self):
+        import contextlib
+
+        from ..framework import Program, program_guard
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._prog = Program()
+            self._startup = Program()
+            with program_guard(self._prog, self._startup):
+                yield self
+            if self._out_vars is None:
+                raise ValueError(
+                    "Preprocessor.block() ended without outputs(...)")
+            # the transform may change arity/shape/dtype: the reader's
+            # metadata must describe the TRANSFORMED batches, because
+            # read_file declares its output vars from it
+            self._state.shapes = [list(v.shape) if v.shape else [-1]
+                                  for v in self._out_vars]
+            self._state.dtypes = [str(v.dtype) for v in self._out_vars]
+            self._state.lod_levels = (
+                list(self._state.lod_levels[:len(self._out_vars)])
+                + [0] * max(0, len(self._out_vars)
+                            - len(self._state.lod_levels)))
+
+        return _ctx()
+
+    def inputs(self):
+        from ..framework import default_main_program
+
+        shapes = self._state.shapes
+        dtypes = self._state.dtypes
+        block = default_main_program().current_block()
+        self._in_vars = []
+        for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+            v = block.create_var(
+                name=unique_name.generate("preprocessor_in"),
+                shape=tuple(shape), dtype=dtype, is_data=True)
+            self._in_vars.append(v)
+        return self._in_vars
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def __call__(self):
+        from ..executor import Executor
+        from .. import core as _core
+
+        if self._out_vars is None:
+            raise ValueError(
+                "Preprocessor: define the transform inside `with "
+                "pre.block():` before calling pre()")
+        if getattr(self, "_applied", False):
+            return self._reader  # idempotent: never double-transform
+        self._applied = True
+        exe = Executor(_core.CPUPlace())
+        exe.run(self._startup)
+        prog = self._prog
+        in_names = [v.name for v in self._in_vars]
+        out_names = [v.name for v in self._out_vars]
+        inner_next = self._state.next_batch
+
+        def transformed_next():
+            from ..lod_tensor import LoDTensor
+
+            batch = inner_next()  # [(arr, lod), ...]
+            feed = {n: (LoDTensor(a, lod) if lod else a)
+                    for n, (a, lod) in zip(in_names, batch)}
+            outs = exe.run(prog, feed=feed, fetch_list=out_names,
+                           return_numpy=False)
+            # fetches are LoDTensors: lods survive pass-through slots
+            return [(np.asarray(o), tuple(o.lod()) or None) for o in outs]
+
+        self._state.next_batch = transformed_next
+        return self._reader
+
+
+class ParallelDo:
+    """The reference's deprecated in-graph data parallelism
+    (parallel_do_op.cc).  Redesigned away: use ParallelExecutor (GSPMD
+    over the device mesh) — the same capability without per-place op
+    replication (docs/OP_PARITY.md)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "ParallelDo was replaced by ParallelExecutor (GSPMD batch "
+            "sharding over the mesh); see docs/OP_PARITY.md")
+

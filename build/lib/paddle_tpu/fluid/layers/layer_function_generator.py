@@ -1,0 +1,79 @@
+"""Layer-function generation utilities (ref:
+python/paddle/fluid/layers/layer_function_generator.py — the reference
+generates Python layer wrappers from C++ OpProtos; here the source of
+truth is the op registry, so generate_layer_fn builds a wrapper from a
+registered op's name)."""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["generate_layer_fn", "autodoc", "templatedoc", "deprecated"]
+
+
+def generate_layer_fn(op_type: str, input_slot: str = "X",
+                      output_slot: str = "Out"):
+    """Build a simple single-in/single-out layer for a registered op
+    (ref :129 generate_layer_fn from OpProto)."""
+    from ...ops.registry import is_registered
+
+    if not is_registered(op_type):
+        raise ValueError(f"op {op_type!r} is not registered")
+
+    from .ops import _UNARY_ATTR_OPS, _UNARY_OPS
+
+    shape_preserving = op_type in _UNARY_OPS or op_type in _UNARY_ATTR_OPS
+
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        if shape_preserving:
+            # only elementwise ops provably keep the input shape; other
+            # ops leave the static shape unset rather than recording a
+            # wrong one
+            out.shape = tuple(x.shape)
+        helper.append_op(type=op_type, inputs={input_slot: [x]},
+                         outputs={output_slot: [out]}, attrs=attrs)
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = f"Auto-generated wrapper for the `{op_type}` op."
+    return layer
+
+
+def autodoc(comment=""):
+    """ref :221 — attach generated doc; the registry op docstring is the
+    source here."""
+    def deco(func):
+        func.__doc__ = (comment + "\n" + (func.__doc__ or "")).strip()
+        return func
+    return deco
+
+
+def templatedoc(op_type=None):
+    """ref :247 — template docstring fill; no proto templates exist in
+    this build, so the decorator is identity with the op name recorded."""
+    def deco(func):
+        if op_type and func.__doc__:
+            func.__doc__ = func.__doc__.replace("${comment}", op_type)
+        return func
+    return deco
+
+
+def deprecated(since="", instead=""):
+    """Mark a layer deprecated; warns once per call site (ref
+    annotations.deprecated)."""
+    def deco(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{func.__name__} is deprecated"
+                + (f" since {since}" if since else "")
+                + (f"; use {instead} instead" if instead else ""),
+                DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return deco

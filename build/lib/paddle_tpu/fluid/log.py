@@ -1,0 +1,42 @@
+"""glog-style leveled logging (ref: the reference uses glog VLOG(n)
+throughout C++, controlled by GLOG_v / GLOG_logtostderr env vars —
+e.g. test_dist_base.py:237 sets them for dist-test subprocesses).
+
+VLOG(n, ...) prints when n <= GLOG_v (default 0 → silent for n >= 1).
+Messages go to stderr (glog's default for GLOG_logtostderr=1, which the
+reference's Python tooling always sets) with a glog-shaped prefix."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def _verbosity() -> int:
+    try:
+        return int(os.environ.get("GLOG_v", "0"))
+    except ValueError:
+        return 0
+
+
+def vlog_is_on(level: int) -> bool:
+    return level <= _verbosity()
+
+
+def _emit(*msg) -> None:
+    t = time.time()
+    stamp = time.strftime("%m%d %H:%M:%S", time.localtime(t))
+    frac = int((t % 1) * 1e6)
+    print(f"I{stamp}.{frac:06d} {os.getpid()} paddle_tpu]",
+          *msg, file=sys.stderr)
+
+
+def VLOG(level: int, *msg) -> None:
+    if vlog_is_on(level):
+        _emit(*msg)
+
+
+def LOG(*msg) -> None:
+    """Unconditional info log (glog LOG(INFO))."""
+    _emit(*msg)

@@ -1,0 +1,123 @@
+"""Profiler: host event aggregation + jax trace (ref:
+python/paddle/fluid/profiler.py:39-221 and platform/profiler.cc — the
+reference aggregates push/pop host events into sorted tables and captures
+device activity via CUPTI; here host events come from the executor's
+block/segment/op timers and device activity from ``jax.profiler``, whose
+traces open in TensorBoard/perfetto/XProf).
+
+``stop_profiler`` prints the reference-style aggregate table (calls, total,
+min, max, ave) and writes a JSON event log that ``tools/timeline.py``
+converts to a chrome://tracing file (ref: tools/timeline.py:36,115).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+import time
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler", "record_event", "is_profiling"]
+
+_trace_dir = None
+_on = False
+_agg = {}        # name -> [calls, total, min, max]
+_timeline = []   # {"name", "ts", "dur"} microseconds since start
+_t0 = 0.0
+
+
+def is_profiling() -> bool:
+    return _on
+
+
+def record_event(name: str, seconds: float, start: float = None) -> None:
+    """Aggregate one timed host event (executor hooks call this)."""
+    if not _on:
+        return
+    e = _agg.get(name)
+    if e is None:
+        _agg[name] = [1, seconds, seconds, seconds]
+    else:
+        e[0] += 1
+        e[1] += seconds
+        e[2] = min(e[2], seconds)
+        e[3] = max(e[3], seconds)
+    ts = ((start if start is not None else time.perf_counter() - seconds)
+          - _t0) * 1e6
+    _timeline.append({"name": name, "ts": ts, "dur": seconds * 1e6})
+
+
+@contextlib.contextmanager
+def _event(name):
+    t = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_event(name, time.perf_counter() - t, start=t)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # no CUDA on this stack; kept as a no-op shim for API parity
+    yield
+
+
+def reset_profiler():
+    _agg.clear()
+    _timeline.clear()
+
+
+def start_profiler(state="All", trace_dir=None):
+    global _trace_dir, _on, _t0
+    import jax
+
+    reset_profiler()
+    _t0 = time.perf_counter()
+    _on = True
+    _trace_dir = trace_dir or os.path.join(tempfile.gettempdir(),
+                                           "paddle_tpu_profile")
+    try:
+        jax.profiler.start_trace(_trace_dir)
+    except RuntimeError:
+        pass  # a trace may already be active
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """Stop tracing, print the aggregate table, write the event log.
+
+    sorted_key in {None, 'calls', 'total', 'max', 'min', 'ave'} mirrors the
+    reference's EnableProfiler table ordering (platform/profiler.h:116)."""
+    global _on
+    import jax
+
+    _on = False
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError:
+        pass
+
+    rows = [(n, c, tot, mn, mx, tot / c)
+            for n, (c, tot, mn, mx) in _agg.items()]
+    key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}
+    rows.sort(key=lambda r: -r[key_idx.get(sorted_key, 2)])
+    if rows:
+        print(f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} "
+              f"{'Min(ms)':>10} {'Max(ms)':>10} {'Ave(ms)':>10}")
+        for n, c, tot, mn, mx, ave in rows:
+            print(f"{n[:40]:<40} {c:>8} {tot * 1e3:>12.3f} "
+                  f"{mn * 1e3:>10.3f} {mx * 1e3:>10.3f} {ave * 1e3:>10.3f}")
+    if profile_path:
+        with open(profile_path, "w") as f:
+            json.dump({"events": _timeline, "trace_dir": _trace_dir}, f)
+    return _trace_dir
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,90 @@
+"""SelectedRows: the sparse-gradient representation (ref:
+paddle/fluid/framework/selected_rows.h:32 — a row-index list plus a value
+tensor of just those rows, produced by lookup_table's backward when
+``is_sparse=True`` and consumed row-wise by sgd/adam and the pserver path).
+
+TPU-native redesign: the reference's rows vector is dynamically sized (one
+entry per *unique* id); XLA needs static shapes, so here SelectedRows keeps
+one (row, value) pair per *occurrence* — shape [N] ids and [N, D] values for
+a batch that looked up N ids.  Duplicates are legal (selected_rows.h allows
+them too: "rows can be duplicated"); every consumer folds them with a
+scatter-add, which is exactly a segment-sum on the MXU-adjacent VPU and
+needs no host-side unique().  The structure is a jax pytree, so it flows
+through jit/grad/GSPMD like any tensor pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int array [N] (duplicates allowed); values: [N, ...] per-row
+    payload; height: the dense dim-0 extent (vocab size) — static."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height=None):
+        self.rows = rows
+        self.values = values
+        self.height = int(height) if height is not None else None
+
+    # -- pytree protocol --
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    # -- consumers --
+    def to_dense(self, height=None):
+        """Fold into a dense [height, ...] tensor (scatter-add merges
+        duplicate rows — ref: math/selected_rows_functor.cc MergeAdd)."""
+        h = height if height is not None else self.height
+        if h is None:
+            raise ValueError("SelectedRows.to_dense needs a height")
+        dense = jnp.zeros((h,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def scatter_sub_into(self, dense, scale=1.0):
+        """dense - scale * this, applied only at the touched rows — the
+        sparse optimizer update (ref: sgd_op.h SelectedRows branch)."""
+        return dense.at[self.rows].add(-scale * self.values)
+
+    def merge_with(self, other: "SelectedRows") -> "SelectedRows":
+        """Sum of two sparse grads = concatenation (consumers scatter-add,
+        so duplicate rows fold automatically; ref: sum over SelectedRows,
+        math/selected_rows_functor.h Add)."""
+        if not isinstance(other, SelectedRows):
+            raise TypeError("can only merge SelectedRows with SelectedRows")
+        return SelectedRows(
+            jnp.concatenate([self.rows, other.rows], 0),
+            jnp.concatenate([self.values, other.values], 0),
+            self.height if self.height is not None else other.height)
+
+    @property
+    def shape(self):
+        # advertise the dense shape so shape-probing heuristics stay sane
+        if self.height is None:
+            return tuple(self.values.shape)
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):  # pragma: no cover - cosmetic
+        return (f"SelectedRows(rows={tuple(self.rows.shape)}, "
+                f"values={tuple(self.values.shape)}, height={self.height})")
+
+
+def is_selected_rows(v) -> bool:
+    return isinstance(v, SelectedRows)

@@ -1,0 +1,400 @@
+"""High-level Trainer with event callbacks and checkpoint/resume.
+
+ref: python/paddle/fluid/trainer.py — ``Trainer`` (:169) builds the programs
+from a ``train_func``, runs an event-driven epoch/step loop (:379), and with
+a ``CheckpointConfig`` (:100) periodically saves serial-numbered checkpoint
+directories with a ``_SUCCESS`` marker (:663, :1212), restores the newest
+complete one on init (:763), keeps at most N via scroll-delete (:1190), and
+persists trainer args (epoch/step) so resume continues mid-epoch (:1060).
+
+This is also the TPU build's preemption-safety story (SURVEY.md §5.3): a
+preempted worker restarts, finds the newest ``_SUCCESS``-marked serial dir,
+and resumes the identical trajectory.  For multihost SPMD runs each process
+saves only its addressable shards (see parallel.multihost.save_sharded /
+load_sharded) under the same serial-dir protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import os
+import shutil
+
+import numpy as np
+
+from . import core, io
+from .data_feeder import DataFeeder
+from .executor import Executor, Scope, global_scope
+from .framework import Program, program_guard
+
+__all__ = [
+    "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
+    "CheckpointConfig", "Trainer",
+]
+
+
+# ---------------------------------------------------------------------------
+# Events (ref: trainer.py:46-97)
+# ---------------------------------------------------------------------------
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        #: set False in the handler to skip this step's fetch
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+# ---------------------------------------------------------------------------
+# CheckpointConfig (ref: trainer.py:100)
+# ---------------------------------------------------------------------------
+
+CKPT_PREFIX = "checkpoint"
+SUCCESS_MARK = "_SUCCESS"
+TRAINER_ARGS_FILE = "trainer_args.json"
+
+
+class CheckpointConfig:
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10, async_save=False):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "checkpoint")
+        self.max_num_checkpoints = int(max_num_checkpoints)
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+        # async_save: snapshot device state synchronously (cheap D2H),
+        # write files in a background thread so the train loop never
+        # blocks on checkpoint IO — the orbax-style async checkpoint,
+        # and the TPU answer to the reference pserver's background
+        # checkpoint thread (ref go/pserver/service.go:346)
+        self.async_save = bool(async_save)
+        # filled on restore
+        self.epoch_id = 0
+        self.step_id = 0
+
+
+def _serial_dirs(root):
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if name.startswith(CKPT_PREFIX + "_"):
+            try:
+                out.append((int(name.rsplit("_", 1)[1]), name))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def _latest_complete_serial(root):
+    """Newest serial whose _SUCCESS marker exists (a kill mid-save leaves an
+    incomplete dir that must be ignored — ref trainer.py:763 checks the
+    success file before trusting a checkpoint)."""
+    for serial, name in reversed(_serial_dirs(root)):
+        if os.path.exists(os.path.join(root, name, SUCCESS_MARK)):
+            return serial
+    return -1
+
+
+_ckpt_lock = threading.Lock()
+_ckpt_state = {}  # ckpt root -> {"threads": [...], "errors": [...]}
+_ckpt_reserved = {}  # checkpoint_dir -> highest serial handed out
+
+
+def _state_for(root):
+    return _ckpt_state.setdefault(root, {"threads": [], "errors": []})
+
+
+def wait_for_checkpoints(checkpoint_dir=None):
+    """Barrier for async saves (call before process exit / evaluation that
+    reads checkpoint files).  Re-raises the first background write error —
+    a failed checkpoint must not pass silently (the sync path raises).
+    State is scoped per checkpoint dir, so two Trainers in one process
+    never join or misattribute each other's writers; no dir = all dirs."""
+    roots = ([os.path.abspath(checkpoint_dir)] if checkpoint_dir
+             else None)
+    with _ckpt_lock:
+        if roots is None:
+            roots = list(_ckpt_state)
+        pending = [t for r in roots for t in
+                   _ckpt_state.get(r, {}).get("threads", [])]
+    for t in pending:
+        t.join()
+    with _ckpt_lock:
+        for r in roots:
+            st = _ckpt_state.get(r)
+            if st is None:
+                continue
+            st["threads"][:] = [t for t in st["threads"] if t.is_alive()]
+            if st["errors"]:
+                exc = st["errors"][0]
+                st["errors"].clear()
+                raise IOError(
+                    f"async checkpoint write failed ({r}): "
+                    f"{exc!r}") from exc
+
+
+def save_checkpoint(executor, checkpoint_dir, main_program,
+                    trainer_args=None, max_num_checkpoints=3,
+                    background=False):
+    """Write serial dir -> persistables -> trainer args -> _SUCCESS, then
+    scroll-delete old serials (ref: trainer.py:663,1190).
+
+    background=True snapshots the persistables to host memory NOW (one
+    D2H sync) and does the file IO in a daemon thread; _SUCCESS is still
+    written last, so a crash mid-write leaves an ignorable incomplete
+    dir.  wait_for_checkpoints() joins outstanding writers and re-raises
+    their errors."""
+    root = os.path.abspath(checkpoint_dir)
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    with _ckpt_lock:
+        # an in-flight async serial has no _SUCCESS yet, so
+        # _latest_complete_serial cannot see it; the serial is reserved ON
+        # DISK (exclusive mkdir, atomic at the filesystem level) so two
+        # processes — or a restarted run racing an orphaned async writer —
+        # can never pick the same directory.  The in-process map remains as
+        # a fast-path floor.
+        serial = max(_latest_complete_serial(checkpoint_dir),
+                     _ckpt_reserved.get(root, -1)) + 1
+        while True:
+            cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
+            try:
+                os.makedirs(cur, exist_ok=False)
+                break
+            except FileExistsError:
+                serial += 1
+        _ckpt_reserved[root] = serial
+    if not background:
+        io.save_persistables(executor, cur, main_program)
+        _finish_checkpoint(checkpoint_dir, cur, trainer_args,
+                           max_num_checkpoints)
+        return serial
+    from .executor import global_scope
+    from .io import _resolve_vars, is_persistable, snapshot_vars
+
+    snapshot = snapshot_vars(
+        global_scope(), _resolve_vars(main_program, is_persistable, None))
+
+    def write():
+        try:
+            io.write_var_files(cur, snapshot)
+            _finish_checkpoint(checkpoint_dir, cur, trainer_args,
+                               max_num_checkpoints)
+        except BaseException as exc:  # surfaced by wait_for_checkpoints
+            # a half-written serial is junk forever (it never gets
+            # _SUCCESS and the pruner skips incomplete dirs) — remove it
+            shutil.rmtree(cur, ignore_errors=True)
+            with _ckpt_lock:
+                _state_for(root)["errors"].append(exc)
+
+    t = threading.Thread(target=write, daemon=True)
+    with _ckpt_lock:
+        st = _state_for(root)
+        # prune finished writers so long runs don't accumulate threads
+        st["threads"][:] = [x for x in st["threads"] if x.is_alive()]
+        st["threads"].append(t)
+    t.start()
+    return serial
+
+
+def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
+                       max_num_checkpoints):
+    if trainer_args is not None:
+        with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
+            json.dump(trainer_args, f)
+    with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
+        f.write("")
+    # scroll-delete: keep newest max_num_checkpoints complete serials,
+    # only ever deleting COMPLETE ones older than the newest keepers (an
+    # in-flight async serial has no _SUCCESS yet and must survive)
+    with _ckpt_lock:
+        serials = [(n, name) for n, name in _serial_dirs(checkpoint_dir)
+                   if os.path.exists(os.path.join(
+                       checkpoint_dir, name, SUCCESS_MARK))]
+        for _, name in serials[:max(0, len(serials) - max_num_checkpoints)]:
+            shutil.rmtree(os.path.join(checkpoint_dir, name),
+                          ignore_errors=True)
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program):
+    """Restore the newest complete checkpoint; returns its trainer args
+    (or None when no checkpoint exists)."""
+    serial = _latest_complete_serial(checkpoint_dir)
+    if serial < 0:
+        return None
+    cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
+    io.load_persistables(executor, cur, main_program)
+    args_path = os.path.join(cur, TRAINER_ARGS_FILE)
+    if os.path.exists(args_path):
+        with open(args_path) as f:
+            return json.load(f)
+    return {}
+
+
+def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    for _, name in _serial_dirs(checkpoint_dir):
+        shutil.rmtree(os.path.join(checkpoint_dir, name), ignore_errors=True)
+    if delete_dir and os.path.isdir(checkpoint_dir):
+        shutil.rmtree(checkpoint_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Trainer (ref: trainer.py:169)
+# ---------------------------------------------------------------------------
+
+
+class Trainer:
+    """``train_func() -> loss`` (or [loss, ...]) builds the model;
+    ``optimizer_func() -> Optimizer`` attaches the backward + update."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        if checkpoint_config is not None and \
+                not isinstance(checkpoint_config, CheckpointConfig):
+            raise TypeError("checkpoint_config must be a CheckpointConfig")
+        self.checkpoint_cfg = checkpoint_config
+        self.place = place if place is not None else core.CPUPlace()
+        self.parallel = parallel
+        self.stop_flag = False
+
+        self.train_program = Program()
+        self.startup_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            self.train_func_outputs = list(outs)
+            self.loss = outs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss, self.startup_program)
+
+        self.exe = Executor(self.place)
+        self.exe.run(self.startup_program)
+
+        if self.checkpoint_cfg:
+            args = load_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir,
+                                   self.train_program)
+            if args is not None:
+                self.checkpoint_cfg.epoch_id = int(args.get("epoch_id", 0))
+                # step_id records the last COMPLETED step; absent (a
+                # checkpoint saved outside the Trainer loop) means none
+                self.checkpoint_cfg.step_id = int(args.get("step_id", -1)) + 1
+        elif param_path:
+            io.load_persistables(self.exe, param_path, self.train_program)
+
+    def stop(self):
+        self.stop_flag = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        """Epoch/step loop with events; resumes from a restored epoch/step
+        (skipping already-consumed steps of the restored epoch, ref
+        trainer.py:1060 trainer args)."""
+        start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
+        feeder = DataFeeder(feed_list=feed_order, place=self.place,
+                            program=self.train_program)
+        try:
+            self._train_loop(start_epoch, num_epochs, event_handler, reader,
+                             feeder)
+        except BaseException:
+            if self.checkpoint_cfg and self.checkpoint_cfg.async_save:
+                # drain writes so the newest checkpoint lands, but never
+                # let a checkpoint error mask the primary training failure
+                try:
+                    wait_for_checkpoints(self.checkpoint_cfg.checkpoint_dir)
+                except Exception as ckpt_exc:
+                    # secondary failure: keep the signal without masking
+                    # the primary training exception
+                    from .log import LOG
+
+                    LOG(f"async checkpoint failed during training "
+                        f"teardown: {ckpt_exc!r}")
+            raise
+        else:
+            if self.checkpoint_cfg and self.checkpoint_cfg.async_save:
+                wait_for_checkpoints(self.checkpoint_cfg.checkpoint_dir)
+
+    def _train_loop(self, start_epoch, num_epochs, event_handler, reader,
+                    feeder):
+        last_epoch_saved = None
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            skip_until = (self.checkpoint_cfg.step_id
+                          if self.checkpoint_cfg and
+                          epoch_id == self.checkpoint_cfg.epoch_id else 0)
+            for step_id, data in enumerate(reader()):
+                if self.stop_flag:
+                    return
+                if step_id < skip_until:
+                    continue
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = self.train_func_outputs if begin.fetch_metrics else []
+                metrics = self.exe.run(self.train_program,
+                                       feed=feeder.feed(data),
+                                       fetch_list=fetch)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                if self.checkpoint_cfg and \
+                        (step_id + 1) % self.checkpoint_cfg.step_interval == 0:
+                    self._save_checkpoint(epoch_id, step_id)
+            if self.checkpoint_cfg and \
+                    (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0:
+                self._save_checkpoint(epoch_id, -1, end_of_epoch=True)
+                last_epoch_saved = epoch_id
+            event_handler(EndEpochEvent(epoch_id))
+        if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
+            # final state is always captured so resume never replays work
+            # (skipped when the in-loop epoch save already wrote it)
+            self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True)
+
+    def test(self, reader, feed_order):
+        feeder = DataFeeder(feed_list=feed_order, place=self.place,
+                            program=self.train_program)
+        test_prog = self.train_program.clone(for_test=True)
+        totals = None
+        count = 0
+        for data in reader():
+            outs = self.exe.run(test_prog, feed=feeder.feed(data),
+                                fetch_list=self.train_func_outputs)
+            vals = [float(np.asarray(o).reshape(-1)[0]) for o in outs]
+            totals = vals if totals is None else \
+                [a + b for a, b in zip(totals, vals)]
+            count += 1
+        return [t / max(count, 1) for t in (totals or [])]
+
+    def save_params(self, param_path):
+        io.save_persistables(self.exe, param_path, self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        io.save_inference_model(
+            param_path, feeded_var_names,
+            [self.train_func_outputs[i] for i in target_var_indexes],
+            self.exe, self.train_program)
+
+    # -- internal --
+    def _save_checkpoint(self, epoch_id, step_id, end_of_epoch=False):
+        args = {"epoch_id": epoch_id + 1 if end_of_epoch else epoch_id,
+                "step_id": -1 if end_of_epoch else step_id}
+        save_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir,
+                        self.train_program, trainer_args=args,
+                        max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+                        background=self.checkpoint_cfg.async_save)

@@ -1,0 +1,89 @@
+"""DistributeTranspiler: multi-worker training (ref: transpiler/
+distribute_transpiler.py:132).
+
+North-star redesign (BASELINE.json): the reference rewrites the program into
+send/recv/listen_and_serv RPC ops against parameter servers.  On a TPU pod
+the parameter-server role is obsolete — parameters and optimizer state live
+sharded/replicated across the same chips that compute, and gradient exchange
+is an XLA all-reduce over ICI.  So ``transpile`` does not inject RPC ops;
+it records the trainer topology and marks the program for SPMD execution:
+
+ - get_trainer_program(): the program, unchanged op-wise — ParallelExecutor /
+   the multihost runner shard the batch over the global mesh
+   (trainers × local devices) and GSPMD inserts collectives.
+ - get_pserver_program(): raises with guidance — there is no pserver process
+   in the TPU deployment; its state-holding role maps onto sharded optimizer
+   state (BuildStrategy.ReduceStrategy.Reduce ≈ ZeRO-1).
+
+Async PS semantics (ref listen_and_serv_op.cc:213 RunAsyncLoop) have no
+literal SPMD equivalent; ``sync_mode=False`` maps onto the TPU-native form
+of the same staleness-for-throughput trade — local SGD with periodic
+parameter averaging (parallel.local_sgd.AsyncLocalSGDTrainer), whose
+staleness is bounded by the sync period rather than unbounded.
+"""
+
+from __future__ import annotations
+
+from ..framework import Program, default_main_program
+
+
+class DistributeTranspilerConfig:
+    """ref: distribute_transpiler.py:116."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None):
+        """Record the trainer topology on the program.  ParallelExecutor
+        reads this annotation and joins the coordination service
+        (parallel.multihost.init) with the first pserver endpoint as the
+        coordinator address — the TPU mapping of the reference's
+        gen_nccl_id-over-gRPC bootstrap (gen_nccl_id_op.cc:31)."""
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self._transpiled = True
+        self.origin_program._dist_info = {
+            "trainer_id": trainer_id,
+            "trainers": trainers,
+            "coordinator": (self.pserver_endpoints[0]
+                            if self.pserver_endpoints else None),
+            # sync_mode=False selects the async-PS replacement: local SGD
+            # with periodic averaging (parallel.local_sgd) instead of the
+            # per-step GSPMD collective program
+            "mode": "spmd_ici" if sync_mode else "async_local_sgd",
+        }
+        # Join the pod NOW: jax.distributed.initialize must run before any
+        # JAX computation touches the backend, and in the reference flow
+        # transpile() is exactly the pre-startup moment (the gen_nccl_id
+        # handshake).  ParallelExecutor re-checks idempotently.
+        from ...parallel import multihost as _mh
+
+        _mh.ensure_init(self.origin_program._dist_info)
+
+    def get_trainer_program(self) -> Program:
+        if not self._transpiled:
+            raise RuntimeError("call transpile() first")
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint) -> Program:
+        raise NotImplementedError(
+            "TPU pods have no parameter-server process: parameters and "
+            "optimizer state are sharded across the mesh and gradients "
+            "all-reduce over ICI.  Launch every host with the trainer "
+            "program (see paddle_tpu.parallel for multihost init).")
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        raise NotImplementedError(
+            "no pserver startup program in the TPU deployment")

@@ -1,0 +1,24 @@
+"""Inference transpiler (ref: transpiler/inference_transpiler.py — folds
+batch-norm into conv weights and fuses activations for inference).
+
+Here the transpile (1) flips train-mode ops to is_test, and (2) runs the
+real conv+BN fold pass (fluid.ir ConvBNFuse): per-channel rescale of the
+conv filter plus a precomputed bias replaces each inference-mode BN whose
+sole input is a conv — the same weight rewrite the reference performs.
+Elementwise activation fusion is left to XLA, which does it universally."""
+
+from __future__ import annotations
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place, scope=None):
+        from ..executor import global_scope
+        from ..ir import ConvBNFuse, Graph
+
+        scope = scope or global_scope()
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in ("batch_norm", "dropout"):
+                    op.attrs["is_test"] = True
+        ConvBNFuse(scope).apply(Graph(program, 0)).to_program()
+        return program

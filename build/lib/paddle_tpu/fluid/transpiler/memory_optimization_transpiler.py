@@ -1,0 +1,21 @@
+"""Memory-optimization transpiler (ref: transpiler/
+memory_optimization_transpiler.py:47,381 — liveness-based var reuse).
+
+On XLA this pass is a no-op by design: buffer liveness analysis and reuse
+happen inside the compiler, and in-place parameter updates are expressed via
+buffer donation in the Executor.  The API is preserved so reference training
+scripts run unchanged.
+"""
+
+from __future__ import annotations
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    if print_log:
+        print("memory_optimize: no-op on XLA (compiler performs liveness reuse)")
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

@@ -1,0 +1,62 @@
+"""PS dispatchers (ref: transpiler/ps_dispatcher.py): assign variables to
+"servers".  In the TPU build the pserver role is sharded state, so the
+consumers are (a) the multihost sharded checkpoint, which round-robins
+replicated variables across processes so every host writes a balanced
+subset (parallel/multihost.py save_sharded — the pserver-shard layout of
+ref go/pserver/service.go:346 applied to checkpoint IO), and (b) any
+transpiler emulating a pserver var layout."""
+
+from __future__ import annotations
+
+import zlib
+
+
+def _var_name(var) -> str:
+    return var if isinstance(var, str) else var.name
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    def _hash_block(self, block_str, total):
+        # crc32, NOT builtin hash(): str hash is salted per process
+        # (PYTHONHASHSEED), and every process must agree on the layout
+        return zlib.crc32(block_str.encode("utf-8")) % total
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server_id = self._hash_block(_var_name(var), len(self._eps))
+            eplist.append(self._eps[server_id])
+        return eplist
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        eplist = []
+        for _ in varlist:
+            eplist.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return eplist
+
+
+def assign_writer(names, n_processes: int, kind: str = "round_robin"):
+    """Deterministic {name: process_id} for replicated-var checkpoint
+    writes.  Every process computes the identical map (names must arrive
+    in the same order everywhere, which plan-derived state dicts do)."""
+    d = (HashName if kind == "hash" else RoundRobin)(range(n_processes))
+    return dict(zip(names, d.dispatch(list(names))))

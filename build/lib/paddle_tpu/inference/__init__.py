@@ -1,0 +1,172 @@
+"""Inference API: the TPU-native equivalent of the reference's C++
+predictor surface (ref: inference/api/paddle_inference_api.h —
+PaddleTensor :67, PaddlePredictor :90, NativeConfig :119, AnalysisConfig
+:156; impl api_impl.cc).
+
+Redesign notes (SURVEY.md §2.9): the reference's analysis pipeline
+(fluid→DFG→TensorRT-subgraph→fluid) exists to hand subgraphs to a separate
+engine; under XLA the *whole* program is already one compiled engine, so
+``AnalysisConfig`` maps to program-level rewrites that still pay off before
+XLA sees the graph (is_test flips + conv+BN folding via
+transpiler.InferenceTranspiler) and the jit cache plays the role of the
+engine cache.  Each predictor owns a private Scope, so multiple predictors
+coexist in one process exactly like the reference's independent predictors
+(paddle_inference_api.h:90 contract: Run() is thread-compatible per clone).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class PaddleTensor:
+    """Named ndarray crossing the predictor boundary
+    (ref: paddle_inference_api.h:67 — name/shape/data/dtype/lod)."""
+    name: str = ""
+    data: Optional[np.ndarray] = None
+    lod: Sequence[Sequence[int]] = field(default_factory=list)
+
+    @property
+    def shape(self):
+        return tuple(self.data.shape) if self.data is not None else ()
+
+    @property
+    def dtype(self):
+        return self.data.dtype if self.data is not None else None
+
+
+@dataclass
+class NativeConfig:
+    """ref: paddle_inference_api.h:119 (model_dir or prog/param files,
+    device selection).  use_tpu=False pins CPU like the reference's
+    use_gpu=False."""
+    model_dir: str = ""
+    prog_file: str = ""
+    param_file: str = ""
+    use_tpu: bool = True
+    device: int = 0
+
+
+@dataclass
+class AnalysisConfig(NativeConfig):
+    """ref: paddle_inference_api.h:156.  enable_ir_optim runs the program
+    rewrites that matter pre-XLA: is_test flips + conv+BN weight folding
+    (transpiler.InferenceTranspiler ≈ the reference's analysis passes +
+    inference_transpiler).  enable_int8 additionally rewrites matmul/conv
+    weights to int8-in-HBM with per-channel scales, dequantized at the
+    consuming op (transpiler.Int8WeightTranspiler ≈ the reference's int8
+    analysis pass; weight-only, so accuracy loss stays <1%)."""
+    enable_ir_optim: bool = True
+    enable_int8: bool = False
+
+
+class PaddlePredictor:
+    """ref: paddle_inference_api.h:90 / api_impl.cc NativePaddlePredictor.
+
+    Loads the saved inference model into a private scope; Run() feeds
+    PaddleTensors, executes the (jit-cached) program, returns fetches.
+    """
+
+    def __init__(self, config: NativeConfig):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.executor import Scope
+
+        self._config = config
+        self._scope = Scope()
+        place = fluid.TPUPlace(config.device) if config.use_tpu \
+            else fluid.CPUPlace()
+        self._exe = fluid.Executor(place)
+        dirname = config.model_dir
+        model_filename = os.path.basename(config.prog_file) or None
+        params_filename = os.path.basename(config.param_file) or None
+        if not dirname and config.prog_file:
+            dirname = os.path.dirname(config.prog_file)
+        self._program, self._feed_names, self._fetch_vars = \
+            fluid.io.load_inference_model(dirname, self._exe,
+                                          model_filename=model_filename,
+                                          params_filename=params_filename,
+                                          scope=self._scope)
+        if isinstance(config, AnalysisConfig) and config.enable_ir_optim:
+            from paddle_tpu.fluid.transpiler import InferenceTranspiler
+
+            InferenceTranspiler().transpile(self._program, place,
+                                            scope=self._scope)
+        if isinstance(config, AnalysisConfig) and config.enable_int8:
+            from paddle_tpu.fluid.transpiler import Int8WeightTranspiler
+
+            Int8WeightTranspiler().transpile(self._program, place,
+                                             scope=self._scope)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return [v.name for v in self._fetch_vars]
+
+    def run(self, inputs: List[PaddleTensor],
+            batch_size: int = -1) -> List[PaddleTensor]:
+        from paddle_tpu.fluid.lod_tensor import LoDTensor
+
+        feed = {}
+        for i, t in enumerate(inputs):
+            name = t.name or self._feed_names[i]
+            # the reference's PaddleTensor carries LoD alongside data
+            # (paddle_inference_api.h:67); a sequence model fed flat data
+            # without its LoD would silently see one giant sequence
+            if t.lod:
+                # offsets-form sanity: every level starts at 0 and is
+                # non-decreasing; the FINEST level ends at the row count,
+                # and each coarser level indexes into the next level's
+                # sequence count (standard nested-LoD invariants —
+                # lengths-form input would fail these loudly instead of
+                # silently mis-slicing)
+                for li, level in enumerate(t.lod):
+                    ok = (len(level) >= 2 and level[0] == 0
+                          and all(a <= b for a, b in zip(level, level[1:])))
+                    if ok:
+                        end = (int(t.data.shape[0]) if li == len(t.lod) - 1
+                               else len(t.lod[li + 1]) - 1)
+                        ok = int(level[-1]) == end
+                    if not ok:
+                        raise ValueError(
+                            f"PaddleTensor '{name}' lod must be offsets "
+                            f"form (e.g. [[0, 2, 5]] for lengths [2, 3]); "
+                            f"level {li} of {t.lod} is inconsistent with "
+                            f"{t.data.shape[0]} rows")
+                feed[name] = LoDTensor(t.data, t.lod)
+            else:
+                feed[name] = t.data
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=[v.name for v in self._fetch_vars],
+                             scope=self._scope, return_numpy=False)
+        result = []
+        for v, o in zip(self._fetch_vars, outs):
+            lod = ()
+            if isinstance(o, LoDTensor):
+                lod = o.lod()
+            result.append(PaddleTensor(name=v.name, data=np.asarray(o),
+                                       lod=lod))
+        return result
+
+    # the reference's C++ clone shares weights via the scope; here a clone
+    # shares the scope (arrays are immutable jax values, so concurrent
+    # Run()s never alias mutable state)
+    def clone(self) -> "PaddlePredictor":
+        c = object.__new__(PaddlePredictor)
+        c._config = self._config
+        c._scope = self._scope
+        c._exe = self._exe
+        c._program = self._program
+        c._feed_names = list(self._feed_names)
+        c._fetch_vars = list(self._fetch_vars)
+        return c
+
+
+def create_paddle_predictor(config: NativeConfig) -> PaddlePredictor:
+    """ref: paddle_inference_api.h:179 CreatePaddlePredictor."""
+    return PaddlePredictor(config)

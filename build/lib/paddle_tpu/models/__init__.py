@@ -1,0 +1,7 @@
+"""Model zoo built on the fluid layer API (ref: benchmark/fluid/ models:
+mnist, resnet, vgg, se_resnext, stacked_dynamic_lstm, machine_translation)."""
+
+from . import bert, deepfm, mnist, resnet, se_resnext, stacked_lstm, transformer, vgg
+
+__all__ = ["bert", "deepfm", "mnist", "resnet", "se_resnext", "stacked_lstm",
+           "transformer", "vgg"]

@@ -1,0 +1,301 @@
+"""Transformer encoder-decoder (the second driver metric: Transformer-base
+tokens/sec/chip).
+
+Functional contract follows the reference's Transformer test model
+(python/paddle/fluid/tests/unittests/transformer_model.py: multi-head
+attention, position encoding, pre/post-process residual+norm+dropout,
+label-smoothed softmax CE) but the design is TPU-first rather than a
+translation: everything is static-shape dense [batch, seq_len] tensors, the
+causal and padding masks are additive biases broadcast into the pre-softmax
+logits (no LoD, no data-dependent shapes), and the whole step traces into a
+single XLA program whose attention/FFN matmuls tile onto the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.initializer import NumpyArrayInitializer
+from ..fluid.param_attr import ParamAttr
+
+_NEG_INF = -1e9
+
+
+class Config:
+    def __init__(self, name, src_vocab_size, tgt_vocab_size, d_model,
+                 d_inner, n_head, n_layer, dropout=0.1, label_smooth=0.1,
+                 moe_experts=0, moe_top_k=2, moe_aux_weight=1e-2,
+                 stacked=False, ring_attention=False, n_microbatches=4):
+        self.name = name
+        self.src_vocab_size = src_vocab_size
+        self.tgt_vocab_size = tgt_vocab_size
+        self.d_model = d_model
+        self.d_inner = d_inner
+        self.n_head = n_head
+        self.n_layer = n_layer
+        self.dropout = dropout
+        self.label_smooth = label_smooth
+        # moe_experts > 0 replaces every FFN with an expert-parallel MoE
+        # layer (Switch-style; experts shard over an "ep" mesh axis)
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_aux_weight = moe_aux_weight
+        # stacked=True builds the encoder/decoder as ONE mesh-aware
+        # layer-stack op with [L, ...] params (layers.transformer_*_stack):
+        # pipeline-parallel over "pp", Megatron-TP over "mp", ring-
+        # attention over "sp" — the pipeline-capable flagship build.
+        # Residual dropout only in this mode (see transformer_stack).
+        self.stacked = stacked
+        # ring_attention=True keeps the per-layer graph but routes every
+        # attention through layers.ring_attention, so the UNstacked model
+        # sequence-parallelizes over an "sp" mesh axis too.  Attention-
+        # probability dropout is skipped in this mode (the [T, T] matrix
+        # never materializes under the ring).
+        self.ring_attention = ring_attention
+        self.n_microbatches = n_microbatches
+
+
+def base_config():
+    """Transformer-base (Vaswani et al.): d_model 512, 8 heads, 6 layers."""
+    return Config("base", src_vocab_size=30000, tgt_vocab_size=30000,
+                  d_model=512, d_inner=2048, n_head=8, n_layer=6)
+
+
+def tiny_config():
+    """CPU-test scale."""
+    return Config("tiny", src_vocab_size=1000, tgt_vocab_size=1000,
+                  d_model=64, d_inner=128, n_head=4, n_layer=2)
+
+
+def _position_encoding(max_len, d_model):
+    """Sinusoid table [max_len, d_model] (Vaswani et al. eq. 5)."""
+    pos = np.arange(max_len, dtype=np.float64)[:, None]
+    div = np.exp(np.arange(0, d_model, 2, dtype=np.float64)
+                 * -(np.log(10000.0) / d_model))
+    table = np.zeros((max_len, d_model), dtype=np.float32)
+    table[:, 0::2] = np.sin(pos * div)
+    table[:, 1::2] = np.cos(pos * div)
+    return table
+
+
+def _shared_causal_bias(lq, lk):
+    """One additive triu causal mask per (program, shape) — every decoder
+    layer shares the same constant var instead of re-materializing it."""
+    from .. import fluid as _fluid
+
+    prog = _fluid.default_main_program()
+    cache = getattr(prog, "_causal_bias_cache", None)
+    if cache is None:
+        cache = prog._causal_bias_cache = {}
+    var = cache.get((lq, lk))
+    if var is None:
+        causal_np = np.triu(np.full((lq, lk), _NEG_INF, np.float32), k=1)
+        var = cache[(lq, lk)] = layers.assign(causal_np)
+    return var
+
+
+def _postprocess(prev, out, dropout):
+    """Residual add + layer norm (+ dropout on the sublayer output)."""
+    if dropout:
+        out = layers.dropout(out, dropout_prob=dropout)
+    return layers.layer_norm(layers.elementwise_add(prev, out),
+                             begin_norm_axis=2)
+
+
+def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
+                          dropout, prefix, causal=False, use_ring=False):
+    """[b, lq, d] x [b, lk, d] -> [b, lq, d]; bias broadcasts into the
+    [b, h, lq, lk] logits (None, [lq, lk] causal, or [b, 1, 1, lk] padding).
+
+    use_ring=True routes the attention through layers.ring_attention
+    (sequence-parallel over an "sp" mesh axis, mathematically identical
+    single-device); the causal mask is then expressed via the op's
+    ``causal`` flag and ``bias`` must be a key-position padding bias
+    ([b, 1, 1, lk]) or None — and attention-probability dropout is skipped
+    (the ring never materializes the probability matrix)."""
+    lq, lk = q_in.shape[1], k_in.shape[1]
+    d_k = d_model // n_head
+    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=ParamAttr(name=f"{prefix}_q_w"))
+    k = layers.fc(k_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=ParamAttr(name=f"{prefix}_k_w"))
+    v = layers.fc(v_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=ParamAttr(name=f"{prefix}_v_w"))
+    # [b, l, d] -> [b, h, l, d_k]
+    q = layers.transpose(layers.reshape(q, [-1, lq, n_head, d_k]),
+                         perm=[0, 2, 1, 3])
+    k = layers.transpose(layers.reshape(k, [-1, lk, n_head, d_k]),
+                         perm=[0, 2, 1, 3])
+    v = layers.transpose(layers.reshape(v, [-1, lk, n_head, d_k]),
+                         perm=[0, 2, 1, 3])
+    if use_ring:
+        ctx = layers.ring_attention(q, k, v, causal=causal,
+                                    scale=d_k ** -0.5, bias=bias)
+    else:
+        logits = layers.matmul(layers.scale(q, scale=d_k ** -0.5), k,
+                               transpose_y=True)
+        if causal:
+            # one shared [lq, lk] mask var per program+shape: layers would
+            # otherwise each carry their own identical triu constant
+            logits = layers.elementwise_add(logits,
+                                            _shared_causal_bias(lq, lk))
+        if bias is not None:
+            logits = layers.elementwise_add(logits, bias)
+        weights = layers.softmax(logits)
+        if dropout:
+            weights = layers.dropout(weights, dropout_prob=dropout)
+        ctx = layers.matmul(weights, v)                  # [b, h, lq, d_k]
+    ctx = layers.reshape(layers.transpose(ctx, perm=[0, 2, 1, 3]),
+                         [-1, lq, d_model])
+    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=ParamAttr(name=f"{prefix}_o_w"))
+
+
+def _ffn(x, d_inner, d_model, prefix, cfg=None, aux_losses=None):
+    if cfg is not None and cfg.moe_experts:
+        out, aux = layers.moe_ffn(x, num_experts=cfg.moe_experts,
+                                  hidden_size=d_inner,
+                                  top_k=cfg.moe_top_k)
+        if aux_losses is not None:
+            aux_losses.append(aux)
+        return out
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu",
+                  param_attr=ParamAttr(name=f"{prefix}_ffn1_w"))
+    return layers.fc(h, d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=f"{prefix}_ffn2_w"))
+
+
+def _embed(word, vocab_size, seq_len, cfg, name):
+    emb = layers.embedding(
+        word, size=[vocab_size, cfg.d_model],
+        param_attr=ParamAttr(
+            name=f"{name}_emb",
+            initializer=fluid.initializer.NormalInitializer(
+                0.0, cfg.d_model ** -0.5)))
+    emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
+    pos = layers.create_parameter(
+        shape=[seq_len, cfg.d_model], dtype="float32",
+        attr=ParamAttr(name=f"{name}_pos_enc",
+                       initializer=NumpyArrayInitializer(
+                           _position_encoding(seq_len, cfg.d_model)),
+                       trainable=False))
+    out = layers.elementwise_add(emb, pos)
+    if cfg.dropout:
+        out = layers.dropout(out, dropout_prob=cfg.dropout)
+    return out
+
+
+def _padding_bias(word, seq_len):
+    """[b, len] int ids -> additive bias [b, 1, 1, len]: NEG_INF at pad(0)."""
+    zeros = layers.fill_constant_batch_size_like(
+        word, shape=[-1, seq_len], dtype="int64", value=0)
+    is_pad = layers.cast(layers.equal(word, zeros), "float32")
+    bias = layers.scale(is_pad, scale=_NEG_INF)
+    return layers.reshape(bias, [-1, 1, 1, seq_len])
+
+
+def moe_config():
+    """Switch-Transformer-style MoE variant of the tiny config (expert
+    parallelism demo/test model; SURVEY.md §2.6: MoE/EP beyond-reference)."""
+    c = tiny_config()
+    c.name = "moe_tiny"
+    c.moe_experts = 4
+    return c
+
+
+def encoder(src_word, cfg, src_len, aux_losses=None):
+    enc = _embed(src_word, cfg.src_vocab_size, src_len, cfg, "src")
+    src_bias = _padding_bias(src_word, src_len)
+    if cfg.stacked:
+        enc = layers.transformer_encoder_stack(
+            enc, bias=src_bias, n_layer=cfg.n_layer, n_head=cfg.n_head,
+            d_inner=cfg.d_inner, dropout=cfg.dropout,
+            n_microbatches=cfg.n_microbatches)
+        return enc, src_bias
+    for i in range(cfg.n_layer):
+        attn = _multi_head_attention(
+            enc, enc, enc, src_bias, cfg.d_model, cfg.n_head, cfg.dropout,
+            prefix=f"enc{i}_self", use_ring=cfg.ring_attention)
+        enc = _postprocess(enc, attn, cfg.dropout)
+        ff = _ffn(enc, cfg.d_inner, cfg.d_model, prefix=f"enc{i}",
+                  cfg=cfg, aux_losses=aux_losses)
+        enc = _postprocess(enc, ff, cfg.dropout)
+    return enc, src_bias
+
+
+def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses=None):
+    dec = _embed(tgt_word, cfg.tgt_vocab_size, tgt_len, cfg, "tgt")
+    if cfg.stacked:
+        dec = layers.transformer_decoder_stack(
+            dec, enc_out, src_bias=src_bias, n_layer=cfg.n_layer,
+            n_head=cfg.n_head, d_inner=cfg.d_inner, dropout=cfg.dropout,
+            n_microbatches=cfg.n_microbatches)
+        return layers.fc(dec, cfg.tgt_vocab_size, num_flatten_dims=2,
+                         param_attr=ParamAttr(name="out_proj_w"))
+    for i in range(cfg.n_layer):
+        self_attn = _multi_head_attention(
+            dec, dec, dec, None, cfg.d_model, cfg.n_head, cfg.dropout,
+            prefix=f"dec{i}_self", causal=True, use_ring=cfg.ring_attention)
+        dec = _postprocess(dec, self_attn, cfg.dropout)
+        cross = _multi_head_attention(
+            dec, enc_out, enc_out, src_bias, cfg.d_model, cfg.n_head,
+            cfg.dropout, prefix=f"dec{i}_cross", use_ring=cfg.ring_attention)
+        dec = _postprocess(dec, cross, cfg.dropout)
+        ff = _ffn(dec, cfg.d_inner, cfg.d_model, prefix=f"dec{i}",
+                  cfg=cfg, aux_losses=aux_losses)
+        dec = _postprocess(dec, ff, cfg.dropout)
+    return layers.fc(dec, cfg.tgt_vocab_size, num_flatten_dims=2,
+                     param_attr=ParamAttr(name="out_proj_w"))
+
+
+def forward(cfg, src_len, tgt_len):
+    """Build data layers + logits + label-smoothed CE loss.  Returns
+    (src_word, tgt_word, lbl_word, avg_cost, logits)."""
+    src_word = layers.data(name="src_word", shape=[src_len], dtype="int64")
+    tgt_word = layers.data(name="tgt_word", shape=[tgt_len], dtype="int64")
+    lbl_word = layers.data(name="lbl_word", shape=[tgt_len, 1], dtype="int64")
+
+    aux_losses = []
+    enc_out, src_bias = encoder(src_word, cfg, src_len, aux_losses)
+    logits = decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses)
+
+    if cfg.label_smooth:
+        hot = layers.one_hot(lbl_word, cfg.tgt_vocab_size)
+        smooth = layers.label_smooth(hot, epsilon=cfg.label_smooth)
+        cost = layers.softmax_with_cross_entropy(logits, smooth,
+                                                 soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(logits, lbl_word)
+    # mask loss at pad targets so padding doesn't dilute the objective
+    zeros = layers.fill_constant_batch_size_like(
+        lbl_word, shape=[-1, tgt_len, 1], dtype="int64", value=0)
+    non_pad = layers.cast(
+        layers.logical_not(layers.equal(lbl_word, zeros)), "float32")
+    cost = layers.elementwise_mul(cost, non_pad)
+    avg_cost = layers.elementwise_div(
+        layers.reduce_sum(cost),
+        layers.elementwise_add(layers.reduce_sum(non_pad),
+                               layers.fill_constant([1], "float32", 1e-8)))
+    for aux in aux_losses:  # Switch load-balancing losses (MoE configs)
+        avg_cost = layers.elementwise_add(
+            avg_cost, layers.scale(aux, scale=cfg.moe_aux_weight))
+    return src_word, tgt_word, lbl_word, avg_cost, logits
+
+
+def build(cfg=None, src_len=64, tgt_len=64, lr=1e-3, warmup_steps=None):
+    """Full training graph with Adam (+ optional noam decay).  Returns
+    (src_word, tgt_word, lbl_word, avg_cost)."""
+    cfg = cfg or tiny_config()
+    src_word, tgt_word, lbl_word, avg_cost, _ = forward(cfg, src_len, tgt_len)
+    if warmup_steps:
+        lr_sched = layers.learning_rate_scheduler.noam_decay(
+            cfg.d_model, warmup_steps)
+        opt = fluid.optimizer.Adam(learning_rate=lr_sched,
+                                   beta1=0.9, beta2=0.98, epsilon=1e-9)
+    else:
+        opt = fluid.optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.98,
+                                   epsilon=1e-9)
+    opt.minimize(avg_cost)
+    return src_word, tgt_word, lbl_word, avg_cost

@@ -1,0 +1,435 @@
+"""Native runtime components (C++ via ctypes).
+
+ref: the reference's native recordio (paddle/fluid/recordio/) and reader
+blocking queue (operators/reader/lod_tensor_blocking_queue.h:31).  The
+shared library is built lazily with g++ on first use and cached next to
+the sources; if no toolchain is available the pure-Python fallbacks keep
+the API working (slower, same semantics).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue as _pyqueue
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libpaddle_tpu_native.so")
+_SRC = [os.path.join(_HERE, "recordio.cc"),
+        os.path.join(_HERE, "blocking_queue.cc"),
+        os.path.join(_HERE, "prefetch.cc")]
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *_SRC, "-o", _SO, "-lz", "-lpthread"]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None (fallbacks used)."""
+    global _lib
+    if _lib is not None:
+        return _lib or None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib or None
+        need_build = not os.path.exists(_SO) or any(
+            os.path.getmtime(s) > os.path.getmtime(_SO) for s in _SRC)
+        if need_build and not _build():
+            _lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib = False
+            return None
+        lib.pt_recordio_writer_open.restype = ctypes.c_void_p
+        lib.pt_recordio_writer_open.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int, ctypes.c_long]
+        lib.pt_recordio_write.restype = ctypes.c_int
+        lib.pt_recordio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_long]
+        lib.pt_recordio_writer_close.restype = ctypes.c_int
+        lib.pt_recordio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.pt_recordio_scanner_open.restype = ctypes.c_void_p
+        lib.pt_recordio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.pt_recordio_next.restype = ctypes.c_long
+        lib.pt_recordio_next.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_char_p)]
+        lib.pt_recordio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib.pt_free.argtypes = [ctypes.c_char_p]
+        lib.pt_queue_create.restype = ctypes.c_void_p
+        lib.pt_queue_create.argtypes = [ctypes.c_long]
+        lib.pt_queue_push.restype = ctypes.c_int
+        lib.pt_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_long, ctypes.c_double]
+        lib.pt_queue_pop.restype = ctypes.c_long
+        lib.pt_queue_pop.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_char_p),
+                                     ctypes.c_double]
+        for name in ("pt_queue_close", "pt_queue_destroy",
+                     "pt_queue_reopen"):
+            getattr(lib, name).argtypes = [ctypes.c_void_p]
+        lib.pt_queue_is_closed.restype = ctypes.c_int
+        lib.pt_queue_is_closed.argtypes = [ctypes.c_void_p]
+        lib.pt_queue_size.restype = ctypes.c_long
+        lib.pt_queue_size.argtypes = [ctypes.c_void_p]
+        lib.pt_prefetch_create.restype = ctypes.c_void_p
+        lib.pt_prefetch_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_long]
+        lib.pt_prefetch_next.restype = ctypes.c_long
+        lib.pt_prefetch_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_double]
+        lib.pt_prefetch_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# RecordIO
+# ---------------------------------------------------------------------------
+
+
+class RecordIOWriter:
+    """ref: recordio/writer.h + python recordio_writer.py surface."""
+
+    def __init__(self, path: str, compressor: int = 1,
+                 max_chunk_bytes: int = 1 << 20):
+        self._lib = get_lib()
+        self._path = path
+        if self._lib:
+            self._h = self._lib.pt_recordio_writer_open(
+                path.encode(), int(bool(compressor)), max_chunk_bytes)
+            if not self._h:
+                raise IOError(f"cannot open {path} for writing")
+        else:
+            import zlib
+
+            self._zlib = zlib
+            self._f = open(path, "wb")
+            self._compressor = int(bool(compressor))
+            self._pending = []
+            self._pending_bytes = 0
+            self._max = max_chunk_bytes
+
+    def write(self, record: bytes):
+        if isinstance(record, str):
+            record = record.encode()
+        if self._lib:
+            if self._lib.pt_recordio_write(self._h, record,
+                                           len(record)) != 0:
+                raise IOError("recordio write failed")
+            return
+        self._pending.append(bytes(record))
+        self._pending_bytes += len(record)
+        if self._pending_bytes >= self._max:
+            self._flush_py()
+
+    def _flush_py(self):
+        import struct
+
+        if not self._pending:
+            return
+        raw = b"".join(struct.pack("<Q", len(r)) + r for r in self._pending)
+        stored = self._zlib.compress(raw, 1) if self._compressor else raw
+        crc = self._zlib.crc32(stored) & 0xFFFFFFFF
+        self._f.write(struct.pack("<IIIQQI", 0x50545231, self._compressor,
+                                  len(self._pending), len(raw), len(stored),
+                                  crc))
+        self._f.write(stored)
+        self._pending, self._pending_bytes = [], 0
+
+    def close(self):
+        if self._lib:
+            if self._lib.pt_recordio_writer_close(self._h) != 0:
+                raise IOError("recordio close failed")
+            self._h = None
+        else:
+            self._flush_py()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+class RecordIOScanner:
+    """ref: recordio/scanner.h — iterate records of a file."""
+
+    def __init__(self, path: str):
+        self._lib = get_lib()
+        self._path = path
+        if self._lib:
+            self._h = self._lib.pt_recordio_scanner_open(path.encode())
+            if not self._h:
+                raise IOError(f"cannot open {path}")
+        else:
+            self._f = open(path, "rb")
+            self._chunk = []
+            self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._lib:
+            out = ctypes.c_char_p()
+            n = self._lib.pt_recordio_next(self._h, ctypes.byref(out))
+            if n == -1:
+                raise StopIteration
+            if n == -2:
+                raise IOError(f"corrupt recordio file {self._path}")
+            data = ctypes.string_at(out, n)
+            self._lib.pt_free(out)
+            return data
+        return self._next_py()
+
+    def _next_py(self) -> bytes:
+        import struct
+        import zlib
+
+        if self._cursor >= len(self._chunk):
+            head = self._f.read(32)
+            if not head:
+                raise StopIteration
+            if len(head) < 32:
+                raise IOError("corrupt recordio header")
+            magic, comp, n, raw_len, stored_len, crc = struct.unpack(
+                "<IIIQQI", head)
+            if magic != 0x50545231:
+                raise IOError("bad recordio magic")
+            stored = self._f.read(stored_len)
+            if (zlib.crc32(stored) & 0xFFFFFFFF) != crc:
+                raise IOError("recordio crc mismatch")
+            raw = zlib.decompress(stored) if comp else stored
+            self._chunk, self._cursor, pos = [], 0, 0
+            for _ in range(n):
+                (ln,) = struct.unpack_from("<Q", raw, pos)
+                pos += 8
+                self._chunk.append(raw[pos: pos + ln])
+                pos += ln
+        rec = self._chunk[self._cursor]
+        self._cursor += 1
+        return rec
+
+    def close(self):
+        if self._lib:
+            if self._h:
+                self._lib.pt_recordio_scanner_close(self._h)
+                self._h = None
+        else:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Blocking queue
+# ---------------------------------------------------------------------------
+
+
+class BlockingQueue:
+    """Bounded byte-payload queue (ref: LoDTensorBlockingQueue)."""
+
+    def __init__(self, capacity: int):
+        self._lib = get_lib()
+        self.capacity = capacity
+        if self._lib:
+            self._h = self._lib.pt_queue_create(capacity)
+        else:
+            self._q = _pyqueue.Queue(maxsize=capacity)
+            self._closed = False
+
+    def push(self, data: bytes, timeout: float = -1.0) -> bool:
+        """False iff the queue is closed."""
+        if self._lib:
+            r = self._lib.pt_queue_push(self._h, data, len(data), timeout)
+            if r == -2:
+                raise TimeoutError("queue push timed out")
+            return r == 0
+        # poll so close() wakes blocked producers (the C++ path uses
+        # condvar notification)
+        import time as _time
+
+        deadline = None if timeout < 0 else _time.monotonic() + timeout
+        while True:
+            if self._closed:
+                return False
+            try:
+                self._q.put(data, timeout=0.05)
+                return True
+            except _pyqueue.Full:
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise TimeoutError("queue push timed out") from None
+
+    def pop(self, timeout: float = -1.0):
+        """bytes, or None when closed and drained."""
+        if self._lib:
+            out = ctypes.c_char_p()
+            n = self._lib.pt_queue_pop(self._h, ctypes.byref(out), timeout)
+            if n == -1:
+                return None
+            if n == -2:
+                raise TimeoutError("queue pop timed out")
+            data = ctypes.string_at(out, n)
+            self._lib.pt_free(out)
+            return data
+        while True:
+            try:
+                return self._q.get(timeout=0.05 if timeout < 0 else timeout)
+            except _pyqueue.Empty:
+                if self._closed:
+                    return None
+                if timeout >= 0:
+                    raise TimeoutError("queue pop timed out") from None
+
+    def close(self):
+        if self._lib:
+            self._lib.pt_queue_close(self._h)
+        else:
+            self._closed = True
+
+    def reopen(self):
+        if self._lib:
+            self._lib.pt_queue_reopen(self._h)
+        else:
+            self._q = _pyqueue.Queue(maxsize=self.capacity)
+            self._closed = False
+
+    def is_closed(self) -> bool:
+        if self._lib:
+            return bool(self._lib.pt_queue_is_closed(self._h))
+        return self._closed
+
+    def size(self) -> int:
+        if self._lib:
+            return self._lib.pt_queue_size(self._h)
+        return self._q.qsize()
+
+    def __del__(self):
+        try:
+            if self._lib and self._h:
+                self._lib.pt_queue_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PrefetchReader:
+    """Multi-threaded prefetching reader over recordio shards (ref: the
+    reference's open_files + double_buffer native reader stack,
+    operators/reader/open_files_op.cc, create_double_buffer_reader_op.cc).
+    N C++ threads scan the files and stage records in a bounded buffer;
+    iteration yields raw record bytes.  An unopenable or corrupt shard
+    raises IOError (after already-buffered records drain) rather than
+    silently truncating the dataset.  Pure-Python thread fallback (over
+    the module's BlockingQueue) when no native toolchain is available."""
+
+    def __init__(self, paths, n_threads: int = 2, capacity: int = 256):
+        self._paths = [os.fspath(p) for p in paths]
+        self._lib = get_lib()
+        self._h = None
+        self._done = False
+        if self._lib is not None:
+            arr = (ctypes.c_char_p * len(self._paths))(
+                *[p.encode() for p in self._paths])
+            self._h = ctypes.c_void_p(self._lib.pt_prefetch_create(
+                arr, len(self._paths), int(n_threads), int(capacity)))
+            return
+        # fallback: worker threads over the (pure-Python) BlockingQueue;
+        # q.push returning False after close() stops abandoned workers
+        self._q = BlockingQueue(capacity)
+        self._errors: list = []
+        n = max(1, min(int(n_threads), len(self._paths) or 1))
+        self._live_left = n
+        self._live_lock = threading.Lock()
+
+        def work(start):
+            try:
+                for i in range(start, len(self._paths), n):
+                    for rec in RecordIOScanner(self._paths[i]):
+                        if not self._q.push(rec):
+                            return  # reader closed early
+            except Exception as exc:  # surfaced to the consumer
+                self._errors.append(exc)
+            finally:
+                with self._live_lock:
+                    self._live_left -= 1
+                    if self._live_left == 0:
+                        self._q.close()
+
+        for t in range(n):
+            threading.Thread(target=work, args=(t,), daemon=True).start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._done:
+            raise StopIteration
+        if self._lib is not None:
+            out = ctypes.c_char_p()
+            n = self._lib.pt_prefetch_next(
+                self._h, ctypes.byref(out), ctypes.c_double(-1.0))
+            if n == -3:
+                self.close()
+                raise IOError(
+                    "PrefetchReader: a shard was unreadable or corrupt")
+            if n < 0:
+                self.close()
+                raise StopIteration
+            data = ctypes.string_at(out, n)
+            self._lib.pt_free(out)
+            return data
+        rec = self._q.pop()
+        if rec is None:  # closed + drained
+            self._done = True
+            if self._errors:
+                raise IOError(
+                    f"PrefetchReader: shard failed: {self._errors[0]!r}")
+            raise StopIteration
+        return rec
+
+    def close(self):
+        self._done = True
+        if self._h is not None:
+            self._lib.pt_prefetch_destroy(self._h)
+            self._h = None
+        elif self._lib is None and hasattr(self, "_q"):
+            self._q.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,138 @@
+"""Activation ops (ref: paddle/fluid/operators/activation_op.{cc,cu,h} —
+~20 activations registered via macro; here each is one jnp expression and the
+backward falls out of the generic vjp rule)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _impl(ctx, _fn=fn):
+        return {"Out": _fn(ctx.input("X"))}
+    return _impl
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("tanh_shrink", lambda x: x - jnp.tanh(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("abs", jnp.abs)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: 1.0 / jnp.sqrt(x))
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("cos", jnp.cos)
+_unary("sin", jnp.sin)
+_unary("softplus", jax.nn.softplus)
+_unary("softsign", jax.nn.soft_sign)
+_unary("softshrink", lambda x: jnp.where(x > 0.5, x - 0.5, jnp.where(x < -0.5, x + 0.5, 0.0)))
+_unary("gelu", jax.nn.gelu)
+
+
+@register_op("relu6")
+def relu6(ctx):
+    t = ctx.attr("threshold", 6.0)
+    return {"Out": jnp.clip(ctx.input("X"), 0.0, t)}
+
+
+@register_op("leaky_relu")
+def leaky_relu(ctx):
+    a = ctx.attr("alpha", 0.02)
+    x = ctx.input("X")
+    return {"Out": jnp.where(x >= 0, x, a * x)}
+
+
+@register_op("elu")
+def elu(ctx):
+    a = ctx.attr("alpha", 1.0)
+    x = ctx.input("X")
+    return {"Out": jnp.where(x >= 0, x, a * (jnp.exp(x) - 1.0))}
+
+
+@register_op("pow")
+def pow_op(ctx):
+    return {"Out": jnp.power(ctx.input("X"), ctx.attr("factor", 1.0))}
+
+
+@register_op("stanh")
+def stanh(ctx):
+    a = ctx.attr("scale_a", 0.67)
+    b = ctx.attr("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * ctx.input("X"))}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ctx):
+    slope = ctx.attr("slope", 0.2)
+    offset = ctx.attr("offset", 0.5)
+    return {"Out": jnp.clip(slope * ctx.input("X") + offset, 0.0, 1.0)}
+
+
+@register_op("hard_shrink")
+def hard_shrink(ctx):
+    t = ctx.attr("threshold", 0.5)
+    x = ctx.input("X")
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ctx):
+    t = ctx.attr("threshold", 1.0)
+    x = ctx.input("X")
+    return {"Out": jnp.where(x > t, x, 0.0)}
+
+
+@register_op("soft_relu")
+def soft_relu(ctx):
+    t = ctx.attr("threshold", 40.0)
+    x = jnp.clip(ctx.input("X"), -t, t)
+    return {"Out": jnp.log(1.0 + jnp.exp(x))}
+
+
+@register_op("brelu")
+def brelu(ctx):
+    t_min = ctx.attr("t_min", 0.0)
+    t_max = ctx.attr("t_max", 24.0)
+    return {"Out": jnp.clip(ctx.input("X"), t_min, t_max)}
+
+
+@register_op("swish")
+def swish(ctx):
+    b = ctx.attr("beta", 1.0)
+    x = ctx.input("X")
+    return {"Out": x * jax.nn.sigmoid(b * x)}
+
+
+@register_op("prelu")
+def prelu(ctx):
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": jnp.where(x >= 0, x, a * x)}
+
+
+@register_op("softmax")
+def softmax(ctx):
+    return {"Out": jax.nn.softmax(ctx.input("X"), axis=-1)}
+
+
+@register_op("log_softmax")
+def log_softmax(ctx):
+    return {"Out": jax.nn.log_softmax(ctx.input("X"), axis=ctx.attr("axis", -1))}

@@ -1,0 +1,588 @@
+"""LoDTensorArray / LoDRankTable ops — the DynamicRNN & beam-search substrate.
+
+ref: paddle/fluid/operators/{tensor_array_read_write_op.cc,
+lod_rank_table_op.cc, lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc,
+shrink_rnn_memory_op.cc, max_sequence_len_op.cc,
+reorder_lod_tensor_by_rank_op.cc, split_lod_tensor_op.cc,
+merge_lod_tensor_op.cc, beam_search_op.cc, beam_search_decode_op.cc}.
+
+TPU design: a tensor array is a trace-time Python list of fixed-shape
+device arrays (indices are concrete — counters root in fill_constant or
+static lod, see control_flow_exec).  The rank table is a host object
+computed from static lod.  Ops that are inherently data-dependent
+(split/merge by mask, beam search) require eager execution and declare
+``eager=True``; the executor drops jit for programs containing them.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad
+
+EAGER_OPS = {
+    "split_lod_tensor", "merge_lod_tensor", "beam_search",
+    "beam_search_decode", "is_empty",
+    # data-dependent output count (LoD out) — host postprocessing, like the
+    # reference's CPU-pinned kernel (multiclass_nms_op.cc)
+    "multiclass_nms",
+    # filesystem side effects need concrete values (save_op.cc etc.)
+    "save", "load", "save_combine", "load_combine", "delete_var",
+    # Faster-RCNN sampling/proposal ops: data-dependent counts + host RNG
+    # (the reference pins them to CPUPlace too)
+    "generate_proposals", "rpn_target_assign", "generate_proposal_labels",
+    "detection_map",
+}
+
+
+import jax as _jax
+
+
+@_jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """LoDTensorArray value (ref: var_type LOD_TENSOR_ARRAY).
+
+    Registered as a jax pytree (vals are children, lods are aux) so arrays
+    can cross jit-segment boundaries in the eager-island executor."""
+
+    def tree_flatten(self):
+        aux = tuple(tuple(map(tuple, l)) if l is not None else None
+                    for l in self.lods)
+        return tuple(self.vals), aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children), [tuple(l) if l is not None else None
+                                    for l in aux])
+
+    def __init__(self, vals: Optional[List] = None,
+                 lods: Optional[List] = None):
+        self.vals: List = list(vals or [])
+        self.lods: List = list(lods or [])
+        while len(self.lods) < len(self.vals):
+            self.lods.append(None)
+
+    def write(self, i: int, val, lod=None):
+        while len(self.vals) <= i:
+            self.vals.append(None)
+            self.lods.append(None)
+        self.vals[i] = val
+        self.lods[i] = lod
+
+    def read(self, i: int):
+        return self.vals[i], self.lods[i]
+
+    def __len__(self):
+        return len(self.vals)
+
+    def clone(self) -> "TensorArray":
+        return TensorArray(list(self.vals), list(self.lods))
+
+    def __add__(self, other):
+        """Element-wise sum (None-aware) — grad accumulation of array grads
+        by the backward's generic `sum` op."""
+        if not isinstance(other, TensorArray):
+            return NotImplemented
+        n = max(len(self.vals), len(other.vals))
+        vals = []
+        for i in range(n):
+            a = self.vals[i] if i < len(self.vals) else None
+            b = other.vals[i] if i < len(other.vals) else None
+            vals.append(b if a is None else (a if b is None else a + b))
+        lods = self.lods if len(self.lods) >= len(other.lods) else other.lods
+        return TensorArray(vals, list(lods))
+
+    __radd__ = __add__
+
+
+class RankTable:
+    """LoDRankTable: (seq_index, length) sorted by length desc, stable
+    (ref: lod_rank_table.h)."""
+
+    def __init__(self, offsets):
+        lens = [int(offsets[i + 1]) - int(offsets[i])
+                for i in range(len(offsets) - 1)]
+        order = sorted(range(len(lens)), key=lambda i: (-lens[i], i))
+        self.items = [(i, lens[i]) for i in order]
+        self.offsets = tuple(int(o) for o in offsets)
+
+    @property
+    def indices(self):
+        return [i for i, _ in self.items]
+
+    @property
+    def lengths(self):
+        return [l for _, l in self.items]
+
+    def num_active(self, t: int) -> int:
+        """How many (length-sorted) sequences still run at step t."""
+        return sum(1 for _, l in self.items if l > t)
+
+
+def _concrete_idx(v, what) -> int:
+    if isinstance(v, jax.core.Tracer):
+        raise NotImplementedError(
+            f"{what}: index must be concrete at trace time (counter chains "
+            f"rooted in fill_constant are; traced data is not)")
+    return int(np.asarray(v).reshape(-1)[0])
+
+
+# ---------------------------------------------------------------------------
+# read/write/length
+# ---------------------------------------------------------------------------
+
+
+@register_op("write_to_array", no_grad_inputs=("I",))
+def write_to_array(ctx):
+    i = _concrete_idx(ctx.input("I"), "write_to_array")
+    arr = ctx.cur_out("Out")
+    arr = arr.clone() if isinstance(arr, TensorArray) else TensorArray()
+    arr.write(i, ctx.input("X"), ctx.in_lod("X"))
+    return {"Out": arr}
+
+
+@register_grad("write_to_array")
+def write_to_array_grad(ctx):
+    """d X = (d Out)[i]."""
+    i = _concrete_idx(ctx.input("I"), "write_to_array_grad")
+    garr = ctx.input("Out@GRAD")
+    x = ctx.input("X")
+    if isinstance(garr, TensorArray) and i < len(garr.vals) \
+            and garr.vals[i] is not None:
+        return {"X@GRAD": garr.vals[i]}
+    return {"X@GRAD": jnp.zeros_like(x)}
+
+
+@register_op("read_from_array", no_grad_inputs=("I",))
+def read_from_array(ctx):
+    i = _concrete_idx(ctx.input("I"), "read_from_array")
+    arr = ctx.input("X")
+    if not isinstance(arr, TensorArray):
+        raise TypeError("read_from_array: X is not a tensor array")
+    val, lod = arr.read(i)
+    return {"Out": val, "Out@LOD": [lod] if lod else [None]}
+
+
+@register_grad("read_from_array")
+def read_from_array_grad(ctx):
+    """d X = array with (d Out) at slot i, zeros elsewhere."""
+    i = _concrete_idx(ctx.input("I"), "read_from_array_grad")
+    arr = ctx.input("X")
+    g = ctx.input("Out@GRAD")
+    garr = TensorArray(
+        [jnp.zeros_like(v) if v is not None else None for v in arr.vals],
+        list(arr.lods))
+    if g is not None:
+        garr.write(i, g, arr.lods[i] if i < len(arr.lods) else None)
+    return {"X@GRAD": garr}
+
+
+@register_op("lod_array_length")
+def lod_array_length(ctx):
+    arr = ctx.input("X")
+    # host value: array lengths drive loop conditions (concrete under jit)
+    return {"Out": np.asarray([len(arr)], np.int64)}
+
+
+@register_op("is_empty")
+def is_empty(ctx):
+    x = ctx.input("X")
+    n = len(x) if isinstance(x, TensorArray) else int(np.prod(x.shape))
+    return {"Out": jnp.asarray([n == 0])}
+
+
+# ---------------------------------------------------------------------------
+# rank table / max len / shrink / reorder
+# ---------------------------------------------------------------------------
+
+
+@register_op("lod_rank_table", no_grad_inputs=("X",))
+def lod_rank_table(ctx):
+    level = int(ctx.attr("level", 0))
+    lod = ctx.in_lod("X")
+    x = ctx.input("X")
+    if lod:
+        offsets = lod[level]
+    else:
+        # lod-free input: every row is a length-1 sequence (ref behavior)
+        offsets = tuple(range(x.shape[0] + 1))
+    return {"Out": RankTable(offsets)}
+
+
+@register_op("max_sequence_len", no_grad_inputs=("RankTable",))
+def max_sequence_len(ctx):
+    table = ctx.input("RankTable")
+    mx = table.lengths[0] if table.items else 0
+    # host value: drives the DynamicRNN loop condition (concrete under jit)
+    return {"Out": np.asarray([mx], np.int64)}
+
+
+@register_op("lod_tensor_to_array", no_grad_inputs=("RankTable",))
+def lod_tensor_to_array(ctx):
+    """Split packed X into per-timestep batches, sequences ordered by the
+    rank table (longest first) so the batch shrinks monotonically."""
+    x = ctx.input("X")
+    table: RankTable = ctx.input("RankTable")
+    off = np.asarray(table.offsets)
+    arr = TensorArray()
+    t_max = table.lengths[0] if table.items else 0
+    for t in range(t_max):
+        rows = [int(off[i]) + t for i, l in table.items if l > t]
+        arr.write(t, x[jnp.asarray(np.asarray(rows, np.int64))])
+    return {"Out": arr}
+
+
+@register_grad("lod_tensor_to_array")
+def lod_tensor_to_array_grad(ctx):
+    x = ctx.input("X")
+    table: RankTable = ctx.input("RankTable")
+    garr = ctx.input("Out@GRAD")
+    off = np.asarray(table.offsets)
+    gx = jnp.zeros_like(x)
+    if isinstance(garr, TensorArray):
+        for t, gv in enumerate(garr.vals):
+            if gv is None:
+                continue
+            rows = [int(off[i]) + t for i, l in table.items if l > t]
+            gx = gx.at[jnp.asarray(np.asarray(rows, np.int64))].add(
+                jnp.asarray(gv, gx.dtype))
+    return {"X@GRAD": gx}
+
+
+@register_op("array_to_lod_tensor", no_grad_inputs=("RankTable",))
+def array_to_lod_tensor(ctx):
+    """Inverse of lod_tensor_to_array: gather timestep batches back into
+    packed rows with the table's original lod."""
+    arr: TensorArray = ctx.input("X")
+    table: RankTable = ctx.input("RankTable")
+    off = np.asarray(table.offsets)
+    total = int(off[-1])
+    pieces, rows = [], []
+    for t, v in enumerate(arr.vals):
+        if v is None:
+            continue
+        active = [i for i, l in table.items if l > t]
+        pieces.append(v)
+        rows.extend(int(off[i]) + t for i in active)
+    cat = jnp.concatenate(pieces, axis=0)
+    inv = np.empty((total,), np.int64)
+    inv[np.asarray(rows, np.int64)] = np.arange(len(rows))
+    out = cat[jnp.asarray(inv)]
+    lod = (tuple(int(o) for o in off),)
+    return {"Out": out, "Out@LOD": [lod]}
+
+
+@register_grad("array_to_lod_tensor")
+def array_to_lod_tensor_grad(ctx):
+    arr: TensorArray = ctx.input("X")
+    table: RankTable = ctx.input("RankTable")
+    g = ctx.input("Out@GRAD")
+    off = np.asarray(table.offsets)
+    garr = TensorArray()
+    for t, v in enumerate(arr.vals):
+        if v is None:
+            continue
+        rows = [int(off[i]) + t for i, l in table.items if l > t]
+        garr.write(t, g[jnp.asarray(np.asarray(rows, np.int64))])
+    return {"X@GRAD": garr}
+
+
+@register_op("shrink_rnn_memory", no_grad_inputs=("I", "RankTable"))
+def shrink_rnn_memory(ctx):
+    """Slice memory rows down to the batch still active at step I
+    (ref: shrink_rnn_memory_op.cc)."""
+    x = ctx.input("X")
+    i = _concrete_idx(ctx.input("I"), "shrink_rnn_memory")
+    table: RankTable = ctx.input("RankTable")
+    n = table.num_active(i)
+    return {"Out": x[:n]}
+
+
+@register_grad("shrink_rnn_memory")
+def shrink_rnn_memory_grad(ctx):
+    x = ctx.input("X")
+    g = ctx.input("Out@GRAD")
+    n = g.shape[0]
+    gx = jnp.zeros_like(x)
+    return {"X@GRAD": gx.at[:n].set(jnp.asarray(g, x.dtype))}
+
+
+@register_op("reorder_lod_tensor_by_rank", no_grad_inputs=("RankTable",))
+def reorder_lod_tensor_by_rank(ctx):
+    """Reorder X's sequences into the rank table's order."""
+    x = ctx.input("X")
+    table: RankTable = ctx.input("RankTable")
+    lod = ctx.in_lod("X")
+    if lod:
+        off = np.asarray(lod[-1])
+        rows, out_len = [], []
+        for i in table.indices:
+            rows.extend(range(int(off[i]), int(off[i + 1])))
+            out_len.append(int(off[i + 1]) - int(off[i]))
+        out = x[jnp.asarray(np.asarray(rows, np.int64))]
+        out_lod = (tuple(np.concatenate([[0], np.cumsum(out_len)]).tolist()),)
+        return {"Out": out, "Out@LOD": [out_lod]}
+    idx = np.asarray(table.indices, np.int64)
+    return {"Out": x[jnp.asarray(idx)]}
+
+
+# ---------------------------------------------------------------------------
+# static (lod-free) array <-> tensor: the StaticRNN substrate.  The dynamic
+# analogues are lod_tensor_to_array/array_to_lod_tensor; these unstack along
+# a leading time axis instead (ref: StaticRNN's step scopes hold the same
+# per-step slices).
+# ---------------------------------------------------------------------------
+
+
+@register_op("tensor_array_unstack")
+def tensor_array_unstack(ctx):
+    x = ctx.input("X")
+    return {"Out": TensorArray([x[t] for t in range(x.shape[0])])}
+
+
+@register_grad("tensor_array_unstack")
+def tensor_array_unstack_grad(ctx):
+    x = ctx.input("X")
+    garr = ctx.input("Out@GRAD")
+    vals = []
+    for t in range(x.shape[0]):
+        g = garr.vals[t] if isinstance(garr, TensorArray) and \
+            t < len(garr.vals) and garr.vals[t] is not None else None
+        vals.append(jnp.zeros_like(x[t]) if g is None
+                    else jnp.asarray(g, x.dtype))
+    return {"X@GRAD": jnp.stack(vals)}
+
+
+@register_op("tensor_array_stack")
+def tensor_array_stack(ctx):
+    arr: TensorArray = ctx.input("X")
+    vals = [v for v in arr.vals if v is not None]
+    return {"Out": jnp.stack(vals)}
+
+
+@register_grad("tensor_array_stack")
+def tensor_array_stack_grad(ctx):
+    arr: TensorArray = ctx.input("X")
+    g = ctx.input("Out@GRAD")
+    garr = TensorArray()
+    j = 0
+    for t, v in enumerate(arr.vals):
+        if v is not None:
+            garr.write(t, g[j])
+            j += 1
+    return {"X@GRAD": garr}
+
+
+# ---------------------------------------------------------------------------
+# IfElse substrate: split/merge by mask (eager — data-dependent shapes)
+# ---------------------------------------------------------------------------
+
+
+@register_op("split_lod_tensor", no_grad_inputs=("Mask",))
+def split_lod_tensor(ctx):
+    x = ctx.input("X")
+    mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
+    lod = ctx.in_lod("X")
+    # Row-wise split equals the reference's sequence-level split whenever
+    # every sequence is a single row; only true multi-row sequences (or a
+    # nonzero level attr) need the unimplemented sequence-level path
+    # (split_lod_tensor_op.cc).
+    if int(ctx.attr("level", 0)) != 0:
+        raise NotImplementedError(
+            "split_lod_tensor: only level=0 splits are supported.")
+    if lod and np.any(np.diff(np.asarray(lod[-1])) != 1):
+        raise NotImplementedError(
+            "split_lod_tensor: sequence-level split of multi-row LoD "
+            "sequences is not supported; only row-wise split where each "
+            "sequence is one row. Ref: split_lod_tensor_op.cc.")
+    if mask.shape[0] != np.asarray(x).shape[0]:
+        raise ValueError(
+            f"split_lod_tensor: mask length {mask.shape[0]} != input rows "
+            f"{np.asarray(x).shape[0]}")
+    t_idx = np.nonzero(mask)[0]
+    f_idx = np.nonzero(~mask)[0]
+    return {"OutTrue": x[jnp.asarray(t_idx)],
+            "OutFalse": x[jnp.asarray(f_idx)]}
+
+
+@register_grad("split_lod_tensor")
+def split_lod_tensor_grad(ctx):
+    x = ctx.input("X")
+    mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
+    gx = jnp.zeros_like(x)
+    gt, gf = ctx.input("OutTrue@GRAD"), ctx.input("OutFalse@GRAD")
+    if gt is not None:
+        gx = gx.at[jnp.asarray(np.nonzero(mask)[0])].add(
+            jnp.asarray(gt, x.dtype))
+    if gf is not None:
+        gx = gx.at[jnp.asarray(np.nonzero(~mask)[0])].add(
+            jnp.asarray(gf, x.dtype))
+    return {"X@GRAD": gx}
+
+
+@register_op("merge_lod_tensor", no_grad_inputs=("Mask", "X"))
+def merge_lod_tensor(ctx):
+    mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
+    in_true, in_false = ctx.input("InTrue"), ctx.input("InFalse")
+    if int(ctx.attr("level", 0)) != 0:
+        raise NotImplementedError(
+            "merge_lod_tensor: only level=0 row-wise merge is supported.")
+    n_rows = (np.asarray(in_true).shape[0] + np.asarray(in_false).shape[0])
+    if mask.shape[0] != n_rows:
+        raise ValueError(
+            f"merge_lod_tensor: mask length {mask.shape[0]} != total rows "
+            f"{n_rows}")
+    shape = (len(mask),) + tuple(np.asarray(in_true).shape[1:])
+    out = jnp.zeros(shape, in_true.dtype)
+    out = out.at[jnp.asarray(np.nonzero(mask)[0])].set(in_true)
+    out = out.at[jnp.asarray(np.nonzero(~mask)[0])].set(in_false)
+    return {"Out": out}
+
+
+@register_grad("merge_lod_tensor")
+def merge_lod_tensor_grad(ctx):
+    mask = np.asarray(ctx.input("Mask")).reshape(-1).astype(bool)
+    g = ctx.input("Out@GRAD")
+    return {"InTrue@GRAD": g[jnp.asarray(np.nonzero(mask)[0])],
+            "InFalse@GRAD": g[jnp.asarray(np.nonzero(~mask)[0])]}
+
+
+# ---------------------------------------------------------------------------
+# beam search (eager)
+# ---------------------------------------------------------------------------
+
+
+@register_op("beam_search", no_grad_inputs=("pre_ids", "ids", "scores"))
+def beam_search(ctx):
+    """One beam-search step (ref: beam_search_op.cc).
+
+    TPU-native deviation: beams are FIXED-WIDTH (no pruning of ended
+    beams — they continue carrying end_id with frozen scores), the standard
+    static-shape formulation.  Inputs: pre_ids [batch*beam, 1],
+    ids/scores [batch*beam, K] candidates.  Outputs selected_ids/
+    selected_scores [batch*beam, 1] with a 2-level lod recording, per source
+    sentence, which parent beam each selected candidate came from.
+    """
+    pre_ids = np.asarray(ctx.input("pre_ids"))
+    pre_scores = ctx.input("pre_scores")
+    pre_scores = np.asarray(pre_scores) if pre_scores is not None else None
+    scores = np.asarray(ctx.input("scores"))
+    ids = ctx.input("ids")
+    ids = np.asarray(ids) if ids is not None else None
+    beam_size = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    lod = ctx.in_lod("ids") or ctx.in_lod("scores")
+    if lod:
+        src_off = lod[0]
+    else:
+        n_src = max(1, pre_ids.shape[0] // beam_size)
+        src_off = tuple(np.arange(n_src + 1) * beam_size)
+
+    sel_ids, sel_scores, parents = [], [], []
+    out_off = [0]
+    for s in range(len(src_off) - 1):
+        lo, hi = int(src_off[s]), int(src_off[s + 1])
+        cand = []  # (score, id, parent_row)
+        for row in range(lo, hi):
+            if int(pre_ids[row, 0]) == end_id:
+                # ended beam: sole candidate is end_id with the score it
+                # ended at (pre_scores), NOT re-accumulated step scores
+                frozen = float(pre_scores[row].reshape(-1)[0]) \
+                    if pre_scores is not None else float(scores[row].max())
+                cand.append((frozen, end_id, row))
+                continue
+            for k in range(scores.shape[1]):
+                cid = int(ids[row, k]) if ids is not None else k
+                cand.append((float(scores[row, k]), cid, row))
+        cand.sort(key=lambda t: -t[0])
+        top = cand[: beam_size]
+        for sc, cid, prow in top:
+            sel_ids.append(cid)
+            sel_scores.append(sc)
+            parents.append(prow)
+        out_off.append(out_off[-1] + len(top))
+
+    # level 1 = per-PARENT-ROW offsets over the output rows (the decode
+    # backtrack contract: searchsorted(level1, out_row) -> parent row)
+    n_prev = pre_ids.shape[0]
+    counts = np.zeros((n_prev,), np.int64)
+    for p in parents:
+        counts[p] += 1
+    par_off = np.concatenate([[0], np.cumsum(counts)])
+    lod_out = (tuple(int(o) for o in out_off),
+               tuple(int(o) for o in par_off))
+    res_ids = jnp.asarray(np.asarray(sel_ids, np.int64).reshape(-1, 1))
+    res_sc = jnp.asarray(np.asarray(sel_scores, np.float32).reshape(-1, 1))
+    out = {"selected_ids": res_ids, "selected_scores": res_sc,
+           "selected_ids@LOD": [lod_out], "selected_scores@LOD": [lod_out]}
+    if ctx.n_outputs("parent_idx"):
+        out["parent_idx"] = jnp.asarray(np.asarray(parents, np.int64))
+    return out
+
+
+@register_op("beam_search_decode", no_grad_inputs=("Ids", "Scores"))
+def beam_search_decode(ctx):
+    """Backtrack full hypotheses from per-step selected ids
+    (ref: beam_search_decode_op.cc).  Ids/Scores are TensorArrays whose
+    step lods carry parent offsets (level 1 = selection counts per parent
+    row)."""
+    ids_arr: TensorArray = ctx.input("Ids")
+    scores_arr: TensorArray = ctx.input("Scores")
+    end_id = int(ctx.attr("end_id", -1))
+    steps = []
+    for t in range(len(ids_arr.vals)):
+        ids_t = np.asarray(ids_arr.vals[t]).reshape(-1)
+        sc_t = np.asarray(scores_arr.vals[t]).reshape(-1)
+        lod_t = ids_arr.lods[t]
+        steps.append((ids_t, sc_t, lod_t))
+
+    # reconstruct parent chains: at each step, lod level-1 maps selected
+    # rows to parent rows of the previous step.  Per the reference output
+    # contract (beam_search_decode_op.h), SentenceScores carries the
+    # per-step score along each backtracked chain (not the final score
+    # repeated), and each source's hypotheses are sorted best-first.
+    n_final = len(steps[-1][0]) if steps else 0
+    final_lod = steps[-1][2] if steps else None
+    if final_lod and len(final_lod) >= 1 and len(final_lod[0]) > 1:
+        src_off = [int(o) for o in final_lod[0]]
+    else:
+        src_off = [0, n_final]
+
+    groups = []  # per source: list of (final_score, chain_ids, chain_scores)
+    for s in range(len(src_off) - 1):
+        group = []
+        for j in range(src_off[s], src_off[s + 1]):
+            chain, chain_sc = [], []
+            row = j
+            for t in range(len(steps) - 1, -1, -1):
+                ids_t, sc_t, lod_t = steps[t]
+                chain.append(int(ids_t[row]))
+                chain_sc.append(float(sc_t[row]))
+                if lod_t and len(lod_t) > 1:
+                    par_off = lod_t[1]
+                    row = int(np.searchsorted(np.asarray(par_off), row,
+                                              side="right") - 1)
+            chain.reverse()
+            chain_sc.reverse()
+            if end_id >= 0 and end_id in chain:
+                k = chain.index(end_id) + 1
+                chain, chain_sc = chain[:k], chain_sc[:k]
+            group.append((float(steps[-1][1][j]), chain, chain_sc))
+        group.sort(key=lambda t: -t[0])
+        groups.append(group)
+
+    flat_ids = [t for g in groups for _, h, _ in g for t in h]
+    flat_sc = [s for g in groups for _, _, hs in g for s in hs]
+    lens = [len(h) for g in groups for _, h, _ in g]
+    off = tuple(np.concatenate([[0], np.cumsum(lens)]).astype(int).tolist())
+    src_counts = np.concatenate([[0], np.cumsum([len(g) for g in groups])])
+    lod = (tuple(int(o) for o in src_counts), off)
+    out_ids = jnp.asarray(np.asarray(flat_ids, np.int64).reshape(-1, 1))
+    out_sc = jnp.asarray(np.asarray(flat_sc, np.float32).reshape(-1, 1))
+    return {"SentenceIds": out_ids, "SentenceScores": out_sc,
+            "SentenceIds@LOD": [lod], "SentenceScores@LOD": [lod]}

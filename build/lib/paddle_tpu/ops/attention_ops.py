@@ -1,0 +1,53 @@
+"""Attention ops — including sequence-parallel ring attention, a
+first-class TPU capability the reference lacks (SURVEY.md §5.7: SP/CP
+"Absent"; its sequence story is LoD packing on one device).
+
+``ring_attention`` is mesh-aware: traced under a ShardedTrainStep whose
+mesh has an "sp" axis, it runs the ppermute ring (parallel/ring_attention
+.py) over ICI; traced single-device (plain Executor) it degrades to the
+mathematically identical full-softmax attention, so programs are portable
+across places — the same portability contract the reference gives ops via
+per-place kernels (op_registry.h OpKernelType).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("ring_attention")
+def ring_attention_op(ctx):
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")  # [B, H, T, D]
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
+    causal = ctx.attr("causal", False)
+    sp_axis = ctx.attr("sp_axis", "sp")
+    scale = ctx.attr("scale", 0.0) or None
+    from ..parallel import ring_attention as ra
+    from ..parallel import spmd
+
+    mesh = spmd.active_mesh()
+    if mesh is not None and sp_axis in mesh.axis_names \
+            and mesh.shape[sp_axis] > 1:
+        out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale,
+                                bias=bias)
+    elif bias is None and _use_flash():
+        from .pallas_flash import flash_attention
+
+        out = flash_attention(q, k, v, scale, causal)
+    else:
+        out = ra.full_attention(q, k, v, causal, scale, bias=bias)
+    return {"Out": out}
+
+
+def _use_flash() -> bool:
+    """Opt-in Pallas flash-attention kernel (PADDLE_TPU_FLASH=1).
+
+    Off by default because tunneled TPU transports (axon remote-compile)
+    cannot compile Mosaic kernels; on a real TPU VM the kernel compiles
+    natively and streams K/V through VMEM (ops/pallas_flash.py)."""
+    import os
+
+    return os.environ.get("PADDLE_TPU_FLASH", "").strip().lower() \
+        in ("1", "true")

@@ -1,0 +1,505 @@
+"""Detection op family (ref: paddle/fluid/operators/detection/ —
+prior_box_op.h, box_coder_op.h, iou_similarity_op.h, bipartite_match_op.cc,
+target_assign_op.h, multiclass_nms_op.cc, roi_pool_op.*, and
+polygon_box_transform_op.cc, anchor_generator_op.h).
+
+TPU design notes:
+ - prior/anchor generation is attr-static: the per-prior (w, h) table is
+   built on host at trace time, only the center grid is device math.
+ - bipartite_match is a greedy global-argmax loop; the reference pins it to
+   CPU (bipartite_match_op.cc GetExpectedKernelType), here it is a
+   ``lax.fori_loop`` over rows with masked argmax — stays inside the jitted
+   program, no host round-trip.
+ - multiclass_nms produces a data-dependent number of boxes (LoD output),
+   which no static-shape program can express — it runs as an EAGER host op
+   (the executor's two-tier fallback), matching its role as a CPU
+   postprocessing op in the reference.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+NO_GRAD = object()
+
+
+# ---------------------------------------------------------------------------
+# prior_box / anchor generation
+# ---------------------------------------------------------------------------
+
+
+def _expand_aspect_ratios(ratios, flip):
+    out = [1.0]
+    for ar in ratios or []:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def _prior_whs(min_sizes, max_sizes, aspect_ratios, min_max_order):
+    """Host-side per-prior (half_w, half_h) table (ref prior_box_op.h:104+:
+    the ordering differs under min_max_aspect_ratios_order)."""
+    whs = []
+    for s, mn in enumerate(min_sizes):
+        if min_max_order:
+            whs.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = math.sqrt(mn * max_sizes[s]) / 2.0
+                whs.append((m, m))
+            for ar in aspect_ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * math.sqrt(ar) / 2.0,
+                            mn / math.sqrt(ar) / 2.0))
+        else:
+            for ar in aspect_ratios:
+                whs.append((mn * math.sqrt(ar) / 2.0,
+                            mn / math.sqrt(ar) / 2.0))
+            if max_sizes:
+                m = math.sqrt(mn * max_sizes[s]) / 2.0
+                whs.append((m, m))
+    return whs
+
+
+@register_op("prior_box", no_grad_inputs=("Input", "Image"))
+def prior_box(ctx):
+    feat, image = ctx.input("Input"), ctx.input("Image")
+    min_sizes = [float(v) for v in ctx.attr("min_sizes")]
+    max_sizes = [float(v) for v in (ctx.attr("max_sizes") or [])]
+    aspect_ratios = _expand_aspect_ratios(ctx.attr("aspect_ratios") or [],
+                                          ctx.attr("flip", False))
+    variances = [float(v) for v in ctx.attr("variances") or
+                 [0.1, 0.1, 0.2, 0.2]]
+    clip = ctx.attr("clip", False)
+    offset = ctx.attr("offset", 0.5)
+    img_h, img_w = image.shape[2], image.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    step_w = ctx.attr("step_w", 0.0) or img_w / fw
+    step_h = ctx.attr("step_h", 0.0) or img_h / fh
+    whs = _prior_whs(min_sizes, max_sizes, aspect_ratios,
+                     ctx.attr("min_max_aspect_ratios_order", False))
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w  # [fw]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h  # [fh]
+    half = jnp.asarray(whs, jnp.float32)  # [P, 2] (half_w, half_h)
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, half.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, half.shape[0]))
+    boxes = jnp.stack([(cxg - half[:, 0]) / img_w,
+                       (cyg - half[:, 1]) / img_h,
+                       (cxg + half[:, 0]) / img_w,
+                       (cyg + half[:, 1]) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("anchor_generator", no_grad_inputs=("Input",))
+def anchor_generator(ctx):
+    """ref: anchor_generator_op.h — RPN-style anchors in IMAGE coordinates
+    (unnormalized, unlike prior_box)."""
+    feat = ctx.input("Input")
+    sizes = [float(v) for v in ctx.attr("anchor_sizes")]
+    ratios = [float(v) for v in ctx.attr("aspect_ratios") or [1.0]]
+    variances = [float(v) for v in ctx.attr("variances") or
+                 [0.1, 0.1, 0.2, 0.2]]
+    stride = [float(v) for v in ctx.attr("stride")]
+    offset = ctx.attr("offset", 0.5)
+    fh, fw = feat.shape[2], feat.shape[3]
+
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / r
+            base_w = round(math.sqrt(area_ratios))
+            base_h = round(base_w * r)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            whs.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+    half = jnp.asarray(whs, jnp.float32)
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, half.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, half.shape[0]))
+    anchors = jnp.stack([cxg - half[:, 0], cyg - half[:, 1],
+                         cxg + half[:, 0], cyg + half[:, 1]], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return {"Anchors": anchors, "Variances": var}
+
+
+# ---------------------------------------------------------------------------
+# box_coder / iou_similarity
+# ---------------------------------------------------------------------------
+
+
+def _center_size(boxes, norm_off):
+    w = boxes[..., 2] - boxes[..., 0] + norm_off
+    h = boxes[..., 3] - boxes[..., 1] + norm_off
+    cx = (boxes[..., 2] + boxes[..., 0]) / 2
+    cy = (boxes[..., 3] + boxes[..., 1]) / 2
+    return cx, cy, w, h
+
+
+@register_op("box_coder", no_grad_inputs=("PriorBox", "PriorBoxVar",
+                                          "TargetBox"))
+def box_coder(ctx):
+    prior = ctx.input("PriorBox")       # [M, 4]
+    pvar = ctx.input("PriorBoxVar")     # [M, 4] or None
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    norm = ctx.attr("box_normalized", True)
+    off = 0.0 if norm else 1.0
+    pcx, pcy, pw, ph = _center_size(prior, off)
+    if code_type == "encode_center_size":
+        # target [N, 4] -> out [N, M, 4]
+        tcx, tcy, tw, th = _center_size(target, off)
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+    else:
+        # decode: target [N, M, 4] deltas -> boxes
+        t = target
+        if pvar is not None:
+            t = t * pvar[None, :, :]
+        tcx = t[..., 0] * pw + pcx
+        tcy = t[..., 1] * ph + pcy
+        tw = jnp.exp(t[..., 2]) * pw
+        th = jnp.exp(t[..., 3]) * ph
+        out = jnp.stack([tcx - tw / 2, tcy - th / 2,
+                         tcx + tw / 2 - off, tcy + th / 2 - off], axis=-1)
+    return {"OutputBox": out}
+
+
+def iou_matrix(a, b, normalized=True):
+    """[N,4] x [M,4] -> [N,M] IoU (ref: iou_similarity_op.h IOUSimilarity)."""
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    ix0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix1 - ix0 + off, 0.0)
+    ih = jnp.maximum(iy1 - iy0 + off, 0.0)
+    inter = iw * ih
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", no_grad_inputs=("X", "Y"))
+def iou_similarity(ctx):
+    return {"Out": iou_matrix(ctx.input("X"), ctx.input("Y"),
+                              ctx.attr("box_normalized", True))}
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match / target_assign
+# ---------------------------------------------------------------------------
+
+
+def _bipartite_match_one(dist):
+    """Greedy global-max matching (ref bipartite_match_op.cc:104 — pick the
+    best (row, col) among unmatched rows/cols, repeat; dist<=eps never
+    matches).  Returns (col_to_row [-1 unmatched], col_dist)."""
+    rows, cols = dist.shape
+    eps = 1e-6
+
+    def body(_, carry):
+        col_to_row, col_dist, row_used = carry
+        masked = jnp.where(row_used[:, None] | (col_to_row[None, :] >= 0),
+                           -jnp.inf, dist)
+        masked = jnp.where(masked < eps, -jnp.inf, masked)
+        flat = jnp.argmax(masked)
+        i, j = flat // cols, flat % cols
+        ok = masked[i, j] > -jnp.inf
+        col_to_row = jnp.where(
+            ok, col_to_row.at[j].set(i.astype(col_to_row.dtype)),
+            col_to_row)
+        col_dist = jnp.where(ok, col_dist.at[j].set(dist[i, j]), col_dist)
+        row_used = jnp.where(ok, row_used.at[i].set(True), row_used)
+        return col_to_row, col_dist, row_used
+
+    init = (jnp.full((cols,), -1, jnp.int32),
+            jnp.zeros((cols,), dist.dtype),
+            jnp.zeros((rows,), bool))
+    col_to_row, col_dist, _ = jax.lax.fori_loop(0, min(rows, cols), body, init)
+    return col_to_row, col_dist
+
+
+@register_op("bipartite_match", no_grad_inputs=("DistMat",))
+def bipartite_match(ctx):
+    dist = ctx.input("DistMat")
+    lod = ctx.in_lod("DistMat")
+    match_type = ctx.attr("match_type", "bipartite")
+    overlap_threshold = ctx.attr("dist_threshold", 0.5)
+    if lod:
+        offsets = lod[-1]
+        segments = [(int(offsets[i]), int(offsets[i + 1]))
+                    for i in range(len(offsets) - 1)]
+    else:
+        segments = [(0, dist.shape[0])]
+    idx_rows, dist_rows = [], []
+    for s, e in segments:
+        c2r, cd = _bipartite_match_one(dist[s:e])
+        if match_type == "per_prediction":
+            # additionally match unmatched cols to their argmax row when
+            # overlap exceeds the threshold (ref :151 ArgMaxMatch)
+            best_row = jnp.argmax(dist[s:e], axis=0).astype(jnp.int32)
+            best = jnp.max(dist[s:e], axis=0)
+            extra = (c2r < 0) & (best >= overlap_threshold)
+            c2r = jnp.where(extra, best_row, c2r)
+            cd = jnp.where(extra, best, cd)
+        idx_rows.append(c2r)
+        dist_rows.append(cd)
+    return {"ColToRowMatchIndices": jnp.stack(idx_rows),
+            "ColToRowMatchDist": jnp.stack(dist_rows)}
+
+
+@register_op("target_assign", no_grad_inputs=("X", "MatchIndices",
+                                              "NegIndices"))
+def target_assign(ctx):
+    x = ctx.input("X")                   # [sum_rows, P, K] (LoD rows)
+    match = ctx.input("MatchIndices")    # [N, M] int32, -1 = mismatch
+    mismatch_value = ctx.attr("mismatch_value", 0)
+    lod = ctx.in_lod("X")
+    n, m = match.shape
+    k = x.shape[-1]
+    p = x.shape[1]
+    offsets = lod[-1] if lod else tuple(range(n + 1))
+    off = jnp.asarray([int(offsets[i]) for i in range(n)])[:, None]  # [N,1]
+    w_off = jnp.arange(m) % p
+    safe = jnp.maximum(match, 0)
+    rows = off + safe                    # [N, M] row into x
+    gathered = x[rows, w_off[None, :], :]          # [N, M, K]
+    matched = (match > -1)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    wt = matched.astype(jnp.float32)
+    neg = ctx.input("NegIndices")
+    if neg is not None and tuple(neg.shape) == tuple(wt.shape[:2]):
+        # mask form (mine_hard_examples emits a same-shape [N, M] 0/1
+        # selection): selected negatives get weight 1, targets stay
+        # mismatch_value
+        wt = jnp.where(neg.astype(bool)[..., None], 1.0, wt)
+    elif neg is not None:
+        # padded-index form with LoD (ref target_assign_op.h
+        # NegTargetAssignFunctor): rows map to images via the LoD
+        neg_lod = ctx.in_lod("NegIndices")
+        noff = neg_lod[-1] if neg_lod else (0, int(neg.shape[0]))
+        nidx = neg.reshape(-1).astype(jnp.int32)
+        batch = jnp.concatenate([
+            jnp.full((int(noff[i + 1]) - int(noff[i]),), i, jnp.int32)
+            for i in range(len(noff) - 1)]) if len(noff) > 1 \
+            else jnp.zeros_like(nidx)
+        wt = wt.at[batch, nidx].set(1.0)
+    return {"Out": out, "OutWeight": wt}
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms — eager host op (data-dependent output count)
+# ---------------------------------------------------------------------------
+
+
+def _nms_one(boxes, scores, score_threshold, nms_top_k, nms_threshold,
+             eta, normalized=True):
+    """Single-class hard-NMS on host numpy (ref multiclass_nms_op.cc:66)."""
+    keep = []
+    idx = np.argsort(-scores)
+    idx = idx[scores[idx] > score_threshold]
+    if nms_top_k > -1:
+        idx = idx[:nms_top_k]
+    adaptive = nms_threshold
+    sel = list(idx)
+    out = []
+    while sel:
+        i = sel.pop(0)
+        out.append(i)
+        if not sel:
+            break
+        a = boxes[i]
+        rest = np.array(sel)
+        b = boxes[rest]
+        off = 0.0 if normalized else 1.0
+        ix0 = np.maximum(a[0], b[:, 0]); iy0 = np.maximum(a[1], b[:, 1])
+        ix1 = np.minimum(a[2], b[:, 2]); iy1 = np.minimum(a[3], b[:, 3])
+        iw = np.maximum(ix1 - ix0 + off, 0); ih = np.maximum(iy1 - iy0 + off, 0)
+        inter = iw * ih
+        area_a = (a[2] - a[0] + off) * (a[3] - a[1] + off)
+        area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+        iou = np.where(area_a + area_b - inter > 0,
+                       inter / (area_a + area_b - inter), 0)
+        sel = [s for s, v in zip(rest, iou) if v <= adaptive]
+        if eta < 1 and adaptive > 0.5:
+            adaptive *= eta
+    return out
+
+
+@register_op("multiclass_nms", no_grad_inputs=("BBoxes", "Scores"))
+def multiclass_nms(ctx):
+    """Host (eager) op.  BBoxes [N, M, 4], Scores [N, C, M] ->
+    LoD output [num_kept, 6] = (label, score, x0, y0, x1, y1) per image
+    (ref: multiclass_nms_op.cc MultiClassOutput)."""
+    bboxes = np.asarray(ctx.input("BBoxes"))
+    scores = np.asarray(ctx.input("Scores"))
+    bg = ctx.attr("background_label", 0)
+    score_threshold = ctx.attr("score_threshold", 0.0)
+    nms_top_k = ctx.attr("nms_top_k", -1)
+    nms_threshold = ctx.attr("nms_threshold", 0.3)
+    eta = ctx.attr("nms_eta", 1.0)
+    keep_top_k = ctx.attr("keep_top_k", -1)
+    normalized = ctx.attr("normalized", True)
+
+    all_out, lod = [], [0]
+    for n in range(bboxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == bg:
+                continue
+            kept = _nms_one(bboxes[n], scores[n, c], score_threshold,
+                            nms_top_k, nms_threshold, eta, normalized)
+            for i in kept:
+                dets.append((scores[n, c, i], c, i))
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            dets.sort(key=lambda t: -t[0])
+            dets = dets[:keep_top_k]
+        for score, c, i in dets:
+            all_out.append([float(c), float(score)] + list(bboxes[n, i]))
+        lod.append(len(all_out))
+    if not all_out:
+        out = np.zeros((1, 1), np.float32)
+        out[0, 0] = -1.0
+        return {"Out": out, "Out@LOD": [(tuple(lod),)]}
+    return {"Out": np.asarray(all_out, np.float32),
+            "Out@LOD": [(tuple(lod),)]}
+
+
+# ---------------------------------------------------------------------------
+# roi_pool / polygon_box_transform
+# ---------------------------------------------------------------------------
+
+
+@register_op("roi_pool", no_grad_inputs=("ROIs",))
+def roi_pool(ctx):
+    """ref: roi_pool_op.* — max-pool each ROI into pooled_h x pooled_w.
+    Vectorized as a masked max over the full feature map per output bin."""
+    x = ctx.input("X")          # [N, C, H, W]
+    rois = ctx.input("ROIs")    # [R, 4] (x0, y0, x1, y1), LoD maps roi->image
+    scale = ctx.attr("spatial_scale", 1.0)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    lod = ctx.in_lod("ROIs")
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    if lod:
+        offsets = lod[-1]
+        batch_of_roi = np.zeros((r,), np.int32)
+        for i in range(len(offsets) - 1):
+            batch_of_roi[int(offsets[i]): int(offsets[i + 1])] = i
+        batch_of_roi = jnp.asarray(batch_of_roi)
+    else:
+        batch_of_roi = jnp.zeros((r,), jnp.int32)
+
+    x0 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y0 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x1 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    rh = jnp.maximum(y1 - y0 + 1, 1)
+    rw = jnp.maximum(x1 - x0 + 1, 1)
+
+    iy = jnp.arange(h)
+    ix = jnp.arange(w)
+
+    def one_roi(b, xx0, yy0, rrh, rrw):
+        img = x[b]  # [C, H, W]
+        # bin boundaries (ref: floor/ceil of fractional bin edges)
+        py = jnp.arange(ph)
+        px = jnp.arange(pw)
+        hstart = yy0 + jnp.floor(py * rrh / ph).astype(jnp.int32)
+        hend = yy0 + jnp.ceil((py + 1) * rrh / ph).astype(jnp.int32)
+        wstart = xx0 + jnp.floor(px * rrw / pw).astype(jnp.int32)
+        wend = xx0 + jnp.ceil((px + 1) * rrw / pw).astype(jnp.int32)
+        hmask = (iy[None, :] >= jnp.clip(hstart, 0, h)[:, None]) & \
+                (iy[None, :] < jnp.clip(hend, 0, h)[:, None])   # [ph, H]
+        wmask = (ix[None, :] >= jnp.clip(wstart, 0, w)[:, None]) & \
+                (ix[None, :] < jnp.clip(wend, 0, w)[:, None])   # [pw, W]
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]   # [ph,pw,H,W]
+        vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(-1, -2))                      # [C, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(one_roi)(batch_of_roi, x0, y0, rh, rw)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("polygon_box_transform", no_grad_inputs=("Input",))
+def polygon_box_transform(ctx):
+    """ref: polygon_box_transform_op.cc — per-pixel quad offsets to absolute
+    coords: odd channels add 4*x of the pixel column, even add 4*y of row
+    (channel pairs are (x, y) offsets)."""
+    x = ctx.input("Input")  # [N, C(=8), H, W]
+    n, c, h, w = x.shape
+    col = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    row = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, 4 * col, 4 * row)
+    return {"Output": base - x}
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples
+# ---------------------------------------------------------------------------
+
+
+@register_op("mine_hard_examples",
+             no_grad_inputs=("ClsLoss", "LocLoss", "MatchIndices",
+                             "MatchDist"))
+def mine_hard_examples(ctx):
+    """ref: mine_hard_examples_op.cc (max_negative mining): rank negatives
+    by loss, keep neg_pos_ratio * num_pos per sample; outputs the updated
+    match indices (hard negatives stay -1, easy negatives set to -2 ... the
+    reference emits NegIndices LoD; here we emit a same-shape mask form
+    UpdatedMatchIndices + NegIndices as a padded [N, max_neg] index tensor
+    with LoD)."""
+    cls_loss = ctx.input("ClsLoss")         # [N, M]
+    loc_loss = ctx.input("LocLoss")
+    match = ctx.input("MatchIndices")       # [N, M]
+    match_dist = ctx.input("MatchDist")
+    neg_ratio = ctx.attr("neg_pos_ratio", 1.0)
+    neg_dist_threshold = ctx.attr("neg_dist_threshold", 0.5)
+    mining = ctx.attr("mining_type", "max_negative")
+    if mining != "max_negative":
+        raise NotImplementedError("only max_negative mining is supported")
+    loss = cls_loss if loc_loss is None else cls_loss + \
+        (loc_loss if ctx.attr("sample_size", 0) else 0 * loc_loss)
+    n, m = match.shape
+    is_neg = match < 0
+    if match_dist is not None:
+        # ref mine_hard_examples_op.h: a prior only qualifies as a
+        # negative candidate when its best overlap is BELOW the
+        # neg_dist_threshold — semi-overlapping priors are ignored
+        is_neg = is_neg & (match_dist < neg_dist_threshold)
+    num_pos = jnp.sum(match >= 0, axis=1)
+    num_neg = jnp.minimum((num_pos * neg_ratio).astype(jnp.int32),
+                          jnp.sum(is_neg, axis=1))
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)            # hardest first
+    rank = jnp.argsort(order, axis=1)
+    selected = rank < num_neg[:, None]                # [N, M] hard negatives
+    updated = jnp.where(is_neg & ~selected, -2, match)  # -2: ignored easy neg
+    return {"UpdatedMatchIndices": updated, "NegIndices": selected}

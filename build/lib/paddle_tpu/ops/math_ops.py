@@ -1,0 +1,257 @@
+"""Dense math ops (ref families: paddle/fluid/operators mul_op.*, matmul_op.cc,
+elementwise_*, sum_op, scale_op, cast_op, clip_op, compare_op, logical_op).
+
+Each impl is a pure JAX function; XLA maps matmuls onto the MXU directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _flatten2(x, num_col_dims):
+    """Fold leading dims: paddle's mul op flattens x to 2-D at num_col_dims."""
+    shape = x.shape
+    lead = 1
+    for d in shape[:num_col_dims]:
+        lead *= d
+    rest = 1
+    for d in shape[num_col_dims:]:
+        rest *= d
+    return x.reshape(lead, rest)
+
+
+@register_op("mul")
+def mul(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    x2 = _flatten2(x, xnc)
+    y2 = _flatten2(y, ync)
+    from ..fluid import amp
+
+    x2, y2, back = amp.cast_operands(x2, y2)
+    out = amp.restore_astype(jnp.matmul(x2, y2), back)
+    # restore leading dims of x and trailing dims of y
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("matmul")
+def matmul(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    alpha = ctx.attr("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    from ..fluid import amp
+
+    x, y, back = amp.cast_operands(x, y)
+    out = amp.restore_astype(jnp.matmul(x, y), back)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+def _bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: y's dims align to x starting at `axis`
+    (ref: elementwise_op_function.h).  axis=-1 means trailing alignment,
+    which matches numpy broadcasting directly."""
+    if y.ndim == x.ndim or y.ndim == 0:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _elementwise(name, fn):
+    @register_op(name)
+    def _impl(ctx, _fn=fn):
+        x, y = ctx.input("X"), ctx.input("Y")
+        y = _bcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": _fn(x, y)}
+    return _impl
+
+
+_elementwise("elementwise_add", jnp.add)
+_elementwise("elementwise_sub", jnp.subtract)
+_elementwise("elementwise_mul", jnp.multiply)
+_elementwise("elementwise_div", jnp.divide)
+_elementwise("elementwise_max", jnp.maximum)
+_elementwise("elementwise_min", jnp.minimum)
+_elementwise("elementwise_pow", jnp.power)
+_elementwise("elementwise_mod", jnp.mod)
+_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+@register_op("scale")
+def scale(ctx):
+    x = ctx.input("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    after = ctx.attr("bias_after_scale", True)
+    out = x * s + b if after else (x + b) * s
+    return {"Out": out}
+
+
+@register_op("sum")
+def sum_op(ctx):
+    from ..fluid.selected_rows import SelectedRows
+
+    xs = [v for v in ctx.inputs_list("X") if v is not None]
+    sparse = [v for v in xs if isinstance(v, SelectedRows)]
+    if sparse:
+        if len(sparse) == len(xs):
+            # all-sparse: concatenation IS the sum (ref: sum over
+            # SelectedRows, math/selected_rows_functor.h Add)
+            out = sparse[0]
+            for v in sparse[1:]:
+                out = out.merge_with(v)
+            return {"Out": out}
+        # mixed: densify the sparse parts into the dense accumulator
+        dense = [v for v in xs if not isinstance(v, SelectedRows)]
+        out = dense[0]
+        for v in dense[1:]:
+            out = out + v
+        for v in sparse:
+            out = out.at[v.rows].add(v.values.astype(out.dtype))
+        return {"Out": out}
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    return {"Out": out}
+
+
+@register_op("mean")
+def mean(ctx):
+    # Fluid's mean outputs shape [1], not a 0-d scalar (ref: mean_op.cc)
+    return {"Out": jnp.mean(ctx.input("X")).reshape(1)}
+
+
+@register_op("cast", no_grad_inputs=())
+def cast(ctx):
+    from ..fluid import core as _core
+
+    dt = _core.np_dtype(ctx.attr("out_dtype", ctx.attr("dtype", "float32")))
+    # .astype preserves host-ness: numpy in -> numpy out (counter path)
+    return {"Out": ctx.input("X").astype(dt)}
+
+
+@register_op("clip")
+def clip(ctx):
+    return {"Out": jnp.clip(ctx.input("X"), ctx.attr("min"), ctx.attr("max"))}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx):
+    x = ctx.input("X")
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale.astype(x.dtype)}
+
+
+def _host(*vals):
+    """True when every value is a host (numpy) array — the counter path.
+    Host values stay concrete through jit traces (see fill_constant's
+    force_cpu), so loop conditions computed from them can drive trace-time
+    unrolling of while sub-blocks."""
+    import numpy as np
+
+    return all(isinstance(v, np.ndarray) for v in vals)
+
+
+def _compare(name, fn, npfn):
+    @register_op(name, no_grad_inputs=("X", "Y"))
+    def _impl(ctx, _fn=fn, _npfn=npfn):
+        x, y = ctx.input("X"), ctx.input("Y")
+        if _host(x, y):
+            return {"Out": _npfn(x, y)}
+        y = _bcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": _fn(x, y)}
+    return _impl
+
+
+import numpy as _np  # noqa: E402
+
+_compare("less_than", jnp.less, _np.less)
+_compare("less_equal", jnp.less_equal, _np.less_equal)
+_compare("greater_than", jnp.greater, _np.greater)
+_compare("greater_equal", jnp.greater_equal, _np.greater_equal)
+_compare("equal", jnp.equal, _np.equal)
+_compare("not_equal", jnp.not_equal, _np.not_equal)
+
+
+def _logical(name, fn, npfn, binary=True):
+    if binary:
+        @register_op(name, no_grad_inputs=("X", "Y"))
+        def _impl(ctx, _fn=fn, _npfn=npfn):
+            x, y = ctx.input("X"), ctx.input("Y")
+            return {"Out": _npfn(x, y) if _host(x, y) else _fn(x, y)}
+    else:
+        @register_op(name, no_grad_inputs=("X",))
+        def _impl(ctx, _fn=fn, _npfn=npfn):
+            x = ctx.input("X")
+            return {"Out": _npfn(x) if _host(x) else _fn(x)}
+    return _impl
+
+
+_logical("logical_and", jnp.logical_and, _np.logical_and)
+_logical("logical_or", jnp.logical_or, _np.logical_or)
+_logical("logical_xor", jnp.logical_xor, _np.logical_xor)
+_logical("logical_not", jnp.logical_not, _np.logical_not, binary=False)
+
+
+@register_op("isfinite", no_grad_inputs=("X",))
+def isfinite(ctx):
+    return {"Out": jnp.all(jnp.isfinite(ctx.input("X"))).reshape(1)}
+
+
+@register_op("has_inf", no_grad_inputs=("X",))
+def has_inf(ctx):
+    return {"Out": jnp.any(jnp.isinf(ctx.input("X"))).reshape(1)}
+
+
+@register_op("has_nan", no_grad_inputs=("X",))
+def has_nan(ctx):
+    return {"Out": jnp.any(jnp.isnan(ctx.input("X"))).reshape(1)}
+
+
+@register_op("sign")
+def sign(ctx):
+    return {"Out": jnp.sign(ctx.input("X"))}
+
+
+@register_op("increment")
+def increment(ctx):
+    x = ctx.input("X")
+    step = ctx.attr("step", 1.0)
+    if _host(x):
+        return {"Out": _np.asarray(x + step).astype(x.dtype)}
+    return {"Out": (x + step).astype(x.dtype)}
+
+
+@register_op("maximum")
+def maximum(ctx):
+    return {"Out": jnp.maximum(ctx.input("X"), ctx.input("Y"))}
+
+
+@register_op("minimum")
+def minimum(ctx):
+    return {"Out": jnp.minimum(ctx.input("X"), ctx.input("Y"))}
+
+
+@register_op("dot")
+def dot(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}

@@ -1,0 +1,176 @@
+"""Metric ops (ref: accuracy_op.*, auc_op.*, mean_iou_op, precision_recall)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("accuracy", no_grad_inputs=("Out", "Indices", "Label"))
+def accuracy(ctx):
+    indices = ctx.input("Indices")  # [N, k] top-k indices
+    label = ctx.input("Label")      # [N, 1]
+    if label.ndim == 2:
+        label = label.reshape(-1)
+    hit = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.array(indices.shape[0], jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": acc.reshape(1), "Correct": correct.reshape(1),
+            "Total": total.reshape(1)}
+
+
+@register_op("auc", no_grad_inputs=("Predict", "Label", "StatPos", "StatNeg"))
+def auc(ctx):
+    """Streaming AUC over histogram buckets (ref: auc_op.h)."""
+    predict = ctx.input("Predict")  # [N, 2] probs
+    label = ctx.input("Label").reshape(-1)
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    num_thresholds = ctx.attr("num_thresholds", 4095)
+    pos_prob = predict[:, -1]
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (label > 0)
+    stat_pos = stat_pos.at[bucket].add(is_pos.astype(stat_pos.dtype))
+    stat_neg = stat_neg.at[bucket].add((~is_pos).astype(stat_neg.dtype))
+    # integrate: iterate buckets from high threshold to low
+    pos_cum = jnp.cumsum(stat_pos[::-1])
+    neg_cum = jnp.cumsum(stat_neg[::-1])
+    tot_pos = pos_cum[-1]
+    tot_neg = neg_cum[-1]
+    # trapezoid area between consecutive operating points
+    prev_pos = jnp.concatenate([jnp.zeros(1, pos_cum.dtype), pos_cum[:-1]])
+    prev_neg = jnp.concatenate([jnp.zeros(1, neg_cum.dtype), neg_cum[:-1]])
+    area = jnp.sum((neg_cum - prev_neg) * (pos_cum + prev_pos) / 2.0)
+    auc_val = jnp.where((tot_pos > 0) & (tot_neg > 0),
+                        area / jnp.maximum(tot_pos * tot_neg, 1e-12), 0.0)
+    return {"AUC": auc_val.reshape(1).astype(jnp.float64)
+            if auc_val.dtype == jnp.float64 else auc_val.reshape(1),
+            "StatPosOut": stat_pos, "StatNegOut": stat_neg}
+
+
+@register_op("mean_iou", no_grad_inputs=("Predictions", "Labels"))
+def mean_iou(ctx):
+    pred = ctx.input("Predictions").reshape(-1).astype(jnp.int32)
+    label = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    n = ctx.attr("num_classes")
+    conf = jnp.zeros((n, n), jnp.float32).at[label, pred].add(1.0)
+    inter = jnp.diag(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {"OutMeanIou": miou.reshape(1), "OutWrong": (conf.sum(1) - inter),
+            "OutCorrect": inter}
+
+
+@register_op("positive_negative_pair",
+             no_grad_inputs=("Score", "Label", "QueryID", "Weight",
+                             "AccumulatePositivePair",
+                             "AccumulateNegativePair",
+                             "AccumulateNeutralPair"))
+def positive_negative_pair(ctx):
+    """Ranking-pair metric (ref: positive_negative_pair_op.h): within each
+    query, every differently-labeled doc pair is positive when score order
+    agrees with label order.  Reference-exact semantics incl. its
+    equal-score behavior (counts as neutral AND negative) and per-pair
+    weight (w_i + w_j)/2.  O(n^2) masks instead of the reference's per-
+    query hash map — static shapes for XLA."""
+    score = ctx.input("Score")
+    label = ctx.input("Label").reshape(-1).astype(jnp.float32)
+    query = ctx.input("QueryID").reshape(-1)
+    col = int(ctx.attr("column", 0))  # ref default 0
+    s = score[:, col].astype(jnp.float32)
+    w_in = ctx.input("Weight")
+    w = (w_in.reshape(-1).astype(jnp.float32) if w_in is not None
+         else jnp.ones_like(s))
+
+    same_q = query[:, None] == query[None, :]
+    n = s.shape[0]
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)
+    diff_label = label[:, None] != label[None, :]
+    pair = same_q & upper & diff_label
+    pw = (w[:, None] + w[None, :]) * 0.5
+    ds = s[:, None] - s[None, :]
+    dl = label[:, None] - label[None, :]
+    neu = jnp.sum(jnp.where(pair & (ds == 0), pw, 0.0))
+    pos = jnp.sum(jnp.where(pair & (ds * dl > 0), pw, 0.0))
+    neg = jnp.sum(jnp.where(pair & ~(ds * dl > 0), pw, 0.0))
+
+    acc_p = ctx.input("AccumulatePositivePair")
+    acc_n = ctx.input("AccumulateNegativePair")
+    acc_u = ctx.input("AccumulateNeutralPair")
+    if acc_p is not None:
+        pos = pos + acc_p.reshape(-1)[0]
+    if acc_n is not None:
+        neg = neg + acc_n.reshape(-1)[0]
+    if acc_u is not None:
+        neu = neu + acc_u.reshape(-1)[0]
+    return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}
+
+
+@register_op("precision_recall",
+             no_grad_inputs=("MaxProbs", "Indices", "Labels", "Weights",
+                             "StatesInfo"))
+def precision_recall(ctx):
+    """Multi-class precision/recall/F1 (ref: precision_recall_op.h).
+    States per class: [TP, FP, TN, FN]; metrics: [macro-P, macro-R,
+    macro-F1, micro-P, micro-R, micro-F1], with the reference's
+    empty-class convention (precision/recall default 1.0, F1 0.0)."""
+    idx = ctx.input("Indices").reshape(-1).astype(jnp.int32)
+    label = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    cls = int(ctx.attr("class_number"))
+    w_in = ctx.input("Weights")
+    w = (w_in.reshape(-1).astype(jnp.float32) if w_in is not None
+         else jnp.ones(idx.shape, jnp.float32))
+
+    hit = idx == label
+    oh_idx = jnp.zeros((idx.shape[0], cls),
+                       jnp.float32).at[jnp.arange(idx.shape[0]), idx].set(1.0)
+    oh_lab = jnp.zeros((idx.shape[0], cls),
+                       jnp.float32).at[jnp.arange(idx.shape[0]),
+                                       label].set(1.0)
+    wv = w[:, None]
+    tp = jnp.sum(jnp.where(hit[:, None], oh_idx * wv, 0.0), axis=0)
+    fp = jnp.sum(jnp.where(~hit[:, None], oh_idx * wv, 0.0), axis=0)
+    fn = jnp.sum(jnp.where(~hit[:, None], oh_lab * wv, 0.0), axis=0)
+    # TN per class j: every sample adds w except those whose idx or label
+    # is j (hit samples subtract once: idx == label == j)
+    total = jnp.sum(w)
+    tn = total - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                              states[:, 3])
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                         1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                        1.0)
+        # macro-F1 is F1(macro-P, macro-R), NOT the mean of per-class
+        # F1s (ref precision_recall_op.h ComputeMetrics)
+        map_, mar = prec.mean(), rec.mean()
+        maf = jnp.where(map_ + mar > 0,
+                        2 * map_ * mar / jnp.maximum(map_ + mar, 1e-12),
+                        0.0)
+        macro = jnp.stack([map_, mar, maf])
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12),
+                       1.0)
+        mr = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12),
+                       1.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr,
+                                                              1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    batch_metrics = metrics(batch_states)
+    prev = ctx.input("StatesInfo")
+    accum_states = batch_states + (prev.astype(jnp.float32)
+                                   if prev is not None else 0.0)
+    accum_metrics = metrics(accum_states)
+    return {"BatchMetrics": batch_metrics.astype(jnp.float64),
+            "AccumMetrics": accum_metrics.astype(jnp.float64),
+            "AccumStatesInfo": accum_states}

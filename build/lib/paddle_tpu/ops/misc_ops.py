@@ -1,0 +1,322 @@
+"""Remaining reference op-parity stragglers (ref: minus_op.cc, cos_sim_op.*,
+l1_norm_op.*, norm_op.*, bilinear_tensor_product_op.*, conv_shift_op.*,
+modified_huber_loss_op.*, label_smooth_op.*, fill_op.cc, flatten_op.cc
+(flatten2/squeeze2/unsqueeze2 emit XShape), random_crop_op.*,
+extract_rows_op.cc / split_ids_op.* / merge_ids_op.* /
+split_selected_rows_op.* (the SelectedRows utility family),
+save_op.cc:36 / load_op.cc:24 / save_combine / load_combine / delete_var
+(in-graph checkpoint ops), get_places_op.cc, detection_map_op.*)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_grad, register_op
+
+
+# ---------------------------------------------------------------------------
+# dense math stragglers
+# ---------------------------------------------------------------------------
+
+
+@register_op("minus")
+def minus(ctx):
+    return {"Out": ctx.input("X") - ctx.input("Y")}
+
+
+@register_op("cos_sim")
+def cos_sim(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")  # [N, D], [N or 1, D]
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("l1_norm")
+def l1_norm(ctx):
+    return {"Out": jnp.sum(jnp.abs(ctx.input("X"))).reshape(1)}
+
+
+@register_op("norm")
+def norm(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    eps = ctx.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / n, "Norm": n}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")        # [N, M], [N, P]
+    w = ctx.input("Weight")                      # [O, M, P]
+    bias = ctx.input("Bias")                     # [1, O] or None
+    out = jnp.einsum("nm,omp,np->no", x, w, y)
+    if bias is not None:
+        out = out + bias
+    return {"Out": out}
+
+
+@register_op("conv_shift")
+def conv_shift(ctx):
+    """Circular correlation (ref conv_shift_op.cc): Out[i, j] =
+    sum_k X[i, (j + k - M//2) mod N] * Y[i, k]."""
+    x, y = ctx.input("X"), ctx.input("Y")        # [B, N], [B, M]
+    n, m = x.shape[1], y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+    return {"Out": jnp.einsum("bnm,bm->bn", x[:, idx], y)}
+
+
+@register_op("modified_huber_loss", no_grad_inputs=("Y",))
+def modified_huber_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")  # prob in [0,1], label {0,1}
+    t = 2.0 * y.astype(x.dtype) - 1.0      # {-1, +1}
+    z = x * t
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("label_smooth", no_grad_inputs=("PriorDist",))
+def label_smooth(ctx):
+    x = ctx.input("X")
+    prior = ctx.input("PriorDist")
+    eps = ctx.attr("epsilon", 0.0)
+    if prior is not None:
+        return {"Out": (1.0 - eps) * x + eps * prior}
+    return {"Out": (1.0 - eps) * x + eps / x.shape[-1]}
+
+
+@register_op("fill")
+def fill(ctx):
+    dt = ctx.attr("dtype", 5)
+    from ..fluid import core
+
+    vals = np.array(ctx.attr("value"), core.np_dtype(dt))
+    return {"Out": vals.reshape(ctx.attr("shape"))}
+
+
+@register_op("random_crop", stateful=True, no_grad_inputs=("X", "Seed"))
+def random_crop(ctx):
+    """Per-INSTANCE random crop windows (ref random_crop_op.h draws fresh
+    offsets per example, not one window for the whole batch)."""
+    x = ctx.input("X")
+    shape = list(ctx.attr("shape"))  # crop dims (trailing)
+    key = ctx.rng()
+    seed = int(ctx.attr("startup_seed", 0) or 0)
+    if seed:
+        # distinct reproducible stream per user seed (on top of the
+        # program-seeded rng, which already varies per step)
+        key = jax.random.fold_in(key, seed)
+    nd = len(shape)
+    lead = x.ndim - nd
+    maxs = jnp.asarray([x.shape[lead + i] - shape[i] for i in range(nd)],
+                       jnp.int32)
+
+    def crop_nd(xi, k, n_lead):
+        """Crop the trailing nd dims of xi (rank n_lead + nd)."""
+        offs = jax.random.randint(k, (nd,), 0, maxs + 1, jnp.int32)
+        starts = [jnp.int32(0)] * n_lead + [offs[i] for i in range(nd)]
+        sizes = list(xi.shape[:n_lead]) + shape
+        return jax.lax.dynamic_slice(xi, starts, sizes)
+
+    if lead >= 1:
+        # per-INSTANCE windows over dim 0
+        keys = jax.random.split(key, x.shape[0])
+        out = jax.vmap(lambda xi, k: crop_nd(xi, k, lead - 1))(x, keys)
+    else:
+        out = crop_nd(x, key, 0)
+    return {"Out": out, "SeedOut": jnp.zeros((1,), jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# shape variants emitting XShape (ref flatten_op.cc flatten2/squeeze2/
+# unsqueeze2 — XShape carries the pre-op shape for the grad op)
+# ---------------------------------------------------------------------------
+
+
+def _xshape(x):
+    return jnp.zeros((0,) + tuple(x.shape), x.dtype)
+
+
+@register_op("flatten2")
+def flatten2(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return {"Out": x.reshape(lead, -1), "XShape": _xshape(x)}
+
+
+@register_op("squeeze2")
+def squeeze2(ctx):
+    x = ctx.input("X")
+    axes = [a % x.ndim for a in (ctx.attr("axes", []) or [])]
+    if axes:
+        shape = [s for i, s in enumerate(x.shape)
+                 if not (i in axes and s == 1)]
+    else:
+        shape = [s for s in x.shape if s != 1]
+    return {"Out": x.reshape(shape), "XShape": _xshape(x)}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ctx):
+    x = ctx.input("X")
+    shape = list(x.shape)
+    for a in sorted(ctx.attr("axes", [])):
+        shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    return {"Out": x.reshape(shape), "XShape": _xshape(x)}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities (ref extract_rows_op.cc, split_ids_op.*,
+# merge_ids_op.*, split_selected_rows_op.* — the pserver sharding helpers;
+# here they serve manual sharding / inspection of sparse values)
+# ---------------------------------------------------------------------------
+
+
+@register_op("extract_rows", no_grad_inputs=("X",))
+def extract_rows(ctx):
+    from ..fluid.selected_rows import SelectedRows
+
+    x = ctx.input("X")
+    if not isinstance(x, SelectedRows):
+        raise TypeError("extract_rows expects a SelectedRows input")
+    return {"Out": x.rows.reshape(-1, 1).astype(jnp.int64)}
+
+
+@register_op("split_ids", no_grad_inputs=("Ids",))
+def split_ids(ctx):
+    """Round-robin id sharding (ref split_ids_op.h: shard = id % N)."""
+    ids = ctx.input("Ids").reshape(-1)
+    n = ctx.n_outputs("Out")
+    outs = []
+    for shard in range(n):
+        mask = (ids % n) == shard
+        # static shapes: emit ids with non-members marked -1, packed front
+        sel = jnp.where(mask, ids, -1)
+        order = jnp.argsort(~mask)  # members first, stable
+        outs.append(jnp.take(sel, order).reshape(-1, 1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", no_grad_inputs=("Ids", "Rows", "X"))
+def merge_ids(ctx):
+    """Scatter per-shard rows back to the original id order (ref
+    merge_ids_op.h)."""
+    ids = ctx.input("Ids").reshape(-1)           # original order
+    xs = ctx.inputs_list("X")                    # per-shard value tensors
+    rows = ctx.inputs_list("Rows")               # per-shard id lists
+    d = xs[0].shape[-1]
+    all_rows = jnp.concatenate([r.reshape(-1) for r in rows])
+    all_vals = jnp.concatenate([x.reshape(-1, d) for x in xs])
+    # out[i] = vals[position of ids[i] in all_rows]
+    eq = ids[:, None] == all_rows[None, :]
+    pos = jnp.argmax(eq, axis=1)
+    out = jnp.take(all_vals, pos, axis=0)
+    # an id absent from every shard violates the op contract (ref
+    # merge_ids_op.h enforces coverage); cannot raise under trace, so
+    # poison those rows with NaN instead of silently returning row 0
+    found = jnp.any(eq, axis=1)
+    out = jnp.where(found[:, None], out, jnp.asarray(jnp.nan, out.dtype))
+    return {"Out": out}
+
+
+@register_op("split_selected_rows", no_grad_inputs=("X",))
+def split_selected_rows(ctx):
+    from ..fluid.selected_rows import SelectedRows
+
+    x = ctx.input("X")
+    if not isinstance(x, SelectedRows):
+        raise TypeError("split_selected_rows expects SelectedRows")
+    sections = ctx.attr("height_sections")
+    n = len(sections)
+    bounds = np.cumsum([0] + list(sections))
+    outs = []
+    for i in range(n):
+        inside = (x.rows >= bounds[i]) & (x.rows < bounds[i + 1])
+        rows = jnp.where(inside, x.rows - bounds[i], 0)
+        vals = jnp.where(inside[:, None], x.values, 0)
+        outs.append(SelectedRows(rows, vals, int(sections[i])))
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# in-graph checkpoint ops (ref save_op.cc:36, load_op.cc:24,
+# save_combine_op.cc, load_combine_op.cc, delete_var_op.cc) — EAGER host
+# ops: they run outside jit so the concrete values can hit the filesystem
+# ---------------------------------------------------------------------------
+
+
+@register_op("save", no_grad_inputs=("X",))
+def save_op(ctx):
+    path = ctx.attr("file_path")
+    if not path.endswith(".npy"):
+        path = path + ".npy"  # np.save appends it; keep the guard aligned
+    overwrite = ctx.attr("overwrite", True)
+    if os.path.exists(path) and not overwrite:
+        raise IOError(f"save: {path} exists and overwrite=False")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.save(path, np.asarray(ctx.input("X")), allow_pickle=False)
+    return {}
+
+
+@register_op("load")
+def load_op(ctx):
+    path = ctx.attr("file_path")
+    if not path.endswith(".npy") and os.path.exists(path + ".npy"):
+        path = path + ".npy"
+    return {"Out": np.load(path)}
+
+
+@register_op("save_combine", no_grad_inputs=("X",))
+def save_combine(ctx):
+    path = ctx.attr("file_path")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrs = [np.asarray(v) for v in ctx.inputs_list("X")]
+    np.savez(path, *arrs)
+    return {}
+
+
+@register_op("load_combine")
+def load_combine(ctx):
+    path = ctx.attr("file_path")
+    if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+        path = path + ".npz"
+    z = np.load(path)
+    return {"Out": [z[k] for k in z.files]}
+
+
+@register_op("delete_var")
+def delete_var(ctx):
+    return {}
+
+
+@register_op("get_places")
+def get_places(ctx):
+    from ..fluid import core
+
+    n = ctx.attr("device_count", 0) or core.get_device_count()
+    return {"Out": np.arange(n, dtype=np.int64)}
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ctx):
+    # grouped transpose conv with groups defaulting to the CHANNEL count
+    # (matching depthwise_conv2d's default), not 1
+    from .registry import ExecContext
+    from .nn_ops import conv2d_transpose
+
+    x = ctx.input("Input")
+    attrs = dict(ctx.attrs)
+    if not attrs.get("groups"):
+        attrs["groups"] = int(x.shape[1])
+    sub = ExecContext(ctx.op_type, ctx.inputs, ctx.outputs_spec, attrs,
+                      ctx._rng_box)
+    return conv2d_transpose(sub)

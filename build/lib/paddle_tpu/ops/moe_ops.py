@@ -1,0 +1,32 @@
+"""Mixture-of-experts op (expert parallelism).
+
+Beyond the reference (SURVEY.md §2.6: MoE/EP "Absent"); the dense dispatch
+formulation and EP sharding live in parallel/moe.py.  The op is a pure JAX
+function so the generic vjp grad path (ops/registry.py) differentiates it —
+gate values, expert weights and inputs all receive gradients; routing
+indices are discrete and correctly get none (straight-through is not used,
+matching Switch Transformer).
+"""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+@register_op("moe_ffn")
+def moe_ffn_op(ctx):
+    from ..parallel import moe
+
+    x = ctx.input("X")
+    out, aux = moe.moe_ffn(
+        x,
+        ctx.input("GateW"),
+        ctx.input("W1"), ctx.input("B1"),
+        ctx.input("W2"), ctx.input("B2"),
+        top_k=int(ctx.attr("top_k", 2)),
+        capacity_factor=float(ctx.attr("capacity_factor", 1.25)),
+        activation=ctx.attr("activation", "relu"))
+    res = {"Out": out}
+    if ctx.n_outputs("AuxLoss"):
+        res["AuxLoss"] = aux
+    return res

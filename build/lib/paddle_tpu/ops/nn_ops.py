@@ -1,0 +1,501 @@
+"""NN ops: conv / pool / norm / embedding (ref: conv_op.*, conv_cudnn_op.cu.cc,
+pool_op.*, batch_norm_op.*, layer_norm_op.*, lrn_op.*, lookup_table_op.*).
+
+All convs lower to ``lax.conv_general_dilated`` — XLA tiles them onto the MXU;
+there is no cuDNN-style algo selection to port.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_grad, register_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv(ctx, x, w):
+    from ..fluid import amp
+
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    nd = x.ndim - 2
+    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    pad = [(p, p) for p in paddings]
+    x, w, back = amp.cast_operands(x, w)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    return amp.restore_astype(out, back)
+
+
+@register_op("conv2d")
+def conv2d(ctx):
+    return {"Output": _conv(ctx, ctx.input("Input"), ctx.input("Filter"))}
+
+
+@register_op("conv3d")
+def conv3d(ctx):
+    return {"Output": _conv(ctx, ctx.input("Input"), ctx.input("Filter"))}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or x.shape[1]
+    pad = [(p, p) for p in paddings]
+    from ..fluid import amp
+
+    x, w, back = amp.cast_operands(x, w)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=groups)
+    return {"Output": amp.restore_astype(out, back)}
+
+
+def _transpose_pad(w_spatial, paddings, dilations):
+    """Paddle conv_transpose padding -> jax conv_transpose padding.
+
+    Paddle: out = (in-1)*stride + (k-1)*dilation + 1 - 2*pad.  jax's
+    ``padding`` pairs pad the stride-dilated input directly, so the full
+    transpose of a VALID region needs (k_eff - 1 - p) on each side."""
+    return [((k - 1) * d + 1 - 1 - p, (k - 1) * d + 1 - 1 - p)
+            for k, p, d in zip(w_spatial, paddings, dilations)]
+
+
+def _grouped_conv_transpose(x, w, strides, pad, dilations, dn, groups):
+    """jax.lax.conv_transpose has no feature_group_count; grouped transpose
+    convs split channels (static group count, so XLA still sees G parallel
+    convs it can fuse)."""
+    if groups <= 1:
+        return jax.lax.conv_transpose(
+            x, w, strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, transpose_kernel=True)
+    outs = [
+        jax.lax.conv_transpose(
+            xg, wg, strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, transpose_kernel=True)
+        for xg, wg in zip(jnp.split(x, groups, axis=1),
+                          jnp.split(w, groups, axis=0))]
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [C_in, C_out/g, kH, kW]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    pad = _transpose_pad(w.shape[2:], paddings, dilations)
+    from ..fluid import amp
+
+    x, w, back = amp.cast_operands(x, w)
+    # transpose_kernel=True flips the kernel and swaps its I/O, so the spec
+    # labels the kernel post-swap: OIHW for a [C_in, C_out, kH, kW] layout
+    out = _grouped_conv_transpose(x, w, strides, pad, dilations,
+                                  ("NCHW", "OIHW", "NCHW"), groups)
+    return {"Output": amp.restore_astype(out, back)}
+
+
+def _pool2d_impl(x, ptype, ksize, strides, paddings, exclusive, global_pooling,
+                 adaptive=False):
+    if global_pooling or (adaptive and list(ksize) == [1, 1]):
+        axis = (2, 3)
+        out = jnp.max(x, axis, keepdims=True) if ptype == "max" \
+            else jnp.mean(x, axis, keepdims=True)
+        return out
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_, pad)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_, pad)
+    if exclusive and any(paddings):
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_, pad)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+@register_op("pool2d")
+def pool2d(ctx):
+    x = ctx.input("X")
+    out = _pool2d_impl(
+        x, ctx.attr("pooling_type", "max"), _pair(ctx.attr("ksize")),
+        _pair(ctx.attr("strides", [1, 1])), _pair(ctx.attr("paddings", [0, 0])),
+        ctx.attr("exclusive", True), ctx.attr("global_pooling", False),
+        ctx.attr("adaptive", False))
+    return {"Out": out}
+
+
+@register_op("batch_norm", no_grad_inputs=("Mean", "Variance"))
+def batch_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    momentum = ctx.attr("momentum", 0.9)
+    eps = ctx.attr("epsilon", 1e-5)
+    is_test = ctx.attr("is_test", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    if layout == "NCHW":
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        bshape = (1,) * (x.ndim - 1) + (-1,)
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axes)
+        use_var = jnp.var(x, axes)
+        saved_mean, saved_var = use_mean, use_var
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean
+        var_out = momentum * var + (1.0 - momentum) * use_var
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": inv}
+
+
+@register_op("layer_norm")
+def layer_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    axis = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axes, keepdims=True)
+    var = jnp.var(x, axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    rest = int(np.prod(x.shape[axis:]))
+    if scale is not None:
+        y = y * scale.reshape((1,) * axis + x.shape[axis:])
+    if bias is not None:
+        y = y + bias.reshape((1,) * axis + x.shape[axis:])
+    return {"Y": y, "Mean": mean.reshape(-1), "Variance": var.reshape(-1)}
+
+
+@register_op("lrn")
+def lrn(ctx):
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+def _lookup_ids(ctx):
+    ids = ctx.input("Ids").astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    return ids
+
+
+@register_op("lookup_table", no_grad_inputs=("Ids",))
+def lookup_table(ctx):
+    w = ctx.input("W")
+    ids = _lookup_ids(ctx)
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": out}
+
+
+@register_grad("lookup_table")
+def lookup_table_grad(ctx):
+    """is_sparse=True emits a SelectedRows grad — (occurrence ids, per-
+    occurrence rows of dOut) with NO dense [V, D] materialization (ref:
+    lookup_table_op.cc LookupTableGradOpDescMaker switches the grad var to
+    SELECTED_ROWS on the same attr; sparse consumers scatter instead).
+    Dense mode scatter-adds into zeros like the reference's dense kernel."""
+    from ..fluid.selected_rows import SelectedRows
+
+    w = ctx.input("W")
+    ids = _lookup_ids(ctx)
+    dout = ctx.input("Out@GRAD")
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(dout.dtype)
+        dout = dout * mask
+    rows = ids.reshape(-1)
+    vals = dout.reshape(-1, dout.shape[-1])
+    if ctx.attr("is_sparse", False):
+        return {"W@GRAD": SelectedRows(rows, vals, height=w.shape[0])}
+    dw = jnp.zeros_like(w).at[rows].add(vals.astype(w.dtype))
+    return {"W@GRAD": dw}
+
+
+@register_op("maxout")
+def maxout(ctx):
+    x = ctx.input("X")  # NCHW
+    g = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // g, g, h, w), axis=2)}
+
+
+@register_op("im2sequence")
+def im2sequence(ctx):
+    x = ctx.input("X")  # NCHW
+    kernels = ctx.attr("kernels")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    paddings = ctx.attr("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                     (paddings[1], paddings[3])))
+    kh, kw = kernels
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), strides, padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": out}
+
+
+@register_op("group_norm")
+def group_norm(ctx):
+    x = ctx.input("X")  # NCHW
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    groups = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axes, keepdims=True)
+    var = jnp.var(xg, axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "Mean": mean.reshape(n, groups), "Variance": var.reshape(n, groups)}
+
+
+@register_op("spp")
+def spp(ctx):
+    """Spatial pyramid pooling (ref: spp_op.*)."""
+    x = ctx.input("X")
+    levels = ctx.attr("pyramid_height")
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = -(-h // bins), -(-w // bins)
+        sh, sw = kh, kw
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        o = _pool2d_impl(x, ptype, [kh, kw], [sh, sw], [ph, pw], False, False)
+        outs.append(o.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# 3-D / indexed pooling, unpool, conv3d_transpose (ref: pool_op.* Pool3D,
+# pool_with_index_op.*, unpool_op.*, conv_transpose_op.* Conv3DTranspose)
+# ---------------------------------------------------------------------------
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+@register_op("pool3d")
+def pool3d(ctx):
+    x = ctx.input("X")  # NCDHW
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _tuple_n(ctx.attr("ksize"), 3)
+    strides = _tuple_n(ctx.attr("strides", [1, 1, 1]), 3)
+    paddings = _tuple_n(ctx.attr("paddings", [0, 0, 0]), 3)
+    if ctx.attr("global_pooling", False):
+        axis = (2, 3, 4)
+        out = jnp.max(x, axis, keepdims=True) if ptype == "max" \
+            else jnp.mean(x, axis, keepdims=True)
+        return {"Out": out}
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        return {"Out": jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                             window, strides_, pad)}
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_, pad)
+    if ctx.attr("exclusive", True) and any(paddings):
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    window, strides_, pad)
+        return {"Out": s / cnt}
+    return {"Out": s / float(np.prod(ksize))}
+
+
+def _pool_with_index(x, ksize, strides, paddings):
+    """Max pool that also returns the argmax's flat position in the input
+    plane (ref pool_with_index_op.h: mask index = h * W + w)."""
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    # flat index grid of the input plane, same spatial shape as x — int32
+    # (exact for any realistic plane; float would corrupt indices > 2^24)
+    flat = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
+    flat = jnp.broadcast_to(flat, x.shape)
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = jax.lax.reduce_window(
+        (x, flat),
+        (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1, jnp.int32)),
+        lambda a, b: sel(a, b), window, strides_, pad)
+    return out, idx.astype(jnp.int64)
+
+
+@register_op("max_pool2d_with_index", no_grad_inputs=())
+def max_pool2d_with_index(ctx):
+    x = ctx.input("X")
+    out, idx = _pool_with_index(
+        x, _tuple_n(ctx.attr("ksize"), 2),
+        _tuple_n(ctx.attr("strides", [1, 1]), 2),
+        _tuple_n(ctx.attr("paddings", [0, 0]), 2))
+    return {"Out": out, "Mask": idx}
+
+
+@register_op("max_pool3d_with_index", no_grad_inputs=())
+def max_pool3d_with_index(ctx):
+    x = ctx.input("X")
+    out, idx = _pool_with_index(
+        x, _tuple_n(ctx.attr("ksize"), 3),
+        _tuple_n(ctx.attr("strides", [1, 1, 1]), 3),
+        _tuple_n(ctx.attr("paddings", [0, 0, 0]), 3))
+    return {"Out": out, "Mask": idx}
+
+
+def _pool_with_index_grad(ctx):
+    """Scatter dOut back to each window's argmax position (works for any
+    spatial rank — the Mask holds flat plane indices).  Explicit because
+    the tuple-carrying reduce_window in the forward has no generic vjp."""
+    x = ctx.input("X")
+    idx = ctx.input("Mask")
+    dout = ctx.input("Out@GRAD")
+    n, c = x.shape[:2]
+    plane = int(np.prod(x.shape[2:]))
+    dx = jnp.zeros((n, c, plane), x.dtype)
+    flat_idx = idx.reshape(n, c, -1).astype(jnp.int64)
+    dx = dx.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+               flat_idx].add(dout.reshape(n, c, -1))
+    return {"X@GRAD": dx.reshape(x.shape)}
+
+
+register_grad("max_pool2d_with_index")(_pool_with_index_grad)
+register_grad("max_pool3d_with_index")(_pool_with_index_grad)
+
+
+@register_op("unpool", no_grad_inputs=("Indices",))
+def unpool(ctx):
+    """ref: unpool_op.* (max unpooling): scatter each pooled value back to
+    the position its max came from."""
+    x = ctx.input("X")             # [N, C, h, w]
+    indices = ctx.input("Indices")  # same shape, flat positions in H*W
+    out_h, out_w = ctx.attr("unpooled_height"), ctx.attr("unpooled_width")
+    if not out_h or not out_w:
+        ksize = _tuple_n(ctx.attr("ksize"), 2)
+        strides = _tuple_n(ctx.attr("strides", [2, 2]), 2)
+        out_h = (x.shape[2] - 1) * strides[0] + ksize[0]
+        out_w = (x.shape[3] - 1) * strides[1] + ksize[1]
+    n, c = x.shape[:2]
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    flat_idx = indices.reshape(n, c, -1).astype(jnp.int64)
+    out = out.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+                 flat_idx].add(x.reshape(n, c, -1))
+    return {"Out": out.reshape(n, c, out_h, out_w)}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [C_in, C_out, kD, kH, kW]
+    strides = _tuple_n(ctx.attr("strides", [1, 1, 1]), 3)
+    paddings = _tuple_n(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _tuple_n(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = ctx.attr("groups", 1) or 1
+    pad = _transpose_pad(w.shape[2:], paddings, dilations)
+    from ..fluid import amp
+
+    x, w, back = amp.cast_operands(x, w)
+    # kernel layout [C_in, C_out, kD, kH, kW]; with transpose_kernel=True
+    # the spec labels the kernel AFTER its I/O swap, hence OIDHW
+    out = _grouped_conv_transpose(x, w, strides, pad, dilations,
+                                  ("NCDHW", "OIDHW", "NCDHW"), groups)
+    return {"Output": amp.restore_astype(out, back)}
+
+
+# ---------------------------------------------------------------------------
+# print op (ref: print_op.cc — debugging passthrough with host logging)
+# ---------------------------------------------------------------------------
+
+
+@register_op("print")
+def print_op(ctx):
+    x = ctx.input("In")
+    message = ctx.attr("message", "") or ""
+    first_n = ctx.attr("first_n", -1)
+    fmt = []
+    if ctx.attr("print_tensor_name", True):
+        fmt.append(message)
+    if ctx.attr("print_tensor_shape", True):
+        fmt.append(f"shape={tuple(x.shape)}")
+    if ctx.attr("print_tensor_dtype", True):
+        fmt.append(f"dtype={x.dtype}")
+    prefix = " ".join(fmt)
+    # jax.debug.callback survives jit: the host callback fires per
+    # execution.  The first_n counter must outlive one op invocation (eager
+    # islands re-run the impl every step), so it keys off the op's attr
+    # dict, which is one stable object per Program op.
+    counter = _PRINT_COUNTS.setdefault(id(ctx.attrs), [0])
+
+    summarize = ctx.attr("summarize", 20)
+    if summarize is None or int(summarize) <= 0:
+        summarize = 20
+
+    def _cb(arr, transforms=None):
+        if first_n is None or first_n < 0 or counter[0] < first_n:
+            counter[0] += 1
+            print(f"{prefix} "
+                  f"values={np.asarray(arr).reshape(-1)[:int(summarize)]}")
+
+    jax.debug.callback(_cb, x)
+    return {"Out": x}
+
+
+_PRINT_COUNTS: dict = {}

@@ -1,0 +1,176 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer/BERT path gets a hand-scheduled kernel
+(SURVEY.md §7.3: "Pallas only where XLA underperforms"): one grid step
+owns a [BLOCK_Q, D] query tile resident in VMEM and streams the K/V tiles
+through the MXU with the online-softmax recurrence, so the [T, T] score
+matrix never hits HBM.  Accumulation is fp32 in VMEM scratch regardless of
+the input dtype (the same master-accumulator discipline as fluid.amp).
+
+Backward: custom_vjp with the standard recompute formulation — dS = P ∘
+(dP - rowsum(dO ∘ O)) — expressed in jnp (XLA fuses it well; a Pallas
+backward is a further optimization, not a correctness need).
+
+Falls back to interpret mode off-TPU, so the same code path is testable on
+the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale, causal, n_k):
+    """Grid step (head, q-block, k-block): one [bq, d] query tile against
+    one [bk, d] K/V tile, with the online-softmax state (m, l, acc) carried
+    in fp32 VMEM scratch across the (sequential, minormost) k dimension of
+    the grid — so VMEM holds only one K/V TILE at a time and t_kv can be
+    arbitrarily long."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    # all index math in i32: under the package-wide x64 mode python ints
+    # promote to i64, which Mosaic's index ops reject
+    q_off = qi * jnp.int32(bq)
+    k_off = ki * jnp.int32(bk)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # under causal masking, blocks strictly above the diagonal contribute
+    # nothing — skip both MXU contractions for them (~2x FLOPs at long T)
+    live = (k_off <= q_off + jnp.int32(bq - 1)) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, bk]
+        if causal:
+            qpos = q_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                    logits.shape, 0)
+            kpos = k_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                    logits.shape, 1)
+            logits = jnp.where(qpos >= kpos, logits, jnp.float32(NEG_INF))
+        m = m_ref[:]
+        l = l_ref[:]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], jnp.float32(1e-30))
+                    ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    t_kv = k.shape[2]
+    bq = min(block_q, t)
+    bk = min(block_k, t_kv)
+    while t % bq:
+        bq //= 2
+    while t_kv % bk:
+        bk //= 2
+    n_k = t_kv // bk
+    # grid iterates k-blocks innermost: TPU grids run sequentially on a
+    # core, so the scratch online-softmax state carries across ki steps
+    grid = (b * h, t // bq, n_k)
+    qr = q.reshape(b * h, t, d)
+    kr = k.reshape(b * h, t_kv, d)
+    vr = v.reshape(b * h, t_kv, d)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, d), jnp.float32),   # fp32 accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, scale=None, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """softmax(scale * q k^T [+ causal mask]) v, streamed (never
+
+    materializes the [T, T] scores).  q/k/v: [B, H, T, D]."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    """Recompute backward (Dao FA2 eq. form): with P the softmax probs,
+    dV = Pᵀ dO;  dS = P ∘ (dO Vᵀ - rowsum(dO ∘ O));  dQ = scale · dS K;
+    dK = scale · dSᵀ Q."""
+    q, k, v, o = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * of, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,39 @@
+"""Pipeline-parallel layer-stack op.
+
+Mesh-aware like ring_attention (ops/attention_ops.py): traced under a mesh
+with a "pp" axis it runs the GPipe ppermute schedule (parallel/pipeline.py);
+single-device it applies the layers sequentially — mathematically identical,
+so programs are portable across places.
+"""
+
+from __future__ import annotations
+
+from .registry import register_op
+
+
+@register_op("gpipe_mlp_stack")
+def gpipe_mlp_stack_op(ctx):
+    from ..parallel import pipeline as pl
+    from ..parallel import spmd
+
+    x = ctx.input("X")            # [N, D]
+    w = ctx.input("W")            # [L, D, D]
+    b = ctx.input("B")            # [L, D]
+    act = ctx.attr("act", "relu")
+    pp_axis = ctx.attr("pp_axis", "pp")
+    n_micro = int(ctx.attr("n_microbatches", 4))
+
+    mesh = spmd.active_mesh()
+    n_layers = w.shape[0]
+    if mesh is not None and pp_axis in mesh.axis_names \
+            and mesh.shape[pp_axis] > 1 \
+            and n_layers % mesh.shape[pp_axis] == 0:
+        s = mesh.shape[pp_axis]
+        per = n_layers // s
+        params = (w.reshape((s, per) + w.shape[1:]),
+                  b.reshape((s, per) + b.shape[1:]))
+        out = pl.gpipe(pl.mlp_stage_fn(act), params, x, mesh, pp_axis,
+                       n_micro)
+    else:
+        out = pl.sequential_stack(w, b, x, act)
+    return {"Out": out}

@@ -1,0 +1,104 @@
+"""Quantization-aware-training ops (ref: fake_quantize_op.cc,
+fake_dequantize_op.cc).
+
+Fake quantization simulates int-k inference inside an fp training graph:
+``Out = round(X / scale * (2^(bits-1) - 1))``.  Backward is straight-through
+(the reference registers these forward-only; QAT wraps them so gradients
+bypass the round) — here each op registers an explicit identity-style grad,
+the standard straight-through estimator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_grad, register_op
+
+
+def _bin_cnt(bits):
+    return float(2 ** (bits - 1) - 1)
+
+
+@register_op("dequantize_weight", no_grad_inputs=("X", "Scale"))
+def dequantize_weight(ctx):
+    """Weight-only int8 inference (transpiler/int8_transpiler.py): X is an
+    int8 weight living in HBM at 1/4 the bytes; Out = X * scale/127 per
+    channel, in the float compute dtype.  XLA fuses the cast+multiply into
+    the consuming matmul/conv read, so this costs no extra HBM round-trip —
+    the TPU analogue of the reference's int8 analysis pass
+    (inference/analysis/, fake_dequantize_op.cc math)."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale")          # [C] float32 per-channel abs-max
+    axis = int(ctx.attr("quant_axis", 0))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return {"Out": x.astype(jnp.float32) * (scale.reshape(shape) / 127.0)}
+
+
+@register_op("fake_quantize_abs_max", no_grad_inputs=())
+def fake_quantize_abs_max(ctx):
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    safe = jnp.where(scale > 0, scale, 1.0)
+    out = jnp.round(x / safe * _bin_cnt(bits))
+    return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+@register_grad("fake_quantize_abs_max")
+def fake_quantize_abs_max_grad(ctx):
+    # straight-through estimator: d(round(x/s*c))/dx ~= identity in QAT
+    return {"X@GRAD": ctx.input("Out@GRAD")}
+
+
+@register_op("fake_quantize_range_abs_max",
+             no_grad_inputs=("InScale", "Iter"))
+def fake_quantize_range_abs_max(ctx):
+    """Training-time scale tracking over a sliding window (ref
+    fake_quantize_op.cc:72 FindRangeAbsMax): the current batch's abs-max is
+    written into OutScales[iter % window]; the running OutScale is the max
+    of the window (monotone max once the window has filled)."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale").reshape(())
+    it = ctx.input("Iter")
+    scales = ctx.cur_out("OutScales")
+    window = ctx.attr("window_size", 10000)
+    bits = ctx.attr("bit_length", 8)
+    is_test = ctx.attr("is_test", False)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale
+        new_scales = scales
+        new_iter = it
+    else:
+        idx = (it.reshape(()) % window).astype(jnp.int32)
+        if scales is None:
+            scales = jnp.zeros((window,), x.dtype)
+        new_scales = scales.at[idx].set(cur)
+        scale = jnp.maximum(jnp.max(new_scales), cur)
+        new_iter = it + 1
+    safe = jnp.where(scale > 0, scale, 1.0)
+    out = jnp.round(x / safe * _bin_cnt(bits))
+    return {"Out": out, "OutScale": scale.reshape(1),
+            "OutScales": new_scales, "IterOut": new_iter}
+
+
+@register_grad("fake_quantize_range_abs_max")
+def fake_quantize_range_abs_max_grad(ctx):
+    return {"X@GRAD": ctx.input("Out@GRAD")}
+
+
+@register_op("fake_dequantize_max_abs", no_grad_inputs=("Scale",))
+def fake_dequantize_max_abs(ctx):
+    x = ctx.input("X")
+    scale = ctx.input("Scale").reshape(())
+    max_range = ctx.attr("max_range", 1.0)
+    return {"Out": x * (scale / max_range)}
+
+
+@register_grad("fake_dequantize_max_abs")
+def fake_dequantize_max_abs_grad(ctx):
+    scale = ctx.input("Scale").reshape(())
+    max_range = ctx.attr("max_range", 1.0)
+    return {"X@GRAD": ctx.input("Out@GRAD") * (scale / max_range)}

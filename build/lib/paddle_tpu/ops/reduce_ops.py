@@ -1,0 +1,77 @@
+"""Reduction / sort / topk ops (ref: paddle/fluid/operators/reduce_*,
+top_k_op.*, arg_min_max_op, cum_op, argsort)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _reduce_axes(ctx, x):
+    dim = ctx.attr("dim", None)
+    if ctx.attr("reduce_all", False) or dim is None:
+        return None
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % x.ndim for d in dim)
+
+
+def _reduce(name, fn):
+    @register_op(name)
+    def _impl(ctx, _fn=fn):
+        x = ctx.input("X")
+        axes = _reduce_axes(ctx, x)
+        keep = ctx.attr("keep_dim", False)
+        return {"Out": _fn(x, axis=axes, keepdims=keep)}
+    return _impl
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+
+
+@register_op("cumsum")
+def cumsum(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    exclusive = ctx.attr("exclusive", False)
+    reverse = ctx.attr("reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+@register_op("arg_max", no_grad_inputs=("X",))
+def arg_max(ctx):
+    return {"Out": jnp.argmax(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("arg_min", no_grad_inputs=("X",))
+def arg_min(ctx):
+    return {"Out": jnp.argmin(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("argsort", no_grad_inputs=("X",))
+def argsort(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k", no_grad_inputs=("X",))
+def top_k(ctx):
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}

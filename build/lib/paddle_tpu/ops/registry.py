@@ -1,0 +1,238 @@
+"""Operator registry: OpDesc -> JAX implementation.
+
+TPU-native analogue of the reference's OpRegistry/OpKernel machinery
+(ref: paddle/fluid/framework/op_registry.h:64, operator.cc:657).  Where the
+reference dispatches each op to a hand-written CPU/CUDA kernel at runtime, here
+every registered op is a pure JAX function; the Executor traces a whole block
+of them into one XLA computation (so "kernel fusion" is XLA's job, not ours).
+
+Gradients: the reference requires a hand-written GradOpDescMaker + grad kernel
+per op (ref: grad_op_desc_maker.h).  Here the *descriptor* side still exists
+(backward.py emits ``<type>_grad`` ops so transpilers can see/edit the backward
+graph), but the grad *implementation* is generic: ``jax.vjp`` over the forward
+impl.  XLA CSE merges the recomputed forward with the original, so this costs
+nothing at runtime.  Ops whose backward must reuse saved randomness or has
+non-vjp semantics register an explicit grad impl.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class ExecContext:
+    """What an op impl sees: input arrays by slot, attrs, and (optionally) rng."""
+
+    __slots__ = ("op_type", "inputs", "outputs_spec", "attrs", "_rng_box")
+
+    def __init__(self, op_type, inputs, outputs_spec, attrs, rng_box=None):
+        self.op_type = op_type
+        self.inputs: Dict[str, List[Any]] = inputs
+        self.outputs_spec: Dict[str, List[str]] = outputs_spec
+        self.attrs: Dict[str, Any] = attrs
+        self._rng_box = rng_box
+
+    def input(self, slot: str, idx: int = 0):
+        vals = self.inputs.get(slot) or []
+        return vals[idx] if idx < len(vals) else None
+
+    def inputs_list(self, slot: str):
+        return self.inputs.get(slot) or []
+
+    def has_input(self, slot: str) -> bool:
+        return bool(self.inputs.get(slot))
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def cur_out(self, slot: str, idx: int = 0):
+        """Current value of an output var (in-out semantics, e.g. a tensor
+        array being appended to).  Injected by the executor."""
+        vals = self.inputs.get(slot + "@CURRENT") or []
+        return vals[idx] if idx < len(vals) else None
+
+    def in_lod(self, slot: str, idx: int = 0):
+        """Static LoD (tuple of offset tuples) of the idx-th input of a slot,
+        or None.  Injected by the executor from `<name>@LOD` env entries."""
+        vals = self.inputs.get(slot + "@LOD") or []
+        return vals[idx] if idx < len(vals) else None
+
+    def seq_offsets(self, slot: str, idx: int = 0, level: int = -1):
+        """Finest (or given) level offsets of an input's LoD, as a tuple."""
+        lod = self.in_lod(slot, idx)
+        if not lod:
+            raise ValueError(
+                f"op {self.op_type}: input slot {slot} carries no LoD "
+                f"(feed it as a LoDTensor / set recursive_sequence_lengths)")
+        return lod[level]
+
+    def n_outputs(self, slot: str) -> int:
+        return len(self.outputs_spec.get(slot) or [])
+
+    def rng(self):
+        """Split a fresh PRNG key off the threaded rng state."""
+        if self._rng_box is None:
+            raise RuntimeError(
+                f"op {self.op_type} needs rng but executor supplied none")
+        key, sub = jax.random.split(self._rng_box[0])
+        self._rng_box[0] = key
+        return sub
+
+
+class OpDef:
+    __slots__ = ("type", "fn", "grad_fn", "infer_shape", "no_grad_inputs",
+                 "stateful", "infer_var_types")
+
+    def __init__(self, type, fn, grad_fn=None, infer_shape=None,
+                 no_grad_inputs=(), stateful=False):
+        self.type = type
+        self.fn = fn
+        self.grad_fn = grad_fn
+        self.infer_shape = infer_shape
+        self.no_grad_inputs = frozenset(no_grad_inputs)
+        self.stateful = stateful
+
+
+REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(op_type: str, *, infer_shape: Optional[Callable] = None,
+                no_grad_inputs: Sequence[str] = (), stateful: bool = False):
+    """Decorator: register ``fn(ctx) -> {slot: array | [arrays]}`` for op_type."""
+
+    def deco(fn):
+        if op_type in REGISTRY:
+            raise ValueError(f"op {op_type} registered twice")
+        REGISTRY[op_type] = OpDef(op_type, fn, infer_shape=infer_shape,
+                                  no_grad_inputs=no_grad_inputs,
+                                  stateful=stateful)
+        return fn
+
+    return deco
+
+
+def register_grad(op_type: str):
+    """Decorator: attach a custom grad impl to a registered op.
+
+    The grad fn sees a ctx whose inputs contain the forward inputs (same slot
+    names), forward outputs, and output grads under ``<slot>@GRAD``; it returns
+    ``{"<slot>@GRAD": value}`` for each differentiable input slot.
+    """
+
+    def deco(fn):
+        REGISTRY[op_type].grad_fn = fn
+        return fn
+
+    return deco
+
+
+def get_op_def(op_type: str) -> OpDef:
+    try:
+        return REGISTRY[op_type]
+    except KeyError:
+        raise NotImplementedError(
+            f"op '{op_type}' has no registered TPU implementation") from None
+
+
+def is_registered(op_type: str) -> bool:
+    return op_type in REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Generic vjp-based grad execution
+# ---------------------------------------------------------------------------
+
+
+def _is_inexact(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+
+
+def run_grad_generic(fwd_def: OpDef, ctx: ExecContext) -> Dict[str, Any]:
+    """Execute ``<type>_grad`` via jax.vjp over the forward impl.
+
+    ctx.inputs holds forward input slots, forward output slots, and
+    ``<out_slot>@GRAD`` slots.  ctx.outputs_spec names the wanted
+    ``<in_slot>@GRAD`` outputs.
+    """
+    if fwd_def.stateful and fwd_def.grad_fn is None:
+        raise NotImplementedError(
+            f"stateful op {fwd_def.type} requires an explicit grad impl")
+
+    # Which forward input slots do we need grads for?
+    want_slots = []
+    for out_slot in ctx.outputs_spec:
+        if not out_slot.endswith(GRAD_SUFFIX):
+            raise ValueError(f"bad grad output slot {out_slot}")
+        s = out_slot[: -len(GRAD_SUFFIX)]
+        if s in fwd_def.no_grad_inputs:
+            continue
+        want_slots.append(s)
+
+    # Which forward output slots have incoming grads?
+    fwd_out_grads = {}
+    for slot, vals in ctx.inputs.items():
+        if slot.endswith(GRAD_SUFFIX):
+            s = slot[: -len(GRAD_SUFFIX)]
+            if any(v is not None for v in vals):
+                fwd_out_grads[s] = vals
+
+    diff_tree = {s: [v for v in ctx.inputs_list(s)] for s in want_slots}
+    nondiff = {
+        s: vals
+        for s, vals in ctx.inputs.items()
+        if s not in diff_tree and not s.endswith(GRAD_SUFFIX)
+    }
+
+    out_slots = sorted(fwd_out_grads)
+
+    def f(dt):
+        merged = dict(nondiff)
+        merged.update(dt)
+        fctx = ExecContext(fwd_def.type, merged, {}, ctx.attrs)
+        outs = _normalize_outputs(fwd_def.fn(fctx))
+        res = {}
+        for s in out_slots:
+            vals = outs.get(s)
+            if vals is None:
+                continue
+            res[s] = [v for v in vals if _is_inexact(v)]
+        return res
+
+    primals_out, vjp_fn = jax.vjp(f, diff_tree)
+    # Build cotangent tree matching primals_out.
+    cot = {}
+    for s in primals_out:
+        gs = fwd_out_grads[s]
+        vals = []
+        for i, p in enumerate(primals_out[s]):
+            g = gs[i] if i < len(gs) else None
+            if g is None:
+                g = jnp.zeros_like(p)
+            vals.append(jnp.asarray(g, p.dtype))
+        cot[s] = vals
+    (grads,) = vjp_fn(cot)
+
+    result = {}
+    for s in want_slots:
+        gvals = grads.get(s)
+        if gvals is None:
+            continue
+        result[s + GRAD_SUFFIX] = gvals
+    return result
+
+
+def _normalize_outputs(outs) -> Dict[str, List[Any]]:
+    norm = {}
+    if outs is None:
+        return norm
+    for slot, v in outs.items():
+        if isinstance(v, (list, tuple)):
+            norm[slot] = list(v)
+        else:
+            norm[slot] = [v]
+    return norm

@@ -1,0 +1,239 @@
+"""Recurrent ops: dynamic_lstm / dynamic_lstmp / dynamic_gru + unit cells.
+
+ref: paddle/fluid/operators/{lstm,lstmp,gru,gru_unit,lstm_unit}_op.cc.
+
+TPU design: the reference reorders packed sequences into length-sorted
+batches (operators/math/sequence2batch.h) and runs a per-timestep CPU/CUDA
+cell kernel.  Here the packed input is padded to [num_seq, T, ...] with
+*static* trace-time lod (executor.trace_block) and the recurrence is one
+``lax.scan`` over time with a validity mask — XLA turns the scan body's
+matmuls into MXU ops and the whole loop compiles to a single fused kernel.
+
+Gate layouts follow the reference exactly:
+ - lstm  Weight = {W_ch, W_ih, W_fh, W_oh}; Bias = {b_c,b_i,b_f,b_o} and,
+   with use_peepholes, {W_ic, W_fc, W_oc} appended (lstm_op.cc:125,135).
+ - gru   Weight = [W_u | W_r (D x 2D), W_c (D x D)];
+   h_t = (1-u_t)*h_{t-1} + u_t*h~_t  (gru_op.cc:144-147).
+ - lstm_unit X = [i, f, o, j]; C = C_prev*sig(f+forget_bias)+sig(i)*tanh(j)
+   (lstm_unit_op.cc:70).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+_ACTS = {
+    "identity": lambda x: x,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+}
+_ACT_ENUM = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+
+def _act(name_or_enum, default):
+    if name_or_enum is None:
+        name_or_enum = default
+    if isinstance(name_or_enum, int):
+        name_or_enum = _ACT_ENUM[name_or_enum]
+    return _ACTS[str(name_or_enum)]
+
+
+def _pad_indices(off, reverse=False):
+    """idx[i, t] = packed row of timestep t of sequence i (sentinel = total
+    for padding); plus the inverse map packed row -> (i*T + t)."""
+    off = np.asarray(off)
+    lens = off[1:] - off[:-1]
+    n = len(lens)
+    total = int(off[-1])
+    T = int(lens.max()) if n else 0
+    idx = np.full((n, T), total, np.int64)
+    inv = np.zeros((total,), np.int64)
+    for i in range(n):
+        rows = np.arange(off[i], off[i + 1])
+        ts = np.arange(lens[i])
+        if reverse:
+            ts = lens[i] - 1 - ts
+        idx[i, ts] = rows
+        inv[rows] = i * T + ts
+    mask = (np.arange(T)[None, :] < lens[:, None])
+    return idx, inv, mask, n, T
+
+
+def _to_padded(x, idx):
+    xp = jnp.concatenate([x, jnp.zeros((1,) + x.shape[1:], x.dtype)], axis=0)
+    return xp[jnp.asarray(idx)]
+
+
+def _to_packed(padded, inv):
+    n, T = padded.shape[0], padded.shape[1]
+    flat = padded.reshape((n * T,) + padded.shape[2:])
+    return flat[jnp.asarray(inv)]
+
+
+@register_op("dynamic_lstm", no_grad_inputs=())
+def dynamic_lstm(ctx):
+    return _lstm_impl(ctx, project=False)
+
+
+@register_op("dynamic_lstmp")
+def dynamic_lstmp(ctx):
+    return _lstm_impl(ctx, project=True)
+
+
+def _lstm_impl(ctx, project):
+    x = ctx.input("Input")          # [total, 4D] (pre-projected by mul/fc)
+    w = ctx.input("Weight")         # [D, 4D] (lstmp: [P, 4D])
+    bias = ctx.input("Bias")        # [1, 4D] (+3D peephole tail)
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    off = ctx.seq_offsets("Input")
+    use_peep = bool(ctx.attr("use_peepholes", True))
+    reverse = bool(ctx.attr("is_reverse", False))
+    gate_act = _act(ctx.attr("gate_activation"), "sigmoid")
+    cell_act = _act(ctx.attr("cell_activation"), "tanh")
+    cand_act = _act(ctx.attr("candidate_activation"), "tanh")
+    d = x.shape[1] // 4
+    if project:
+        proj_w = ctx.input("ProjWeight")   # [D, P]
+        proj_act = _act(ctx.attr("proj_activation"), "identity")
+        p = proj_w.shape[1]
+    idx, inv, mask, n, t_max = _pad_indices(off, reverse)
+    xs = jnp.transpose(_to_padded(x, idx), (1, 0, 2))       # [T, n, 4D]
+    ms = jnp.asarray(mask.T[:, :, None])                    # [T, n, 1]
+
+    b_gate = bias[:, : 4 * d] if bias is not None else 0.0
+    if use_peep and bias is not None and bias.shape[-1] >= 7 * d:
+        w_ic = bias[0, 4 * d: 5 * d]
+        w_fc = bias[0, 5 * d: 6 * d]
+        w_oc = bias[0, 6 * d: 7 * d]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    h_init = h0 if h0 is not None else jnp.zeros(
+        (n, p if project else d), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((n, d), x.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + h_prev @ w + b_gate
+        g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=1)
+        if w_ic is not None:
+            g_i = g_i + w_ic * c_prev
+            g_f = g_f + w_fc * c_prev
+        i = gate_act(g_i)
+        f = gate_act(g_f)
+        cand = cand_act(g_c)
+        c = f * c_prev + i * cand
+        if w_oc is not None:
+            g_o = g_o + w_oc * c
+        o = gate_act(g_o)
+        h = o * cell_act(c)
+        if project:
+            h = proj_act(h @ proj_w)
+        h = jnp.where(m_t, h, h_prev)
+        c = jnp.where(m_t, c, c_prev)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(step, (h_init, c_init), (xs, ms))
+    hidden = _to_packed(jnp.transpose(hs, (1, 0, 2)), inv)
+    cell = _to_packed(jnp.transpose(cs, (1, 0, 2)), inv)
+    out_slot = "Projection" if project else "Hidden"
+    res = {out_slot: hidden, "Cell": cell}
+    if ctx.n_outputs("BatchGate"):
+        res["BatchGate"] = jnp.zeros_like(x)
+    if ctx.n_outputs("BatchCellPreAct"):
+        res["BatchCellPreAct"] = jnp.zeros_like(cell)
+    if ctx.n_outputs("BatchHidden"):
+        res["BatchHidden"] = jnp.zeros_like(hidden)
+    return res
+
+
+@register_op("dynamic_gru")
+def dynamic_gru(ctx):
+    x = ctx.input("Input")          # [total, 3D] = [xu | xr | xc]
+    w = ctx.input("Weight")         # [D, 3D] = [W_u|W_r (D,2D), W_c (D,D)]
+    bias = ctx.input("Bias")        # [1, 3D]
+    h0 = ctx.input("H0")
+    off = ctx.seq_offsets("Input")
+    reverse = bool(ctx.attr("is_reverse", False))
+    gate_act = _act(ctx.attr("gate_activation"), "sigmoid")
+    cand_act = _act(ctx.attr("activation"), "tanh")
+    d = x.shape[1] // 3
+    w_ur = w[:, : 2 * d]
+    w_c = w[:, 2 * d:]
+    idx, inv, mask, n, t_max = _pad_indices(off, reverse)
+    xs = jnp.transpose(_to_padded(x, idx), (1, 0, 2))
+    ms = jnp.asarray(mask.T[:, :, None])
+    if bias is not None:
+        b_ur, b_c = bias[:, : 2 * d], bias[:, 2 * d:]
+    else:
+        b_ur = b_c = 0.0
+    h_init = h0 if h0 is not None else jnp.zeros((n, d), x.dtype)
+
+    origin_mode = bool(ctx.attr("origin_mode", False))
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        xu, xr, xc = jnp.split(x_t, [d, 2 * d], axis=1)
+        ur = gate_act(jnp.concatenate([xu, xr], 1) + h_prev @ w_ur + b_ur)
+        u, r = jnp.split(ur, 2, axis=1)
+        cand = cand_act(xc + (r * h_prev) @ w_c + b_c)
+        if origin_mode:
+            h = u * h_prev + (1.0 - u) * cand
+        else:
+            h = (1.0 - u) * h_prev + u * cand
+        h = jnp.where(m_t, h, h_prev)
+        return h, h
+
+    _, hs = lax.scan(step, h_init, (xs, ms))
+    hidden = _to_packed(jnp.transpose(hs, (1, 0, 2)), inv)
+    res = {"Hidden": hidden}
+    for slot in ("BatchGate", "BatchResetHiddenPrev", "BatchHidden"):
+        if ctx.n_outputs(slot):
+            shape = (x.shape[0], 3 * d) if slot == "BatchGate" \
+                else (x.shape[0], d)
+            res[slot] = jnp.zeros(shape, x.dtype)
+    return res
+
+
+@register_op("gru_unit")
+def gru_unit(ctx):
+    """ref: gru_unit_op.cc:118-121 (activation attrs are int enums,
+    gru_unit_op.h:34)."""
+    x = ctx.input("Input")          # [B, 3D]
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    gate_act = _act(ctx.attr("gate_activation", 1), "sigmoid")
+    cand_act = _act(ctx.attr("activation", 2), "tanh")
+    d = h_prev.shape[1]
+    xb = x + bias if bias is not None else x
+    xu, xr, xc = jnp.split(xb, [d, 2 * d], axis=1)
+    ur = gate_act(jnp.concatenate([xu, xr], 1) + h_prev @ w[:, : 2 * d])
+    u, r = jnp.split(ur, 2, axis=1)
+    reset_h = r * h_prev
+    cand = cand_act(xc + reset_h @ w[:, 2 * d:])
+    h = (1.0 - u) * h_prev + u * cand
+    gate = jnp.concatenate([u, r, cand], axis=1)
+    return {"Gate": gate, "ResetHiddenPrev": reset_h, "Hidden": h}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx):
+    """ref: lstm_unit_op.cc:70 — X=[i,f,o,j], forget_bias added to f."""
+    x = ctx.input("X")
+    c_prev = ctx.input("C_prev")
+    fb = float(ctx.attr("forget_bias", 0.0))
+    i, f, o, j = jnp.split(x, 4, axis=1)
+    c = c_prev * jax.nn.sigmoid(f + fb) + jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = c * jax.nn.sigmoid(o)
+    return {"C": c, "H": h}

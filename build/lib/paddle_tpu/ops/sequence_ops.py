@@ -1,0 +1,421 @@
+"""Sequence (LoD) ops — the variable-length-sequence capability.
+
+ref: paddle/fluid/operators/sequence_*, SURVEY.md §2.4 "Sequence (LoD) ops".
+
+TPU design: sequences stay *packed* ([sum_len, ...], reference LoD layout,
+ref lod_tensor.h:58) but the offsets are static trace-time constants (see
+executor.trace_block).  All index math therefore happens in numpy at trace
+time and lowers to static gathers/segment ops — XLA sees fixed shapes, and
+jax.ops.segment_* provide the reductions the reference hand-writes in
+operators/math/sequence_pooling.cc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _lengths(off) -> np.ndarray:
+    off = np.asarray(off, np.int64)
+    return off[1:] - off[:-1]
+
+
+def _seg_ids(off) -> np.ndarray:
+    return np.repeat(np.arange(len(off) - 1), _lengths(off))
+
+
+def _concrete(x, what):
+    """Static int values of a tensor input, or a clear error under trace."""
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            f"{what} must be statically known (a constant/feed, not a traced "
+            f"intermediate) — dynamic output shapes are unsupported on TPU")
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# pooling / softmax
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_pool")
+def sequence_pool(ctx):
+    """ref: sequence_pool_op.cc + math/sequence_pooling.cc."""
+    x = ctx.input("X")
+    off = ctx.seq_offsets("X")
+    lod = ctx.in_lod("X")
+    pooltype = str(ctx.attr("pooltype", "AVERAGE")).upper()
+    n = len(off) - 1
+    seg = jnp.asarray(_seg_ids(off))
+    lens = _lengths(off)
+    lens_dev = jnp.asarray(lens.astype(np.float32)).reshape(
+        (-1,) + (1,) * (x.ndim - 1))
+    out_lod = [tuple(tuple(l) for l in lod[:-1])] if len(lod) > 1 else [None]
+
+    maxidx = None
+    if pooltype == "SUM":
+        out = jax.ops.segment_sum(x, seg, num_segments=n)
+    elif pooltype == "AVERAGE":
+        out = jax.ops.segment_sum(x, seg, num_segments=n) / \
+            jnp.maximum(lens_dev, 1.0)
+    elif pooltype == "SQRT":
+        out = jax.ops.segment_sum(x, seg, num_segments=n) / \
+            jnp.sqrt(jnp.maximum(lens_dev, 1.0))
+    elif pooltype == "MAX":
+        out = jax.ops.segment_max(x, seg, num_segments=n)
+        out = jnp.where(jnp.asarray(lens).reshape(
+            (-1,) + (1,) * (x.ndim - 1)) > 0, out, 0.0)
+        # arg position within each sequence (ref outputs MaxIndex)
+        if ctx.n_outputs("MaxIndex"):
+            eq = x == out[seg]
+            pos = jnp.arange(x.shape[0]).reshape((-1,) + (1,) * (x.ndim - 1))
+            big = x.shape[0] + 1
+            cand = jnp.where(eq, pos, big)
+            maxidx = jax.ops.segment_min(
+                jnp.broadcast_to(cand, x.shape), seg, num_segments=n)
+            maxidx = (maxidx - jnp.asarray(
+                np.concatenate([[0], np.cumsum(lens)[:-1]])).reshape(
+                    (-1,) + (1,) * (x.ndim - 1))).astype(jnp.int32)
+            # empty sequences: segment_min returned the `big` sentinel;
+            # mask those rows to 0 the same way Out is masked
+            maxidx = jnp.where(jnp.asarray(lens).reshape(
+                (-1,) + (1,) * (x.ndim - 1)) > 0, maxidx, 0)
+    elif pooltype == "LAST":
+        idx = np.where(lens > 0, np.asarray(off[1:]) - 1, 0)
+        out = x[jnp.asarray(idx)]
+        out = jnp.where(jnp.asarray(lens).reshape(
+            (-1,) + (1,) * (x.ndim - 1)) > 0, out, 0.0)
+    elif pooltype == "FIRST":
+        idx = np.where(lens > 0, np.asarray(off[:-1]), 0)
+        out = x[jnp.asarray(idx)]
+        out = jnp.where(jnp.asarray(lens).reshape(
+            (-1,) + (1,) * (x.ndim - 1)) > 0, out, 0.0)
+    else:
+        raise ValueError(f"unknown pooltype {pooltype}")
+    res = {"Out": out, "Out@LOD": out_lod}
+    if maxidx is not None:
+        res["MaxIndex"] = maxidx
+    return res
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx):
+    """ref: sequence_softmax_op.cc — softmax within each sequence."""
+    x = ctx.input("X")
+    off = ctx.seq_offsets("X")
+    n = len(off) - 1
+    seg = jnp.asarray(_seg_ids(off))
+    flat = x.reshape(-1)
+    smax = jax.ops.segment_max(flat, seg, num_segments=n)
+    e = jnp.exp(flat - smax[seg])
+    denom = jax.ops.segment_sum(e, seg, num_segments=n)
+    return {"Out": (e / denom[seg]).reshape(x.shape)}
+
+
+# ---------------------------------------------------------------------------
+# expand / concat / reverse / reshape / slice
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_expand", no_grad_inputs=("Y",))
+def sequence_expand(ctx):
+    """ref: sequence_expand_op.cc — repeat each X sequence per Y's lod at
+    ref_level."""
+    x = ctx.input("X")
+    y_lod = ctx.in_lod("Y")
+    ref_level = int(ctx.attr("ref_level", -1))
+    if not y_lod:
+        raise ValueError("sequence_expand: Y carries no LoD")
+    ref = y_lod[ref_level]
+    x_lod = ctx.in_lod("X")
+    if x_lod:
+        x_off = np.asarray(x_lod[-1])
+    else:
+        x_off = np.arange(x.shape[0] + 1)
+    n_ref = len(ref) - 1
+    if len(x_off) - 1 != n_ref:
+        raise ValueError(
+            f"sequence_expand: X has {len(x_off) - 1} sequences but Y lod "
+            f"level {ref_level} has {n_ref}")
+    rep = _lengths(ref)
+    idx, out_len = [], []
+    for i in range(n_ref):
+        rows = np.arange(x_off[i], x_off[i + 1])
+        for _ in range(int(rep[i])):
+            idx.append(rows)
+            out_len.append(len(rows))
+    idx = np.concatenate(idx) if idx else np.zeros((0,), np.int64)
+    out = x[jnp.asarray(idx)]
+    out_lod = (tuple(np.concatenate([[0], np.cumsum(out_len)]).tolist()),)
+    return {"Out": out, "Out@LOD": [out_lod]}
+
+
+@register_op("sequence_expand_as", no_grad_inputs=("Y",))
+def sequence_expand_as(ctx):
+    """ref: sequence_expand_as_op.cc — row i of X repeated y_len[i] times."""
+    x = ctx.input("X")
+    y_off = ctx.seq_offsets("Y", level=0)
+    rep = _lengths(y_off)
+    if x.shape[0] != len(rep):
+        raise ValueError("sequence_expand_as: X rows != Y sequence count")
+    idx = np.repeat(np.arange(x.shape[0]), rep)
+    out_lod = (tuple(int(v) for v in y_off),)
+    return {"Out": x[jnp.asarray(idx)], "Out@LOD": [out_lod]}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx):
+    """ref: sequence_concat_op.cc — concat the j-th sequence of every input."""
+    xs = ctx.inputs_list("X")
+    offs = [np.asarray(ctx.seq_offsets("X", idx=i)) for i in range(len(xs))]
+    n = len(offs[0]) - 1
+    if any(len(o) - 1 != n for o in offs):
+        raise ValueError("sequence_concat: inputs disagree on sequence count")
+    base = np.concatenate([[0], np.cumsum([x.shape[0] for x in xs])])[:-1]
+    idx, out_len = [], []
+    for j in range(n):
+        total = 0
+        for i, o in enumerate(offs):
+            rows = np.arange(o[j], o[j + 1]) + base[i]
+            idx.append(rows)
+            total += len(rows)
+        out_len.append(total)
+    idx = np.concatenate(idx) if idx else np.zeros((0,), np.int64)
+    cat = jnp.concatenate(xs, axis=0)
+    out_lod = (tuple(np.concatenate([[0], np.cumsum(out_len)]).tolist()),)
+    return {"Out": cat[jnp.asarray(idx)], "Out@LOD": [out_lod]}
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ctx):
+    """ref: sequence_reverse_op.h — reverse rows within each sequence."""
+    x = ctx.input("X")
+    off = np.asarray(ctx.seq_offsets("X"))
+    idx = np.concatenate(
+        [np.arange(off[i + 1] - 1, off[i] - 1, -1)
+         for i in range(len(off) - 1)]) if len(off) > 1 \
+        else np.zeros((0,), np.int64)
+    return {"Y": x[jnp.asarray(idx)]}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx):
+    """ref: sequence_reshape_op.cc — re-chunk each sequence's flattened data
+    to rows of new_dim."""
+    x = ctx.input("X")
+    off = np.asarray(ctx.seq_offsets("X"))
+    new_dim = int(ctx.attr("new_dim"))
+    d = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+    lens = _lengths(off) * d
+    if np.any(lens % new_dim):
+        raise ValueError("sequence_reshape: sequence bytes not divisible by "
+                         f"new_dim={new_dim}")
+    new_lens = lens // new_dim
+    out = x.reshape(-1, new_dim)
+    out_lod = (tuple(np.concatenate([[0], np.cumsum(new_lens)]).tolist()),)
+    return {"Out": out, "Out@LOD": [out_lod]}
+
+
+@register_op("sequence_slice", no_grad_inputs=("Offset", "Length"))
+def sequence_slice(ctx):
+    """ref: sequence_slice_op.cc — per-sequence [offset, offset+length)."""
+    x = ctx.input("X")
+    off = np.asarray(ctx.seq_offsets("X"))
+    o = _concrete(ctx.input("Offset"), "sequence_slice Offset").reshape(-1)
+    l = _concrete(ctx.input("Length"), "sequence_slice Length").reshape(-1)
+    idx, out_len = [], []
+    for i in range(len(off) - 1):
+        s = off[i] + int(o[i])
+        idx.append(np.arange(s, s + int(l[i])))
+        out_len.append(int(l[i]))
+    idx = np.concatenate(idx) if idx else np.zeros((0,), np.int64)
+    out_lod = (tuple(np.concatenate([[0], np.cumsum(out_len)]).tolist()),)
+    return {"Out": x[jnp.asarray(idx)], "Out@LOD": [out_lod]}
+
+
+# ---------------------------------------------------------------------------
+# pad / unpad / mask / enumerate / lod_reset
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_pad", no_grad_inputs=("PadValue",))
+def sequence_pad(ctx):
+    """ref: sequence_pad_op.cc — packed -> [num_seq, pad_len, ...] + Length.
+
+    The input lod is stashed on Out (static metadata) so sequence_unpad can
+    restore the exact packing without reading the Length tensor's values.
+    """
+    x = ctx.input("X")
+    pad_value = ctx.input("PadValue")
+    off = np.asarray(ctx.seq_offsets("X"))
+    lod = ctx.in_lod("X")
+    lens = _lengths(off)
+    pad_len = int(ctx.attr("padded_length", -1))
+    if pad_len in (-1, 0, None):
+        pad_len = int(lens.max()) if len(lens) else 0
+    if len(lens) and int(lens.max()) > pad_len:
+        raise ValueError(f"padded_length {pad_len} < max sequence length "
+                         f"{int(lens.max())}")
+    n = len(off) - 1
+    idx = np.full((n, pad_len), x.shape[0], np.int64)  # point at pad row
+    for i in range(n):
+        idx[i, : lens[i]] = np.arange(off[i], off[i + 1])
+    pv = jnp.asarray(pad_value, x.dtype)
+    pad_row = jnp.broadcast_to(pv, x.shape[1:]).reshape((1,) + x.shape[1:])
+    xp = jnp.concatenate([x, pad_row], axis=0)
+    out = xp[jnp.asarray(idx)]
+    return {"Out": out, "Out@LOD": [lod],
+            "Length": jnp.asarray(lens.astype(np.int64))}
+
+
+@register_op("sequence_unpad", no_grad_inputs=("Length",))
+def sequence_unpad(ctx):
+    """ref: sequence_unpad_op.cc — [num_seq, pad_len, ...] + lengths ->
+    packed."""
+    x = ctx.input("X")
+    lod = ctx.in_lod("X")
+    if lod:
+        off = np.asarray(lod[-1])
+        lens = _lengths(off)
+    else:
+        lens = _concrete(ctx.input("Length"),
+                         "sequence_unpad Length").reshape(-1).astype(np.int64)
+        off = np.concatenate([[0], np.cumsum(lens)])
+    n, pad_len = x.shape[0], x.shape[1]
+    rows = []
+    for i in range(n):
+        rows.append(np.arange(i * pad_len, i * pad_len + lens[i]))
+    idx = np.concatenate(rows) if rows else np.zeros((0,), np.int64)
+    flat = x.reshape((n * pad_len,) + x.shape[2:])
+    out_lod = (tuple(int(v) for v in off),)
+    return {"Out": flat[jnp.asarray(idx)], "Out@LOD": [out_lod]}
+
+
+@register_op("sequence_mask", no_grad_inputs=("X",))
+def sequence_mask(ctx):
+    """ref: sequence_mask_op.cc — lengths -> [..., maxlen] 0/1 mask."""
+    x = ctx.input("X")
+    maxlen = int(ctx.attr("maxlen", -1))
+    if maxlen < 0:
+        maxlen = int(_concrete(x, "sequence_mask lengths (maxlen=-1)").max())
+    dt = ctx.attr("out_dtype", "int64")
+    from ..fluid import core as _core
+
+    np_dt = _core.np_dtype(dt) if not isinstance(dt, type) else dt
+    mask = (jnp.arange(maxlen) < x[..., None]).astype(jnp.dtype(np_dt))
+    return {"Y": mask}
+
+
+@register_op("sequence_enumerate", no_grad_inputs=("X",))
+def sequence_enumerate(ctx):
+    """ref: sequence_enumerate_op.cc — sliding win_size windows per
+    sequence, pad_value beyond the end."""
+    x = ctx.input("X")
+    off = np.asarray(ctx.seq_offsets("X"))
+    win = int(ctx.attr("win_size"))
+    pad = ctx.attr("pad_value", 0)
+    total = x.shape[0]
+    seg = _seg_ids(off)
+    base = np.arange(total)
+    cols = []
+    flat = x.reshape(total) if x.ndim > 1 else x
+    flatp = jnp.concatenate([flat, jnp.full((1,), pad, flat.dtype)])
+    ends = np.asarray(off)[seg + 1] if total else np.zeros((0,), np.int64)
+    for k in range(win):
+        j = base + k
+        valid = j < ends
+        cols.append(jnp.asarray(np.where(valid, j, total)))
+    out = jnp.stack([flatp[c] for c in cols], axis=1)
+    return {"Out": out}
+
+
+@register_op("lod_reset", no_grad_inputs=("Y",))
+def lod_reset(ctx):
+    """ref: lod_reset_op.cc — replace X's lod from Y (its lod, else its
+    values as offsets) or from the target_lod attr."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    if y is not None:
+        y_lod = ctx.in_lod("Y")
+        if y_lod:
+            new = tuple(tuple(int(v) for v in lvl) for lvl in y_lod)
+        else:
+            off = _concrete(y, "lod_reset Y offsets").reshape(-1)
+            new = (tuple(int(v) for v in off),)
+    else:
+        tgt = ctx.attr("target_lod")
+        if not tgt:
+            raise ValueError("lod_reset: no Y input and empty target_lod")
+        new = (tuple(int(v) for v in tgt),)
+    if new[-1][-1] != x.shape[0]:
+        raise ValueError(f"lod_reset: offsets end {new[-1][-1]} != rows "
+                         f"{x.shape[0]}")
+    return {"Out": x, "Out@LOD": [new]}
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv / row_conv
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_conv", no_grad_inputs=("PaddingData",))
+def sequence_conv(ctx):
+    """ref: sequence_conv_op.cc + math/context_project.h — gather a
+    [contextLength] window of rows around each position (zero outside the
+    sequence) and project: Out = im2col(X) @ Filter."""
+    x = ctx.input("X")
+    filt = ctx.input("Filter")
+    off = np.asarray(ctx.seq_offsets("X"))
+    ctx_len = int(ctx.attr("contextLength"))
+    ctx_start = int(ctx.attr("contextStart", -((ctx_len - 1) // 2)))
+    stride = int(ctx.attr("contextStride", 1))
+    if stride != 1:
+        raise NotImplementedError("sequence_conv: contextStride must be 1 "
+                                  "(matches the reference's restriction)")
+    total, d = x.shape[0], x.shape[1]
+    seg = _seg_ids(off)
+    starts = np.asarray(off)[seg] if total else np.zeros((0,), np.int64)
+    ends = np.asarray(off)[seg + 1] if total else np.zeros((0,), np.int64)
+    xp = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    pieces = []
+    base = np.arange(total)
+    for k in range(ctx_len):
+        j = base + ctx_start + k
+        valid = (j >= starts) & (j < ends)
+        pieces.append(xp[jnp.asarray(np.where(valid, j, total))])
+    cols = jnp.concatenate(pieces, axis=1)  # [total, ctx_len*d]
+    return {"Out": cols @ filt}
+
+
+@register_op("row_conv")
+def row_conv(ctx):
+    """ref: row_conv_op.cc — lookahead convolution:
+    out[t] = sum_k filter[k] * x[t+k], within each sequence."""
+    x = ctx.input("X")
+    filt = ctx.input("Filter")  # [future_context_size + 1, D]
+    off = np.asarray(ctx.seq_offsets("X"))
+    k_len = filt.shape[0]
+    total = x.shape[0]
+    seg = _seg_ids(off)
+    ends = np.asarray(off)[seg + 1] if total else np.zeros((0,), np.int64)
+    xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)], axis=0)
+    base = np.arange(total)
+    out = jnp.zeros_like(x)
+    for k in range(k_len):
+        j = base + k
+        valid = j < ends
+        out = out + xp[jnp.asarray(np.where(valid, j, total))] * filt[k]
+    return {"Out": out}
+
+
+@register_op("sequence_erase", no_grad_inputs=("X",))
+def sequence_erase(ctx):
+    raise NotImplementedError(
+        "sequence_erase produces data-dependent shapes (it removes tokens by "
+        "value) and cannot run inside a static XLA trace; erase tokens in "
+        "the reader pipeline instead (paddle_tpu.reader)")

@@ -1,0 +1,262 @@
+"""Shape / indexing / layout ops (ref: reshape_op.cc, transpose_op.*,
+concat_op.*, split_op.*, gather_op.*, squeeze/unsqueeze, flatten, stack,
+slice, expand, pad, one_hot, multiplex, reverse)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+def _infer_reshape(shape_attr, x):
+    """Fluid reshape: 0 keeps the input dim, one -1 is inferred."""
+    shape = list(shape_attr)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        total = int(np.prod(x.shape)) if x.ndim else 1
+        shape[shape.index(-1)] = total // known
+    return shape
+
+
+@register_op("reshape")
+def reshape(ctx):
+    x = ctx.input("X")
+    out = x.reshape(_infer_reshape(ctx.attr("shape"), x))
+    return {"Out": out}
+
+
+@register_op("reshape2")
+def reshape2(ctx):
+    x = ctx.input("X")
+    out = x.reshape(_infer_reshape(ctx.attr("shape"), x))
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("transpose")
+def transpose(ctx):
+    return {"Out": jnp.transpose(ctx.input("X"), ctx.attr("axis"))}
+
+
+@register_op("transpose2")
+def transpose2(ctx):
+    x = ctx.input("X")
+    return {"Out": jnp.transpose(x, ctx.attr("axis")),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("concat")
+def concat(ctx):
+    xs = ctx.inputs_list("X")
+    return {"Out": jnp.concatenate(xs, axis=ctx.attr("axis", 0))}
+
+
+@register_op("split")
+def split(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    sections = ctx.attr("sections", None)
+    num = ctx.attr("num", 0)
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        n = num or ctx.n_outputs("Out")
+        outs = jnp.split(x, n, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("squeeze")
+def squeeze(ctx):
+    x = ctx.input("X")
+    axes = ctx.attr("axes", None)
+    if axes:
+        axes = tuple(a % x.ndim for a in axes)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": out}
+
+
+@register_op("unsqueeze")
+def unsqueeze(ctx):
+    x = ctx.input("X")
+    for a in sorted(ctx.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("flatten")
+def flatten(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op("stack")
+def stack(ctx):
+    return {"Y": jnp.stack(ctx.inputs_list("X"), axis=ctx.attr("axis", 0))}
+
+
+@register_op("unstack")
+def unstack(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(a, axis) for a in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("slice")
+def slice_op(ctx):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("expand")
+def expand(ctx):
+    x = ctx.input("X")
+    times = ctx.attr("expand_times")
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("expand_as")
+def expand_as(ctx):
+    x, y = ctx.input("X"), ctx.input("target_tensor") or ctx.input("Y")
+    times = [t // s for t, s in zip(y.shape, x.shape)]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("gather", no_grad_inputs=("Index",))
+def gather(ctx):
+    x = ctx.input("X")
+    idx = ctx.input("Index").astype(jnp.int32)
+    return {"Out": jnp.take(x, idx.reshape(-1), axis=0)}
+
+
+@register_op("scatter", no_grad_inputs=("Ids",))
+def scatter(ctx):
+    x = ctx.input("X")
+    ids = ctx.input("Ids").astype(jnp.int32).reshape(-1)
+    upd = ctx.input("Updates")
+    if ctx.attr("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    return {"Out": x.at[ids].add(upd)}
+
+
+@register_op("pad")
+def pad(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("paddings")
+    val = ctx.attr("pad_value", 0.0)
+    cfg = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, cfg, constant_values=val)}
+
+
+@register_op("pad2d")
+def pad2d(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("paddings")  # [top, bottom, left, right]
+    mode = ctx.attr("mode", "constant")
+    val = ctx.attr("pad_value", 0.0)
+    fmt = ctx.attr("data_format", "NCHW")
+    if fmt == "NCHW":
+        cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        cfg = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, cfg, constant_values=val)}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, cfg, mode=jmode)}
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    val = ctx.attr("pad_value", 0.0)
+    cfg = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, cfg, constant_values=val)}
+
+
+@register_op("crop")
+def crop(ctx):
+    x = ctx.input("X")
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": x[idx]}
+
+
+@register_op("reverse")
+def reverse(ctx):
+    x = ctx.input("X")
+    return {"Out": jnp.flip(x, axis=tuple(ctx.attr("axis")))}
+
+
+@register_op("one_hot", no_grad_inputs=("X",))
+def one_hot(ctx):
+    x = ctx.input("X").astype(jnp.int32)
+    depth = ctx.attr("depth")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    return {"Out": jax.nn.one_hot(x, depth, dtype=jnp.float32)}
+
+
+@register_op("shape", no_grad_inputs=("Input",))
+def shape_op(ctx):
+    return {"Out": jnp.array(ctx.input("Input").shape, jnp.int32)}
+
+
+@register_op("multiplex", no_grad_inputs=("Ids",))
+def multiplex(ctx):
+    ids = ctx.input("Ids").astype(jnp.int32).reshape(-1)
+    xs = jnp.stack(ctx.inputs_list("X"), axis=0)  # [n_candidates, N, D]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("where", no_grad_inputs=("Condition",))
+def where(ctx):
+    return {"Out": jnp.where(ctx.input("Condition"), ctx.input("X"), ctx.input("Y"))}
+
+
+@register_op("tile")
+def tile(ctx):
+    return {"Out": jnp.tile(ctx.input("X"), ctx.attr("repeat_times"))}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx):
+    x = ctx.input("X")  # NCHW
+    out_h = ctx.attr("out_h")
+    out_w = ctx.attr("out_w")
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, out_h, out_w), method="bilinear")
+    return {"Out": out}
+
+
+@register_op("nearest_interp")
+def nearest_interp(ctx):
+    x = ctx.input("X")
+    out_h = ctx.attr("out_h")
+    out_w = ctx.attr("out_w")
+    n, c, h, w = x.shape
+    return {"Out": jax.image.resize(x, (n, c, out_h, out_w), method="nearest")}

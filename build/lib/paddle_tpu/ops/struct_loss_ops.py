@@ -1,0 +1,558 @@
+"""Structured losses: linear-chain CRF, CTC (warpctc), NCE, hierarchical
+sigmoid, edit distance, chunk evaluation, ctc alignment.
+
+ref: paddle/fluid/operators/{linear_chain_crf,crf_decoding,warpctc,nce,
+hierarchical_sigmoid,edit_distance,chunk_eval,ctc_align}_op.*.
+
+TPU design: the dynamic programs (CRF forward, Viterbi, CTC alpha) run as
+``lax.scan`` over padded [num_seq, T, ...] batches built from static lod —
+log-space throughout (the reference works in exp space with row-max
+rescaling, operators/math/cross_entropy + linear_chain_crf_op.h; log-space
+is the numerically-equivalent XLA-friendly form).  Gradients fall out of
+jax.vjp over the scans.  chunk_eval / ctc_align produce data-dependent
+shapes/contents and run on the eager tier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, register_grad
+from .array_ops import EAGER_OPS
+from .rnn_ops import _pad_indices, _to_padded
+
+EAGER_OPS.update({"chunk_eval", "ctc_align", "edit_distance"})
+
+NEG = -1e30
+
+
+def _padded_batch(x, off, reverse=False):
+    """packed [N, ...] + offsets -> ([S, T, ...], mask [S, T], lens)."""
+    idx, inv, mask, n, t_max = _pad_indices(off, reverse)
+    return _to_padded(x, idx), jnp.asarray(mask), inv, n, t_max
+
+
+def _to_packed_rows(padded, inv):
+    """[S, T, ...] -> packed [N, ...] via the inverse index map."""
+    s, t = padded.shape[0], padded.shape[1]
+    flat = padded.reshape((s * t,) + padded.shape[2:])
+    return flat[jnp.asarray(inv)]
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+@register_op("linear_chain_crf", no_grad_inputs=("Label",))
+def linear_chain_crf(ctx):
+    """ref: linear_chain_crf_op.cc — Transition rows: [start; end; A].
+
+    Outputs LogLikelihood = NEGATIVE log-likelihood per sequence (the
+    quantity the reference's book models minimize directly)."""
+    emission = ctx.input("Emission")       # [N, K] packed
+    transition = ctx.input("Transition")   # [K+2, K]
+    label = ctx.input("Label")             # [N, 1] int
+    off = np.asarray(ctx.seq_offsets("Emission"))
+    k = emission.shape[1]
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+
+    em, mask, inv, n_seq, t_max = _padded_batch(emission, off)
+    lab = _to_padded(label.reshape(-1), _pad_indices(off)[0]).astype(jnp.int32)
+    mask_f = mask.astype(em.dtype)
+
+    # forward algorithm (log space), scan over time
+    alpha0 = start_w[None, :] + em[:, 0, :]
+
+    def fwd(alpha, t):
+        em_t = em[:, t, :]
+        m_t = mask_f[:, t][:, None]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None, :, :], axis=1)
+        alpha_new = em_t + nxt
+        return alpha * (1 - m_t) + alpha_new * m_t, alpha_new
+
+    alpha_fin, alphas = lax.scan(fwd, alpha0, jnp.arange(1, max(t_max, 1)))
+    log_z = jax.nn.logsumexp(alpha_fin + end_w[None, :], axis=1)
+
+    # gold path score
+    lens = np.asarray(off[1:] - off[:-1])
+    first_lab = lab[:, 0]
+    last_idx = jnp.asarray(np.maximum(lens - 1, 0))
+    last_lab = jnp.take_along_axis(lab, last_idx[:, None], axis=1)[:, 0]
+    em_score = jnp.sum(
+        jnp.take_along_axis(em, lab[:, :, None], axis=2)[:, :, 0] * mask_f,
+        axis=1)
+    pair_mask = mask_f[:, 1:]
+    tr_score = jnp.sum(trans[lab[:, :-1], lab[:, 1:]] * pair_mask, axis=1) \
+        if t_max > 1 else 0.0
+    gold = start_w[first_lab] + em_score + tr_score + end_w[last_lab]
+
+    nll = (log_z - gold).reshape(-1, 1)
+    res = {"LogLikelihood": nll, "LogLikelihood@LOD": [None]}
+    if ctx.n_outputs("Alpha"):
+        # real (log-space) forward variables, repacked to lod rows
+        all_alpha = jnp.concatenate([alpha0[:, None, :],
+                                     jnp.transpose(alphas, (1, 0, 2))],
+                                    axis=1) if t_max > 1 \
+            else alpha0[:, None, :]
+        res["Alpha"] = _to_packed_rows(all_alpha, inv)
+    if ctx.n_outputs("EmissionExps"):
+        res["EmissionExps"] = jnp.exp(emission)
+    if ctx.n_outputs("TransitionExps"):
+        res["TransitionExps"] = jnp.exp(transition)
+    return res
+
+
+@register_op("crf_decoding", no_grad_inputs=("Emission", "Transition",
+                                             "Label"))
+def crf_decoding(ctx):
+    """ref: crf_decoding_op.cc — Viterbi; with Label, emit per-position
+    correctness 0/1 (the chunk_eval co-input)."""
+    emission = ctx.input("Emission")
+    transition = ctx.input("Transition")
+    label = ctx.input("Label")
+    off = np.asarray(ctx.seq_offsets("Emission"))
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+
+    em, mask, inv, n_seq, t_max = _padded_batch(emission, off)
+    mask_f = mask.astype(em.dtype)
+
+    alpha0 = start_w[None, :] + em[:, 0, :]
+
+    def step(alpha, t):
+        m_t = mask_f[:, t][:, None]
+        cand = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(cand, axis=1)
+        alpha_new = em[:, t, :] + jnp.max(cand, axis=1)
+        return alpha * (1 - m_t) + alpha_new * m_t, best_prev
+
+    alpha_fin, back = lax.scan(step, alpha0, jnp.arange(1, max(t_max, 1)))
+
+    # backtrack as a reverse scan: positions past each sequence's end are
+    # mask-gated, so cur holds that sequence's own best-last tag until its
+    # true final step is reached
+    best_last = jnp.argmax(alpha_fin + end_w[None, :], axis=1).astype(
+        jnp.int32)
+
+    def bt(cur, t):
+        ptr = back[t - 1]                                     # [S, K]
+        prev = jnp.take_along_axis(ptr, cur[:, None],
+                                   axis=1)[:, 0].astype(jnp.int32)
+        cur2 = jnp.where(mask[:, t], prev, cur)
+        return cur2, cur                                      # emit tag@t
+
+    if t_max > 1:
+        cur0, tags_rev = lax.scan(bt, best_last,
+                                  jnp.arange(t_max - 1, 0, -1))
+        # tags_rev[i] = tag at position t_max-1-i; prepend position 0
+        padded_path = jnp.concatenate(
+            [cur0[:, None], jnp.flip(jnp.transpose(tags_rev), axis=1)],
+            axis=1)                                           # [S, T]
+    else:
+        padded_path = best_last[:, None]
+    viterbi = _to_packed_rows(padded_path, inv).reshape(-1, 1).astype(
+        jnp.int64)
+    if label is not None:
+        correct = (viterbi == label.astype(viterbi.dtype)).astype(jnp.int64)
+        return {"ViterbiPath": correct}
+    return {"ViterbiPath": viterbi}
+
+
+# ---------------------------------------------------------------------------
+# CTC (warpctc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("warpctc", no_grad_inputs=("Label",))
+def warpctc(ctx):
+    """ref: warpctc_op.cc — CTC loss on packed (lod) logits/labels.
+
+    Log-space alpha recursion over the blank-interleaved label l'
+    (standard CTC forward), scanned over time for the whole padded batch.
+    """
+    logits = ctx.input("Logits")           # [N, C] packed, unnormalized
+    label = ctx.input("Label")             # [L, 1] packed int
+    blank = int(ctx.attr("blank", 0))
+    norm_by_times = bool(ctx.attr("norm_by_times", False))
+    log_off = np.asarray(ctx.seq_offsets("Logits"))
+    lab_off = np.asarray(ctx.seq_offsets("Label"))
+
+    t_lens = np.asarray(log_off[1:] - log_off[:-1])
+    l_lens = np.asarray(lab_off[1:] - lab_off[:-1])
+    # the reference kernel errors on infeasible pairs; lengths are static
+    # here so catch what we can at trace time (repeats need label values)
+    for i in range(len(t_lens)):
+        if t_lens[i] < l_lens[i]:
+            raise ValueError(
+                f"warpctc: sequence {i} has {int(t_lens[i])} frames but "
+                f"{int(l_lens[i])} labels — no CTC alignment exists")
+    l_max = int(l_lens.max()) if len(l_lens) else 0
+
+    def _loss_fn(lg):
+        log_probs = jax.nn.log_softmax(lg, axis=-1)
+        lp, mask, inv, n_seq, t_max = _padded_batch(log_probs, log_off)
+
+        lab_idx, _, lab_mask, _, _ = _pad_indices(lab_off)
+        lab = _to_padded(label.reshape(-1), lab_idx).astype(jnp.int32)
+
+        # l' = [blank, y1, blank, y2, ..., blank], length 2*l_max+1
+        s_len = 2 * l_max + 1
+        ext = jnp.full((n_seq, s_len), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        ext_valid = np.zeros((n_seq, s_len), bool)
+        for i in range(n_seq):
+            ext_valid[i, : 2 * int(l_lens[i]) + 1] = True
+        ext_valid = jnp.asarray(ext_valid)
+
+        # can-skip: l'[s] != blank and l'[s] != l'[s-2]
+        skip_ok = jnp.zeros((n_seq, s_len), bool)
+        if s_len > 2:
+            skip_ok = skip_ok.at[:, 2:].set(
+                (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+        def emit(t):
+            return jnp.take_along_axis(lp[:, t, :], ext, axis=1)
+
+        alpha = jnp.full((n_seq, s_len), NEG, lp.dtype)
+        alpha = alpha.at[:, 0].set(emit(0)[:, 0])
+        if s_len > 1:
+            alpha = alpha.at[:, 1].set(
+                jnp.where(ext_valid[:, 1], emit(0)[:, 1], NEG))
+
+        def step(alpha, t):
+            stay = alpha
+            prev1 = jnp.concatenate(
+                [jnp.full((n_seq, 1), NEG, alpha.dtype), alpha[:, :-1]],
+                axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((n_seq, 2), NEG, alpha.dtype), alpha[:, :-2]],
+                axis=1)
+            prev2 = jnp.where(skip_ok, prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+            new = merged + emit(t)
+            new = jnp.where(ext_valid, new, NEG)
+            m_t = jnp.asarray(mask[:, t])[:, None]
+            return jnp.where(m_t, new, alpha), None
+
+        alpha, _ = lax.scan(step, alpha, jnp.arange(1, max(t_max, 1)))
+
+        # loss = -log(alpha[2L] + alpha[2L-1]) at the last frame
+        last_s = jnp.asarray(2 * l_lens)
+        a_end = jnp.take_along_axis(alpha, last_s[:, None], axis=1)[:, 0]
+        a_end1 = jnp.take_along_axis(
+            alpha, jnp.maximum(last_s - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(a_end, jnp.where(jnp.asarray(l_lens) > 0,
+                                            a_end1, NEG))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.asarray(t_lens, loss.dtype)
+        return loss.reshape(-1, 1).astype(lg.dtype)
+
+    loss, vjp_fn = jax.vjp(_loss_fn, logits)
+    res = {"Loss": loss, "Loss@LOD": [None]}
+    if ctx.n_outputs("WarpCTCGrad"):
+        # d(sum loss)/d logits — the reference's cached backward buffer;
+        # XLA dead-code-eliminates this when the output is unused
+        (res["WarpCTCGrad"],) = vjp_fn(jnp.ones_like(loss))
+    return res
+
+
+# ---------------------------------------------------------------------------
+# NCE / hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+
+def _nce_cost(x, weight, bias, label, samples, k, num_classes):
+    """Shared NCE objective given fixed noise samples."""
+    num_true = label.shape[1]
+
+    def logits_for(ids):
+        w = weight[ids]                    # [B, n, D]
+        out = jnp.einsum("bd,bnd->bn", x, w)
+        if bias is not None:
+            out = out + bias.reshape(-1)[ids]
+        return out
+
+    log_kq = jnp.log(float(k) / num_classes)
+    true_lg = logits_for(label) - log_kq
+    noise_lg = logits_for(samples) - log_kq
+    cost = jnp.sum(jax.nn.softplus(-true_lg), axis=1) / num_true \
+        + jnp.sum(jax.nn.softplus(noise_lg), axis=1)
+    return cost, true_lg, noise_lg
+
+
+@register_op("nce", no_grad_inputs=("Label", "SampleWeight"),
+             stateful=True)
+def nce(ctx):
+    """ref: nce_op.cc — noise-contrastive estimation, uniform sampler.
+    Fresh negatives each step from the threaded rng; the grad op replays
+    the objective with the SampleLabels the forward actually drew."""
+    x = ctx.input("Input")                 # [B, D]
+    label = ctx.input("Label")             # [B, num_true]
+    weight = ctx.input("Weight")           # [C, D]
+    bias = ctx.input("Bias")               # [C]
+    num_classes = int(ctx.attr("num_total_classes"))
+    k = int(ctx.attr("num_neg_samples", 10))
+    b = x.shape[0]
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(b, num_true)
+
+    # Determinism tiers (ref nce_op.h PrepareSamples): custom_neg_classes
+    # pins the negatives outright (the reference's unit-test hook); a
+    # nonzero seed attr gives one fixed PRNGKey-derived sample matrix
+    # (reproducible across runs/sessions); else fresh draws from the
+    # session-threaded rng each step.
+    custom = ctx.attr("custom_neg_classes") or []
+    seed = int(ctx.attr("seed", 0))
+    if custom:
+        samples = jnp.broadcast_to(
+            jnp.asarray(np.asarray(custom, np.int64)[None, :]), (b, len(custom)))
+        k = len(custom)
+    else:
+        key = jax.random.PRNGKey(seed) if seed != 0 else ctx.rng()
+        samples = jax.random.randint(key, (b, k), 0, num_classes)
+    cost, true_lg, noise_lg = _nce_cost(x, weight, bias, label, samples,
+                                        k, num_classes)
+    return {"Cost": cost.reshape(-1, 1),
+            "SampleLogits": jnp.concatenate([true_lg, noise_lg], axis=1),
+            "SampleLabels": jnp.concatenate([label, samples], axis=1)}
+
+
+@register_grad("nce")
+def nce_grad(ctx):
+    """Differentiates _nce_cost with the forward's drawn samples (read
+    back from the SampleLabels output)."""
+    x = ctx.input("Input")
+    label = ctx.input("Label")
+    weight = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    sample_labels = ctx.input("SampleLabels")
+    gcost = ctx.input("Cost@GRAD")
+    num_classes = int(ctx.attr("num_total_classes"))
+    b = x.shape[0]
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(b, num_true)
+    samples = sample_labels[:, num_true:]
+    k = samples.shape[1]  # actual draw count (custom_neg_classes may differ)
+
+    cot = gcost.reshape(-1).astype(x.dtype)
+    if bias is not None:
+        _, vjp_fn = jax.vjp(
+            lambda xv, wv, bv: _nce_cost(xv, wv, bv, label, samples, k,
+                                         num_classes)[0], x, weight, bias)
+        gx, gw, gb = vjp_fn(cot)
+        return {"Input@GRAD": gx, "Weight@GRAD": gw, "Bias@GRAD": gb}
+    _, vjp_fn = jax.vjp(
+        lambda xv, wv: _nce_cost(xv, wv, None, label, samples, k,
+                                 num_classes)[0], x, weight)
+    gx, gw = vjp_fn(cot)
+    return {"Input@GRAD": gx, "Weight@GRAD": gw}
+
+
+@register_op("hierarchical_sigmoid", no_grad_inputs=("Label",))
+def hierarchical_sigmoid(ctx):
+    """ref: hierarchical_sigmoid_op.cc + math/matrix_bit_code.h — complete
+    binary tree over classes; code(c) = c + num_classes, path node ids
+    code>>(d+1) - 1, bit (code>>d)&1."""
+    x = ctx.input("X")                     # [B, D]
+    w = ctx.input("W")                     # [C-1, D]
+    label = ctx.input("Label").reshape(-1)  # [B]
+    bias = ctx.input("Bias")               # [1, C-1] or [C-1]
+    num_classes = int(ctx.attr("num_classes"))
+    code = label.astype(jnp.int32) + num_classes
+    max_depth = int(np.floor(np.log2(num_classes))) + 1
+
+    total = 0.0
+    pre_out = []
+    for d in range(max_depth):
+        node = (code >> (d + 1)) - 1
+        valid = node >= 0
+        bit = (code >> d) & 1
+        node_c = jnp.maximum(node, 0)
+        logit = jnp.einsum("bd,bd->b", x, w[node_c])
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[node_c]
+        # sigmoid cross entropy with target = bit
+        loss_d = jax.nn.softplus(logit) - bit * logit
+        total = total + jnp.where(valid, loss_d, 0.0)
+        pre_out.append(jnp.where(valid, logit, 0.0))
+    res = {"Out": total.reshape(-1, 1)}
+    if ctx.n_outputs("PreOut"):
+        res["PreOut"] = jnp.stack(pre_out, axis=1)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# edit distance / chunk eval / ctc align (metrics; eager tier)
+# ---------------------------------------------------------------------------
+
+
+@register_op("edit_distance", no_grad_inputs=("Hyps", "Refs"))
+def edit_distance(ctx):
+    """ref: edit_distance_op.cc — Levenshtein per (hyp, ref) pair."""
+    hyps = np.asarray(ctx.input("Hyps")).reshape(-1)
+    refs = np.asarray(ctx.input("Refs")).reshape(-1)
+    h_off = np.asarray(ctx.seq_offsets("Hyps"))
+    r_off = np.asarray(ctx.seq_offsets("Refs"))
+    normalized = bool(ctx.attr("normalized", False))
+    n = len(h_off) - 1
+    out = np.zeros((n, 1), np.float32)
+    for i in range(n):
+        h = hyps[h_off[i]: h_off[i + 1]]
+        r = refs[r_off[i]: r_off[i + 1]]
+        m, l = len(h), len(r)
+        dp = np.arange(l + 1, dtype=np.int64)
+        for a in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = a
+            for bi in range(1, l + 1):
+                dp[bi] = min(prev[bi] + 1, dp[bi - 1] + 1,
+                             prev[bi - 1] + (h[a - 1] != r[bi - 1]))
+        d = float(dp[l])
+        if normalized:
+            d = d / max(l, 1)
+        out[i, 0] = d
+    return {"Out": jnp.asarray(out),
+            "SequenceNum": jnp.asarray([n], jnp.int64)}
+
+
+def _extract_chunks(tags, scheme, num_types):
+    """(type, begin, end) chunks from a tag sequence (IOB/IOE/IOBES/plain).
+
+    Tag layout per ref chunk_eval_op.h: scheme 'IOB' -> tag = type*2 +
+    {0:B, 1:I}; 'IOE' -> {0:I, 1:E}; 'IOBES' -> type*4 + {B,I,E,S};
+    'plain' -> tag = type.  The 'other' tag is num_types*k (the largest).
+    """
+    chunks = []
+    cur_type, cur_start = None, None
+
+    def flush(end):
+        nonlocal cur_type, cur_start
+        if cur_type is not None:
+            chunks.append((cur_type, cur_start, end))
+            cur_type, cur_start = None, None
+
+    for i, t in enumerate(tags):
+        t = int(t)
+        if scheme == "plain":
+            ty = t if t < num_types else None
+            if ty is None:
+                flush(i)
+            elif cur_type != ty:
+                flush(i)
+                cur_type, cur_start = ty, i
+            continue
+        if scheme == "IOB":
+            n_tag = 2
+            ty, pos = divmod(t, n_tag) if t < num_types * n_tag else (None, None)
+            if ty is None:
+                flush(i)
+            elif pos == 0:          # B
+                flush(i)
+                cur_type, cur_start = ty, i
+            else:                   # I
+                if cur_type != ty:
+                    flush(i)
+                    cur_type, cur_start = ty, i
+        elif scheme == "IOE":
+            n_tag = 2
+            ty, pos = divmod(t, n_tag) if t < num_types * n_tag else (None, None)
+            if ty is None:
+                flush(i)
+            else:
+                if cur_type != ty:
+                    flush(i)
+                    cur_type, cur_start = ty, i
+                if pos == 1:        # E closes the chunk
+                    flush(i + 1)
+        elif scheme == "IOBES":
+            n_tag = 4
+            ty, pos = divmod(t, n_tag) if t < num_types * n_tag else (None, None)
+            if ty is None:
+                flush(i)
+            elif pos == 0:          # B
+                flush(i)
+                cur_type, cur_start = ty, i
+            elif pos == 1:          # I
+                if cur_type != ty:
+                    flush(i)
+                    cur_type, cur_start = ty, i
+            elif pos == 2:          # E
+                if cur_type != ty:
+                    cur_type, cur_start = ty, i
+                flush(i + 1)
+            else:                   # S
+                flush(i)
+                chunks.append((ty, i, i + 1))
+    flush(len(tags))
+    return set(chunks)
+
+
+@register_op("chunk_eval", no_grad_inputs=("Inference", "Label"))
+def chunk_eval(ctx):
+    """ref: chunk_eval_op.cc — precision/recall/F1 over extracted chunks."""
+    inf = np.asarray(ctx.input("Inference")).reshape(-1)
+    lab = np.asarray(ctx.input("Label")).reshape(-1)
+    off = np.asarray(ctx.seq_offsets("Inference"))
+    num_types = int(ctx.attr("num_chunk_types"))
+    scheme = str(ctx.attr("chunk_scheme", "IOB"))
+    excluded = set(ctx.attr("excluded_chunk_types") or [])
+
+    n_inf = n_lab = n_correct = 0
+    for i in range(len(off) - 1):
+        seq_inf = inf[off[i]: off[i + 1]]
+        seq_lab = lab[off[i]: off[i + 1]]
+        ci = {c for c in _extract_chunks(seq_inf, scheme, num_types)
+              if c[0] not in excluded}
+        cl = {c for c in _extract_chunks(seq_lab, scheme, num_types)
+              if c[0] not in excluded}
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_correct += len(ci & cl)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return {
+        "Precision": jnp.asarray([p], jnp.float32),
+        "Recall": jnp.asarray([r], jnp.float32),
+        "F1-Score": jnp.asarray([f1], jnp.float32),
+        # int64 parity with the reference (chunk_eval_op.h outputs int64);
+        # host numpy arrays sidestep jax's disabled-x64 truncation — this is
+        # an eager metric op, nothing downstream re-enters jit with these.
+        "NumInferChunks": np.asarray([n_inf], np.int64),
+        "NumLabelChunks": np.asarray([n_lab], np.int64),
+        "NumCorrectChunks": np.asarray([n_correct], np.int64),
+    }
+
+
+@register_op("ctc_align", no_grad_inputs=("Input",))
+def ctc_align(ctx):
+    """ref: ctc_align_op.cc — merge repeats, drop blanks (eager: output
+    packing is data-dependent)."""
+    x = np.asarray(ctx.input("Input")).reshape(-1)
+    off = np.asarray(ctx.seq_offsets("Input"))
+    blank = int(ctx.attr("blank", 0))
+    merge = bool(ctx.attr("merge_repeated", True))
+    rows, lens = [], []
+    for i in range(len(off) - 1):
+        seq = x[off[i]: off[i + 1]]
+        out = []
+        prev = None
+        for t in seq:
+            t = int(t)
+            if merge and prev is not None and t == prev:
+                prev = t
+                continue
+            prev = t
+            if t != blank:
+                out.append(t)
+        rows.extend(out)
+        lens.append(len(out))
+    offsets = tuple(np.concatenate([[0], np.cumsum(lens)]).tolist())
+    arr = jnp.asarray(np.asarray(rows, np.int64).reshape(-1, 1)) if rows \
+        else jnp.zeros((0, 1), jnp.int64)
+    return {"Output": arr, "Output@LOD": [(offsets,)]}

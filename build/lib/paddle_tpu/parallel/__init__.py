@@ -1,0 +1,17 @@
+"""Parallelism: device meshes, SPMD sharding rules, collectives.
+
+TPU-native replacement for the reference's distributed stack (SURVEY.md §2.5,
+§2.6): NCCL context maps + gRPC parameter servers become a
+``jax.sharding.Mesh`` with GSPMD-inserted collectives over ICI.
+"""
+
+from .local_sgd import AsyncLocalSGDTrainer
+from .mesh import make_mesh, make_mesh_nd, local_device_count
+from .spmd import (batch_spec, infer_param_specs, shard_program_step,
+                   ShardedTrainStep)
+from .master import Task, TaskDispatcher, task_reader
+
+__all__ = ["make_mesh", "make_mesh_nd", "local_device_count", "batch_spec",
+           "infer_param_specs", "shard_program_step", "ShardedTrainStep",
+           "Task", "TaskDispatcher", "task_reader",
+           "AsyncLocalSGDTrainer"]

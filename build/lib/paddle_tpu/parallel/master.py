@@ -1,0 +1,210 @@
+"""Fault-tolerant dataset task dispatch — the go/master equivalent
+(ref: go/master/service.go — chunk partition :106, GetTask :368,
+TaskFinished :411, TaskFailed :455, timeout requeue :341 checkTimeoutFunc,
+failure cap :313 processFailedTask, snapshot-to-store :207 / recover :166).
+
+The reference's elastic-data-loading design: trainers are STATELESS
+consumers of a task queue over dataset chunks; any trainer can die or join
+mid-pass because unfinished tasks time out and requeue, and the master's
+own state snapshots to etcd.  Here the dispatcher is an in-process (or
+process-shared via a file snapshot) object the input pipeline consumes;
+coordination-service membership is jax.distributed's job, data elasticity
+is this one's.
+
+Usage::
+
+    m = TaskDispatcher(chunks, chunks_per_task=2, timeout=60., failure_max=3)
+    while True:
+        task = m.get_task()           # None => pass finished
+        if task is None: break
+        try:
+            for chunk in task.chunks: consume(chunk)
+            m.task_finished(task.task_id)
+        except Exception:
+            m.task_failed(task.task_id)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Task", "TaskDispatcher"]
+
+
+@dataclass
+class Task:
+    task_id: int
+    chunks: List  # opaque chunk descriptors (paths, index ranges, ...)
+    epoch: int = 0
+    num_failure: int = 0
+    dispatched_at: float = field(default=0.0, compare=False)
+
+
+class TaskDispatcher:
+    """Single-master task queue with timeout requeue + failure caps.
+
+    ``snapshot_path`` persists state after every transition (the etcd role,
+    ref service.go:207); a restarted master resumes mid-pass (recover
+    :166).  Pending tasks are reclaimed lazily: every get_task() first
+    requeues pending tasks older than ``timeout`` (the reference arms a
+    timer per dispatch — same observable behavior, no threads)."""
+
+    def __init__(self, chunks: List, chunks_per_task: int = 1,
+                 timeout: float = 60.0, failure_max: int = 3,
+                 snapshot_path: Optional[str] = None):
+        self.chunks_per_task = int(chunks_per_task)
+        self.timeout = float(timeout)
+        self.failure_max = int(failure_max)
+        self.snapshot_path = snapshot_path
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+            return
+        self.cur_pass = 0
+        self.todo: List[Task] = self._partition(chunks)
+        self.pending: dict = {}
+        self.done: List[Task] = []
+        self.failed: List[Task] = []
+        self._all_chunks = list(chunks)
+        self._snapshot()
+
+    # -- construction helpers --
+    def _partition(self, chunks) -> List[Task]:
+        n = self.chunks_per_task
+        return [Task(task_id=i, chunks=list(chunks[i * n:(i + 1) * n]))
+                for i in range((len(chunks) + n - 1) // n)]
+
+    # -- the protocol --
+    def get_task(self) -> Optional[Task]:
+        """Next task, or None when nothing is dispatchable RIGHT NOW —
+        distinguish "pass done" from "stragglers still pending" with
+        ``pass_finished()``.  Reclaims timed-out pending tasks first
+        (ref :341)."""
+        self._reclaim_timeouts()
+        if not self.todo:
+            return None
+        t = self.todo.pop(0)
+        t.dispatched_at = time.time()
+        self.pending[t.task_id] = t
+        self._snapshot()
+        return t
+
+    def task_finished(self, task_id: int) -> None:
+        t = self.pending.pop(task_id, None)
+        if t is None:
+            return  # late report after timeout-requeue (ref epoch check)
+        self.done.append(t)
+        self._snapshot()
+
+    def task_failed(self, task_id: int) -> None:
+        t = self.pending.pop(task_id, None)
+        if t is None:
+            return
+        self._fail(t)
+        self._snapshot()
+
+    def pass_finished(self) -> bool:
+        self._reclaim_timeouts()
+        return not self.todo and not self.pending
+
+    def start_new_pass(self) -> None:
+        """Re-arm the queue with all chunks for the next pass (the
+        reference flips CurPass when todo+pending drain, :411)."""
+        self.cur_pass += 1
+        self.todo = self._partition(self._all_chunks)
+        self.pending = {}
+        self.done = []
+        self.failed = []
+        self._snapshot()
+
+    # -- internals --
+    def _fail(self, t: Task) -> None:
+        t.num_failure += 1
+        if t.num_failure > self.failure_max:
+            self.failed.append(t)  # discard (ref :330)
+        else:
+            self.todo.append(t)    # re-dispatch (ref :336)
+
+    def _reclaim_timeouts(self) -> None:
+        now = time.time()
+        for tid in list(self.pending):
+            t = self.pending[tid]
+            if now - t.dispatched_at > self.timeout:
+                del self.pending[tid]
+                self._fail(t)
+        # NOTE: the pass does NOT auto-flip when todo+pending drain; epoch
+        # boundaries stay explicit via start_new_pass()
+
+    # -- persistence (the etcd role) --
+    def _snapshot(self) -> None:
+        if not self.snapshot_path:
+            return
+        state = {
+            "cur_pass": self.cur_pass,
+            "todo": [self._ser(t) for t in self.todo],
+            "pending": [self._ser(t) for t in self.pending.values()],
+            "done": [self._ser(t) for t in self.done],
+            "failed": [self._ser(t) for t in self.failed],
+            "all_chunks": self._all_chunks,
+            "chunks_per_task": self.chunks_per_task,
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)  # atomic (crash-safe)
+
+    def _recover(self) -> None:
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self.cur_pass = state["cur_pass"]
+        self.chunks_per_task = state["chunks_per_task"]
+        self._all_chunks = state["all_chunks"]
+        # pending tasks were in flight when the master died: requeue them
+        # (their consumers cannot report back to a new master instance)
+        self.todo = [self._de(t) for t in state["todo"]] + \
+            [self._de(t) for t in state["pending"]]
+        self.pending = {}
+        self.done = [self._de(t) for t in state["done"]]
+        self.failed = [self._de(t) for t in state["failed"]]
+
+    @staticmethod
+    def _ser(t: Task) -> dict:
+        return {"task_id": t.task_id, "chunks": t.chunks, "epoch": t.epoch,
+                "num_failure": t.num_failure}
+
+    @staticmethod
+    def _de(d: dict) -> Task:
+        return Task(task_id=d["task_id"], chunks=d["chunks"],
+                    epoch=d.get("epoch", 0),
+                    num_failure=d.get("num_failure", 0))
+
+
+def task_reader(dispatcher: TaskDispatcher, chunk_reader):
+    """Adapter: a paddle reader that pulls tasks from the dispatcher and
+    yields samples from each chunk via ``chunk_reader(chunk)`` — the shape
+    of the v2 master-client reader (ref python/paddle/v2/master/client.py).
+    Marks tasks finished only after ALL their samples were consumed."""
+
+    def reader():
+        while True:
+            task = dispatcher.get_task()
+            if task is None:
+                if dispatcher.pass_finished():
+                    return
+                # stragglers still pending on another consumer: wait for
+                # their timeout so a died consumer's chunks requeue to us
+                # instead of being silently dropped
+                time.sleep(min(max(dispatcher.timeout / 10.0, 0.01), 1.0))
+                continue
+            try:
+                for chunk in task.chunks:
+                    yield from chunk_reader(chunk)
+            except Exception:
+                dispatcher.task_failed(task.task_id)
+                raise
+            dispatcher.task_finished(task.task_id)
+
+    return reader

@@ -1,0 +1,47 @@
+"""Mesh construction (ref analogue: platform/nccl_helper.h NCCLContextMap —
+rank math over trainers × local GPUs becomes an N-D device mesh)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count(platform=None) -> int:
+    try:
+        return len(jax.devices(platform)) if platform else len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def make_mesh(n_devices=None, tp=1, axis_names=("dp", "mp")) -> Mesh:
+    """Build a (dp × tp) mesh over the first n_devices devices.
+
+    tp ("mp" axis) shards model weights; dp shards the batch.  On a real pod
+    the mesh should map tp to the innermost ICI dimension — jax device order
+    already enumerates ICI-adjacent chips first.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} visible")
+    if n % tp != 0:
+        raise ValueError(f"n_devices={n} not divisible by tp={tp}")
+    arr = np.array(devs[:n]).reshape(n // tp, tp)
+    return Mesh(arr, axis_names)
+
+
+def make_mesh_nd(**axes) -> Mesh:
+    """N-D mesh from named axis sizes, e.g. ``make_mesh_nd(dp=2, mp=2,
+    pp=2)``.  Axis order = keyword order (python dicts preserve it); later
+    axes map to faster-varying device indices, i.e. the innermost/most-
+    ICI-adjacent dimension — put the most communication-hungry axis last."""
+    names = tuple(axes)
+    sizes = tuple(int(s) for s in axes.values())
+    n = int(np.prod(sizes))
+    devs = jax.devices()
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} visible")
+    arr = np.array(devs[:n]).reshape(sizes)
+    return Mesh(arr, names)

@@ -1,0 +1,357 @@
+"""SPMD sharding of traced Programs over a mesh.
+
+This is the TPU-native replacement for the reference's
+multi_devices_graph_pass (ref: details/multi_devices_graph_pass.cc:323):
+instead of replicating ops per device and inserting AllReduce op-handles, we
+annotate shardings on the ONE traced XLA program and let GSPMD partition it:
+
+ - batch ("dp" axis): every fed tensor sharded on dim 0 → data parallelism;
+   gradient all-reduce falls out of the partitioned backward matmuls.
+ - tensor parallelism ("mp" axis): 2-D parameters (fc/embedding weights) and
+   their optimizer accumulators sharded on the output dim; XLA inserts the
+   activation all-gathers/reduce-scatters over ICI.
+
+ZeRO-1 style optimizer-state sharding (BuildStrategy.ReduceStrategy.Reduce)
+uses the same mechanism with accumulator specs sharded on "dp".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..fluid import core
+from ..fluid.executor import BlockPlan, _MISSING, global_scope, trace_block
+from ..fluid.framework import Parameter, Program, RNG_STATE_VAR
+
+
+def batch_spec(mesh: Mesh) -> P:
+    return P("dp") if "dp" in mesh.axis_names else P(mesh.axis_names[0])
+
+
+# -- active-mesh context: ops whose implementation is mesh-aware (ring
+# attention) discover the mesh their trace is being partitioned over --
+_ACTIVE_MESH: List[Mesh] = []
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH[-1] if _ACTIVE_MESH else None
+
+
+class mesh_scope:
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _ACTIVE_MESH.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _ACTIVE_MESH.pop()
+        return False
+
+
+def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
+                      tp_axis: str = "mp", zero1: bool = False,
+                      dp_axis: str = "dp") -> Dict[str, P]:
+    """Choose a PartitionSpec per state var.
+
+    2-D params with a dim divisible by the tp axis size get sharded on that
+    dim (prefer the output/last dim); accumulators follow their param (same
+    shape) — matching how Megatron-style TP shards fc/embedding weights.
+
+    zero1=True additionally shards optimizer accumulators over the dp axis
+    (ReduceStrategy.Reduce ≡ ZeRO-1, ref multi_devices_graph_pass.cc:434-446
+    kReduce): params stay replicated, their m/v/momentum state is partitioned
+    on dp, and GSPMD all-gathers the updated params after the (now sharded)
+    optimizer math — the reduce-to-owner + broadcast-param dataflow of the
+    reference expressed as shardings.
+    """
+    has_tp = tp_axis in mesh.axis_names
+    has_dp = zero1 and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
+
+    def hint_spec(v) -> Optional[P]:
+        """Params created with sharding hints.
+
+        ``dist_spec``: a per-dim tuple of mesh-axis names/None (stacked
+        transformer params — e.g. ("pp", None, "mp")); axes absent from the
+        mesh or with non-divisible dims degrade to replicated PER DIM, so
+        the same program runs on any mesh shape.  A param with a dist_spec
+        never falls through to the generic 2-D TP heuristic (a stacked
+        [L, d] layer-norm scale must NOT shard d over mp — the shard_map
+        body expects it replicated).
+
+        ``dist_hint``: a single axis name (expert weights → "ep",
+        pipeline-stacked weights → "pp") sharding dim 0 on that axis.
+        """
+        ds = getattr(v, "dist_spec", None)
+        if ds is not None:
+            shape = v.shape or ()
+            dims = []
+            for d, ax in enumerate(ds[: len(shape)]):
+                ok = (ax is not None and ax in mesh.axis_names
+                      and mesh.shape[ax] > 1 and shape[d] is not None
+                      and shape[d] % mesh.shape[ax] == 0)
+                dims.append(ax if ok else None)
+            return P(*dims)
+        axis = getattr(v, "dist_hint", None)
+        if axis is None or axis not in mesh.axis_names \
+                or mesh.shape[axis] <= 1:
+            return None
+        shape = v.shape
+        if not shape or shape[0] is None or shape[0] % mesh.shape[axis] != 0:
+            return None
+        return P(*([axis] + [None] * (len(shape) - 1)))
+
+    has_hints = any(
+        getattr(v, "dist_hint", None) in mesh.axis_names
+        or any(ax in mesh.axis_names
+               for ax in (getattr(v, "dist_spec", None) or ()) if ax)
+        for v in program.global_block().vars.values()
+        if isinstance(v, Parameter))
+    if not has_tp and not has_dp and not has_hints:
+        return {n: P() for n in set(plan.state_in) | set(plan.state_out)}
+    tp_size = mesh.shape[tp_axis] if has_tp else 1
+    dp_size = mesh.shape[dp_axis] if has_dp else 1
+    gb = program.global_block()
+
+    def spec_for_shape(shape) -> P:
+        if not has_tp or shape is None or len(shape) < 2:
+            return P()
+        # shard last dim if divisible, else second-to-last, else replicate
+        if shape[-1] is not None and shape[-1] % tp_size == 0 and shape[-1] >= tp_size:
+            return P(*([None] * (len(shape) - 1) + [tp_axis]))
+        if shape[0] is not None and shape[0] % tp_size == 0 and shape[0] >= tp_size:
+            return P(*([tp_axis] + [None] * (len(shape) - 1)))
+        return P()
+
+    def zero1_spec(shape, base: P) -> P:
+        """Shard an accumulator's first dp-divisible, not-already-sharded
+        dim on dp (ZeRO-1)."""
+        if not has_dp or shape is None:
+            return base
+        used = list(base) + [None] * (len(shape) - len(base))
+        for d, n in enumerate(shape):
+            if used[d] is None and n is not None and n % dp_size == 0 \
+                    and n >= dp_size:
+                used[d] = dp_axis
+                return P(*used)
+        return base
+
+    specs: Dict[str, P] = {}
+    param_shapes = {}
+    for name in set(plan.state_in) | set(plan.state_out):
+        if name == RNG_STATE_VAR:
+            specs[name] = P()
+            continue
+        if gb._has_var_recursive(name):
+            v = gb._var_recursive(name)
+            hs = hint_spec(v) if isinstance(v, Parameter) else None
+            if hs is not None:
+                specs[name] = hs
+                param_shapes[name] = tuple(v.shape)
+                continue
+            if isinstance(v, Parameter) and v.shape is not None \
+                    and len(v.shape) == 2:
+                specs[name] = spec_for_shape(v.shape)
+                param_shapes[name] = tuple(v.shape)
+                continue
+            if isinstance(v, Parameter):
+                specs[name] = P()
+                param_shapes[name] = tuple(v.shape) if v.shape else None
+                continue
+        specs[name] = None  # decide below (maybe accumulator)
+    # accumulators share their param's spec (plus dp under ZeRO-1) so
+    # optimizer math stays local.  Ownership comes from the optimizer's
+    # explicit registry (Program._accumulator_owner, written by
+    # Optimizer._add_accumulator); the name-containment fallback only covers
+    # programs rebuilt without an optimizer object (e.g. deserialized).
+    acc_owner = getattr(program, "_accumulator_owner", {})
+    for name, spec in list(specs.items()):
+        if spec is not None:
+            continue
+        v = gb._var_recursive(name) if gb._has_var_recursive(name) else None
+        shape = tuple(v.shape) if v is not None and v.shape else None
+        matched = P()
+        pname = acc_owner.get(name)
+        if pname is not None:
+            if pname in param_shapes and shape == param_shapes[pname] \
+                    and shape is not None:
+                matched = zero1_spec(shape, specs[pname])
+            # else: shape-[1] state like beta_pow stays replicated
+        else:
+            for pname, pshape in param_shapes.items():
+                if pname in name and shape == pshape and shape is not None:
+                    matched = zero1_spec(shape, specs[pname])
+                    break
+        specs[name] = matched
+    return specs
+
+
+class ShardedTrainStep:
+    """A Program's block jitted over a mesh with explicit shardings.
+
+    Used by __graft_entry__.dryrun_multichip and the multihost runner; the
+    single-host ParallelExecutor uses the degenerate dp-only version.
+    """
+
+    def __init__(self, program: Program, feed_names: List[str],
+                 fetch_names: List[str], mesh: Mesh, tp_axis: str = "mp",
+                 donate: bool = False, zero1: bool = False,
+                 multihost: bool = False):
+        self.program = program
+        self.mesh = mesh
+        self.multihost = multihost
+        self.plan = BlockPlan(program, 0, feed_names, fetch_names)
+        self.specs = infer_param_specs(program, self.plan, mesh, tp_axis,
+                                       zero1=zero1)
+        self.bspec = batch_spec(mesh)
+        self._bdiv = None  # lazy: jax.process_index needs initialized dist
+
+        plan = self.plan
+
+        def fn(feed_vals, state_vals):
+            with mesh_scope(mesh):
+                return trace_block(program, 0, plan, feed_vals, state_vals)
+
+        # input shardings are carried by the placed arrays (place_feed /
+        # place_state); pin the output state so updated params keep their
+        # layout across steps, and pin fetches replicated so every host can
+        # materialize them (Fluid fetch semantics: full value on host).
+        out_state_names = list(plan.state_out) + \
+            ([RNG_STATE_VAR] if plan.needs_rng else [])
+        out_shardings = (
+            NamedSharding(mesh, P()),
+            {k: NamedSharding(mesh, self.specs.get(k, P()))
+             for k in out_state_names},
+        )
+        self._fn = jax.jit(
+            fn,
+            out_shardings=out_shardings,
+            donate_argnums=(1,) if donate else ())
+
+    def _place(self, val, sh: NamedSharding, from_full: bool = False):
+        """from_full=True: ``val`` is the FULL global value on every host
+        (state vars after identical init) — sharded specs slice it.
+        from_full=False: ``val`` is this process's LOCAL piece (feeds) —
+        sharded specs concatenate across processes."""
+        if isinstance(val, jax.Array) and getattr(val, "sharding", None) == sh:
+            return val
+        if self.multihost:
+            if isinstance(val, jax.Array) and not val.is_fully_addressable:
+                return val  # already a global array from a previous step
+            from . import multihost as mh
+
+            arr = np.asarray(val)
+            if sh.spec == P() or from_full:
+                # State must be bit-identical across hosts; broadcast
+                # process 0's value rather than trusting per-host init
+                # (ref: parallel_executor.cc:234 BCastParamsToDevices).
+                from jax.experimental import multihost_utils as mhu
+
+                arr = np.asarray(mhu.broadcast_one_to_all(arr))
+            if from_full and sh.spec != P():
+                # full value everywhere + sharded spec (ZeRO-1 accumulators,
+                # mp weights): each device takes ITS SLICE of the full
+                # array — host_local concatenation would inflate the shape
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
+            return mh.host_local_to_global(arr, self.mesh, sh.spec)
+        return jax.device_put(jnp.asarray(val), sh)
+
+    def place_state(self, scope=None):
+        """Place scope state onto the mesh with the chosen shardings."""
+        scope = scope or global_scope()
+        state = {}
+        for name in self.plan.state_in:
+            val = scope.get(name, _MISSING)
+            if val is _MISSING:
+                raise RuntimeError(f"state var {name} missing from scope")
+            sh = NamedSharding(self.mesh, self.specs.get(name, P()))
+            state[name] = self._place(val, sh, from_full=True)
+        if self.plan.needs_rng:
+            rk = scope.get(RNG_STATE_VAR, _MISSING)
+            if rk is _MISSING:
+                rk = jax.random.PRNGKey(self.program.random_seed or 0)
+            state[RNG_STATE_VAR] = self._place(
+                rk, NamedSharding(self.mesh, P()), from_full=True)
+        return state
+
+    def _batch_divisor(self) -> int:
+        """How many equal shards this process's feed must split into: the
+        whole batch-axis size single-host, but only the LOCAL extent of the
+        batch axes multihost (each process feeds its local batch; the batch
+        axis may span processes — dp over DCN — or live inside one)."""
+        axes = [ax for ax in self.bspec if ax is not None]
+        if not axes:
+            return 1
+        if not self.multihost:
+            n = 1
+            for ax in axes:
+                n *= self.mesh.shape[ax]
+            return n
+        pid = jax.process_index()
+        devs = self.mesh.devices
+        local = np.vectorize(lambda d: d.process_index == pid)(devs)
+        n = 1
+        for ax in axes:
+            ai = list(self.mesh.axis_names).index(ax)
+            n *= sum(1 for i in range(devs.shape[ai])
+                     if np.take(local, i, axis=ai).any())
+        return n
+
+    def place_feed(self, feed: Dict[str, np.ndarray]):
+        """Shard feeds on the batch axis.  Multihost: each process passes its
+        LOCAL batch; the global batch is num_processes x local.
+
+        Uneven final batches (ref: details/data_balance_op_handle.cc — the
+        reference redistributes short batches so no device sees a ragged
+        shard): a batch whose leading dim is NOT divisible by the dp size
+        cannot shard evenly, so it executes REPLICATED — every device
+        computes the full short batch, which is mathematically identical to
+        the single-device result (exact loss, exact update; no padding
+        bias).  It costs the dp speedup for that one (final) batch and one
+        extra compile for its shape — the shape change forces a recompile
+        anyway."""
+        if self._bdiv is None:
+            self._bdiv = self._batch_divisor()
+        dp_size = self._bdiv
+        arrays = {k: np.asarray(v) for k, v in feed.items()}
+        # 0-d feeds (scalars like a fed learning rate) have no batch dim to
+        # shard; they replicate regardless and must not veto dp sharding
+        batched = {k: a for k, a in arrays.items() if a.ndim > 0}
+        divisible = all(a.shape[0] % dp_size == 0 for a in batched.values())
+        if not divisible and self.multihost:
+            raise ValueError(
+                "multihost batches must be dp-divisible per process "
+                f"(local dp extent {dp_size}); pad or drop the final short "
+                f"batch "
+                f"(got shapes { {k: a.shape for k, a in batched.items()} })")
+        sh = NamedSharding(self.mesh,
+                           self.bspec if divisible else P())
+        rep = NamedSharding(self.mesh, P())
+        out = {}
+        gb = self.program.global_block()
+        for k, arr in arrays.items():
+            if gb._has_var_recursive(k):
+                want = core.np_dtype(gb._var_recursive(k).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            out[k] = self._place(arr, sh if arr.ndim > 0 else rep)
+        return out
+
+    def fetch_to_host(self, val) -> np.ndarray:
+        from . import multihost as mh
+
+        return mh.fetch_to_host(val)
+
+    def __call__(self, feed, state):
+        return self._fn(feed, state)
+
+
+def shard_program_step(program, feed_names, fetch_names, mesh, **kw):
+    return ShardedTrainStep(program, feed_names, fetch_names, mesh, **kw)

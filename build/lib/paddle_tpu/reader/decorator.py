@@ -1,0 +1,239 @@
+"""Reader decorators (ref: python/paddle/reader/decorator.py:36-443)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from queue import Queue
+from threading import Thread
+
+__all__ = ["PipeReader", "map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "cache"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in zip_longest_check(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    def zip_longest_check(*iters):
+        sentinel = object()
+        for row in itertools.zip_longest(*iters, fillvalue=sentinel):
+            if sentinel in row:
+                raise ComposeNotAligned("readers have different lengths")
+            yield row
+
+    return reader
+
+
+def buffered(reader, size):
+    class EndSignal:
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel-map a reader with worker threads (ref: decorator.py:243)."""
+    end = object()
+
+    def data_reader():
+        in_q = Queue(buffer_size)
+        out_q = Queue(buffer_size)
+
+        def feed():
+            for sample in reader():
+                in_q.put(sample)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                sample = in_q.get()
+                if sample is end:
+                    out_q.put(end)
+                    return
+                out_q.put(mapper(sample))
+
+        feeder = Thread(target=feed)
+        feeder.daemon = True
+        feeder.start()
+        workers = []
+        for _ in range(process_num):
+            w = Thread(target=work)
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        finished = 0
+        while finished < process_num:
+            sample = out_q.get()
+            if sample is end:
+                finished += 1
+            else:
+                yield sample
+
+    return data_reader
+
+
+def cache(reader):
+    all_data = []
+
+    def cache_reader():
+        if not all_data:
+            all_data.extend(reader())
+        for d in all_data:
+            yield d
+
+    return cache_reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (ref:
+    python/paddle/reader/decorator.py:438 — used to read sharded datasets
+    from `hadoop fs -cat` style pipes).  ``get_line`` yields decoded lines
+    split on ``line_break``; callers parse each into a sample."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("PipeReader command must be a string")
+        import subprocess
+
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+        if file_type == "gzip":
+            import zlib
+
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        elif file_type != "plain":
+            raise TypeError(f"file_type {file_type} is not allowed")
+
+    def close(self):
+        if self.process.poll() is None:
+            self.process.terminate()
+        if self.process.stdout and not self.process.stdout.closed:
+            self.process.stdout.close()
+        self.process.wait()
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
+        import zlib
+
+        # incremental decoder: a multibyte UTF-8 char split across the
+        # bufsize boundary must not be dropped
+        decoder = codecs.getincrementaldecoder("utf-8")("ignore")
+        remained = ""
+        try:
+            while True:
+                buff = self.process.stdout.read(self.bufsize)
+                if not buff:
+                    break
+                if self.file_type == "gzip":
+                    out = [self.dec.decompress(buff)]
+                    # concatenated members (one per shard in `cat *.gz`
+                    # pipes): restart the decompressor on leftover bytes —
+                    # but only when they start a real member; gzip(1)
+                    # tolerates trailing garbage (block padding) and so
+                    # must we
+                    while self.dec.eof and \
+                            self.dec.unused_data.startswith(b"\x1f\x8b"):
+                        rest = self.dec.unused_data
+                        self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                        out.append(self.dec.decompress(rest))
+                    buff = b"".join(out)
+                decomp_buff = decoder.decode(buff)
+                if not cut_lines:
+                    yield decomp_buff
+                    continue
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            remained += decoder.decode(b"", final=True)
+            if remained:
+                yield remained
+        finally:
+            # consumers that stop early (firstn) must not leak the child
+            self.close()

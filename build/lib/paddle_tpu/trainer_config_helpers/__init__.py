@@ -1,0 +1,350 @@
+"""trainer_config_helpers — the legacy v2-generation model-config DSL,
+lowered onto Fluid programs (ref: python/paddle/trainer_config_helpers/
+layers.py — img_conv_layer :2331, batch_norm_layer :3050, img_pool_layer
+:2542, fc_layer :1003, addto_layer :3434; networks.py img_conv_group;
+optimizers.py settings/MomentumOptimizer; attrs.py ExtraAttr).
+
+The reference generation builds a protobuf ModelConfig consumed by the C++
+GradientMachine (legacy/gserver/gradientmachines/GradientMachine.h:75); its
+layer/trainer capabilities are a strict subset of the Fluid surface, so
+here each helper simply appends the equivalent Fluid ops to the default
+program and returns the fluid Variable — one substrate, two front ends.
+The subset implemented is what the reference's own v2-era benchmark
+configs use (benchmark/paddle/image/{vgg,resnet}.py + common extras); a
+config file written against the reference runs unchanged after swapping
+the import.
+
+v2 configs are geometry-implicit (data_layer carries a flat ``size``; conv
+layers recover [C, H, W] from ``num_channels`` assuming square images, the
+reference's own default when the provider does not say otherwise).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..fluid import layers, nets, optimizer as fluid_opt, regularizer
+
+__all__ = [
+    "get_config_arg", "set_config_args", "settings", "outputs",
+    "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
+    "batch_norm_layer", "addto_layer", "img_conv_group", "dropout_layer",
+    "embedding_layer", "img_cmrnorm_layer", "concat_layer",
+    "cross_entropy", "classification_cost",
+    "LinearActivation", "ReluActivation", "SoftmaxActivation",
+    "TanhActivation", "SigmoidActivation", "MaxPooling", "AvgPooling",
+    "MomentumOptimizer", "AdamOptimizer", "L2Regularization", "ExtraAttr",
+    "ParamAttr", "define_py_data_sources2", "get_settings",
+]
+
+
+# --- config args (ref: the trainer binary's --config_args) ---------------
+
+_config_args = {}
+
+
+def set_config_args(**kwargs):
+    """Test/driver hook standing in for the reference's --config_args."""
+    _config_args.update(kwargs)
+
+
+def get_config_arg(name, type_, default=None):
+    v = _config_args.get(name, default)
+    if v is None:
+        return None
+    if isinstance(v, type_):
+        return v
+    if type_ is bool and isinstance(v, str):
+        # the reference DSL parses bool config args numerically;
+        # bool("0")/bool("False") == True would silently flip flags
+        return v.strip().lower() not in ("", "0", "false", "no", "off")
+    return type_(v)
+
+
+# --- activations / pooling markers (ref: activations.py, poolings.py) ----
+
+
+class _Activation:
+    fluid_name = None
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class LinearActivation(_Activation):
+    fluid_name = None
+
+
+class ReluActivation(_Activation):
+    fluid_name = "relu"
+
+
+class SoftmaxActivation(_Activation):
+    fluid_name = "softmax"
+
+
+class TanhActivation(_Activation):
+    fluid_name = "tanh"
+
+
+class SigmoidActivation(_Activation):
+    fluid_name = "sigmoid"
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, str):
+        return act or None
+    return act.fluid_name
+
+
+class MaxPooling:
+    fluid_name = "max"
+
+
+class AvgPooling:
+    fluid_name = "avg"
+
+
+def _pool_name(p):
+    return getattr(p, "fluid_name", None) or "max"
+
+
+# --- attrs / optimizers / settings ---------------------------------------
+
+
+class ExtraAttr:
+    """ref attrs.py ExtraLayerAttribute — only drop_rate is meaningful on
+    the Fluid substrate (device placement is XLA's business)."""
+
+    def __init__(self, drop_rate=0.0, **kwargs):
+        self.drop_rate = drop_rate
+
+
+ExtraLayerAttribute = ExtraAttr
+
+
+class ParamAttr:
+    def __init__(self, name=None, initial_std=None, initial_mean=None,
+                 learning_rate=None, **kwargs):
+        self.name = name
+
+
+class MomentumOptimizer:
+    def __init__(self, momentum=0.9):
+        self.momentum = momentum
+
+    def build(self, lr, reg):
+        return fluid_opt.Momentum(learning_rate=lr, momentum=self.momentum,
+                                  regularization=reg)
+
+
+class AdamOptimizer:
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.kw = dict(beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+    def build(self, lr, reg):
+        return fluid_opt.Adam(learning_rate=lr, regularization=reg,
+                              **self.kw)
+
+
+class L2Regularization:
+    def __init__(self, rate):
+        self.rate = rate
+
+    def build(self):
+        return regularizer.L2DecayRegularizer(self.rate)
+
+
+_settings = {}
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, **kwargs):
+    """ref optimizers.py settings(): record the training hyper-parameters;
+    v2.trainer.SGD (or the caller) turns them into a Fluid optimizer."""
+    _settings.clear()
+    _settings.update(batch_size=batch_size, learning_rate=learning_rate,
+                     learning_method=learning_method,
+                     regularization=regularization)
+
+
+def get_settings():
+    return dict(_settings)
+
+
+def build_settings_optimizer():
+    """Fluid optimizer from the last settings() call."""
+    method = _settings.get("learning_method") or MomentumOptimizer(0.0)
+    reg = _settings.get("regularization")
+    return method.build(_settings.get("learning_rate", 1e-3),
+                        reg.build() if reg is not None else None)
+
+
+_outputs = []
+
+
+def outputs(*layers_):
+    """ref config_parser outputs(): mark the topology's sink layers."""
+    _outputs[:] = list(layers_)
+
+
+def get_outputs():
+    return list(_outputs)
+
+
+def define_py_data_sources2(train_list, test_list, module=None, obj=None,
+                            args=None):
+    """Data comes from Python readers on this substrate; the declaration
+    is accepted for config compatibility and otherwise inert."""
+    return None
+
+
+# --- layers --------------------------------------------------------------
+
+
+def data_layer(name, size, height=None, width=None, depth=None):
+    """Flat [size] float input (v2 geometry convention).  Labels are
+    declared with data_layer too in v2 configs; integer-classification use
+    is detected at the cost layer, not here."""
+    v = layers.data(name=name, shape=[int(size)], dtype="float32")
+    v._v2_geom = (height, width)
+    return v
+
+
+def _to_nchw(input, num_channels):
+    """Recover [N, C, H, W] from a flat v2 data layer when needed."""
+    shape = input.shape
+    if shape is not None and len(shape) >= 4:
+        return input, int(shape[1])
+    size = int(shape[-1])
+    geom = getattr(input, "_v2_geom", None) or (None, None)
+    if num_channels is None:
+        num_channels = 3 if size % 3 == 0 else 1
+    if geom[0]:
+        h, w = int(geom[0]), int(geom[1] or geom[0])
+    else:
+        h = w = int(math.isqrt(size // num_channels))
+    return layers.reshape(input, [-1, num_channels, h, w]), num_channels
+
+
+# the reference DSL wraps every layer in @wrap_act_default; configs rely
+# on these implicit activations (fc->tanh, conv/bn->relu, addto->linear)
+def _default_act(act, default):
+    return default if act is None else act
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    act = _default_act(act, TanhActivation())
+    out = layers.fc(input=input, size=int(size), act=_act_name(act),
+                    name=name)
+    if layer_attr is not None and getattr(layer_attr, "drop_rate", 0):
+        out = layers.dropout(out, dropout_prob=layer_attr.drop_rate)
+    return out
+
+
+def img_conv_layer(input, filter_size, num_filters, name=None,
+                   num_channels=None, act=None, groups=1, stride=1,
+                   padding=0, bias_attr=None, param_attr=None,
+                   trans=False, layer_attr=None):
+    act = _default_act(act, ReluActivation())
+    x, _ = _to_nchw(input, num_channels)
+    return layers.conv2d(input=x, num_filters=int(num_filters),
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, groups=groups,
+                         act=_act_name(act), bias_attr=bias_attr,
+                         name=name)
+
+
+def img_pool_layer(input, pool_size, name=None, num_channels=None,
+                   pool_type=None, stride=1, padding=0, layer_attr=None,
+                   **kwargs):
+    x, _ = _to_nchw(input, num_channels)
+    return layers.pool2d(input=x, pool_size=pool_size,
+                         pool_type=_pool_name(pool_type),
+                         pool_stride=stride, pool_padding=padding)
+
+
+def batch_norm_layer(input, act=None, name=None, num_channels=None,
+                     use_global_stats=None, moving_average_fraction=0.9,
+                     layer_attr=None, **kwargs):
+    act = _default_act(act, ReluActivation())
+    x, _ = _to_nchw(input, num_channels)
+    return layers.batch_norm(input=x, act=_act_name(act),
+                             is_test=bool(use_global_stats),
+                             momentum=moving_average_fraction)
+
+
+def addto_layer(input, act=None, name=None, bias_attr=None):
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    out = input[0]
+    for other in input[1:]:
+        out = layers.elementwise_add(out, other)
+    a = _act_name(act)  # reference default: LinearActivation
+    if a:
+        out = getattr(layers, a)(out)
+    return out
+
+
+def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75, name=None,
+                      num_channels=None, layer_attr=None):
+    """Cross-map response normalization (ref layers.py:3199; AlexNet's
+    LRN).  The v2 ``scale`` is the per-window alpha of the fluid lrn op."""
+    x, _ = _to_nchw(input, num_channels)
+    return layers.lrn(x, n=int(size), k=1.0, alpha=scale, beta=power,
+                      name=name)
+
+
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
+    """Channel concat (ref layers.py:3527; default IdentityActivation)."""
+    out = layers.concat(list(input), axis=1)
+    a = _act_name(act)
+    if a:
+        out = getattr(layers, a)(out)
+    return out
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_batchnorm_drop_rate=0, conv_with_batchnorm=False,
+                   pool_stride=1, pool_type=None, **kwargs):
+    x, _ = _to_nchw(input, num_channels)
+    return nets.img_conv_group(
+        input=x, conv_num_filter=list(conv_num_filter),
+        pool_size=pool_size, conv_padding=conv_padding,
+        conv_filter_size=conv_filter_size, conv_act=_act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm,
+        conv_batchnorm_drop_rate=conv_batchnorm_drop_rate,
+        pool_stride=pool_stride, pool_type=_pool_name(pool_type))
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    return layers.dropout(input, dropout_prob=dropout_rate)
+
+
+def embedding_layer(input, size, name=None, param_attr=None):
+    return layers.embedding(input=input, size=size)
+
+
+def _as_label(label):
+    """v2 declares classification labels as data_layer(size=num_class);
+    the cost layer reinterprets them as int64 class ids [N, 1]."""
+    if label.dtype is not None and "int" in str(label.dtype):
+        return label
+    relabeled = layers.cast(label, "int64")
+    return layers.reshape(relabeled, [-1, 1]) \
+        if len(relabeled.shape or ()) == 2 and relabeled.shape[-1] != 1 \
+        else relabeled
+
+
+def cross_entropy(input, label, name=None, **kwargs):
+    return layers.mean(
+        layers.cross_entropy(input=input, label=_as_label(label)))
+
+
+def classification_cost(input, label, name=None, **kwargs):
+    return cross_entropy(input, label, name=name)

@@ -1,0 +1,31 @@
+"""paddle.v2 compatibility facade (ref: python/paddle/v2/__init__.py).
+
+The v2 generation drove a C++ GradientMachine via swig
+(ref: v2/trainer.py:37 SGD, legacy/gserver/gradientmachines/
+GradientMachine.h:75); every capability it exposed — layer config, SGD
+event loop, parameters dict — is a subset of the Fluid surface, so this
+facade lowers the v2 API onto Fluid programs and the TPU executor.
+A v2-era training script (init / layer graph / parameters.create /
+trainer.SGD(...).train(reader, event_handler)) runs unchanged.
+"""
+
+from __future__ import annotations
+
+from .. import batch, reader  # reader composition is shared with v2
+from ..trainer_config_helpers import (AdamOptimizer, AvgPooling,
+                                      LinearActivation, MaxPooling,
+                                      MomentumOptimizer, ReluActivation,
+                                      SigmoidActivation, SoftmaxActivation,
+                                      TanhActivation)
+from . import activation, data_type, event, layer, optimizer, parameters, \
+    pooling, trainer
+
+__all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
+           "data_type", "event", "optimizer", "parameters", "trainer"]
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """ref v2/__init__.py init(): swig_paddle.initPaddle arg marshalling.
+    Device selection is the executor's Place on this substrate; accepted
+    for script compatibility."""
+    return None

@@ -1,0 +1,10 @@
+"""v2 activation namespace (ref: python/paddle/v2/activation.py — renames
+trainer_config_helpers activations: Relu == ReluActivation etc.)."""
+
+from ..trainer_config_helpers import (LinearActivation as Linear,
+                                      ReluActivation as Relu,
+                                      SigmoidActivation as Sigmoid,
+                                      SoftmaxActivation as Softmax,
+                                      TanhActivation as Tanh)
+
+__all__ = ["Linear", "Relu", "Sigmoid", "Softmax", "Tanh"]

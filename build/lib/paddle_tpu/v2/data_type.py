@@ -1,0 +1,35 @@
+"""v2 data-type declarations (ref: python/paddle/v2/data_type.py — thin
+wrappers over trainer.PyDataProvider2 input types)."""
+
+from __future__ import annotations
+
+
+class InputType:
+    def __init__(self, dim, dtype, seq=False):
+        self.dim = dim
+        self.dtype = dtype
+        self.seq = seq
+
+
+def dense_vector(dim):
+    return InputType(dim, "float32")
+
+
+def dense_array(dim):
+    return InputType(dim, "float32")
+
+
+def integer_value(value_range):
+    return InputType(value_range, "int64")
+
+
+def sparse_binary_vector(dim):
+    return InputType(dim, "float32")
+
+
+def integer_value_sequence(value_range):
+    return InputType(value_range, "int64", seq=True)
+
+
+def dense_vector_sequence(dim):
+    return InputType(dim, "float32", seq=True)

@@ -1,0 +1,44 @@
+"""v2 training events (ref: python/paddle/v2/event.py — BeginPass :58,
+EndPass :67, BeginIteration :80, EndIteration :89, TestResult :48).
+Fired by trainer.SGD.train around every batch/pass."""
+
+from __future__ import annotations
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult"]
+
+
+class WithMetric:
+    def __init__(self, metrics=None):
+        self.metrics = dict(metrics or {})
+
+
+class TestResult(WithMetric):
+    def __init__(self, cost, metrics=None):
+        super().__init__(metrics)
+        self.cost = cost
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, metrics=None):
+        super().__init__(metrics)
+        self.pass_id = pass_id
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        super().__init__(metrics)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost

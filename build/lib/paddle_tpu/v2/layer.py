@@ -1,0 +1,39 @@
+"""v2 layer namespace (ref: python/paddle/v2/layer.py — the v2 book API:
+``paddle.layer.data/fc/conv/...``), lowered onto Fluid like
+trainer_config_helpers (one substrate, both v2 front ends)."""
+
+from __future__ import annotations
+
+from ..fluid import layers as _fl
+from ..trainer_config_helpers import (_act_name, _to_nchw, addto_layer,
+                                      batch_norm_layer, classification_cost,
+                                      cross_entropy, dropout_layer,
+                                      embedding_layer, fc_layer,
+                                      img_conv_layer, img_pool_layer)
+
+__all__ = ["data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
+           "addto", "dropout", "cross_entropy_cost", "classification_cost",
+           "mse_cost"]
+
+
+def data(name, type):
+    """paddle.v2.layer.data(name=..., type=paddle.data_type.X(dim))."""
+    v = _fl.data(name=name, shape=[int(type.dim)], dtype=type.dtype)
+    if type.dtype == "int64":
+        # classification labels / token ids arrive as [N, 1] ids
+        v.shape = (v.shape[0], 1)
+    return v
+
+
+fc = fc_layer
+embedding = embedding_layer
+img_conv = img_conv_layer
+img_pool = img_pool_layer
+batch_norm = batch_norm_layer
+addto = addto_layer
+dropout = dropout_layer
+cross_entropy_cost = cross_entropy
+
+
+def mse_cost(input, label, name=None):
+    return _fl.mean(_fl.square_error_cost(input=input, label=label))

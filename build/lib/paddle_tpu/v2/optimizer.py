@@ -1,0 +1,68 @@
+"""v2 optimizers (ref: python/paddle/v2/optimizer.py — Momentum :183,
+Adam :220, AdaGrad, RMSProp; each wrapped the swig ParameterUpdater).
+Here each builds the matching Fluid optimizer at SGD-construction time."""
+
+from __future__ import annotations
+
+from ..fluid import optimizer as fluid_opt, regularizer as fluid_reg
+
+__all__ = ["Optimizer", "Momentum", "Adam", "AdaGrad", "RMSProp"]
+
+
+def _reg(regularization):
+    if regularization is None:
+        return None
+    if isinstance(regularization, fluid_reg.WeightDecayRegularizer):
+        return regularization
+    # trainer_config_helpers.L2Regularization marker
+    build = getattr(regularization, "build", None)
+    return build() if build else None
+
+
+class Optimizer:
+    def __init__(self, learning_rate=1e-3, regularization=None, **kwargs):
+        self.learning_rate = learning_rate
+        self.regularization = _reg(regularization)
+
+    def build(self):
+        raise NotImplementedError
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, sparse=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def build(self):
+        return fluid_opt.Momentum(learning_rate=self.learning_rate,
+                                  momentum=self.momentum,
+                                  regularization=self.regularization)
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def build(self):
+        return fluid_opt.Adam(learning_rate=self.learning_rate,
+                              beta1=self.beta1, beta2=self.beta2,
+                              epsilon=self.epsilon,
+                              regularization=self.regularization)
+
+
+class AdaGrad(Optimizer):
+    def build(self):
+        return fluid_opt.Adagrad(learning_rate=self.learning_rate,
+                                 regularization=self.regularization)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def build(self):
+        return fluid_opt.RMSProp(learning_rate=self.learning_rate,
+                                 rho=self.rho, epsilon=self.epsilon,
+                                 regularization=self.regularization)

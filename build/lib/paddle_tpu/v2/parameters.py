@@ -1,0 +1,70 @@
+"""v2 Parameters (ref: python/paddle/v2/parameters.py — a name->ndarray
+dict view over the GradientMachine's parameters; here a view over the
+fluid global scope, where Fluid keeps the same state)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    def __init__(self, program, scope=None):
+        from ..fluid.executor import global_scope
+
+        self._program = program
+        self._scope = scope or global_scope()
+
+    def names(self):
+        return [p.name for p in
+                self._program.global_block().all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, key):
+        return key in self.names()
+
+    def __contains__(self, key):
+        return self.has_key(key)
+
+    def get(self, key):
+        return np.asarray(self._scope.get(key))
+
+    def __getitem__(self, key):
+        return self.get(key)
+
+    def set(self, key, value):
+        self._scope.set(key, np.asarray(value))
+
+    def __setitem__(self, key, value):
+        self.set(key, value)
+
+    def to_tar(self, f):
+        """ref parameters.py to_tar — the v2 checkpoint container.  The
+        substrate's native format is one .npz; keep the method name so v2
+        scripts save/restore unchanged."""
+        np.savez(f, **{n: self.get(n) for n in self.names()})
+
+    @staticmethod
+    def from_tar(f):
+        data = np.load(f)
+        loaded = _LoadedParameters({n: data[n] for n in data.files})
+        return loaded
+
+    def init_from_tar(self, f):
+        data = np.load(f)
+        for n in data.files:
+            if self.has_key(n):
+                self.set(n, data[n])
+
+
+class _LoadedParameters(dict):
+    def get(self, key):  # noqa: A003 - v2 API name
+        return self[key]
+
+
+def create(cost):
+    """ref parameters.py create(topology): parameters of cost's program."""
+    return Parameters(cost.block.program)

@@ -1,0 +1,5 @@
+"""v2 pooling namespace (ref: python/paddle/v2/pooling.py)."""
+
+from ..trainer_config_helpers import (AvgPooling as Avg, MaxPooling as Max)
+
+__all__ = ["Max", "Avg"]

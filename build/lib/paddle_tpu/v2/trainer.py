@@ -1,0 +1,103 @@
+"""v2 SGD trainer + event loop (ref: python/paddle/v2/trainer.py:37 SGD,
+:137 train — reader loop around a swig GradientMachine's
+forwardBackward + ParameterUpdater).  Here the cost's Fluid program is the
+topology, Optimizer.build().minimize is the update equation, and the Fluid
+Executor runs the jitted step; the v2 event protocol (BeginPass /
+BeginIteration / EndIteration / EndPass, trainer.test -> TestResult) is
+preserved verbatim so v2 scripts' monitoring loops run unchanged."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import fluid
+from . import event as v2_event
+from . import optimizer as v2_optimizer
+from .parameters import Parameters
+
+__all__ = ["SGD"]
+
+
+class SGD:
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, **kwargs):
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters should be a Parameters object "
+                            "(paddle.parameters.create(cost))")
+        if not isinstance(update_equation, v2_optimizer.Optimizer):
+            raise TypeError("update_equation must be a v2 Optimizer")
+        self._cost = cost
+        self._parameters = parameters
+        self._program = cost.block.program
+        self._startup = fluid.default_startup_program()
+        update_equation.build().minimize(cost)
+        self._extra = list(extra_layers or [])
+        self._place = fluid.CPUPlace() if not _accel() else fluid.TPUPlace()
+        self._exe = fluid.Executor(self._place)
+        self._exe.run(self._startup)
+        self._test_program = None
+
+    def _feed(self, data_batch, feeding):
+        """feeding: {data_layer_name: column index} (ref trainer.py:137
+        DataFeeder contract).  Without it, columns map to the program's
+        data layers in declaration order."""
+        gb = self._program.global_block()
+        data_vars = [v for v in gb.vars.values()
+                     if getattr(v, "is_data", False)]
+        if feeding is None:
+            feeding = {v.name: i for i, v in enumerate(data_vars)}
+        feed = {}
+        for v in data_vars:
+            col = feeding.get(v.name)
+            if col is None:
+                continue
+            vals = [np.asarray(row[col]) for row in data_batch]
+            arr = np.stack(vals)
+            if v.dtype is not None and "int" in str(v.dtype):
+                # scalar class labels become [N, 1]; integer SEQUENCES
+                # (n-gram windows etc.) keep all their columns
+                arr = arr.astype(np.int64).reshape(len(vals), -1)
+            else:
+                arr = arr.astype(np.float32).reshape(len(vals), -1)
+            feed[v.name] = arr
+        return feed
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """ref trainer.py:137: for each pass, for each batch: feed,
+        one train step, fire events."""
+        event_handler = event_handler or (lambda e: None)
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                (cost_val,) = self._exe.run(
+                    self._program, feed=self._feed(data_batch, feeding),
+                    fetch_list=[self._cost])
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id,
+                    float(np.asarray(cost_val).reshape(-1)[0])))
+            event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader, feeding=None):
+        """ref trainer.py:216: forward-only pass over the reader; returns
+        the average cost as a TestResult."""
+        if self._test_program is None:
+            self._test_program = self._program.clone(for_test=True)
+        costs, n = [], 0
+        for data_batch in reader():
+            (cost_val,) = self._exe.run(
+                self._test_program, feed=self._feed(data_batch, feeding),
+                fetch_list=[self._cost])
+            costs.append(float(np.asarray(cost_val).reshape(-1)[0])
+                         * len(data_batch))
+            n += len(data_batch)
+        return v2_event.TestResult(cost=sum(costs) / max(n, 1))
+
+    def save_parameter_to_tar(self, f):
+        self._parameters.to_tar(f)
+
+
+def _accel() -> bool:
+    from ..fluid import core
+
+    return core.is_compiled_with_tpu()
