@@ -1322,7 +1322,8 @@ def _stack_params(helper, dtype, n_layer, d_model, d_inner, decoder,
 
 def transformer_encoder_stack(input, bias=None, n_layer=2, n_head=4,
                               d_inner=None, dropout=0.0, is_test=False,
-                              n_microbatches=4, param_attr=None, name=None):
+                              n_microbatches=4, recompute=False,
+                              param_attr=None, name=None):
     """A full transformer ENCODER stack as one mesh-aware op (TPU-native
     capability — see parallel/transformer_stack.py).  input: [N, T, D];
     bias: optional [N, 1, 1, T] additive key bias (padding mask).
@@ -1351,14 +1352,16 @@ def transformer_encoder_stack(input, bias=None, n_layer=2, n_head=4,
         outputs={"Out": [out], "RngKey": [rng_key]},
         attrs={"n_head": int(n_head), "dropout": float(dropout),
                "is_test": bool(is_test),
-               "n_microbatches": int(n_microbatches)})
+               "n_microbatches": int(n_microbatches),
+               "recompute": bool(recompute)})
     return out
 
 
 def transformer_decoder_stack(input, enc_out, src_bias=None, n_layer=2,
                               n_head=4, d_inner=None, dropout=0.0,
                               is_test=False, n_microbatches=4,
-                              param_attr=None, name=None):
+                              recompute=False, param_attr=None,
+                              name=None):
     """A full transformer DECODER stack (causal self-attn + cross-attn +
     FFN per layer) as one mesh-aware op; see transformer_encoder_stack.
     input: [N, Tt, D]; enc_out: [N, Ts, D]; src_bias: [N, 1, 1, Ts]."""
@@ -1381,7 +1384,8 @@ def transformer_decoder_stack(input, enc_out, src_bias=None, n_layer=2,
         outputs={"Out": [out], "RngKey": [rng_key]},
         attrs={"n_head": int(n_head), "dropout": float(dropout),
                "is_test": bool(is_test),
-               "n_microbatches": int(n_microbatches)})
+               "n_microbatches": int(n_microbatches),
+               "recompute": bool(recompute)})
     return out
 
 

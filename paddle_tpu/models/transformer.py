@@ -27,7 +27,8 @@ class Config:
     def __init__(self, name, src_vocab_size, tgt_vocab_size, d_model,
                  d_inner, n_head, n_layer, dropout=0.1, label_smooth=0.1,
                  moe_experts=0, moe_top_k=2, moe_aux_weight=1e-2,
-                 stacked=False, ring_attention=False, n_microbatches=4):
+                 stacked=False, ring_attention=False, n_microbatches=4,
+                 recompute=False):
         self.name = name
         self.src_vocab_size = src_vocab_size
         self.tgt_vocab_size = tgt_vocab_size
@@ -55,6 +56,11 @@ class Config:
         # never materializes under the ring).
         self.ring_attention = ring_attention
         self.n_microbatches = n_microbatches
+        # recompute=True (stacked mode) wraps each layer in
+        # jax.checkpoint: backward rematerializes activations layer by
+        # layer — peak memory O(T*D) instead of O(L*T*D) for long
+        # sequences at the cost of one extra forward
+        self.recompute = recompute
 
 
 def base_config():
@@ -212,7 +218,8 @@ def encoder(src_word, cfg, src_len, aux_losses=None):
         enc = layers.transformer_encoder_stack(
             enc, bias=src_bias, n_layer=cfg.n_layer, n_head=cfg.n_head,
             d_inner=cfg.d_inner, dropout=cfg.dropout,
-            n_microbatches=cfg.n_microbatches)
+            n_microbatches=cfg.n_microbatches,
+            recompute=getattr(cfg, "recompute", False))
         return enc, src_bias
     for i in range(cfg.n_layer):
         attn = _multi_head_attention(
@@ -231,7 +238,8 @@ def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses=None):
         dec = layers.transformer_decoder_stack(
             dec, enc_out, src_bias=src_bias, n_layer=cfg.n_layer,
             n_head=cfg.n_head, d_inner=cfg.d_inner, dropout=cfg.dropout,
-            n_microbatches=cfg.n_microbatches)
+            n_microbatches=cfg.n_microbatches,
+            recompute=getattr(cfg, "recompute", False))
         return layers.fc(dec, cfg.tgt_vocab_size, num_flatten_dims=2,
                          param_attr=ParamAttr(name="out_proj_w"))
     for i in range(cfg.n_layer):

@@ -41,6 +41,7 @@ def _stack_args(ctx, decoder):
         dropout=float(ctx.attr("dropout", 0.0)),
         is_test=bool(ctx.attr("is_test", False)),
         n_micro=int(ctx.attr("n_microbatches", 4)),
+        recompute=bool(ctx.attr("recompute", False)),
         mesh=spmd.active_mesh(),
     )
 
@@ -57,7 +58,7 @@ def _forward(ctx, decoder):
     out = ts.stack_apply(a["kind"], x, a["enc"], a["bias"], a["params"],
                          key, n_head=a["n_head"], dropout=a["dropout"],
                          is_test=a["is_test"], n_micro=a["n_micro"],
-                         mesh=a["mesh"])
+                         mesh=a["mesh"], recompute=a["recompute"])
     return {"Out": out, "RngKey": key}
 
 
@@ -74,7 +75,7 @@ def _backward(ctx, decoder):
             return ts.stack_apply(a["kind"], xx, ee, a["bias"], pp, key,
                                   n_head=a["n_head"], dropout=a["dropout"],
                                   is_test=a["is_test"], n_micro=a["n_micro"],
-                                  mesh=a["mesh"])
+                                  mesh=a["mesh"], recompute=a["recompute"])
 
         _, vjp = jax.vjp(f, x, a["enc"], a["params"])
         gx, genc, gparams = vjp(gout)
@@ -84,7 +85,7 @@ def _backward(ctx, decoder):
             return ts.stack_apply(a["kind"], xx, None, a["bias"], pp, key,
                                   n_head=a["n_head"], dropout=a["dropout"],
                                   is_test=a["is_test"], n_micro=a["n_micro"],
-                                  mesh=a["mesh"])
+                                  mesh=a["mesh"], recompute=a["recompute"])
 
         _, vjp = jax.vjp(f, x, a["params"])
         gx, gparams = vjp(gout)

@@ -279,13 +279,20 @@ def _pspecs(params, decoder, mesh, pp, mp):
 
 def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
                 key, *, n_head: int, dropout: float, is_test: bool,
-                n_micro: int, mesh: Optional[Mesh]):
+                n_micro: int, mesh: Optional[Mesh],
+                recompute: bool = False):
     """Apply a stacked encoder ('enc') or decoder ('dec') to x.
 
     x: [N, T, D]; enc: [N, Ts, D] (decoder only); bias: [N, 1, 1, Tk] or
     None (encoder self / decoder cross key bias); params: stacked arrays
     keyed by ENCODER_SLOTS/DECODER_SLOTS; key: PRNG key (ignored when
     dropout=0 or is_test).
+
+    recompute=True wraps each layer in ``jax.checkpoint``: the backward
+    pass rematerializes activations layer by layer instead of saving them
+    all, cutting peak memory from O(L*T*D) to O(T*D) + one extra forward —
+    the standard long-sequence recipe (and exactly what the reference's
+    memory_optimize pass tried to approximate with var reuse).
     """
     decoder = kind == "dec"
     n_layer = params["WQ"].shape[0]
@@ -308,6 +315,8 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
                 return _encoder_layer(p, xx, bias, kk, attend=attend,
                                       dropout=dropout, is_test=is_test,
                                       mp_axis=None)
+        if recompute:
+            layer_fn = jax.checkpoint(layer_fn)
         return _scan_layers(layer_fn, params, x, key, n_layer)
 
     # pp path: one shard_map over the whole mesh; stages hold L/S layers
@@ -327,6 +336,19 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
 
     attend = _attend_in_shard_map(local_heads, sp)
 
+    def one_layer(p_i, xx, tree, kk):
+        if decoder:
+            return _decoder_layer(
+                p_i, xx, tree.get("enc"), tree.get("bias"), kk,
+                attend=attend, dropout=dropout, is_test=is_test,
+                mp_axis=mp)
+        return _encoder_layer(
+            p_i, xx, tree.get("bias"), kk, attend=attend,
+            dropout=dropout, is_test=is_test, mp_axis=mp)
+
+    if recompute:
+        one_layer = jax.checkpoint(one_layer)
+
     def stage_fn(local_params, tree, t):
         # local_params leaves: [L/S, ...] (this stage's layers)
         xx = tree["x"]
@@ -337,15 +359,7 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
                     key, lax.axis_index(pp)), t), i)
             if dp is not None:
                 kk = jax.random.fold_in(kk, lax.axis_index(dp))
-            if decoder:
-                xx = _decoder_layer(
-                    p_i, xx, tree.get("enc"), tree.get("bias"), kk,
-                    attend=attend, dropout=dropout, is_test=is_test,
-                    mp_axis=mp)
-            else:
-                xx = _encoder_layer(
-                    p_i, xx, tree.get("bias"), kk, attend=attend,
-                    dropout=dropout, is_test=is_test, mp_axis=mp)
+            xx = one_layer(p_i, xx, tree, kk)
         return {**tree, "x": xx}
 
     in_specs = (

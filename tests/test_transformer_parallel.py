@@ -125,3 +125,21 @@ def test_stacked_transformer_trains_with_dropout():
     loss, feeds = _build(cfg, seed=19)
     base, _ = _run_executor(loss, feeds)
     assert np.isfinite(base).all() and base[-1] < base[0], base
+
+
+def test_stacked_recompute_matches_plain():
+    """cfg.recompute wraps each layer in jax.checkpoint; the math is
+    identical, so losses must match the non-remat build exactly."""
+    cfg = _tiny_cfg(stacked=True)
+    loss, feeds = _build(cfg, seed=29)
+    base, init = _run_executor(loss, feeds)
+
+    import paddle_tpu.fluid.framework as fw
+    from paddle_tpu.fluid import unique_name
+
+    fw.fresh_session()
+    unique_name.switch()
+    cfg2 = _tiny_cfg(stacked=True, recompute=True)
+    loss2, feeds2 = _build(cfg2, seed=29)
+    out, init2 = _run_executor(loss2, feeds2)
+    np.testing.assert_allclose(base, out, rtol=1e-5, atol=1e-6)
