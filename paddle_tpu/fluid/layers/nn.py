@@ -37,7 +37,7 @@ __all__ = [
     "multiplex", "pool3d", "random_crop", "rank_loss",
     "image_resize_short", "Print", "load",
     "linear_chain_crf", "crf_decoding", "nce", "hsigmoid", "warpctc",
-    "edit_distance", "ctc_greedy_decoder",
+    "edit_distance", "ctc_greedy_decoder", "sequence_erase",
 ]
 
 
@@ -1137,13 +1137,27 @@ def warpctc(input, label, blank=0, norm_by_times=False):
     return loss_out
 
 
+def sequence_erase(input, tokens=None, name=None):
+    """Remove listed token values from a LoD sequence tensor (ref:
+    layers/nn.py sequence_erase, sequence_erase_op.cc).  Output rows are
+    data-dependent, so the op executes as an eager host island."""
+    helper = LayerHelper("sequence_erase", **locals())
+    out = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype())
+    helper.append_op(
+        type="sequence_erase", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"tokens": [int(t) for t in (tokens or [])]})
+    return out
+
+
 def edit_distance(input, label, normalized=True, ignored_tokens=None):
-    """ref: layers/nn.py edit_distance."""
+    """ref: layers/nn.py edit_distance (ignored tokens are erased from
+    both hypotheses and references first, via sequence_erase)."""
     helper = LayerHelper("edit_distance", **locals())
     if ignored_tokens:
-        raise NotImplementedError(
-            "ignored_tokens: erase tokens in the reader pipeline instead "
-            "(sequence_erase is host-side preprocessing on TPU)")
+        input = sequence_erase(input, tokens=ignored_tokens)
+        label = sequence_erase(label, tokens=ignored_tokens)
     edit_distance_out = helper.create_variable_for_type_inference(
         dtype="float32")
     sequence_num = helper.create_variable_for_type_inference(dtype="int64")

@@ -31,6 +31,8 @@ EAGER_OPS = {
     # data-dependent output count (LoD out) — host postprocessing, like the
     # reference's CPU-pinned kernel (multiclass_nms_op.cc)
     "multiclass_nms",
+    # removes rows by VALUE: output row count depends on the data
+    "sequence_erase",
     # filesystem side effects need concrete values (save_op.cc etc.)
     "save", "load", "save_combine", "load_combine", "delete_var",
     # Faster-RCNN sampling/proposal ops: data-dependent counts + host RNG

@@ -415,7 +415,20 @@ def row_conv(ctx):
 
 @register_op("sequence_erase", no_grad_inputs=("X",))
 def sequence_erase(ctx):
-    raise NotImplementedError(
-        "sequence_erase produces data-dependent shapes (it removes tokens by "
-        "value) and cannot run inside a static XLA trace; erase tokens in "
-        "the reader pipeline instead (paddle_tpu.reader)")
+    """Remove listed token values from packed sequences (ref:
+    sequence_erase_op.cc — post-processing for CTC-style decode output).
+
+    The output row count depends on the DATA, so this is an eager host op
+    (array_ops.EAGER_OPS): the executor runs it between jitted segments
+    with concrete values, the same way the reference pins data-dependent
+    kernels to CPUPlace."""
+    x = np.asarray(ctx.input("X"))
+    tokens = set(int(t) for t in (ctx.attr("tokens") or []))
+    off = ctx.seq_offsets("X")
+    flat = x.reshape(len(x), -1)[:, 0]
+    keep = np.array([int(v) not in tokens for v in flat], bool)
+    new_off = [0]
+    for s, e in zip(off, off[1:]):
+        new_off.append(new_off[-1] + int(keep[s:e].sum()))
+    out = x[keep]
+    return {"Out": jnp.asarray(out), "Out@LOD": (tuple(new_off),)}

@@ -232,3 +232,30 @@ def test_lod_reset():
     (out,) = _run_seq_op("lod_reset", x, [3, 3],
                          attrs={"target_lod": [0, 2, 4, 6]})
     assert out.recursive_sequence_lengths() == [[2, 2, 2]]
+
+
+def test_sequence_erase_and_ignored_edit_distance():
+    """sequence_erase removes rows by VALUE with a data-dependent output
+    LoD (eager host island; ref sequence_erase_op.cc), and edit_distance
+    consumes it for ignored_tokens."""
+    layers = fluid.layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        erased = layers.sequence_erase(x, tokens=[0, 2])
+        ref = layers.data("ref", shape=[1], dtype="int64", lod_level=1)
+        dist, seq_num = layers.edit_distance(x, ref, normalized=False,
+                                             ignored_tokens=[0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = fluid.create_lod_tensor(
+        np.array([[3], [0], [2], [5], [2], [7]], np.int64), [[4, 2]])
+    refv = fluid.create_lod_tensor(
+        np.array([[3], [5], [0], [7]], np.int64), [[2, 2]])
+    out, d = exe.run(main, feed={"x": xv, "ref": refv},
+                     fetch_list=[erased, dist], return_numpy=False)
+    np.testing.assert_array_equal(np.asarray(out).ravel(), [3, 5, 7])
+    assert out.recursive_sequence_lengths() == [[2, 1]]
+    # after erasing 0s: hyps [3,2,5]/[2,7] vs refs [3,5]/[7]
+    # edit distances: [3,2,5]->[3,5] = 1 insertion-ish; [2,7]->[7] = 1
+    np.testing.assert_allclose(np.asarray(d).ravel(), [1.0, 1.0])
