@@ -33,6 +33,16 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+def _amp_einsum(spec, a, b):
+    """Contraction in the AMP compute dtype (bf16 on the MXU) with the
+    result restored to the fp32 activation contract — same recipe as the
+    matmul-class ops (fluid/amp.py cast_operands); identity when AMP off."""
+    from ..fluid import amp
+
+    a2, b2, back = amp.cast_operands(a, b)
+    return amp.restore_astype(jnp.einsum(spec, a2, b2), back)
+
+
 def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o,
                   bias=None):
     """One online-softmax accumulation step of q against a (k, v) block.
@@ -40,7 +50,7 @@ def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o,
     q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; m/l/o are the running max,
     denominator and (unnormalized) output; bias, if given, is an additive
     [B, 1, 1, Tk] key-position bias (padding mask) for THIS k block."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    s = _amp_einsum("bhqd,bhkd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
     if bias is not None:
         s = s + bias
     if causal:
@@ -56,7 +66,7 @@ def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o,
     corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
     corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o_new = o * corr + _amp_einsum("bhqk,bhkd->bhqd", p, v)
     return m_new, l_new, o_new
 
 
@@ -129,7 +139,7 @@ def full_attention(q, k, v, causal: bool = False, scale=None, bias=None):
     no sp mesh is active)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = _amp_einsum("bhqd,bhkd->bhqk", q, k) * scale
     if bias is not None:
         s = s + bias
     if causal:
@@ -137,4 +147,4 @@ def full_attention(q, k, v, causal: bool = False, scale=None, bias=None):
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return _amp_einsum("bhqk,bhkd->bhqd", p, v)

@@ -142,21 +142,30 @@ def _attend_gspmd_ring(n_head, mesh, sp_axis):
     return go
 
 
+def _mm(a, b):
+    """Matmul in the AMP compute dtype (fluid/amp.py recipe: bf16 operands
+    on the MXU, result restored fp32); identity when AMP is off."""
+    from ..fluid import amp
+
+    a2, b2, back = amp.cast_operands(a, b)
+    return amp.restore_astype(a2 @ b2, back)
+
+
 def _mha(p, prefix, x, kv, bias, causal, attend, mp_axis):
     """Projections + attention + output projection for one attention
     sublayer; prefix selects self ("W") or cross ("C") weights."""
-    q = x @ p[prefix + "Q"]
-    k = kv @ p[prefix + "K"]
-    v = kv @ p[prefix + "V"]
-    out = attend(q, k, v, bias, causal) @ p[prefix + "O"]
+    q = _mm(x, p[prefix + "Q"])
+    k = _mm(kv, p[prefix + "K"])
+    v = _mm(kv, p[prefix + "V"])
+    out = _mm(attend(q, k, v, bias, causal), p[prefix + "O"])
     if mp_axis is not None:
         out = lax.psum(out, mp_axis)
     return out
 
 
 def _ffn_sublayer(p, x, key, dropout, is_test, mp_axis, ln):
-    h = jax.nn.relu(x @ p["FFN1W"] + p["FFN1B"])
-    ff = h @ p["FFN2W"]
+    h = jax.nn.relu(_mm(x, p["FFN1W"]) + p["FFN1B"])
+    ff = _mm(h, p["FFN2W"])
     if mp_axis is not None:
         ff = lax.psum(ff, mp_axis)
     ff = ff + p["FFN2B"]
