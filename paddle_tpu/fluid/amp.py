@@ -70,6 +70,23 @@ def amp_guard(dtype: str = "bfloat16"):
         _state["dtype"] = prev
 
 
+def matmul(a, b):
+    """``a @ b`` in the AMP compute dtype with the result restored to the
+    fp32 activation contract; identity when AMP is off.  The shared helper
+    for code that contracts OUTSIDE the op library (stacked transformer,
+    ring attention) — one policy, every path."""
+    a2, b2, back = cast_operands(a, b)
+    return restore_astype(a2 @ b2, back)
+
+
+def einsum(spec, a, b):
+    """Two-operand einsum under the same AMP recipe as :func:`matmul`."""
+    import jax.numpy as jnp
+
+    a2, b2, back = cast_operands(a, b)
+    return restore_astype(jnp.einsum(spec, a2, b2), back)
+
+
 def cast_operands(*arrays):
     """Cast fp32 contraction operands to the AMP dtype.
 

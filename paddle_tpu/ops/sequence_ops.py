@@ -425,6 +425,9 @@ def sequence_erase(ctx):
     x = np.asarray(ctx.input("X"))
     tokens = set(int(t) for t in (ctx.attr("tokens") or []))
     off = ctx.seq_offsets("X")
+    if x.size == 0:  # all-empty sequences: nothing to erase
+        return {"Out": jnp.asarray(x),
+                "Out@LOD": (tuple(int(o) for o in off),)}
     flat = x.reshape(len(x), -1)[:, 0]
     keep = np.array([int(v) not in tokens for v in flat], bool)
     new_off = [0]

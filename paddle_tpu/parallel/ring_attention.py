@@ -34,13 +34,11 @@ except ImportError:  # pragma: no cover
 
 
 def _amp_einsum(spec, a, b):
-    """Contraction in the AMP compute dtype (bf16 on the MXU) with the
-    result restored to the fp32 activation contract — same recipe as the
-    matmul-class ops (fluid/amp.py cast_operands); identity when AMP off."""
+    """Contraction under the shared AMP recipe (fluid/amp.py einsum):
+    bf16 operands on the MXU, fp32 activation contract restored."""
     from ..fluid import amp
 
-    a2, b2, back = amp.cast_operands(a, b)
-    return amp.restore_astype(jnp.einsum(spec, a2, b2), back)
+    return amp.einsum(spec, a, b)
 
 
 def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o,

@@ -143,12 +143,11 @@ def _attend_gspmd_ring(n_head, mesh, sp_axis):
 
 
 def _mm(a, b):
-    """Matmul in the AMP compute dtype (fluid/amp.py recipe: bf16 operands
-    on the MXU, result restored fp32); identity when AMP is off."""
+    """Matmul under the shared AMP recipe (fluid/amp.py matmul): bf16
+    operands on the MXU, fp32 activation contract restored."""
     from ..fluid import amp
 
-    a2, b2, back = amp.cast_operands(a, b)
-    return amp.restore_astype(a2 @ b2, back)
+    return amp.matmul(a, b)
 
 
 def _mha(p, prefix, x, kv, bias, causal, attend, mp_axis):
