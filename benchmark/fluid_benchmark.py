@@ -59,6 +59,13 @@ def parse_args(argv=None):
                         "stacked (pipeline-capable layer-stack op, shards "
                         "over pp/mp meshes), or ring (ring-attention "
                         "sequence parallelism over an sp mesh)")
+    p.add_argument("--mesh", default="",
+                   help="named mesh axes for SPMD execution, e.g. "
+                        "'dp2,pp4' or 'dp2,pp2,mp2' — runs the train step "
+                        "through ShardedTrainStep over that mesh (needs "
+                        "that many devices; on a dev box set "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+                        " with --device CPU)")
     p.add_argument("--update_method", default="local",
                    choices=["local", "pserver", "nccl2"])
     p.add_argument("--no_test", action="store_true")
@@ -207,6 +214,10 @@ def main(argv=None):
 
     rng = np.random.RandomState(0)
     feed = feed_fn(rng)
+
+    if args.mesh:
+        return _run_mesh(args, fluid, prog, loss, feed, name, unit,
+                         items_per_batch)
     if on_accel:
         from paddle_tpu.fluid import core as _core
 
@@ -236,6 +247,47 @@ def main(argv=None):
                   f"_{args.update_method}",
         "value": round(rate, 2), "unit": unit + "/chip",
         "vs_baseline": 0.0, "final_loss": round(last, 4)}))
+    return 0
+
+
+def _run_mesh(args, fluid, prog, loss, feed, name, unit, items_per_batch):
+    """--mesh 'dp2,pp4': jit the train step over a named device mesh via
+    ShardedTrainStep (the same path dryrun_multichip exercises) — dp
+    shards the batch, pp/mp/sp/ep shard the model per the programs'
+    dist_spec hints."""
+    import re
+
+    from paddle_tpu.parallel.mesh import make_mesh_nd
+    from paddle_tpu.parallel.spmd import ShardedTrainStep
+
+    axes = {}
+    for part in args.mesh.split(","):
+        m = re.fullmatch(r"([a-z]+)(\d+)", part.strip())
+        if not m:
+            raise SystemExit(f"--mesh: bad axis spec {part!r} "
+                             f"(want e.g. dp2,pp4)")
+        axes[m.group(1)] = int(m.group(2))
+    mesh = make_mesh_nd(**axes)
+    step = ShardedTrainStep(prog, list(feed), [loss.name], mesh)
+    state = step.place_state()
+    placed = step.place_feed({k: np.asarray(v) for k, v in feed.items()})
+    fetches, new_state = step(placed, state)  # compile + warmup
+    state = {**state, **new_state}  # step returns only UPDATED vars
+
+    t0 = time.perf_counter()
+    iters = args.iterations * args.pass_num
+    for _ in range(iters):
+        fetches, new_state = step(placed, state)
+        state = {**state, **new_state}
+    last = float(np.asarray(fetches[0]).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    rate = items_per_batch * iters / dt
+    print(json.dumps({
+        "metric": f"{name}_bs{args.batch_size}_mesh_{args.mesh}",
+        "value": round(rate, 2), "unit": unit + ("" if "/chip" in unit
+                                                 else "/global"),
+        "vs_baseline": 0.0, "final_loss": round(last, 4),
+        "mesh": dict(mesh.shape)}))
     return 0
 
 
