@@ -26,7 +26,8 @@ from .transformer import (Config, _ffn, _multi_head_attention, _padding_bias,
 class BertConfig:
     def __init__(self, name, vocab_size=30522, d_model=768, d_inner=3072,
                  n_head=12, n_layer=12, type_vocab_size=2, max_len=512,
-                 dropout=0.1, ring_attention=False):
+                 dropout=0.1, ring_attention=False, stacked=False,
+                 n_microbatches=4, recompute=False):
         self.name = name
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -40,6 +41,14 @@ class BertConfig:
         # layers.ring_attention: long sequences shard over an "sp" mesh
         # axis (models/transformer.Config.ring_attention semantics)
         self.ring_attention = ring_attention
+        # stacked=True builds the encoder as ONE mesh-aware layer-stack op
+        # (layers.transformer_encoder_stack): pipeline over "pp", Megatron
+        # TP over "mp", ring attention over "sp" — same semantics as
+        # models/transformer.Config.stacked; recompute adds per-layer
+        # jax.checkpoint for long-sequence memory
+        self.stacked = stacked
+        self.n_microbatches = n_microbatches
+        self.recompute = recompute
 
 
 def base_config():
@@ -70,6 +79,12 @@ def _bert_embed(ids, type_ids, cfg, seq_len):
 
 
 def encoder_stack(emb, pad_bias, cfg):
+    if getattr(cfg, "stacked", False):
+        return layers.transformer_encoder_stack(
+            emb, bias=pad_bias, n_layer=cfg.n_layer, n_head=cfg.n_head,
+            d_inner=cfg.d_inner, dropout=cfg.dropout,
+            n_microbatches=getattr(cfg, "n_microbatches", 4),
+            recompute=getattr(cfg, "recompute", False))
     enc = emb
     for i in range(cfg.n_layer):
         attn = _multi_head_attention(
