@@ -351,7 +351,10 @@ def bench_mnist(fluid, platform, on_accel):
 def bench_resnet_infer(fluid, platform, on_accel):
     """Inference throughput via the predictor path (ref baseline: ResNet-50
     infer bs16 = 217.69 images/sec on 2x Xeon 6148, IntelOptimizedPaddle
-    .md:85-87).  Forward-only for_test clone, deferred fetches."""
+    .md:85-87).  Forward-only for_test clone, deferred fetches.
+    BENCH_INT8=1 additionally rewrites the weights int8-in-HBM
+    (transpiler.Int8WeightTranspiler) — the weight-bandwidth-bound
+    deployment configuration."""
     from paddle_tpu.models import resnet
 
     batch = _env_int("resnet_infer", "BS", 16)
@@ -362,6 +365,11 @@ def bench_resnet_infer(fluid, platform, on_accel):
         class_dim=class_dim, depth=50, image_shape=(3, image_hw, image_hw),
         lr=0.1)
     infer_prog = fluid.default_main_program().clone(for_test=True)
+    int8 = os.environ.get("BENCH_INT8", "") in ("1", "true")
+    if int8:
+        from paddle_tpu.fluid.transpiler import Int8WeightTranspiler
+
+        Int8WeightTranspiler().transpile(infer_prog)
 
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
@@ -387,9 +395,12 @@ def bench_resnet_infer(fluid, platform, on_accel):
     dt = time.perf_counter() - t0
     assert np.isfinite(last).all()
     ips = batch * steps / dt
-    return result_line(f"resnet50_{image_hw}px_bs{batch}_infer_{platform}",
-                       ips, "images/sec/chip", "resnet_infer",
-                       amp=fluid.amp.compute_dtype() or "off")
+    tag = "_int8" if int8 else ""
+    return result_line(
+        f"resnet50_{image_hw}px_bs{batch}_infer{tag}_{platform}",
+        ips, "images/sec/chip", "resnet_infer",
+        amp=fluid.amp.compute_dtype() or "off",
+        weights=("int8" if int8 else "fp32"))
 
 
 def bench_decode(fluid, platform, on_accel):
