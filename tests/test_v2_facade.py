@@ -155,3 +155,49 @@ def test_v2_config_googlenet_trains():
         batches=10, batch=8, data_name="data")
     assert np.isfinite(losses).all()
     assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_v2_sgd_integer_window_feed():
+    """Integer feeds with multiple columns (n-gram windows) must reach the
+    program intact — a review-caught truncation bug reduced every int feed
+    to its first column."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 57
+    with fluid.program_guard(main, startup):
+        words = paddle_v2.layer.data(
+            name="ngram", type=paddle_v2.data_type.integer_value_sequence(20))
+        words.shape = (-1, 4)  # 4-token window per row
+        emb = paddle_v2.layer.embedding(input=words, size=[20, 8])
+        emb = fluid.layers.reshape(emb, [-1, 4 * 8])
+        pred = paddle_v2.layer.fc(input=emb, size=20,
+                                  act=paddle_v2.activation.Softmax())
+        label = paddle_v2.layer.data(
+            name="next", type=paddle_v2.data_type.integer_value(20))
+        cost = paddle_v2.layer.classification_cost(input=pred, label=label)
+        parameters = paddle_v2.parameters.create(cost)
+        trainer = paddle_v2.trainer.SGD(
+            cost=cost, parameters=parameters,
+            update_equation=paddle_v2.optimizer.Adam(learning_rate=5e-3))
+
+        # next token = (sum of window) % 20: only learnable if ALL four
+        # columns survive the feed path
+        rng = np.random.RandomState(2)
+        data = []
+        for _ in range(256):
+            w = rng.randint(0, 20, size=4)
+            data.append((w, int(w.sum()) % 20))
+
+        def reader():
+            for i in range(0, len(data), 32):
+                yield data[i:i + 32]
+
+        costs = []
+
+        def handler(e):
+            if isinstance(e, paddle_v2.event.EndIteration):
+                costs.append(e.cost)
+
+        trainer.train(reader=reader, num_passes=16, event_handler=handler,
+                      feeding={"ngram": 0, "next": 1})
+        assert np.mean(costs[-8:]) < np.mean(costs[:8]) * 0.8, (
+            costs[:4], costs[-4:])
