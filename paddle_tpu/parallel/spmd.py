@@ -202,7 +202,8 @@ class ShardedTrainStep:
     def __init__(self, program: Program, feed_names: List[str],
                  fetch_names: List[str], mesh: Mesh, tp_axis: str = "mp",
                  donate: bool = False, zero1: bool = False,
-                 multihost: bool = False):
+                 multihost: bool = False,
+                 feed_specs: Optional[Dict[str, P]] = None):
         self.program = program
         self.mesh = mesh
         self.multihost = multihost
@@ -210,6 +211,16 @@ class ShardedTrainStep:
         self.specs = infer_param_specs(program, self.plan, mesh, tp_axis,
                                        zero1=zero1)
         self.bspec = batch_spec(mesh)
+        # per-feed PartitionSpec overrides (e.g. long sequences sharded on
+        # an "sp" axis at the SOURCE: P("dp", "sp") for [N, T] token feeds
+        # avoids an all-gather+reslice before the first ring step); axes
+        # absent from the mesh degrade to replicated per dim
+        self.feed_specs = {}
+        for name, spec in (feed_specs or {}).items():
+            dims = [ax if (ax is None or (ax in mesh.axis_names
+                                          and mesh.shape[ax] > 1)) else None
+                    for ax in tuple(spec)]
+            self.feed_specs[name] = P(*dims)
         self._bdiv = None  # lazy: jax.process_index needs initialized dist
 
         plan = self.plan
@@ -341,7 +352,11 @@ class ShardedTrainStep:
                 want = core.np_dtype(gb._var_recursive(k).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            out[k] = self._place(arr, sh if arr.ndim > 0 else rep)
+            if k in self.feed_specs and divisible:
+                use = NamedSharding(self.mesh, self.feed_specs[k])
+            else:
+                use = sh if arr.ndim > 0 else rep
+            out[k] = self._place(arr, use)
         return out
 
     def fetch_to_host(self, val) -> np.ndarray:

@@ -168,3 +168,32 @@ def test_stacked_bert_dp2_pp2():
                   if s is not None and "pp" in tuple(s)]
     assert len(pp_sharded) >= 12, f"stack params not pp-sharded: {pp_sharded}"
     np.testing.assert_allclose(base, out, rtol=2e-4, atol=2e-4)
+
+
+def test_feed_specs_shard_sequence_dim():
+    """feed_specs=P('dp','sp') places token feeds sequence-sharded at the
+    source (no resharding before the first ring step) with identical
+    losses."""
+    cfg = _tiny_cfg(ring_attention=True)
+    loss, feeds = _build(cfg, seed=37)
+    base, init = _run_executor(loss, feeds)
+
+    from jax.sharding import PartitionSpec as P
+
+    scope = _executor._global_scope
+    for k, v in init.items():
+        scope.set(k, v)
+    mesh = make_mesh_nd(dp=2, sp=2)
+    step = ShardedTrainStep(
+        fluid.default_main_program(), list(feeds[0]), [loss.name], mesh,
+        feed_specs={"src_word": P("dp", "sp"),
+                    "tgt_word": P("dp", "sp")})
+    state = step.place_state()
+    out = []
+    for f in feeds:
+        placed = step.place_feed(f)
+        assert placed["src_word"].sharding.spec == P("dp", "sp")
+        fetches, new_state = step(placed, state)
+        state = {**state, **new_state}
+        out.append(float(np.asarray(fetches[0]).reshape(-1)[0]))
+    np.testing.assert_allclose(base, out, rtol=2e-4, atol=2e-4)
