@@ -67,6 +67,10 @@ BASELINES = {
                              # (IntelOptimizedPaddle.md:85-87)
     "vgg": 28.46,            # images/sec, VGG-19 train bs=64, 2x Xeon 6148
                              # (IntelOptimizedPaddle.md:33-35)
+    "alexnet": 399.00,       # images/sec, AlexNet train bs=64
+                             # (IntelOptimizedPaddle.md:63-65)
+    "googlenet": 250.46,     # images/sec, GoogleNet train bs=64
+                             # (IntelOptimizedPaddle.md:53-55)
 }
 
 # Peak dense bf16 TFLOPs per chip by TPU generation, for MFU reporting.
@@ -469,9 +473,51 @@ def bench_decode(fluid, platform, on_accel):
                     "absolute generation rate (eager-island execution)"}
 
 
+def _bench_v2_image(model, fluid, platform, on_accel, ref_hw):
+    """AlexNet/GoogleNet via their legacy-DSL configs (benchmark/v2/) —
+    the configs themselves are the reference's; baselines are the
+    published bs=64 CPU training rates (IntelOptimizedPaddle.md)."""
+    import os as _os
+
+    from paddle_tpu.trainer_config_helpers import (
+        build_settings_optimizer, get_outputs, set_config_args)
+
+    batch = _env_int(model, "BS", 64 if on_accel else 4)
+    steps = _env_int(model, "STEPS", 10 if on_accel else 3)
+    # CPU fallback geometries keep every pool non-degenerate
+    hw = ref_hw if on_accel else (67 if model == "alexnet" else 64)
+    class_dim = 1000 if on_accel else 10
+    set_config_args(height=hw, width=hw, num_class=class_dim,
+                    batch_size=batch, is_infer=False)
+    path = _os.path.join(REPO, "benchmark", "v2", f"{model}.py")
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), {"__name__": "config"})
+    (loss,) = get_outputs()
+    build_settings_optimizer().minimize(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {"data": rng.normal(size=(batch, 3 * hw * hw)).astype(np.float32),
+            "label": rng.randint(0, class_dim,
+                                 size=(batch, 1)).astype(np.int64)}
+    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    ips = batch * steps / dt
+    return result_line(f"{model}_{hw}px_bs{batch}_train_{platform}",
+                       ips, "images/sec/chip", model,
+                       amp=fluid.amp.compute_dtype() or "off")
+
+
+def bench_alexnet(fluid, platform, on_accel):
+    return _bench_v2_image("alexnet", fluid, platform, on_accel, 227)
+
+
+def bench_googlenet(fluid, platform, on_accel):
+    return _bench_v2_image("googlenet", fluid, platform, on_accel, 224)
+
+
 BENCHES = {"resnet": bench_resnet, "transformer": bench_transformer,
            "mnist": bench_mnist, "resnet_infer": bench_resnet_infer,
-           "decode": bench_decode, "vgg": bench_vgg}
+           "decode": bench_decode, "vgg": bench_vgg,
+           "alexnet": bench_alexnet, "googlenet": bench_googlenet}
 
 
 def _run_one(model, fluid, platform, on_accel):
