@@ -370,14 +370,18 @@ def bench_resnet_infer(fluid, platform, on_accel):
         lr=0.1)
     infer_prog = fluid.default_main_program().clone(for_test=True)
     int8 = os.environ.get("BENCH_INT8", "") in ("1", "true")
-    if int8:
-        from paddle_tpu.fluid.transpiler import Int8WeightTranspiler
-
-        Int8WeightTranspiler().transpile(infer_prog)
 
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
+    if int8:
+        # AFTER startup: the transpiler quantizes the weights that now
+        # live in the scope (before startup there is nothing to quantize
+        # and every param would be silently skipped)
+        from paddle_tpu.fluid.transpiler import Int8WeightTranspiler
+
+        quantized = Int8WeightTranspiler().transpile(infer_prog)
+        assert quantized, "int8 transpile quantized no weights"
     rng = np.random.RandomState(0)
     feed = {"img": rng.normal(size=(batch, 3, image_hw, image_hw))
             .astype(np.float32)}
@@ -477,8 +481,6 @@ def _bench_v2_image(model, fluid, platform, on_accel, ref_hw):
     """AlexNet/GoogleNet via their legacy-DSL configs (benchmark/v2/) —
     the configs themselves are the reference's; baselines are the
     published bs=64 CPU training rates (IntelOptimizedPaddle.md)."""
-    import os as _os
-
     from paddle_tpu.trainer_config_helpers import (
         build_settings_optimizer, get_outputs, set_config_args)
 
@@ -489,7 +491,7 @@ def _bench_v2_image(model, fluid, platform, on_accel, ref_hw):
     class_dim = 1000 if on_accel else 10
     set_config_args(height=hw, width=hw, num_class=class_dim,
                     batch_size=batch, is_infer=False)
-    path = _os.path.join(REPO, "benchmark", "v2", f"{model}.py")
+    path = os.path.join(REPO, "benchmark", "v2", f"{model}.py")
     with open(path) as f:
         exec(compile(f.read(), path, "exec"), {"__name__": "config"})
     (loss,) = get_outputs()

@@ -271,8 +271,9 @@ def _run_mesh(args, fluid, prog, loss, feed, name, unit, items_per_batch):
     step = ShardedTrainStep(prog, list(feed), [loss.name], mesh)
     state = step.place_state()
     placed = step.place_feed({k: np.asarray(v) for k, v in feed.items()})
-    fetches, new_state = step(placed, state)  # compile + warmup
-    state = {**state, **new_state}  # step returns only UPDATED vars
+    for _ in range(max(1, args.skip_batch_num)):  # compile + warmup
+        fetches, new_state = step(placed, state)
+        state = {**state, **new_state}  # step returns only UPDATED vars
 
     t0 = time.perf_counter()
     iters = args.iterations * args.pass_num

@@ -352,8 +352,15 @@ class ShardedTrainStep:
                 want = core.np_dtype(gb._var_recursive(k).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            if k in self.feed_specs and divisible:
-                use = NamedSharding(self.mesh, self.feed_specs[k])
+            spec = self.feed_specs.get(k)
+            if spec is not None and divisible and all(
+                    ax is None or (d < arr.ndim
+                                   and arr.shape[d] % self.mesh.shape[ax] == 0)
+                    for d, ax in enumerate(tuple(spec))):
+                # every sharded dim divides evenly; a ragged dim (odd
+                # seq len on sp2) degrades to the default batch sharding
+                # instead of crashing in device_put
+                use = NamedSharding(self.mesh, spec)
             else:
                 use = sh if arr.ndim > 0 else rep
             out[k] = self._place(arr, use)

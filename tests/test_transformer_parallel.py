@@ -160,7 +160,7 @@ def test_stacked_bert_dp2_pp2():
     feeds = [bert.synthetic_batch(cfg, 8, 8, 2, np.random.RandomState(i))
              for i in range(3)]
     base, init = _run_executor(loss, feeds)
-    assert base[-1] < base[0] + 1e-6 or np.isfinite(base).all()
+    assert np.isfinite(base).all(), base
 
     mesh = make_mesh_nd(dp=2, pp=2)
     out, step = _run_mesh(loss, feeds, init, mesh)
