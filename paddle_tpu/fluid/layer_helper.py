@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from . import unique_name
+from . import core, unique_name
 from .framework import Parameter, Variable, default_main_program, default_startup_program
 from .initializer import ConstantInitializer, XavierInitializer
 from .param_attr import ParamAttr
@@ -98,12 +98,28 @@ class LayerHelper:
             attr._set_default_initializer(default_initializer)
         if attr.name is None:
             attr.name = unique_name.generate(".".join([self.name, "w"]))
+        gb = self.main_program.global_block()
+        if gb.has_var(attr.name):
+            # Named reuse = weight tying (the scope is name-keyed, so same
+            # name is same storage).  Return the existing Parameter instead
+            # of re-creating it — and refuse a shape/dtype mismatch here,
+            # where the offending layer is on the stack, rather than letting
+            # a later op fail with an unrelated broadcast error.
+            existing = gb.var(attr.name)
+            if tuple(existing.shape) != tuple(shape) \
+                    or core.convert_dtype(existing.dtype) \
+                    != core.convert_dtype(dtype):
+                raise ValueError(
+                    f"parameter {attr.name!r} reused with shape {shape} "
+                    f"dtype {dtype}, but it already exists with shape "
+                    f"{existing.shape} dtype {existing.dtype}")
+            return existing
         startup_block = self.startup_program.global_block()
         sp = startup_block.create_parameter(
             shape=shape, dtype=dtype, **attr._to_kwargs(with_initializer=True))
         attr.initializer(sp, startup_block)
         # mirror in the main program
-        return self.main_program.global_block().create_parameter(
+        return gb.create_parameter(
             shape=shape, dtype=dtype, **attr._to_kwargs())
 
     def create_variable_for_type_inference(self, dtype, stop_gradient=False):

@@ -224,6 +224,9 @@ def _gpipe_tree_body(params, xs: Dict[str, jnp.ndarray], *, stage_fn,
     s_total = lax.axis_size(pp_axis)
     stage = lax.axis_index(pp_axis)
     n = next(iter(xs.values())).shape[0]
+    if n % n_micro:
+        raise ValueError(
+            f"per-stage local batch {n} not divisible by n_micro {n_micro}")
     mb = n // n_micro
     xmb = {k: v.reshape((n_micro, mb) + v.shape[1:]) for k, v in xs.items()}
     perm = [(j, (j + 1) % s_total) for j in range(s_total)]
@@ -334,6 +337,19 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
     mp_size = mesh.shape[mp] if mp else 1
     if n_head % mp_size != 0:
         raise ValueError(f"n_head {n_head} not divisible by mp size {mp_size}")
+    if mp_size > 1:
+        # The pp layer body psums partial row-parallel outputs over mp, which
+        # is only correct when every Megatron-sharded weight dim actually
+        # splits mp_size ways; _pspecs degrading a dim to replicated here
+        # would silently scale outputs by mp_size.
+        table = DECODER_SLOTS if decoder else ENCODER_SLOTS
+        for slot, mp_dim in table.items():
+            if mp_dim is not None and params[slot].shape[mp_dim] % mp_size:
+                raise ValueError(
+                    f"param {slot} dim {mp_dim} (= "
+                    f"{params[slot].shape[mp_dim]}) not divisible by mp size "
+                    f"{mp_size}; d_model and d_inner must be divisible "
+                    f"by mp")
     local_heads = n_head // mp_size
 
     xs = {"x": x}

@@ -131,6 +131,14 @@ class ParamAttr:
         self.name = name
 
 
+def _param_name(param_attr):
+    """Thread a v2 ParamAttr name down to the fluid layer so that legacy
+    configs sharing one parameter by name across layers get genuinely tied
+    weights (the fluid scope is name-keyed, so same name == same storage
+    and the backward accumulates both consumers' gradients)."""
+    return getattr(param_attr, "name", None)
+
+
 class MomentumOptimizer:
     def __init__(self, momentum=0.9):
         self.momentum = momentum
@@ -239,7 +247,7 @@ def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, layer_attr=None):
     act = _default_act(act, TanhActivation())
     out = layers.fc(input=input, size=int(size), act=_act_name(act),
-                    name=name)
+                    param_attr=_param_name(param_attr), name=name)
     if layer_attr is not None and getattr(layer_attr, "drop_rate", 0):
         out = layers.dropout(out, dropout_prob=layer_attr.drop_rate)
     return out
@@ -255,7 +263,7 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
                          filter_size=filter_size, stride=stride,
                          padding=padding, groups=groups,
                          act=_act_name(act), bias_attr=bias_attr,
-                         name=name)
+                         param_attr=_param_name(param_attr), name=name)
 
 
 def img_pool_layer(input, pool_size, name=None, num_channels=None,
@@ -327,7 +335,8 @@ def dropout_layer(input, dropout_rate, name=None):
 
 
 def embedding_layer(input, size, name=None, param_attr=None):
-    return layers.embedding(input=input, size=size)
+    return layers.embedding(input=input, size=size,
+                            param_attr=_param_name(param_attr))
 
 
 def _as_label(label):

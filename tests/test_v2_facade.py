@@ -201,3 +201,59 @@ def test_v2_sgd_integer_window_feed():
                       feeding={"ngram": 0, "next": 1})
         assert np.mean(costs[-8:]) < np.mean(costs[:8]) * 0.8, (
             costs[:4], costs[-4:])
+
+
+def test_dsl_param_attr_name_ties_weights():
+    """ADVICE r4 (low): a legacy config naming the same parameter in two
+    fc_layers must get ONE shared (tied) weight, not two independents."""
+    import paddle_tpu.trainer_config_helpers as tch
+    import paddle_tpu.fluid.executor as _executor
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 59
+    with fluid.program_guard(main, startup):
+        x = tch.data_layer(name="x", size=8)
+        shared = tch.ParamAttr(name="tied_w")
+        a = tch.fc_layer(input=x, size=8, param_attr=shared,
+                         act=tch.LinearActivation())
+        b = tch.fc_layer(input=a, size=8, param_attr=shared,
+                         act=tch.LinearActivation())
+        lbl = tch.data_layer(name="label", size=1)
+        cost = tch.regression_cost(input=b, label=lbl) \
+            if hasattr(tch, "regression_cost") \
+            else fluid.layers.mean(fluid.layers.square(b))
+        import paddle_tpu.fluid.optimizer as opt
+        opt.SGD(learning_rate=0.05).minimize(cost)
+
+        params = [v for v in main.global_block().vars
+                  if v == "tied_w"]
+        assert params == ["tied_w"]
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = _executor._global_scope
+        w0 = np.asarray(scope.get("tied_w")).copy()
+        feed = {"x": np.random.RandomState(0).normal(
+                    size=(4, 8)).astype(np.float32),
+                "label": np.zeros((4, 1), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[cost])
+        w1 = np.asarray(scope.get("tied_w"))
+        # both consumers' gradients flow into the one storage slot
+        assert not np.allclose(w0, w1)
+
+
+def test_dsl_param_reuse_shape_mismatch_raises():
+    """Reusing a parameter name with a different shape must fail at the
+    layer call site, not crash later inside an unrelated op."""
+    import pytest
+    import paddle_tpu.trainer_config_helpers as tch
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = tch.data_layer(name="x", size=8)
+        shared = tch.ParamAttr(name="tied_w2")
+        a = tch.fc_layer(input=x, size=8, param_attr=shared,
+                         act=tch.LinearActivation())
+        with pytest.raises(ValueError, match="tied_w2"):
+            tch.fc_layer(input=a, size=4, param_attr=shared,
+                         act=tch.LinearActivation())

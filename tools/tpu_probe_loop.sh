@@ -1,0 +1,23 @@
+#!/bin/bash
+# Detached TPU liveness probe loop. Writes status to /root/repo/.tpu_status:
+#   "wedged <timestamp> <n_attempts>" while the tunnel hangs,
+#   "alive <timestamp>" once a tiny matmul completes — then exits.
+# Probes are spaced far apart (7 min) and tiny, to avoid stacking work on a
+# wedged tunnel (see docs/PERF.md wedge notes).
+STATUS=/root/repo/.tpu_status
+N=0
+while true; do
+  N=$((N+1))
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+x = jnp.ones((256,256), jnp.bfloat16)
+(x@x).block_until_ready()
+" >/dev/null 2>&1; then
+    echo "alive $(date -u +%FT%TZ)" > "$STATUS"
+    exit 0
+  fi
+  echo "wedged $(date -u +%FT%TZ) $N" > "$STATUS"
+  sleep 420
+done
