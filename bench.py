@@ -412,15 +412,24 @@ def bench_resnet_infer(fluid, platform, on_accel):
 
 
 def bench_decode(fluid, platform, on_accel):
-    """Beam-search GENERATION throughput (BENCH_MODEL=decode): the
-    contrib.decoder BeamSearchDecoder loop — data-dependent shapes, so the
-    executor runs it as eager islands (per-step dispatches; over a
-    tunneled TPU the ~ms/dispatch floor applies per op).  No reference
-    decode-throughput figure exists, so vs_baseline is reported as 0 and
-    the metric stands on its absolute tokens/sec."""
+    """Beam-search GENERATION throughput (BENCH_MODEL=decode).
+
+    Default engine: JitBeamSearchDecoder — the WHOLE generation loop is one
+    lax.while_loop XLA program (2 dispatches total: loop + LoD packaging),
+    the VERDICT r4 missing-#1 path.  BENCH_DECODE_ENGINE=eager selects the
+    legacy While-loop BeamSearchDecoder (per-op dispatches per step) for
+    comparison.  No reference decode-throughput figure exists, so
+    vs_baseline is reported as 0 and the metric stands on its absolute
+    tokens/sec."""
     from paddle_tpu.fluid import layers
     from paddle_tpu.fluid.contrib.decoder import (BeamSearchDecoder,
-                                                  InitState, StateCell)
+                                                  InitState,
+                                                  JitBeamSearchDecoder,
+                                                  StateCell)
+
+    engine = os.environ.get("BENCH_DECODE_ENGINE", "jit")
+    decoder_cls = BeamSearchDecoder if engine == "eager" \
+        else JitBeamSearchDecoder
 
     batch = _env_int("decode", "BS", 8)
     rounds = _env_int("decode", "STEPS", 3)
@@ -444,10 +453,10 @@ def bench_decode(fluid, platform, on_accel):
                            lod_level=2)
     init_scores = layers.data(name="init_scores", shape=[1],
                               dtype="float32", lod_level=2)
-    dec = BeamSearchDecoder(cell, init_ids, init_scores,
-                            target_dict_dim=v, word_dim=d, topk_size=50,
-                            sparse_emb=False, max_len=max_len,
-                            beam_size=beam, end_id=1)
+    dec = decoder_cls(cell, init_ids, init_scores,
+                      target_dict_dim=v, word_dim=d, topk_size=50,
+                      sparse_emb=False, max_len=max_len,
+                      beam_size=beam, end_id=1)
     dec.decode()
     out_ids, _ = dec()
 
@@ -470,11 +479,15 @@ def bench_decode(fluid, platform, on_accel):
                          fetch_list=[out_ids], return_numpy=False)
         n_tokens += int(np.asarray(ids).size)
     dt = time.perf_counter() - t0
-    return {"metric": f"beam_decode_b{batch}_beam{beam}_len{max_len}_{platform}",
+    return {"metric": f"beam_decode_b{batch}_beam{beam}_len{max_len}"
+                      f"_{engine}_{platform}",
             "value": round(n_tokens / dt, 2), "unit": "tokens/sec/chip",
             "vs_baseline": 0.0,
-            "note": "no published reference decode throughput; "
-                    "absolute generation rate (eager-island execution)"}
+            "note": "no published reference decode throughput; absolute "
+                    "generation rate ("
+                    + ("one compiled while_loop program"
+                       if engine != "eager" else "eager-island execution")
+                    + ")"}
 
 
 def _bench_v2_image(model, fluid, platform, on_accel, ref_hw):

@@ -1,8 +1,9 @@
 """Decoder DSL (ref: python/paddle/fluid/contrib/decoder/)."""
 
 from . import beam_search_decoder
-from .beam_search_decoder import (BeamSearchDecoder, InitState, StateCell,
+from .beam_search_decoder import (BeamSearchDecoder, InitState,
+                                  JitBeamSearchDecoder, StateCell,
                                   TrainingDecoder)
 
 __all__ = ["beam_search_decoder", "InitState", "StateCell",
-           "TrainingDecoder", "BeamSearchDecoder"]
+           "TrainingDecoder", "BeamSearchDecoder", "JitBeamSearchDecoder"]

@@ -14,11 +14,15 @@ harnesses:
    arrays indexed by the step counter and each step expands hypotheses with
    layers.beam_search, terminating early once every beam emits end_id.
 
-TPU note: the generation loop is data-dependent (live beam widths change
-shape), so the executor runs it as eager islands between jitted segments
-(fluid/executor.py) — correctness first; the batch/beam dims inside each
-step still compile.  The reference runs the same structure as host-side
-while/array ops around device kernels.
+TPU note: BeamSearchDecoder's generation loop is data-dependent (live beam
+widths change shape), so the executor runs it as eager islands between
+jitted segments (fluid/executor.py) — the reference runs the same
+structure as host-side while/array ops around device kernels.  The
+TPU-native path is JitBeamSearchDecoder below: the SAME StateCell, but the
+whole loop compiles to ONE lax.while_loop XLA program with static
+[batch, beam] shapes (ops/beam_search_jit.py) — prefer it for generation
+throughput; keep BeamSearchDecoder for multi-hypothesis warm starts or
+cells with data-dependent host ops.
 """
 
 from __future__ import annotations
@@ -31,9 +35,10 @@ from ... import layers, unique_name
 from ...framework import Variable
 from ...layer_helper import LayerHelper
 
-__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder",
+           "JitBeamSearchDecoder"]
 
-_TRAINING, _BEAM = "training", "beam_search"
+_TRAINING, _BEAM, _JIT = "training", "beam_search", "jit_beam_search"
 
 
 def _loop_array(helper, init, zero_idx):
@@ -152,10 +157,7 @@ class StateCell:
         if self._backings or self._decoder is None:
             return
         for name, init in self._init_states.items():
-            if self._decoder.type == _TRAINING:
-                b = _RnnMemoryBacking(self._decoder.dynamic_rnn, init)
-            else:
-                b = _ArrayBacking(self._decoder, init)
+            b = self._decoder._make_backing(name, init)
             self._backings[name] = b
             self._cur[name] = b.current()
 
@@ -211,6 +213,9 @@ class TrainingDecoder:
         self._done = False
 
     type = _TRAINING
+
+    def _make_backing(self, name, init_state):
+        return _RnnMemoryBacking(self._rnn, init_state)
 
     @property
     def dynamic_rnn(self):
@@ -285,6 +290,9 @@ class BeamSearchDecoder:
         self._state_cell._enter_decoder(self)
 
     type = _BEAM
+
+    def _make_backing(self, name, init_state):
+        return _ArrayBacking(self, init_state)
 
     @property
     def state_cell(self):
@@ -394,3 +402,217 @@ class BeamSearchDecoder:
                                          scores=self._scores_array,
                                          beam_size=self._beam_size,
                                          end_id=self._end_id)
+
+
+class _JitBacking:
+    """State storage inside a JitBeamSearchDecoder: a placeholder variable
+    in the step sub-block.  The jit_beam_search executor handler feeds it
+    each lax.while_loop iteration and reads the committed output name."""
+
+    def __init__(self, decoder, name, init_state: InitState):
+        init = init_state.value
+        shape = (-1,) + tuple(init.shape[1:]) if init.shape else (-1,)
+        self._ph = decoder._step_block.create_var(
+            name=unique_name.generate(f"jbs_state_{name}"),
+            dtype=init.dtype, shape=shape)
+        self._decoder = decoder
+        self._name = name
+        decoder._register_state(name, init, self._ph)
+
+    def current(self):
+        return self._ph
+
+    def commit(self, new_value):
+        self._decoder._commit_state(self._name, new_value)
+
+
+class JitBeamSearchDecoder:
+    """TPU-native generation harness: the SAME StateCell as
+    BeamSearchDecoder, but the whole loop compiles to ONE XLA program.
+
+    Where BeamSearchDecoder builds a While program (one host iteration per
+    step, per-op dispatches — the reference's structure,
+    ref: beam_search_op.cc:24 / beam_search_decode_op.cc),
+    ``decode()`` here builds the cell's single step into a sub-block of
+    placeholder variables and appends one ``jit_beam_search`` op that runs
+    it under ``lax.while_loop`` with static [batch, beam] state and a
+    finished-mask early exit (ops/beam_search_jit.py).  ``__call__``
+    returns the same 2-level-LoD (ids, scores) pair as BeamSearchDecoder —
+    the LoD packaging is the single eager boundary op.
+
+    Contract notes:
+     - every source sentence decodes ``beam_size`` hypotheses (the eager
+       op is fixed-width too, so results agree — see the oracle test);
+     - per-sentence tensors the cell consumes (encoder context) must be
+       passed via ``input_var_dict``; they are tiled beam-wide ONCE,
+       outside the loop (the eager path re-expands per step instead);
+     - the cell updater must use only jit-traceable layers (no
+       data-dependent host ops) — true for every standard RNN/attention
+       cell.
+    """
+
+    type = _JIT
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        # topk_size/sparse_emb accepted for BeamSearchDecoder signature
+        # parity: global top-k over beam*vocab subsumes the per-beam
+        # topk_size prefilter whenever beam_size <= topk_size, and gather
+        # from a dense embedding is the TPU lookup path.
+        self._helper = LayerHelper("jit_beam_search_decoder", name=name)
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+        self._beam_size = beam_size
+        self._max_len = max_len
+        self._end_id = end_id
+        self._state_names = []      # registration order == engine order
+        self._state_inits = {}
+        self._state_phs = {}
+        self._state_out_names = {}
+        self._step_block = None
+        self._outputs = None
+        self._state_cell._enter_decoder(self)
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def _make_backing(self, name, init_state):
+        return _JitBacking(self, name, init_state)
+
+    def _register_state(self, name, init, ph):
+        self._state_names.append(name)
+        self._state_inits[name] = init
+        self._state_phs[name] = ph
+
+    def _commit_state(self, name, new_value):
+        self._state_out_names[name] = new_value.name
+
+    def decode(self):
+        if self._outputs is not None:
+            raise ValueError("decode() already ran for this decoder")
+        try:
+            return self._decode()
+        except Exception:
+            # detach so the cell can be reused by another decoder after a
+            # failed build (mirrors BeamSearchDecoder.block's unwind)
+            if self._state_cell._decoder is self:
+                self._state_cell._leave_decoder(self)
+            raise
+
+    def _decode(self):
+        cell = self._state_cell
+        program = self._helper.main_program
+        parent_block = program.current_block()
+        self._step_block = program._create_block()  # now current
+        try:
+            id_feed = self._step_block.create_var(
+                name=unique_name.generate("jbs_prev_ids"),
+                dtype="int64", shape=(-1, 1))
+            prev_emb = layers.embedding(
+                id_feed, size=[self._target_dict_dim, self._word_dim],
+                dtype="float32", is_sparse=False)
+
+            feeds = {}
+            ctx_phs, ctx_vars = [], []
+            for name, var in self._input_var_dict.items():
+                if name not in cell._inputs:
+                    raise ValueError(
+                        f"input_var_dict key {name!r} unknown to the cell")
+                ph = self._step_block.create_var(
+                    name=unique_name.generate(f"jbs_ctx_{name}"),
+                    dtype=var.dtype,
+                    shape=(-1,) + tuple((var.shape or ())[1:]))
+                ctx_phs.append(ph.name)
+                ctx_vars.append(var.name)
+                feeds[name] = ph
+            for name in cell._inputs:
+                if name not in feeds:
+                    feeds[name] = prev_emb
+
+            cell.compute_state(inputs=feeds)
+            cell.update_states()
+            probs = layers.fc(input=cell.out_state(),
+                              size=self._target_dict_dim, act="softmax")
+        finally:
+            program._rollback()
+
+        # loop-invariant values the step reads but does not define:
+        # parameters and any batch-independent captures
+        defined = {id_feed.name} | set(self._state_phs[n].name
+                                       for n in self._state_names)
+        defined |= set(ctx_phs)
+        written, x_names = set(), []
+        for op in self._step_block.ops:
+            for n in op.input_arg_names:
+                if n and n not in written and n not in defined \
+                        and n not in x_names \
+                        and parent_block._has_var_recursive(n):
+                    x_names.append(n)
+            written.update(n for n in op.output_arg_names if n)
+
+        def _out(name, dtype, shape):
+            v = parent_block.create_var(
+                name=unique_name.generate(name), dtype=dtype, shape=shape)
+            v.stop_gradient = True
+            return v
+
+        L = self._max_len
+        h_ids = _out("jbs_hist_ids", "int64", (L + 1, -1, self._beam_size))
+        h_par = _out("jbs_hist_par", "int32", (L + 1, -1, self._beam_size))
+        h_sc = _out("jbs_hist_sc", "float32",
+                    (L + 1, -1, self._beam_size))
+        n_steps = _out("jbs_nsteps", "int32", (1,))
+        parent_block.append_op(
+            type="jit_beam_search",
+            inputs={"InitIds": [self._init_ids.name],
+                    "InitScores": [self._init_scores.name],
+                    "StateInit": [self._state_inits[n].name
+                                  for n in self._state_names],
+                    "Context": ctx_vars,
+                    "X": x_names},
+            outputs={"HistIds": [h_ids.name],
+                     "HistParents": [h_par.name],
+                     "HistScores": [h_sc.name],
+                     "NumSteps": [n_steps.name]},
+            attrs={"sub_block": self._step_block.idx,
+                   "id_feed": id_feed.name,
+                   "state_feeds": [self._state_phs[n].name
+                                   for n in self._state_names],
+                   "state_outs": [self._state_out_names[n]
+                                  for n in self._state_names],
+                   "ctx_feeds": ctx_phs,
+                   "prob_var": probs.name,
+                   "beam_size": int(self._beam_size),
+                   "max_len": int(self._max_len),
+                   "end_id": int(self._end_id),
+                   "vocab_size": int(self._target_dict_dim)})
+
+        out_ids = parent_block.create_var(
+            name=unique_name.generate("jbs_sentence_ids"), dtype="int64",
+            shape=(-1, 1), lod_level=2)
+        out_scores = parent_block.create_var(
+            name=unique_name.generate("jbs_sentence_scores"),
+            dtype="float32", shape=(-1, 1), lod_level=2)
+        out_ids.stop_gradient = out_scores.stop_gradient = True
+        parent_block.append_op(
+            type="beam_search_pack",
+            inputs={"HistIds": [h_ids.name], "HistParents": [h_par.name],
+                    "HistScores": [h_sc.name], "NumSteps": [n_steps.name]},
+            outputs={"SentenceIds": [out_ids.name],
+                     "SentenceScores": [out_scores.name]},
+            attrs={"end_id": int(self._end_id)})
+        self._outputs = (out_ids, out_scores)
+        self._state_cell._leave_decoder(self)
+        return self._outputs
+
+    def __call__(self):
+        if self._outputs is None:
+            raise ValueError("run decode() before calling the decoder")
+        return self._outputs

@@ -27,7 +27,7 @@ from .registry import register_op, register_grad
 
 EAGER_OPS = {
     "split_lod_tensor", "merge_lod_tensor", "beam_search",
-    "beam_search_decode", "is_empty",
+    "beam_search_decode", "beam_search_pack", "is_empty",
     # data-dependent output count (LoD out) — host postprocessing, like the
     # reference's CPU-pinned kernel (multiclass_nms_op.cc)
     "multiclass_nms",
@@ -503,6 +503,11 @@ def beam_search(ctx):
                 cand.append((float(scores[row, k]), cid, row))
         cand.sort(key=lambda t: -t[0])
         top = cand[: beam_size]
+        # the level-1 parent-offset lod below (and beam_search_decode's
+        # searchsorted backtrack) requires output rows GROUPED BY PARENT
+        # row; selection order is by score, so regroup (stable: score
+        # order is kept within a parent)
+        top.sort(key=lambda t: t[2])
         for sc, cid, prow in top:
             sel_ids.append(cid)
             sel_scores.append(sc)
@@ -576,6 +581,59 @@ def beam_search_decode(ctx):
                 chain, chain_sc = chain[:k], chain_sc[:k]
             group.append((float(steps[-1][1][j]), chain, chain_sc))
         group.sort(key=lambda t: -t[0])
+        groups.append(group)
+
+    flat_ids = [t for g in groups for _, h, _ in g for t in h]
+    flat_sc = [s for g in groups for _, _, hs in g for s in hs]
+    lens = [len(h) for g in groups for _, h, _ in g]
+    off = tuple(np.concatenate([[0], np.cumsum(lens)]).astype(int).tolist())
+    src_counts = np.concatenate([[0], np.cumsum([len(g) for g in groups])])
+    lod = (tuple(int(o) for o in src_counts), off)
+    out_ids = jnp.asarray(np.asarray(flat_ids, np.int64).reshape(-1, 1))
+    out_sc = jnp.asarray(np.asarray(flat_sc, np.float32).reshape(-1, 1))
+    return {"SentenceIds": out_ids, "SentenceScores": out_sc,
+            "SentenceIds@LOD": [lod], "SentenceScores@LOD": [lod]}
+
+
+@register_op("beam_search_pack",
+             no_grad_inputs=("HistIds", "HistParents", "HistScores",
+                             "NumSteps"))
+def beam_search_pack(ctx):
+    """Boundary op of the JITTED beam search (ops/beam_search_jit.py): turn
+    the while_loop's dense [n_steps, batch, beam] histories into the same
+    2-level-LoD SentenceIds/SentenceScores contract beam_search_decode
+    emits (ref: beam_search_decode_op.cc) — backtrack parent chains,
+    truncate at the first end_id, best-final-score-first per source.  The
+    only data-dependent (hence eager/host) step of the whole decode."""
+    from .beam_search_jit import NEG_INF
+
+    h_ids = np.asarray(ctx.input("HistIds"))
+    h_par = np.asarray(ctx.input("HistParents"))
+    h_sc = np.asarray(ctx.input("HistScores"))
+    n = int(np.asarray(ctx.input("NumSteps")).reshape(-1)[0])
+    end_id = int(ctx.attr("end_id"))
+    _, B, K = h_ids.shape
+
+    groups = []
+    for b in range(B):
+        group = []
+        for k in range(K):
+            chain, chain_sc, row = [], [], k
+            for t in range(n - 1, -1, -1):
+                chain.append(int(h_ids[t, b, row]))
+                chain_sc.append(float(h_sc[t, b, row]))
+                if t > 0:
+                    row = int(h_par[t, b, row])
+            chain.reverse()
+            chain_sc.reverse()
+            final = chain_sc[-1]
+            if final <= NEG_INF / 2:
+                continue  # dead lane (beam never fanned out this wide)
+            if end_id in chain:
+                cut = chain.index(end_id) + 1
+                chain, chain_sc = chain[:cut], chain_sc[:cut]
+            group.append((final, chain, chain_sc))
+        group.sort(key=lambda g: -g[0])
         groups.append(group)
 
     flat_ids = [t for g in groups for _, h, _ in g for t in h]

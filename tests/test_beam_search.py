@@ -40,10 +40,16 @@ def test_beam_search_step_topk():
     }, fetch_list=[sel_ids, sel_scores], return_numpy=False)
     got_ids = np.asarray(res[0]).ravel()
     got_scores = np.asarray(res[1]).ravel()
-    # source 0: best two scores 0.9 (id 11), 0.8 (id 13)
-    # source 1: best two scores 0.7 (id 19), 0.6 (id 17)
-    np.testing.assert_array_equal(got_ids, [11, 13, 19, 17])
-    np.testing.assert_allclose(got_scores, [0.9, 0.8, 0.7, 0.6], rtol=1e-6)
+    # source 0: best two scores 0.9 (id 11, parent row 0), 0.8 (id 13,
+    # parent row 1); source 1: 0.7 (id 19, parent row 3), 0.6 (id 17,
+    # parent row 2).  Output rows are GROUPED BY PARENT ROW (the level-1
+    # lod contract beam_search_decode's backtrack relies on), so source
+    # 1's selections appear parent-row-2-first: [17, 19].
+    np.testing.assert_array_equal(got_ids, [11, 13, 17, 19])
+    np.testing.assert_allclose(got_scores, [0.9, 0.8, 0.6, 0.7], rtol=1e-6)
+    lod_out = res[0].lod()
+    # parent offsets: row0->1 sel, row1->1, row2->1, row3->1
+    assert lod_out[1] == (0, 1, 2, 3, 4)
 
 
 def test_beam_search_ended_beam_frozen():

@@ -1,0 +1,237 @@
+"""Jitted whole-loop beam search (VERDICT r4 missing #1).
+
+The oracle: JitBeamSearchDecoder (ONE lax.while_loop program +
+one eager LoD-packaging op) must produce the SAME hypotheses and scores as
+the eager BeamSearchDecoder While-loop path (ops/array_ops.py beam_search /
+beam_search_decode, ref: beam_search_op.cc / beam_search_decode_op.cc),
+when both run the same cell with identical parameters.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.decoder import (BeamSearchDecoder, InitState,
+                                              JitBeamSearchDecoder,
+                                              StateCell)
+from paddle_tpu.fluid.executor import BlockPlan
+from paddle_tpu.fluid.framework import Parameter
+
+V, D, BATCH, BEAM, MAX_LEN, END = 23, 8, 3, 4, 6, 1
+
+
+def _build(decoder_cls, seed, **extra):
+    """The bench_decode model shape: embed src -> h0, one-fc cell."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = layers.data(name="src", shape=[1], dtype="int64")
+        h0 = layers.fc(input=layers.embedding(src, size=[V, D]), size=D,
+                       act="tanh")
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0,
+                                                need_reorder=True)},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            c.set_state("h", layers.fc(input=[c.get_input("x"),
+                                              c.get_state("h")],
+                                       size=D, act="tanh"))
+
+        init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
+                               lod_level=2)
+        init_scores = layers.data(name="init_scores", shape=[1],
+                                  dtype="float32", lod_level=2)
+        dec = decoder_cls(cell, init_ids, init_scores, target_dict_dim=V,
+                          word_dim=D, topk_size=V, sparse_emb=False,
+                          max_len=MAX_LEN, beam_size=BEAM, end_id=END,
+                          **extra)
+        dec.decode()
+        out_ids, out_scores = dec()
+    return main, startup, out_ids, out_scores
+
+
+def _feed(batch=BATCH):
+    lod2 = [[1] * batch, [1] * batch]
+    return {"src": np.arange(2, 2 + batch).reshape(batch, 1)
+            .astype(np.int64),
+            "init_ids": fluid.create_lod_tensor(
+                np.zeros((batch, 1), np.int64), lod2),
+            "init_scores": fluid.create_lod_tensor(
+                np.zeros((batch, 1), np.float32), lod2)}
+
+
+def _params(program):
+    return [v for v in program.global_block().vars.values()
+            if isinstance(v, Parameter)]
+
+
+def _run(main, startup, fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetches,
+                   return_numpy=False)
+
+
+def test_jit_decode_matches_eager_dsl():
+    """Same cell, same weights: the compiled while_loop decode returns the
+    exact hypotheses (and scores to fp tolerance) of the eager While path."""
+    e_main, e_start, e_ids, e_sc = _build(BeamSearchDecoder, seed=31)
+    j_main, j_start, j_ids, j_sc = _build(JitBeamSearchDecoder, seed=31)
+
+    ids_a, sc_a = _run(e_main, e_start, [e_ids, e_sc], _feed())
+    lod_a = ids_a.lod()
+    ids_a, sc_a = np.asarray(ids_a), np.asarray(sc_a)
+
+    # copy the eager program's initialized params onto the jit program's
+    # (same layer sequence -> same order/shapes, different unique names)
+    pa, pb = _params(e_main), _params(j_main)
+    assert [tuple(p.shape) for p in pa] == [tuple(p.shape) for p in pb]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(j_start)
+    scope = _executor._global_scope
+    for a, b in zip(pa, pb):
+        scope.set(b.name, np.asarray(scope.get(a.name)))
+    ids_b, sc_b = exe.run(j_main, feed=_feed(), fetch_list=[j_ids, j_sc],
+                          return_numpy=False)
+    assert ids_b.lod() == lod_a
+    np.testing.assert_array_equal(np.asarray(ids_b), ids_a)
+    np.testing.assert_allclose(np.asarray(sc_b), sc_a, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_jit_decode_is_two_dispatches():
+    """The decode program must compile to ONE jit segment (encoder + whole
+    generation loop) plus ONE eager boundary op (LoD packaging) — the <=3
+    dispatch contract of SURVEY §7 hard part #1."""
+    main, _, out_ids, out_scores = _build(JitBeamSearchDecoder, seed=5)
+    plan = BlockPlan(main, 0,
+                     feed_names=["src", "init_ids", "init_scores"],
+                     fetch_names=[out_ids.name, out_scores.name])
+    kinds = [k for k, _ in plan.segments]
+    assert kinds == ["jit", "eager"], plan.segments
+    assert len(plan.segments[1][1]) == 1  # just beam_search_pack
+
+
+def test_jit_decode_output_contract():
+    """2-level LoD, beam_size hypotheses per source, chains truncate at
+    end_id, per-source best-first score order, scores accumulate."""
+    main, startup, out_ids, out_scores = _build(JitBeamSearchDecoder,
+                                                seed=13)
+    ids, sc = _run(main, startup, [out_ids, out_scores], _feed())
+    lod = ids.lod()
+    assert len(lod) == 2 and len(lod[0]) == BATCH + 1
+    ids, sc = np.asarray(ids).reshape(-1), np.asarray(sc).reshape(-1)
+    for s in range(BATCH):
+        hyps = range(int(lod[0][s]), int(lod[0][s + 1]))
+        finals = []
+        for j in hyps:
+            lo, hi = int(lod[1][j]), int(lod[1][j + 1])
+            chain = ids[lo:hi]
+            assert 1 <= len(chain) <= MAX_LEN + 1
+            assert END not in chain[:-1]  # truncated at first end_id
+            finals.append(sc[hi - 1])
+            # scores along a chain are non-increasing (log-prob sums)
+            assert np.all(np.diff(sc[lo:hi]) <= 1e-6)
+        assert np.all(np.diff(finals) <= 1e-6)  # best-first
+
+
+def test_jit_decode_early_exit():
+    """A cell whose projection always puts all mass on end_id finishes
+    every beam at step 1; the while_loop must stop early and hypotheses
+    must be exactly [init, END]."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        src = layers.data(name="src", shape=[1], dtype="int64")
+        h0 = layers.fc(input=layers.embedding(src, size=[V, D]), size=D,
+                       act="tanh")
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0)}, out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            # keep h independent of x so the projection is constant
+            c.set_state("h", layers.fc(input=c.get_state("h"), size=D,
+                                       act="tanh"))
+
+        init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
+                               lod_level=2)
+        init_scores = layers.data(name="init_scores", shape=[1],
+                                  dtype="float32", lod_level=2)
+        dec = JitBeamSearchDecoder(cell, init_ids, init_scores,
+                                   target_dict_dim=V, word_dim=D,
+                                   max_len=MAX_LEN, beam_size=BEAM,
+                                   end_id=END)
+        dec.decode()
+        out_ids, _ = dec()
+        # force the projection towards end_id by zeroing its weight and
+        # biasing end_id (weights are scope state, set after startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = _executor._global_scope
+        proj_w = [v for v in _params(main)][-2]
+        proj_b = [v for v in _params(main)][-1]
+        scope.set(proj_w.name,
+                  np.zeros(tuple(proj_w.shape), np.float32))
+        bias = np.full((V,), -30.0, np.float32)
+        bias[END] = 30.0
+        scope.set(proj_b.name, bias)
+        nsteps = next(v for v in main.global_block().vars
+                      if v.startswith("jbs_nsteps"))
+        ids, n = exe.run(main, feed=_feed(), fetch_list=[out_ids, nsteps],
+                         return_numpy=False)
+        # beam 0 ends at step 1, the fanned-out stragglers at step 2: the
+        # while_loop must stop at t=3, far short of max_len
+        assert int(np.asarray(n).reshape(-1)[0]) == 3
+        lod = ids.lod()
+        flat = np.asarray(ids).reshape(-1)
+        for s in range(BATCH):
+            first = int(lod[0][s])
+            best = flat[int(lod[1][first]):int(lod[1][first + 1])]
+            np.testing.assert_array_equal(best, [0, END])
+        for j in range(len(lod[1]) - 1):
+            chain = flat[int(lod[1][j]):int(lod[1][j + 1])]
+            assert chain[-1] == END and len(chain) <= 3
+
+
+def test_jit_decode_context_vars():
+    """input_var_dict context (encoder output per sentence) is tiled
+    beam-wide outside the loop and actually reaches the cell: decodes from
+    different contexts diverge."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 41
+    with fluid.program_guard(main, startup):
+        src = layers.data(name="src", shape=[1], dtype="int64")
+        enc = layers.fc(input=layers.embedding(src, size=[V, D]), size=D,
+                        act="tanh")
+        h0 = layers.fc(input=enc, size=D, act="tanh")
+        cell = StateCell(inputs={"x": None, "context": None},
+                         states={"h": InitState(init=h0)}, out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            c.set_state("h", layers.fc(
+                input=[c.get_input("x"), c.get_input("context"),
+                       c.get_state("h")], size=D, act="tanh"))
+
+        init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
+                               lod_level=2)
+        init_scores = layers.data(name="init_scores", shape=[1],
+                                  dtype="float32", lod_level=2)
+        dec = JitBeamSearchDecoder(cell, init_ids, init_scores,
+                                   target_dict_dim=V, word_dim=D,
+                                   input_var_dict={"context": enc},
+                                   max_len=MAX_LEN, beam_size=BEAM,
+                                   end_id=END)
+        dec.decode()
+        out_ids, out_sc = dec()
+    _, sc = _run(main, startup, [out_ids, out_sc], _feed())
+    lod = sc.lod()
+    sc = np.asarray(sc).reshape(-1)
+    # different src rows -> different contexts -> different score chains
+    a = sc[int(lod[1][0]):int(lod[1][1])]
+    b = sc[int(lod[1][int(lod[0][1])]):int(lod[1][int(lod[0][1]) + 1])]
+    assert not np.allclose(a[1:], b[1:len(a)])
