@@ -463,6 +463,15 @@ def bench_decode(fluid, platform, on_accel):
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
+    int8 = os.environ.get("BENCH_INT8", "") in ("1", "true")
+    if int8:
+        # weight-only int8 under the compiled decode loop: embeddings +
+        # projection stream int8 from HBM, dequant fused at the consumer
+        from paddle_tpu.fluid.transpiler.int8_transpiler import (
+            Int8WeightTranspiler)
+        quantized = Int8WeightTranspiler().transpile(
+            fluid.default_main_program())
+        assert quantized, "int8 transpile quantized no weights"
     rng = np.random.RandomState(0)
     lod2 = [[1] * batch, [1] * batch]
     feed = {"src": rng.randint(2, v, size=(batch, 1)).astype(np.int64),
@@ -480,7 +489,7 @@ def bench_decode(fluid, platform, on_accel):
         n_tokens += int(np.asarray(ids).size)
     dt = time.perf_counter() - t0
     return {"metric": f"beam_decode_b{batch}_beam{beam}_len{max_len}"
-                      f"_{engine}_{platform}",
+                      f"_{engine}{'_int8' if int8 else ''}_{platform}",
             "value": round(n_tokens / dt, 2), "unit": "tokens/sec/chip",
             "vs_baseline": 0.0,
             "note": "no published reference decode throughput; absolute "

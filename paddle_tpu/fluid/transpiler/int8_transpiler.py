@@ -28,6 +28,9 @@ import numpy as np
 _QUANT_TARGETS = {
     "mul": ("Y", 1),        # [in, out]
     "conv2d": ("Filter", 0),  # [out_c, in_c, kh, kw]
+    # embeddings: per-row scales; the dominant weight of decode programs.
+    # XLA fuses gather+dequant, so int8 rows stream from HBM.
+    "lookup_table": ("W", 0),
 }
 
 
@@ -45,9 +48,15 @@ class Int8WeightTranspiler:
 
         scope = scope or global_scope()
         gb = program.global_block()
-        quantized = []
+        # pass 1 — collect every consuming site across ALL blocks before
+        # touching the scope: a shared weight (tied embedding, reused
+        # projection) may be consumed in several blocks, and _quantize
+        # drops the fp32 copy, so per-block collect-and-rewrite would
+        # miss later consumers
+        sites = []  # (block, op index, op, slot, wname)
+        axes = {}   # wname -> quant axis (consistent per target table)
+        weights = {}
         for block in program.blocks:
-            insertions = []  # (index, weight name, new input name)
             for i, op in enumerate(block.ops):
                 target = _QUANT_TARGETS.get(op.type)
                 if target is None:
@@ -57,29 +66,66 @@ class Int8WeightTranspiler:
                 if len(names) != 1:
                     continue
                 wname = names[0]
-                if not gb._has_var_recursive(wname) or \
-                        not isinstance(gb._var_recursive(wname), Parameter):
-                    continue
-                w = scope.get(wname, None)
-                if w is None:
-                    continue
-                w = np.asarray(w)
-                if w.size < self.min_elements or \
-                        not np.issubdtype(w.dtype, np.floating):
-                    continue
-                insertions.append((i, op, slot, axis, wname, w))
-            # rewrite back-to-front so indices stay valid
-            for i, op, slot, axis, wname, w in reversed(insertions):
-                dq_name = self._quantize(block, scope, wname, w, axis)
-                op.inputs[slot] = [dq_name]
+                if wname not in weights:
+                    if not gb._has_var_recursive(wname) or \
+                            not isinstance(gb._var_recursive(wname),
+                                           Parameter):
+                        continue
+                    w = scope.get(wname, None)
+                    if w is None:
+                        continue
+                    w = np.asarray(w)
+                    if w.size < self.min_elements or \
+                            not np.issubdtype(w.dtype, np.floating):
+                        continue
+                    weights[wname] = w
+                    axes[wname] = axis
+                elif axes[wname] != axis:
+                    continue  # same weight, incompatible channel axis
+                sites.append((block, i, op, slot, wname))
+
+        # pass 2 — quantize each weight ONCE and rewrite every consumer
+        for wname, w in weights.items():
+            self._quantize(gb, scope, wname, w, axes[wname])
+        for _, _, op, slot, wname in sites:
+            op.inputs[slot] = [wname + "@DEQ"]
+        # one dequantize_weight per (block, weight), before its first
+        # consumer there (shared by all consumers in that block); insert
+        # back-to-front so original indices stay valid
+        for block in program.blocks:
+            firsts = {}  # wname -> first consumer index in this block
+            for b, i, _, _, wname in sites:
+                if b is block:
+                    firsts[wname] = min(firsts.get(wname, i), i)
+            for wname, i in sorted(firsts.items(), key=lambda t: -t[1]):
                 block._insert_op(
                     i, type="dequantize_weight",
                     inputs={"X": [wname + "@INT8"],
                             "Scale": [wname + "@SCALE"]},
-                    outputs={"Out": [dq_name]},
-                    attrs={"quant_axis": axis})
-                quantized.append(wname)
-        return quantized
+                    outputs={"Out": [wname + "@DEQ"]},
+                    attrs={"quant_axis": axes[wname]})
+            if firsts:
+                self._patch_owner_ops(program, block, list(firsts))
+        return list(weights)
+
+    def _patch_owner_ops(self, program, block, wnames):
+        """Sub-block weights (e.g. the step block of a jit_beam_search op,
+        or a While body) are pulled into scope through the OWNING op's X
+        input list, which was computed at build time against the float
+        weights.  Swap the quantized names in so the executor feeds the
+        int8 weight + scale instead of the (now dropped) float copy."""
+        owner = None
+        for b in program.blocks:
+            for op in b.ops:
+                if op.attr("sub_block") == block.idx:
+                    owner = op
+                    break
+        if owner is None or "X" not in owner.inputs:
+            return
+        x = [n for n in owner.inputs["X"] if n not in wnames]
+        for w in wnames:
+            x.extend([w + "@INT8", w + "@SCALE"])
+        owner.inputs["X"] = x
 
     def _quantize(self, block, scope, wname, w, axis):
         """Store int8 weight + per-channel scale in scope/block; drop the

@@ -15,6 +15,7 @@ import paddle_tpu.fluid as fluid
 import paddle_tpu.fluid.executor as _executor
 from paddle_tpu.fluid import layers
 from paddle_tpu.fluid.contrib.decoder import (BeamSearchDecoder, InitState,
+                                              JitBeamSearchDecoder,
                                               StateCell, TrainingDecoder)
 
 V = 14          # vocab: 0 pad, 1 EOS, 2 GO, 3.. chain tokens
@@ -111,49 +112,59 @@ def test_training_decoder_then_beam_search_generation(tmp_path):
     assert losses[-1] < 0.15, (losses[0], losses[-1])
     fluid.io.save_persistables(exe, str(tmp_path), main)
 
-    # ---------- decode program (same layer order => same param names) ----
-    unique_name.switch()  # restart counters so fc_*/embedding_* line up
-    dmain, dstartup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(dmain, dstartup):
-        src, h0 = _encoder()
-        cell = _build_cell(h0)
-        init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
-                               lod_level=2)
-        init_scores = layers.data(name="init_scores", shape=[1],
-                                  dtype="float32", lod_level=2)
-        bsd = BeamSearchDecoder(cell, init_ids, init_scores,
-                                target_dict_dim=V, word_dim=D,
-                                topk_size=V, sparse_emb=False,
-                                max_len=CHAIN_LEN + 2, beam_size=2,
-                                end_id=EOS)
-        bsd.decode()
-        out_ids, out_scores = bsd()
+    # ---------- decode programs (same layer order => same param names) ---
+    # the reference workflow generates through the While/beam_search path;
+    # the TPU-native path generates the SAME chains through ONE compiled
+    # while_loop (JitBeamSearchDecoder) — both run here from the trained
+    # weights, and must agree
+    results = {}
+    for decoder_cls in (BeamSearchDecoder, JitBeamSearchDecoder):
+        unique_name.switch()  # restart counters so fc_*/embedding_* align
+        dmain, dstartup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(dmain, dstartup):
+            src, h0 = _encoder()
+            cell = _build_cell(h0)
+            init_ids = layers.data(name="init_ids", shape=[1],
+                                   dtype="int64", lod_level=2)
+            init_scores = layers.data(name="init_scores", shape=[1],
+                                      dtype="float32", lod_level=2)
+            bsd = decoder_cls(cell, init_ids, init_scores,
+                              target_dict_dim=V, word_dim=D,
+                              topk_size=V, sparse_emb=False,
+                              max_len=CHAIN_LEN + 2, beam_size=2,
+                              end_id=EOS)
+            bsd.decode()
+            out_ids, out_scores = bsd()
 
-    with fluid.scope_guard(_executor.Scope()):
-        exe2 = fluid.Executor(fluid.CPUPlace())
-        exe2.run(dstartup)
-        fluid.io.load_persistables(exe2, str(tmp_path), dmain)
+        with fluid.scope_guard(_executor.Scope()):
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            exe2.run(dstartup)
+            fluid.io.load_persistables(exe2, str(tmp_path), dmain)
 
-        b = 2
-        lod2 = [[1] * b, [1] * b]
-        dfeed = {
-            "src": np.array([[3], [5]], np.int64),
-            "init_ids": fluid.create_lod_tensor(
-                np.full((b, 1), GO, np.int64), lod2),
-            "init_scores": fluid.create_lod_tensor(
-                np.zeros((b, 1), np.float32), lod2)}
-        ids, scores = exe2.run(dmain, feed=dfeed,
-                               fetch_list=[out_ids, out_scores],
-                               return_numpy=False)
-        hyp_lens = ids.recursive_sequence_lengths()[-1]
-        flat = np.asarray(ids).ravel()
-        # each source decodes beam_size hypotheses; the TOP hypothesis of
-        # each source must follow the learned chain (first tokens after GO)
-        offsets = np.cumsum([0] + list(hyp_lens))
-        hyps_per_src = len(hyp_lens) // b
-        for i, start in enumerate((3, 5)):
-            top = flat[offsets[i * hyps_per_src]:
-                       offsets[i * hyps_per_src] + hyp_lens[i * hyps_per_src]]
-            want = _chain(start, CHAIN_LEN)
-            got = [t for t in top.tolist() if t not in (GO, EOS)]
-            assert got[:3] == want[:3], (start, got, want)
+            b = 2
+            lod2 = [[1] * b, [1] * b]
+            dfeed = {
+                "src": np.array([[3], [5]], np.int64),
+                "init_ids": fluid.create_lod_tensor(
+                    np.full((b, 1), GO, np.int64), lod2),
+                "init_scores": fluid.create_lod_tensor(
+                    np.zeros((b, 1), np.float32), lod2)}
+            ids, scores = exe2.run(dmain, feed=dfeed,
+                                   fetch_list=[out_ids, out_scores],
+                                   return_numpy=False)
+            hyp_lens = ids.recursive_sequence_lengths()[-1]
+            flat = np.asarray(ids).ravel()
+            results[decoder_cls.__name__] = (
+                tuple(hyp_lens), tuple(flat.tolist()),
+                tuple(np.round(np.asarray(scores).ravel(), 4).tolist()))
+            # each source decodes beam_size hypotheses; the TOP hypothesis
+            # of each source must follow the learned chain
+            offsets = np.cumsum([0] + list(hyp_lens))
+            hyps_per_src = len(hyp_lens) // b
+            for i, start in enumerate((3, 5)):
+                j = i * hyps_per_src
+                top = flat[offsets[j]:offsets[j] + hyp_lens[j]]
+                want = _chain(start, CHAIN_LEN)
+                got = [t for t in top.tolist() if t not in (GO, EOS)]
+                assert got[:3] == want[:3], (start, got, want)
+    assert results["BeamSearchDecoder"] == results["JitBeamSearchDecoder"]

@@ -235,3 +235,109 @@ def test_jit_decode_context_vars():
     a = sc[int(lod[1][0]):int(lod[1][1])]
     b = sc[int(lod[1][int(lod[0][1])]):int(lod[1][int(lod[0][1]) + 1])]
     assert not np.allclose(a[1:], b[1:len(a)])
+
+
+def test_jit_decode_int8_weights():
+    """Weight-only int8 composes with the compiled decode loop (VERDICT r4
+    next #7): the transpiler rewrites weights consumed INSIDE the step
+    sub-block (embedding + fc muls) to int8 + per-channel scales, patches
+    the jit_beam_search op's loop-invariant input list, and the program
+    still runs as one compiled loop with near-identical scores."""
+    from paddle_tpu.fluid.transpiler.int8_transpiler import (
+        Int8WeightTranspiler)
+
+    main, startup, out_ids, out_scores = _build(JitBeamSearchDecoder,
+                                                seed=61)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    sc32 = exe.run(main, feed=_feed(), fetch_list=[out_scores],
+                   return_numpy=False)[0]
+    best32 = [np.asarray(sc32).reshape(-1)[int(sc32.lod()[1][
+        int(sc32.lod()[0][s]) + 1]) - 1] for s in range(BATCH)]
+
+    quantized = Int8WeightTranspiler(min_elements=32).transpile(main)
+    # the step block's embedding and both fc muls must be covered
+    assert len(quantized) >= 3, quantized
+    scope = _executor._global_scope
+    emb = [q for q in quantized if "embedding" in q]
+    assert emb and np.asarray(scope.get(emb[0] + "@INT8")).dtype == np.int8
+    assert all(scope.get(q, None) is None for q in quantized)  # fp32 freed
+
+    jit_op = next(op for op in main.global_block().ops
+                  if op.type == "jit_beam_search")
+    x = jit_op.inputs["X"]
+    assert any(n.endswith("@INT8") for n in x)
+    assert not any(n in quantized for n in x)  # stale fp32 names swapped
+
+    sc8 = exe.run(main, feed=_feed(), fetch_list=[out_scores],
+                  return_numpy=False)[0]
+    best8 = [np.asarray(sc8).reshape(-1)[int(sc8.lod()[1][
+        int(sc8.lod()[0][s]) + 1]) - 1] for s in range(BATCH)]
+    # per-channel weight-only int8: best-hypothesis log-probs shift by
+    # quantization noise only
+    np.testing.assert_allclose(best8, best32, atol=0.15)
+
+
+def test_jit_decode_int8_tied_embedding():
+    """A weight shared across blocks (tied source/target embedding named
+    via ParamAttr, consumed by the encoder in the global block AND by the
+    decode step sub-block) must quantize ONCE with every consumer rewired
+    — the multi-block case the collect-then-quantize transpiler handles."""
+    from paddle_tpu.fluid.transpiler.int8_transpiler import (
+        Int8WeightTranspiler)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 67
+    with fluid.program_guard(main, startup):
+        src = layers.data(name="src", shape=[1], dtype="int64")
+        # tie the decode-step embedding to the encoder's by name: the
+        # step block's lookup_table will consume the SAME parameter
+        h0 = layers.fc(input=layers.embedding(src, size=[V, D],
+                                              param_attr="tied_emb"),
+                       size=D, act="tanh")
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0)}, out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            c.set_state("h", layers.fc(input=[c.get_input("x"),
+                                              c.get_state("h")],
+                                       size=D, act="tanh"))
+
+        init_ids = layers.data(name="init_ids", shape=[1], dtype="int64",
+                               lod_level=2)
+        init_scores = layers.data(name="init_scores", shape=[1],
+                                  dtype="float32", lod_level=2)
+        dec = JitBeamSearchDecoder(cell, init_ids, init_scores,
+                                   target_dict_dim=V, word_dim=D,
+                                   max_len=MAX_LEN, beam_size=BEAM,
+                                   end_id=END)
+        # route the step embedding through the tied parameter
+        import paddle_tpu.fluid.contrib.decoder.beam_search_decoder as bsd
+        orig_embedding = layers.embedding
+        try:
+            def tied_embedding(input, size, **kw):
+                kw["param_attr"] = "tied_emb"
+                return orig_embedding(input, size, **kw)
+            bsd.layers.embedding = tied_embedding
+            dec.decode()
+        finally:
+            bsd.layers.embedding = orig_embedding
+        out_ids, out_scores = dec()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ids32 = exe.run(main, feed=_feed(), fetch_list=[out_ids],
+                    return_numpy=False)[0]
+    quantized = Int8WeightTranspiler(min_elements=32).transpile(main)
+    assert quantized.count("tied_emb") == 1  # quantized once, not per site
+    scope = _executor._global_scope
+    assert scope.get("tied_emb", None) is None          # fp32 freed
+    assert scope.get("tied_emb@INT8") is not None
+    ids8 = exe.run(main, feed=_feed(), fetch_list=[out_ids],
+                   return_numpy=False)[0]
+    assert np.asarray(ids8).size > 0
+    # top chain robust to int8 noise on this tiny model
+    a = np.asarray(ids32).ravel()[:int(ids32.lod()[1][1])]
+    b = np.asarray(ids8).ravel()[:int(ids8.lod()[1][1])]
+    np.testing.assert_array_equal(a, b)
