@@ -1273,13 +1273,16 @@ def gpipe_mlp_stack(input, n_layers, act="relu", n_microbatches=4,
 
 
 def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
-                   bias=None, name=None):
-    """Sequence-parallel attention (TPU-native capability beyond the
-    reference — see parallel/ring_attention.py).  q, k, v: [B, H, T, D].
-    Under a mesh with an `sp` axis the sequence dim shards across devices
-    and K/V rotate the ICI ring; single-device it equals full softmax
-    attention.  ``bias``, if given, is an additive [B, 1, 1, T] key bias
-    (padding mask) that rides the ring with K/V."""
+                   bias=None, flash=None, name=None):
+    """Fused attention (TPU-native capability beyond the reference — see
+    parallel/ring_attention.py + ops/pallas_flash.py).  q, k, v:
+    [B, H, T, D].  Under a mesh with an `sp` axis the sequence dim shards
+    across devices and K/V rotate the ICI ring; single-device the executor
+    picks the Pallas flash kernel (fwd + bwd VMEM streaming) or XLA full
+    softmax.  ``bias``, if given, is an additive [B, 1, 1, T] key bias
+    (padding mask).  ``flash``: True forces the Pallas kernel, False
+    forbids it, None (default) = auto (TPU backend, PADDLE_TPU_FLASH
+    honored — ops/attention_ops._use_flash)."""
     helper = LayerHelper("ring_attention", **locals())
     out = helper.create_variable_for_type_inference(helper.input_dtype("q"))
     out.shape = tuple(q.shape)
@@ -1290,7 +1293,8 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
         type="ring_attention", inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": float(scale or 0.0),
-               "sp_axis": sp_axis})
+               "sp_axis": sp_axis,
+               "flash": -1 if flash is None else int(bool(flash))})
     return out
 
 def _stack_params(helper, dtype, n_layer, d_model, d_inner, decoder,
@@ -1337,7 +1341,7 @@ def _stack_params(helper, dtype, n_layer, d_model, d_inner, decoder,
 def transformer_encoder_stack(input, bias=None, n_layer=2, n_head=4,
                               d_inner=None, dropout=0.0, is_test=False,
                               n_microbatches=4, recompute=False,
-                              param_attr=None, name=None):
+                              flash=None, param_attr=None, name=None):
     """A full transformer ENCODER stack as one mesh-aware op (TPU-native
     capability — see parallel/transformer_stack.py).  input: [N, T, D];
     bias: optional [N, 1, 1, T] additive key bias (padding mask).
@@ -1367,15 +1371,16 @@ def transformer_encoder_stack(input, bias=None, n_layer=2, n_head=4,
         attrs={"n_head": int(n_head), "dropout": float(dropout),
                "is_test": bool(is_test),
                "n_microbatches": int(n_microbatches),
-               "recompute": bool(recompute)})
+               "recompute": bool(recompute),
+               "flash": -1 if flash is None else int(bool(flash))})
     return out
 
 
 def transformer_decoder_stack(input, enc_out, src_bias=None, n_layer=2,
                               n_head=4, d_inner=None, dropout=0.0,
                               is_test=False, n_microbatches=4,
-                              recompute=False, param_attr=None,
-                              name=None):
+                              recompute=False, flash=None,
+                              param_attr=None, name=None):
     """A full transformer DECODER stack (causal self-attn + cross-attn +
     FFN per layer) as one mesh-aware op; see transformer_encoder_stack.
     input: [N, Tt, D]; enc_out: [N, Ts, D]; src_bias: [N, 1, 1, Ts]."""
@@ -1399,7 +1404,8 @@ def transformer_decoder_stack(input, enc_out, src_bias=None, n_layer=2,
         attrs={"n_head": int(n_head), "dropout": float(dropout),
                "is_test": bool(is_test),
                "n_microbatches": int(n_microbatches),
-               "recompute": bool(recompute)})
+               "recompute": bool(recompute),
+               "flash": -1 if flash is None else int(bool(flash))})
     return out
 
 

@@ -28,7 +28,7 @@ class Config:
                  d_inner, n_head, n_layer, dropout=0.1, label_smooth=0.1,
                  moe_experts=0, moe_top_k=2, moe_aux_weight=1e-2,
                  stacked=False, ring_attention=False, n_microbatches=4,
-                 recompute=False):
+                 recompute=False, flash_attention=None):
         self.name = name
         self.src_vocab_size = src_vocab_size
         self.tgt_vocab_size = tgt_vocab_size
@@ -55,6 +55,12 @@ class Config:
         # probability dropout is skipped in this mode (the [T, T] matrix
         # never materializes under the ring).
         self.ring_attention = ring_attention
+        # flash_attention: True routes every attention through the Pallas
+        # streamed kernel (fwd + bwd, ops/pallas_flash.py), False forbids
+        # it, None = auto (on for TPU backends; PADDLE_TPU_FLASH
+        # overrides).  Attention-probability dropout is skipped on the
+        # flash path (the [T, T] matrix never materializes), like ring.
+        self.flash_attention = flash_attention
         self.n_microbatches = n_microbatches
         # recompute=True (stacked mode) wraps each layer in
         # jax.checkpoint: backward rematerializes activations layer by
@@ -111,7 +117,8 @@ def _postprocess(prev, out, dropout):
 
 
 def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
-                          dropout, prefix, causal=False, use_ring=False):
+                          dropout, prefix, causal=False, use_ring=False,
+                          flash=None):
     """[b, lq, d] x [b, lk, d] -> [b, lq, d]; bias broadcasts into the
     [b, h, lq, lk] logits (None, [lq, lk] causal, or [b, 1, 1, lk] padding).
 
@@ -136,9 +143,16 @@ def _multi_head_attention(q_in, k_in, v_in, bias, d_model, n_head,
                          perm=[0, 2, 1, 3])
     v = layers.transpose(layers.reshape(v, [-1, lk, n_head, d_k]),
                          perm=[0, 2, 1, 3])
-    if use_ring:
+    from ..ops.attention_ops import _flash_decision
+    if use_ring or flash or (flash is None and _flash_decision()):
+        # the fused attention op: executor picks ring (sp mesh axis) /
+        # Pallas flash / XLA full softmax; prob-dropout is skipped.
+        # flash=None auto-routes here when the backend would take the
+        # Pallas path (TPU, PADDLE_TPU_FLASH honored) so the Config
+        # docstring's "None = auto" holds for dense builds too
         ctx = layers.ring_attention(q, k, v, causal=causal,
-                                    scale=d_k ** -0.5, bias=bias)
+                                    scale=d_k ** -0.5, bias=bias,
+                                    flash=flash)
     else:
         logits = layers.matmul(layers.scale(q, scale=d_k ** -0.5), k,
                                transpose_y=True)
@@ -219,12 +233,14 @@ def encoder(src_word, cfg, src_len, aux_losses=None):
             enc, bias=src_bias, n_layer=cfg.n_layer, n_head=cfg.n_head,
             d_inner=cfg.d_inner, dropout=cfg.dropout,
             n_microbatches=cfg.n_microbatches,
-            recompute=getattr(cfg, "recompute", False))
+            recompute=getattr(cfg, "recompute", False),
+            flash=getattr(cfg, "flash_attention", None))
         return enc, src_bias
     for i in range(cfg.n_layer):
         attn = _multi_head_attention(
             enc, enc, enc, src_bias, cfg.d_model, cfg.n_head, cfg.dropout,
-            prefix=f"enc{i}_self", use_ring=cfg.ring_attention)
+            prefix=f"enc{i}_self", use_ring=cfg.ring_attention,
+            flash=getattr(cfg, "flash_attention", None))
         enc = _postprocess(enc, attn, cfg.dropout)
         ff = _ffn(enc, cfg.d_inner, cfg.d_model, prefix=f"enc{i}",
                   cfg=cfg, aux_losses=aux_losses)
@@ -239,17 +255,20 @@ def decoder(tgt_word, enc_out, src_bias, cfg, tgt_len, aux_losses=None):
             dec, enc_out, src_bias=src_bias, n_layer=cfg.n_layer,
             n_head=cfg.n_head, d_inner=cfg.d_inner, dropout=cfg.dropout,
             n_microbatches=cfg.n_microbatches,
-            recompute=getattr(cfg, "recompute", False))
+            recompute=getattr(cfg, "recompute", False),
+            flash=getattr(cfg, "flash_attention", None))
         return layers.fc(dec, cfg.tgt_vocab_size, num_flatten_dims=2,
                          param_attr=ParamAttr(name="out_proj_w"))
     for i in range(cfg.n_layer):
         self_attn = _multi_head_attention(
             dec, dec, dec, None, cfg.d_model, cfg.n_head, cfg.dropout,
-            prefix=f"dec{i}_self", causal=True, use_ring=cfg.ring_attention)
+            prefix=f"dec{i}_self", causal=True, use_ring=cfg.ring_attention,
+            flash=getattr(cfg, "flash_attention", None))
         dec = _postprocess(dec, self_attn, cfg.dropout)
         cross = _multi_head_attention(
             dec, enc_out, enc_out, src_bias, cfg.d_model, cfg.n_head,
-            cfg.dropout, prefix=f"dec{i}_cross", use_ring=cfg.ring_attention)
+            cfg.dropout, prefix=f"dec{i}_cross", use_ring=cfg.ring_attention,
+            flash=getattr(cfg, "flash_attention", None))
         dec = _postprocess(dec, cross, cfg.dropout)
         ff = _ffn(dec, cfg.d_inner, cfg.d_model, prefix=f"dec{i}",
                   cfg=cfg, aux_losses=aux_losses)

@@ -27,27 +27,48 @@ def ring_attention_op(ctx):
     from ..parallel import ring_attention as ra
     from ..parallel import spmd
 
+    flash_req = int(ctx.attr("flash", -1))
     mesh = spmd.active_mesh()
     if mesh is not None and sp_axis in mesh.axis_names \
             and mesh.shape[sp_axis] > 1:
         out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale,
                                 bias=bias)
-    elif bias is None and _use_flash():
-        from .pallas_flash import flash_attention
+    elif _flash_decision(flash_req):
+        from .pallas_flash import bias_supported, flash_attention
 
-        out = flash_attention(q, k, v, scale, causal)
+        if bias_supported(bias, q.shape[0], k.shape[2]):
+            out = flash_attention(q, k, v, bias, scale, causal)
+        else:
+            out = ra.full_attention(q, k, v, causal, scale, bias=bias)
     else:
         out = ra.full_attention(q, k, v, causal, scale, bias=bias)
     return {"Out": out}
 
 
-def _use_flash() -> bool:
-    """Opt-in Pallas flash-attention kernel (PADDLE_TPU_FLASH=1).
+def _flash_decision(flash_req: int = -1) -> bool:
+    """Pallas flash-attention kernel gate.
 
-    Off by default because tunneled TPU transports (axon remote-compile)
-    cannot compile Mosaic kernels; on a real TPU VM the kernel compiles
-    natively and streams K/V through VMEM (ops/pallas_flash.py)."""
+    Precedence: the PADDLE_TPU_FLASH env kill-switch wins over everything
+    (=0 forces OFF even for models built with flash=True — it is the
+    tunnel safeguard bench.py relies on; =1 forces ON), then the per-op
+    attr (1 on / 0 off), then AUTO: on when the backend is a TPU (the
+    kernels compile natively on a TPU VM and stream K/V through VMEM —
+    ops/pallas_flash.py), off on CPU/GPU (interpret mode is a correctness
+    tool, not a fast path)."""
     import os
 
-    return os.environ.get("PADDLE_TPU_FLASH", "").strip().lower() \
-        in ("1", "true")
+    import jax
+
+    v = os.environ.get("PADDLE_TPU_FLASH", "").strip().lower()
+    if v in ("0", "false"):
+        return False
+    if v in ("1", "true"):
+        return True
+    if flash_req != -1:
+        return bool(flash_req)
+    return jax.default_backend() == "tpu"
+
+
+def _use_flash() -> bool:
+    """AUTO-mode gate (no per-op request) — see _flash_decision."""
+    return _flash_decision(-1)

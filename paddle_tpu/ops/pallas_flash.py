@@ -1,18 +1,33 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
-The hot op of the transformer/BERT path gets a hand-scheduled kernel
+The hot op of the transformer/BERT path gets hand-scheduled kernels
 (SURVEY.md §7.3: "Pallas only where XLA underperforms"): one grid step
-owns a [BLOCK_Q, D] query tile resident in VMEM and streams the K/V tiles
+owns a [BLOCK, D] tile resident in VMEM and streams the opposing tiles
 through the MXU with the online-softmax recurrence, so the [T, T] score
-matrix never hits HBM.  Accumulation is fp32 in VMEM scratch regardless of
-the input dtype (the same master-accumulator discipline as fluid.amp).
+matrix never hits HBM — forward, dQ, and dK/dV alike.  Accumulation is
+fp32 in VMEM scratch regardless of the input dtype (the same
+master-accumulator discipline as fluid.amp).
 
-Backward: custom_vjp with the standard recompute formulation — dS = P ∘
-(dP - rowsum(dO ∘ O)) — expressed in jnp (XLA fuses it well; a Pallas
-backward is a further optimization, not a correctness need).
+Backward (Dao FlashAttention-2 formulation): the forward emits the
+per-row logsumexp L, so each backward tile recomputes P = exp(S - L)
+locally; with delta = rowsum(dO ∘ O) precomputed (one fused elementwise
+reduce in XLA):
 
-Falls back to interpret mode off-TPU, so the same code path is testable on
-the CPU mesh.
+    dV = Pᵀ dO;   dS = P ∘ (dO Vᵀ - delta);   dQ = scale·dS K;
+    dK = scale·dSᵀ Q
+
+split into two kernels matching the reduction directions: a dQ kernel
+(q-tile resident, streams K/V) and a dK/dV kernel (k-tile resident,
+streams Q/dO).  Both skip dead causal blocks.
+
+``bias`` is the additive KEY-padding bias ([B, 1, 1, Tk], the shape the
+models build) — broadcast into the logits inside the kernels; it gets no
+gradient (it is derived from input padding, never trained).
+
+Falls back to interpret mode off-TPU, so the same kernel code is testable
+on the CPU mesh.  ref: the reference's fused scaled_dot_product kernels
+live in paddle/fluid/operators/math/ + cuDNN; this is the TPU-native
+counterpart.
 """
 
 from __future__ import annotations
@@ -28,13 +43,23 @@ DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, n_k):
-    """Grid step (head, q-block, k-block): one [bq, d] query tile against
-    one [bk, d] K/V tile, with the online-softmax state (m, l, acc) carried
-    in fp32 VMEM scratch across the (sequential, minormost) k dimension of
-    the grid — so VMEM holds only one K/V TILE at a time and t_kv can be
-    arbitrarily long."""
+def _causal_mask(logits, q_off, k_off):
+    qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+    kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    return jnp.where(qpos >= kpos, logits, jnp.float32(NEG_INF))
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, n_k,
+                  has_bias):
+    """Forward grid step (bh, q-block, k-block): one [bq, d] query tile
+    against one [bk, d] K/V tile, online-softmax state (m, l, acc) in fp32
+    VMEM scratch carried across the (sequential, minormost) k dimension —
+    VMEM holds one K/V TILE at a time, t_kv can be arbitrarily long."""
+    if has_bias:
+        bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+        bias_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -62,12 +87,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bq, bk]
+        if bias_ref is not None:
+            logits = logits + bias_ref[0].astype(jnp.float32)  # [1, bk]
         if causal:
-            qpos = q_off + jax.lax.broadcasted_iota(jnp.int32,
-                                                    logits.shape, 0)
-            kpos = k_off + jax.lax.broadcasted_iota(jnp.int32,
-                                                    logits.shape, 1)
-            logits = jnp.where(qpos >= kpos, logits, jnp.float32(NEG_INF))
+            logits = _causal_mask(logits, q_off, k_off)
         m = m_ref[:]
         l = l_ref[:]
         m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
@@ -81,22 +104,162 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(ki == n_k - 1)
     def _flush():
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:], jnp.float32(1e-30))
-                    ).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:], jnp.float32(1e-30))
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l)
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
-    from jax.experimental.pallas import tpu as pltpu
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, n_k, has_bias):
+    """dQ grid step (bh, q-block, k-block): q/dO/lse/delta tiles resident,
+    K/V tiles stream; dq accumulates in fp32 scratch over ki."""
+    if has_bias:
+        bias_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        bias_ref = None
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+    q_off = qi * jnp.int32(bq)
+    k_off = ki * jnp.int32(bk)
 
-    b, h, t, d = q.shape
-    t_kv = k.shape[2]
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (k_off <= q_off + jnp.int32(bq - 1)) if causal else True
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_off, k_off)
+        p = jnp.exp(s - lse_ref[0])                     # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bq, bk]
+        ds = p * (dp - delta_ref[0])
+        dq_acc[:] += jnp.float32(scale) * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, n_q, has_bias):
+    """dK/dV grid step (bh, k-block, q-block): K/V tiles resident, Q/dO/
+    lse/delta tiles stream; dk/dv accumulate in fp32 scratch over qi."""
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        bias_ref = None
+    qi = pl.program_id(2)
+    kjj = pl.program_id(1)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+    q_off = qi * jnp.int32(bq)
+    k_off = kjj * jnp.int32(bk)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (q_off + jnp.int32(bq - 1) >= k_off) if causal else True
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        # [bk, bq] orientation: k rows resident
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        if bias_ref is not None:
+            # key-bias is constant along q: one column vector [bk, 1]
+            st = st + bias_ref[0].reshape(bk, 1).astype(jnp.float32)
+        if causal:
+            kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
+            qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
+            st = jnp.where(qpos >= kpos, st, jnp.float32(NEG_INF))
+        pt = jnp.exp(st - lse_ref[0].reshape(1, bq))    # [bk, bq]
+        dv_acc[:] += jax.lax.dot_general(
+            pt, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bk, bq]
+        dst = pt * (dpt - delta_ref[0].reshape(1, bq))
+        dk_acc[:] += jnp.float32(scale) * jax.lax.dot_general(
+            dst, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _blocks(t, t_kv, block_q, block_k):
     bq = min(block_q, t)
     bk = min(block_k, t_kv)
     while t % bq:
         bq //= 2
     while t_kv % bk:
         bk //= 2
+    return bq, bk
+
+
+def bias_supported(bias, b, t_kv) -> bool:
+    """Whether the kernels can take this additive bias: key-padding shaped
+    [B|1, 1, 1, Tk] or [B|1, Tk].  The SAME predicate gates the op-level
+    routing (ops/attention_ops.py), so an unsupported bias falls back to
+    the XLA path instead of crashing here."""
+    if bias is None:
+        return True
+    if bias.ndim == 4:
+        return (bias.shape[1] == 1 and bias.shape[2] == 1
+                and bias.shape[0] in (1, b) and bias.shape[3] == t_kv)
+    return bias.ndim == 2 and bias.shape[0] in (1, b) \
+        and bias.shape[1] == t_kv
+
+
+def _bias_2d(bias, b, h, t_kv):
+    """Normalize a supported bias (see bias_supported) to [B, Tk]."""
+    if bias is None:
+        return None
+    if not bias_supported(bias, b, t_kv):
+        raise ValueError(
+            f"flash_attention bias must be key-padding shaped "
+            f"[B|1, 1, 1, Tk] or [B|1, Tk]; got {bias.shape}")
+    if bias.ndim == 4:
+        bias = bias.reshape(bias.shape[0], bias.shape[3])
+    if bias.shape[0] == 1 and b > 1:
+        bias = jnp.broadcast_to(bias, (b, t_kv))
+    return bias
+
+
+def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
+                   interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    t_kv = k.shape[2]
+    bq, bk = _blocks(t, t_kv, block_q, block_k)
     n_k = t_kv // bk
     # grid iterates k-blocks innermost: TPU grids run sequentially on a
     # core, so the scratch online-softmax state carries across ki steps
@@ -104,66 +267,165 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
     qr = q.reshape(b * h, t, d)
     kr = k.reshape(b * h, t_kv, d)
     vr = v.reshape(b * h, t_kv, d)
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+    ]
+    args = [qr, kr, vr]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda i, j, s, h=h: (i // h, 0, s)))
+        args.append(bias.reshape(b, 1, t_kv))
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          n_k=n_k),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                          n_k=n_k, has_bias=bias is not None),
+        out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32)],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+                   pl.BlockSpec((1, bq, 1), lambda i, j, s: (i, j, 0))],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running denominator
             pltpu.VMEM((bq, d), jnp.float32),   # fp32 accumulator
         ],
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, t, d)
+    )(*args)
+    return out.reshape(b, h, t, d), lse.reshape(b, h, t, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, scale=None, causal=False,
+def _flash_backward(q, k, v, bias, out, lse, do, scale, causal, block_q,
+                    block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    t_kv = k.shape[2]
+    bq, bk = _blocks(t, t_kv, block_q, block_k)
+    n_q, n_k = t // bq, t_kv // bk
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [b, h, t, 1]
+    qr = q.reshape(b * h, t, d)
+    kr = k.reshape(b * h, t_kv, d)
+    vr = v.reshape(b * h, t_kv, d)
+    dor = do.reshape(b * h, t, d)
+    lser = lse.reshape(b * h, t, 1)
+    dr = delta.reshape(b * h, t, 1)
+    has_bias = bias is not None
+    bias_args = [bias.reshape(b, 1, t_kv)] if has_bias else []
+
+    # dQ: q-tile resident, k innermost
+    q_res = [pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+             pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+             pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+             pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+             pl.BlockSpec((1, bq, 1), lambda i, j, s: (i, j, 0)),
+             pl.BlockSpec((1, bq, 1), lambda i, j, s: (i, j, 0))]
+    if has_bias:
+        q_res.append(pl.BlockSpec(
+            (1, 1, bk), lambda i, j, s, h=h: (i // h, 0, s)))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          n_k=n_k, has_bias=has_bias),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, n_q, n_k),
+        in_specs=q_res,
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, dr, *bias_args)
+
+    # dK/dV: k-tile resident, q innermost
+    kv_res = [pl.BlockSpec((1, bq, d), lambda i, j, s: (i, s, 0)),
+              pl.BlockSpec((1, bk, d), lambda i, j, s: (i, j, 0)),
+              pl.BlockSpec((1, bk, d), lambda i, j, s: (i, j, 0)),
+              pl.BlockSpec((1, bq, d), lambda i, j, s: (i, s, 0)),
+              pl.BlockSpec((1, bq, 1), lambda i, j, s: (i, s, 0)),
+              pl.BlockSpec((1, bq, 1), lambda i, j, s: (i, s, 0))]
+    if has_bias:
+        kv_res.append(pl.BlockSpec(
+            (1, 1, bk), lambda i, j, s, h=h: (i // h, 0, j)))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          n_q=n_q, has_bias=has_bias),
+        out_shape=[jax.ShapeDtypeStruct((b * h, t_kv, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, t_kv, d), v.dtype)],
+        grid=(b * h, n_k, n_q),
+        in_specs=kv_res,
+        out_specs=[pl.BlockSpec((1, bk, d), lambda i, j, s: (i, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda i, j, s: (i, j, 0))],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, dr, *bias_args)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t_kv, d),
+            dv.reshape(b, h, t_kv, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=None):
-    """softmax(scale * q k^T [+ causal mask]) v, streamed (never
+    """softmax(scale · q kᵀ + bias [+ causal mask]) v, streamed (never
+    materializes the [T, T] scores).  q/k/v: [B, H, T, D]; bias: additive
+    key-padding bias [B, 1, 1, Tk] (or [B, Tk]) or None, non-trainable."""
+    out, _ = _flash_fwd_impl(q, k, v, bias, scale, causal, block_q,
+                             block_k, interpret)
+    return out
 
-    materializes the [T, T] scores).  q/k/v: [B, H, T, D]."""
+
+def _resolve(q, scale, interpret):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
+    return scale, interpret
+
+
+def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
+                    interpret):
+    scale, interpret = _resolve(q, scale, interpret)
+    bias = _bias_2d(bias, q.shape[0], q.shape[1], k.shape[2])
+    return _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
                           interpret)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
-    return out, (q, k, v, out)
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal, block_q,
+                               block_k, interpret)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    """Recompute backward (Dao FA2 eq. form): with P the softmax probs,
-    dV = Pᵀ dO;  dS = P ∘ (dO Vᵀ - rowsum(dO ∘ O));  dQ = scale · dS K;
-    dK = scale · dSᵀ Q."""
-    q, k, v, o = res
+    q, k, v, bias, out, lse = res
+    scale, interpret = _resolve(q, scale, interpret)
+    bias2 = _bias_2d(bias, q.shape[0], q.shape[1], k.shape[2])
+    dq, dk, dv = _flash_backward(q, k, v, bias2, out, lse, do, scale,
+                                 causal, block_q, block_k, interpret)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_bwd_reference(q, k, v, do, bias=None, scale=None, causal=False):
+    """jnp recompute backward (the pre-r5 path) — kept as the OpTest
+    reference the Pallas dQ/dK/dV kernels are verified against."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    of = o.astype(jnp.float32)
+    qf, kf, vf = (a.astype(jnp.float32) for a in (q, k, v))
     dof = do.astype(jnp.float32)
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         tq, tk = q.shape[2], k.shape[2]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    of = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
     delta = jnp.sum(dof * of, axis=-1, keepdims=True)
@@ -171,6 +433,3 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
-flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -30,8 +30,11 @@ def _stack_args(ctx, decoder):
     from ..parallel import spmd
     from ..parallel import transformer_stack as ts
 
+    from . import attention_ops
+
     slots = ts.DECODER_SLOTS if decoder else ts.ENCODER_SLOTS
     params = _collect(ctx, slots)
+    flash_req = int(ctx.attr("flash", -1))
     return dict(
         kind="dec" if decoder else "enc",
         enc=ctx.input("EncOut") if decoder else None,
@@ -42,6 +45,7 @@ def _stack_args(ctx, decoder):
         is_test=bool(ctx.attr("is_test", False)),
         n_micro=int(ctx.attr("n_microbatches", 4)),
         recompute=bool(ctx.attr("recompute", False)),
+        flash=attention_ops._flash_decision(flash_req),
         mesh=spmd.active_mesh(),
     )
 
@@ -58,7 +62,8 @@ def _forward(ctx, decoder):
     out = ts.stack_apply(a["kind"], x, a["enc"], a["bias"], a["params"],
                          key, n_head=a["n_head"], dropout=a["dropout"],
                          is_test=a["is_test"], n_micro=a["n_micro"],
-                         mesh=a["mesh"], recompute=a["recompute"])
+                         mesh=a["mesh"], recompute=a["recompute"],
+                         flash=a["flash"])
     return {"Out": out, "RngKey": key}
 
 
@@ -75,7 +80,8 @@ def _backward(ctx, decoder):
             return ts.stack_apply(a["kind"], xx, ee, a["bias"], pp, key,
                                   n_head=a["n_head"], dropout=a["dropout"],
                                   is_test=a["is_test"], n_micro=a["n_micro"],
-                                  mesh=a["mesh"], recompute=a["recompute"])
+                                  mesh=a["mesh"], recompute=a["recompute"],
+                                  flash=a["flash"])
 
         _, vjp = jax.vjp(f, x, a["enc"], a["params"])
         gx, genc, gparams = vjp(gout)
@@ -85,7 +91,8 @@ def _backward(ctx, decoder):
             return ts.stack_apply(a["kind"], xx, None, a["bias"], pp, key,
                                   n_head=a["n_head"], dropout=a["dropout"],
                                   is_test=a["is_test"], n_micro=a["n_micro"],
-                                  mesh=a["mesh"], recompute=a["recompute"])
+                                  mesh=a["mesh"], recompute=a["recompute"],
+                                  flash=a["flash"])
 
         _, vjp = jax.vjp(f, x, a["params"])
         gx, gparams = vjp(gout)

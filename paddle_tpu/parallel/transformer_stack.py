@@ -94,12 +94,13 @@ def _dropout(x, key, rate, is_test):
     return x * keep.astype(x.dtype)
 
 
-def _attend(q, k, v, bias, causal, local_heads, sp_axis):
+def _attend(q, k, v, bias, causal, local_heads, sp_axis, flash=False):
     """[b, tq, dh] x [b, tk, dh] -> [b, tq, dh] with dh split into
     ``local_heads`` heads; bias is [b, 1, 1, tk(-local)] or None.  Inside a
-    shard_map with an sp axis the ring schedule runs over it; otherwise
-    plain full-softmax attention.  ``scale`` uses the GLOBAL head dim, which
-    equals the local head dim (mp splits heads, not head size)."""
+    shard_map with an sp axis the ring schedule runs over it; with
+    flash=True the Pallas streamed kernel (fwd + bwd) runs instead of the
+    XLA full-softmax; ``scale`` uses the GLOBAL head dim, which equals the
+    local head dim (mp splits heads, not head size)."""
     b, tq, dh = q.shape
     tk = k.shape[1]
     dk = dh // local_heads
@@ -110,16 +111,27 @@ def _attend(q, k, v, bias, causal, local_heads, sp_axis):
     if sp_axis is not None:
         ctx = ra._ring_body(q4, k4, v4, bias, axis_name=sp_axis,
                             causal=causal, scale=scale)
+    elif flash and _flash_bias_ok(bias, b, tk):
+        from ..ops.pallas_flash import flash_attention
+
+        ctx = flash_attention(q4, k4, v4, bias, scale, causal)
     else:
         ctx = ra.full_attention(q4, k4, v4, causal=causal, scale=scale,
                                 bias=bias)
     return ctx.transpose(0, 2, 1, 3).reshape(b, tq, dh)
 
 
-def _attend_in_shard_map(local_heads, sp_axis):
+def _flash_bias_ok(bias, b, t_kv):
+    from ..ops.pallas_flash import bias_supported
+
+    return bias_supported(bias, b, t_kv)
+
+
+def _attend_in_shard_map(local_heads, sp_axis, flash=False):
     """Attention callable for code already INSIDE a shard_map body."""
     def go(q, k, v, bias, causal):
-        return _attend(q, k, v, bias, causal, local_heads, sp_axis)
+        return _attend(q, k, v, bias, causal, local_heads, sp_axis,
+                       flash=flash)
 
     return go
 
@@ -291,7 +303,7 @@ def _pspecs(params, decoder, mesh, pp, mp):
 def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
                 key, *, n_head: int, dropout: float, is_test: bool,
                 n_micro: int, mesh: Optional[Mesh],
-                recompute: bool = False):
+                recompute: bool = False, flash: bool = False):
     """Apply a stacked encoder ('enc') or decoder ('dec') to x.
 
     x: [N, T, D]; enc: [N, Ts, D] (decoder only); bias: [N, 1, 1, Tk] or
@@ -314,8 +326,8 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
 
     if pp is None:
         # scan path; mp (GSPMD) and sp (mesh-aware ring op) still apply
-        attend = (_attend_in_shard_map(n_head, None) if sp is None
-                  else _attend_gspmd_ring(n_head, mesh, sp))
+        attend = (_attend_in_shard_map(n_head, None, flash=flash)
+                  if sp is None else _attend_gspmd_ring(n_head, mesh, sp))
         if decoder:
             def layer_fn(p, xx, kk):
                 return _decoder_layer(p, xx, enc, bias, kk, attend=attend,
@@ -358,7 +370,7 @@ def stack_apply(kind: str, x, enc, bias, params: Dict[str, jnp.ndarray],
     if bias is not None:
         xs["bias"] = bias
 
-    attend = _attend_in_shard_map(local_heads, sp)
+    attend = _attend_in_shard_map(local_heads, sp, flash=flash)
 
     def one_layer(p_i, xx, tree, kk):
         if decoder:
