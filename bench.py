@@ -71,6 +71,9 @@ BASELINES = {
                              # (IntelOptimizedPaddle.md:63-65)
     "googlenet": 250.46,     # images/sec, GoogleNet train bs=64
                              # (IntelOptimizedPaddle.md:53-55)
+    "rnn": 347.83,           # sequences/sec: LSTM 2-layer+fc h=512 bs=64
+                             # at 184 ms/batch (reference
+                             # benchmark/README.md:113-120) -> 64/0.184
 }
 
 # Peak dense bf16 TFLOPs per chip by TPU generation, for MFU reporting.
@@ -208,7 +211,12 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
         from paddle_tpu.fluid import core as _core
 
         dev = _core.get_jax_device(place)
-        feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+        # LoD feeds are (rows, lengths) tuples: stage only the rows array;
+        # the lengths must stay host ints (the executor int()s each one —
+        # device scalars there would mean per-element D2H syncs per step)
+        feed = {k: ((jax.device_put(v[0], dev), v[1])
+                    if isinstance(v, tuple) else jax.device_put(v, dev))
+                for k, v in feed.items()}
     spd = max(1, min(spd, steps)) if spd > 0 else 1
     if spd > 1:
         n_chunks = max(1, steps // spd)
@@ -391,7 +399,12 @@ def bench_resnet_infer(fluid, platform, on_accel):
         from paddle_tpu.fluid import core as _core
 
         dev = _core.get_jax_device(place)
-        feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+        # LoD feeds are (rows, lengths) tuples: stage only the rows array;
+        # the lengths must stay host ints (the executor int()s each one —
+        # device scalars there would mean per-element D2H syncs per step)
+        feed = {k: ((jax.device_put(v[0], dev), v[1])
+                    if isinstance(v, tuple) else jax.device_put(v, dev))
+                for k, v in feed.items()}
     for _ in range(2):
         exe.run(infer_prog, feed=feed, fetch_list=[prediction])
     t0 = time.perf_counter()
@@ -530,6 +543,39 @@ def _bench_v2_image(model, fluid, platform, on_accel, ref_hw):
                        amp=fluid.amp.compute_dtype() or "off")
 
 
+def bench_rnn(fluid, platform, on_accel):
+    """IMDB-style LSTM training via the legacy-DSL rnn config
+    (benchmark/v2/rnn.py == the reference benchmark/paddle/rnn/rnn.py
+    structure).  Fixed-length sequences (the config's pad_seq=True
+    regime: one compiled shape).  Baseline: LSTM 2-layer h=512 bs=64 at
+    184 ms/batch -> 347.8 sequences/sec."""
+    from paddle_tpu.trainer_config_helpers import (
+        build_settings_optimizer, get_outputs, set_config_args)
+
+    batch = _env_int("rnn", "BS", 64 if on_accel else 8)
+    steps = _env_int("rnn", "STEPS", 10 if on_accel else 3)
+    hidden = 512 if on_accel else 32
+    seqlen = 100 if on_accel else 10
+    vocab = 30000 if on_accel else 100
+    set_config_args(vocab_size=vocab, hidden_size=hidden, lstm_num=2,
+                    emb_size=128 if on_accel else 16, batch_size=batch)
+    path = os.path.join(REPO, "benchmark", "v2", "rnn.py")
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), {"__name__": "config"})
+    (loss,) = get_outputs()
+    build_settings_optimizer().minimize(loss)
+
+    rng = np.random.RandomState(0)
+    rows = rng.randint(1, vocab, size=(batch * seqlen, 1)).astype(np.int64)
+    feed = {"data": (rows, [[seqlen] * batch]),
+            "label": rng.randint(0, 2, size=(batch, 1)).astype(np.int64)}
+    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    sps = batch * steps / dt
+    return result_line(f"rnn_lstm2_h{hidden}_len{seqlen}_bs{batch}"
+                       f"_train_{platform}", sps, "sequences/sec/chip",
+                       "rnn", amp=fluid.amp.compute_dtype() or "off")
+
+
 def bench_alexnet(fluid, platform, on_accel):
     return _bench_v2_image("alexnet", fluid, platform, on_accel, 227)
 
@@ -541,7 +587,8 @@ def bench_googlenet(fluid, platform, on_accel):
 BENCHES = {"resnet": bench_resnet, "transformer": bench_transformer,
            "mnist": bench_mnist, "resnet_infer": bench_resnet_infer,
            "decode": bench_decode, "vgg": bench_vgg,
-           "alexnet": bench_alexnet, "googlenet": bench_googlenet}
+           "alexnet": bench_alexnet, "googlenet": bench_googlenet,
+           "rnn": bench_rnn}
 
 
 def _run_one(model, fluid, platform, on_accel):

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""IMDB sentiment LSTM config in the legacy trainer_config_helpers DSL
+(ref config: benchmark/paddle/rnn/rnn.py — embedding -> stacked
+simple_lstm -> last_seq -> softmax fc; vocab/hidden/lstm_num readable from
+config args; BASELINE.md row: LSTM h=512 at 184 ms/batch bs=64 is the
+published era figure for this family)."""
+
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+
+num_class = get_config_arg("num_class", int, 2)
+vocab_size = get_config_arg("vocab_size", int, 30000)
+batch_size = get_config_arg("batch_size", int, 128)
+lstm_num = get_config_arg("lstm_num", int, 1)
+hidden_size = get_config_arg("hidden_size", int, 128)
+emb_size = get_config_arg("emb_size", int, 128)
+
+define_py_data_sources2(
+    "train.list", None, module="provider", obj="process",
+    args={"vocab_size": vocab_size})
+
+settings(
+    batch_size=batch_size,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25)
+
+net = data_layer("data", size=vocab_size)
+net = embedding_layer(input=net, size=emb_size)
+
+for _ in range(lstm_num):
+    net = simple_lstm(input=net, size=hidden_size)
+
+net = last_seq(input=net)
+net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+
+lab = data_layer("label", num_class)
+loss = classification_cost(input=net, label=lab)
+outputs(loss)
