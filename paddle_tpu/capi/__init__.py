@@ -1,0 +1,73 @@
+"""C predictor API: build helpers (the C sources live alongside).
+
+``build_capi()`` compiles libpaddle_capi.so against the running
+interpreter's headers (lazy, cached, same pattern as paddle_tpu.native);
+``build_demo()`` additionally links demo_predictor.c.  Callers embedding
+the library elsewhere can copy paddle_capi.{h,c} and link with
+`python3-config --includes --ldflags --embed`.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "paddle_capi.c")
+_SO = os.path.join(_HERE, "libpaddle_capi.so")
+_DEMO_SRC = os.path.join(_HERE, "demo_predictor.c")
+_DEMO_BIN = os.path.join(_HERE, "demo_predictor")
+
+
+def _python_link_flags() -> List[str]:
+    """Embed-link flags from sysconfig (python3-config --ldflags --embed
+    equivalent, but independent of the helper script's presence)."""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    flags = [f"-L{sysconfig.get_config_var('LIBDIR')}", f"-lpython{ver}"]
+    for var in ("LIBS", "SYSLIBS"):
+        flags += (sysconfig.get_config_var(var) or "").split()
+    return flags
+
+
+_HDR = os.path.join(_HERE, "paddle_capi.h")
+
+
+def _compile(cmd) -> Optional[str]:
+    """Run a gcc command.  Missing toolchain -> None (callers skip); a
+    COMPILE failure raises with gcc's stderr — a broken paddle_capi.c must
+    fail tests, not skip them as 'no toolchain'."""
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=120)
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return None
+    except subprocess.CalledProcessError as exc:
+        raise RuntimeError(
+            f"paddle_capi build failed: {' '.join(cmd)}\n{exc.stderr}")
+    return cmd[cmd.index("-o") + 1]
+
+
+def build_capi(force: bool = False) -> Optional[str]:
+    """Compile libpaddle_capi.so; returns its path or None (no toolchain)."""
+    srcs = [_SRC, _HDR]
+    if not force and os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= max(os.path.getmtime(s) for s in srcs):
+        return _SO
+    inc = sysconfig.get_paths()["include"]
+    return _compile(["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}", _SRC,
+                     "-o", _SO] + _python_link_flags())
+
+
+def build_demo(force: bool = False) -> Optional[str]:
+    """Compile the standalone demo binary; returns its path or None."""
+    srcs = [_DEMO_SRC, _SRC, _HDR]
+    if not force and os.path.exists(_DEMO_BIN) and \
+            os.path.getmtime(_DEMO_BIN) >= max(os.path.getmtime(s)
+                                               for s in srcs):
+        return _DEMO_BIN
+    inc = sysconfig.get_paths()["include"]
+    return _compile(["gcc", "-O2", f"-I{inc}", f"-I{_HERE}", _DEMO_SRC,
+                     _SRC, "-o", _DEMO_BIN] + _python_link_flags())

@@ -1,0 +1,71 @@
+"""Python half of the C predictor API (paddle_capi.c embeds CPython and
+calls these functions).  Handles are small ints so the C side never holds
+Python object pointers; blobs cross the boundary as raw bytes + shape +
+dtype string, keeping the C surface free of numpy's C API.
+
+ref: the reference's C inference surface (legacy/capi/ — paddle_matrix of
+floats over a GradientMachine) and C++ embedding demo
+(fluid/train/demo/demo_trainer.cc:1).  Redesign: the TPU runtime below
+Python is PJRT, so the C shim embeds the interpreter instead of
+reimplementing the predictor; the contract (create/run/destroy on a saved
+inference model, no Python required IN THE CALLER) is the same.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_predictors: Dict[int, object] = {}
+_next_handle = 1
+
+
+def create(model_dir: str, use_tpu: int, enable_int8: int = 0) -> int:
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    global _next_handle
+    cfg = AnalysisConfig(model_dir=model_dir, use_tpu=bool(use_tpu),
+                         enable_int8=bool(enable_int8))
+    pred = create_paddle_predictor(cfg)
+    h = _next_handle
+    _next_handle += 1
+    _predictors[h] = pred
+    return h
+
+
+def destroy(h: int) -> None:
+    _predictors.pop(h, None)
+
+
+def input_names(h: int) -> List[str]:
+    return _predictors[h].get_input_names()
+
+
+def output_names(h: int) -> List[str]:
+    return _predictors[h].get_output_names()
+
+
+def run(h: int, names: Sequence[str], blobs: Sequence[bytes],
+        shapes: Sequence[Sequence[int]], dtypes: Sequence[str]
+        ) -> List[Tuple[str, bytes, List[int], str]]:
+    """Feed raw buffers, return raw buffers.
+
+    Each input i is np.frombuffer(blobs[i], dtypes[i]).reshape(shapes[i]).
+    Returns one (name, data_bytes, shape, dtype_str) tuple per fetch, in
+    the predictor's output order.  C-contiguous both ways."""
+    from paddle_tpu.inference import PaddleTensor
+
+    pred = _predictors[h]
+    tensors = []
+    for name, blob, shape, dt in zip(names, blobs, shapes, dtypes):
+        arr = np.frombuffer(blob, dtype=np.dtype(dt)).reshape(
+            [int(s) for s in shape])
+        tensors.append(PaddleTensor(name=name, data=arr))
+    outs = pred.run(tensors)
+    result = []
+    for t in outs:
+        data = np.ascontiguousarray(t.data)
+        result.append((t.name, data.tobytes(),
+                       [int(s) for s in data.shape], str(data.dtype)))
+    return result

@@ -1,0 +1,74 @@
+/* Minimal C embedding demo: load a saved inference model and run one
+ * batch, no Python in the caller.
+ *
+ * ref analogue: fluid/train/demo/demo_trainer.cc:1 (C++ embedding of the
+ * reference runtime) and legacy/capi/examples.  Usage:
+ *
+ *   ./demo_predictor <model_dir> <n_features> [batch]
+ *
+ * Feeds ones[batch, n_features] float32 into the first input and prints
+ * each output's name, shape, and first few values. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <n_features> [batch]\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int64_t n_features = atoll(argv[2]);
+  int64_t batch = argc > 3 ? atoll(argv[3]) : 4;
+
+  PD_Predictor* pred = PD_NewPredictor(model_dir, /*use_tpu=*/0);
+  if (pred == NULL) {
+    fprintf(stderr, "failed to load %s\n", model_dir);
+    return 1;
+  }
+  printf("inputs:");
+  for (int i = 0; i < PD_GetInputNum(pred); i++)
+    printf(" %s", PD_GetInputName(pred, i));
+  printf("\noutputs:");
+  for (int i = 0; i < PD_GetOutputNum(pred); i++)
+    printf(" %s", PD_GetOutputName(pred, i));
+  printf("\n");
+
+  int64_t numel = batch * n_features;
+  float* x = (float*)malloc((size_t)numel * sizeof(float));
+  for (int64_t i = 0; i < numel; i++) x[i] = 1.0f;
+  int64_t shape[2];
+  shape[0] = batch;
+  shape[1] = n_features;
+  const char* name = PD_GetInputName(pred, 0);
+  const void* datas[1];
+  const int64_t* shapes[1];
+  int ndims[1];
+  PD_DType dtypes[1];
+  datas[0] = x;
+  shapes[0] = shape;
+  ndims[0] = 2;
+  dtypes[0] = PD_FLOAT32;
+  if (PD_Run(pred, &name, datas, shapes, ndims, dtypes, 1) != 0) {
+    fprintf(stderr, "PD_Run failed\n");
+    return 1;
+  }
+  for (int i = 0; i < PD_GetOutputCount(pred); i++) {
+    int64_t n = 0;
+    const float* out = (const float*)PD_GetOutputData(pred, i, &n);
+    int64_t oshape[16];
+    int nd = PD_GetOutputShape(pred, i, oshape, 16);
+    printf("out[%d] %s shape=[", i, PD_GetOutputName(pred, i));
+    for (int d = 0; d < nd; d++)
+      printf("%s%lld", d ? "," : "", (long long)oshape[d]);
+    printf("] first=");
+    for (int64_t j = 0; j < (n < 5 ? n : 5); j++) printf(" %g", out[j]);
+    printf("\n");
+  }
+  free(x);
+  PD_DeletePredictor(pred);
+  printf("DEMO_OK\n");
+  return 0;
+}

@@ -1,0 +1,317 @@
+/* Implementation of paddle_capi.h: embeds CPython, delegates to
+ * paddle_tpu.capi._embed (handles + raw-bytes contract).  See the header
+ * for the design rationale and reference citations. */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "paddle_capi.h"
+
+/* ------------------------------------------------------------------ */
+/* interpreter lifecycle                                               */
+/* ------------------------------------------------------------------ */
+
+static PyObject* g_embed = NULL; /* paddle_tpu.capi._embed module */
+
+static int ensure_interpreter(void) {
+  if (g_embed != NULL) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    /* release the GIL the init left with this thread so later entry
+     * points (any thread) can PyGILState_Ensure symmetrically */
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  const char* root = getenv("PADDLE_TPU_ROOT");
+  if (root != NULL && root[0] != '\0') {
+    PyObject* sys_path = PySys_GetObject("path"); /* borrowed */
+    PyObject* dir = PyUnicode_FromString(root);
+    if (sys_path != NULL && dir != NULL) PyList_Insert(sys_path, 0, dir);
+    Py_XDECREF(dir);
+  }
+  const char* plat = getenv("PADDLE_CAPI_PLATFORM");
+  if (plat != NULL && plat[0] != '\0') {
+    /* pin the backend before any jax import initializes it */
+    PyObject* jax = PyImport_ImportModule("jax");
+    if (jax != NULL) {
+      PyObject* cfg = PyObject_GetAttrString(jax, "config");
+      if (cfg != NULL) {
+        PyObject* r = PyObject_CallMethod(cfg, "update", "ss",
+                                          "jax_platforms", plat);
+        Py_XDECREF(r);
+        Py_DECREF(cfg);
+      }
+      Py_DECREF(jax);
+    }
+    if (PyErr_Occurred()) PyErr_Print();
+  }
+  g_embed = PyImport_ImportModule("paddle_tpu.capi._embed");
+  if (g_embed == NULL) {
+    PyErr_Print();
+    fprintf(stderr,
+            "paddle_capi: cannot import paddle_tpu.capi._embed — set "
+            "PADDLE_TPU_ROOT to the paddle_tpu repository directory\n");
+  }
+  PyGILState_Release(st);
+  return g_embed == NULL ? -1 : 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* predictor struct: handle + cached names + last-run outputs          */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+  char* name;
+  void* data;
+  int64_t numel;
+  int64_t shape[16];
+  int ndim;
+  PD_DType dtype;
+} pd_output;
+
+struct PD_Predictor {
+  long handle;
+  int n_in;
+  char** in_names;
+  int n_out_names;
+  char** out_names;
+  int n_out;
+  pd_output* outs;
+};
+
+static void free_outputs(PD_Predictor* p) {
+  for (int i = 0; i < p->n_out; i++) {
+    free(p->outs[i].name);
+    free(p->outs[i].data);
+  }
+  free(p->outs);
+  p->outs = NULL;
+  p->n_out = 0;
+}
+
+static char** dup_name_list(PyObject* list, int* n) {
+  *n = (int)PyList_Size(list);
+  char** out = (char**)calloc((size_t)*n, sizeof(char*));
+  for (int i = 0; i < *n; i++) {
+    PyObject* s = PyList_GetItem(list, i); /* borrowed */
+    const char* c = PyUnicode_AsUTF8(s);
+    out[i] = strdup(c != NULL ? c : "");
+  }
+  return out;
+}
+
+static const char* dtype_to_str(PD_DType d) {
+  switch (d) {
+    case PD_FLOAT32: return "float32";
+    case PD_INT64: return "int64";
+    case PD_INT32: return "int32";
+  }
+  return "float32";
+}
+
+static int str_to_dtype(const char* s, PD_DType* out, size_t* itemsize) {
+  if (strcmp(s, "float32") == 0) { *out = PD_FLOAT32; *itemsize = 4; }
+  else if (strcmp(s, "int64") == 0) { *out = PD_INT64; *itemsize = 8; }
+  else if (strcmp(s, "int32") == 0) { *out = PD_INT32; *itemsize = 4; }
+  else return -1;
+  return 0;
+}
+
+static size_t dtype_size(PD_DType d) {
+  return d == PD_INT64 ? 8 : 4;
+}
+
+/* ------------------------------------------------------------------ */
+/* API                                                                 */
+/* ------------------------------------------------------------------ */
+
+PD_Predictor* PD_NewPredictor(const char* model_dir, int use_tpu) {
+  if (ensure_interpreter() != 0) return NULL;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PD_Predictor* p = NULL;
+  PyObject* h = PyObject_CallMethod(g_embed, "create", "si", model_dir,
+                                    use_tpu);
+  if (h == NULL) {
+    PyErr_Print();
+    goto done;
+  }
+  p = (PD_Predictor*)calloc(1, sizeof(PD_Predictor));
+  p->handle = PyLong_AsLong(h);
+  Py_DECREF(h);
+  PyObject* ins = PyObject_CallMethod(g_embed, "input_names", "l",
+                                      p->handle);
+  PyObject* outs = PyObject_CallMethod(g_embed, "output_names", "l",
+                                       p->handle);
+  if (ins == NULL || outs == NULL) {
+    PyErr_Print();
+    Py_XDECREF(ins);
+    Py_XDECREF(outs);
+    /* the Python-side predictor was registered; unregister it or the
+     * loaded model leaks across PD_NewPredictor retries */
+    PyObject* r = PyObject_CallMethod(g_embed, "destroy", "l", p->handle);
+    if (r == NULL) PyErr_Print();
+    Py_XDECREF(r);
+    free(p);
+    p = NULL;
+    goto done;
+  }
+  p->in_names = dup_name_list(ins, &p->n_in);
+  p->out_names = dup_name_list(outs, &p->n_out_names);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+done:
+  PyGILState_Release(st);
+  return p;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (p == NULL) return;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(g_embed, "destroy", "l", p->handle);
+  if (r == NULL) PyErr_Print();
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  for (int i = 0; i < p->n_in; i++) free(p->in_names[i]);
+  free(p->in_names);
+  for (int i = 0; i < p->n_out_names; i++) free(p->out_names[i]);
+  free(p->out_names);
+  free_outputs(p);
+  free(p);
+}
+
+int PD_GetInputNum(PD_Predictor* p) { return p == NULL ? 0 : p->n_in; }
+
+const char* PD_GetInputName(PD_Predictor* p, int i) {
+  if (p == NULL || i < 0 || i >= p->n_in) return NULL;
+  return p->in_names[i];
+}
+
+int PD_GetOutputNum(PD_Predictor* p) {
+  return p == NULL ? 0 : p->n_out_names;
+}
+
+const char* PD_GetOutputName(PD_Predictor* p, int i) {
+  if (p == NULL || i < 0 || i >= p->n_out_names) return NULL;
+  return p->out_names[i];
+}
+
+int PD_Run(PD_Predictor* p, const char* const* names,
+           const void* const* data, const int64_t* const* shapes,
+           const int* ndims, const PD_DType* dtypes, int n_inputs) {
+  if (p == NULL) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* py_names = PyList_New(n_inputs);
+  PyObject* py_blobs = PyList_New(n_inputs);
+  PyObject* py_shapes = PyList_New(n_inputs);
+  PyObject* py_dtypes = PyList_New(n_inputs);
+  PyObject* result = NULL;
+  for (int i = 0; i < n_inputs; i++) {
+    int64_t numel = 1;
+    PyObject* shp = PyList_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; d++) {
+      numel *= shapes[i][d];
+      PyList_SetItem(shp, d, PyLong_FromLongLong(shapes[i][d]));
+    }
+    PyList_SetItem(py_names, i, PyUnicode_FromString(names[i]));
+    PyList_SetItem(py_blobs, i, PyBytes_FromStringAndSize(
+        (const char*)data[i],
+        (Py_ssize_t)((size_t)numel * dtype_size(dtypes[i]))));
+    PyList_SetItem(py_shapes, i, shp);
+    PyList_SetItem(py_dtypes, i,
+                   PyUnicode_FromString(dtype_to_str(dtypes[i])));
+  }
+  result = PyObject_CallMethod(g_embed, "run", "lOOOO", p->handle,
+                               py_names, py_blobs, py_shapes, py_dtypes);
+  if (result == NULL) {
+    PyErr_Print();
+    goto done;
+  }
+  /* parse into a staging array first: on ANY mid-parse failure the
+   * previous run's outputs must stay installed and valid (the header's
+   * buffer-validity contract — outputs survive until the next
+   * SUCCESSFUL PD_Run or destroy) */
+  {
+    int n_new = (int)PyList_Size(result);
+    pd_output* staged =
+        (pd_output*)calloc((size_t)n_new, sizeof(pd_output));
+    int parsed = 0;
+    int ok = 1;
+    for (int i = 0; i < n_new && ok; i++) {
+      PyObject* tup = PyList_GetItem(result, i); /* borrowed */
+      const char* name = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+      PyObject* blob = PyTuple_GetItem(tup, 1);
+      PyObject* shape = PyTuple_GetItem(tup, 2);
+      const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 3));
+      pd_output* o = &staged[i];
+      o->name = strdup(name != NULL ? name : "");
+      size_t itemsize;
+      if (dt == NULL || str_to_dtype(dt, &o->dtype, &itemsize) != 0) {
+        fprintf(stderr, "paddle_capi: unsupported output dtype %s\n",
+                dt == NULL ? "?" : dt);
+        parsed = i + 1;
+        ok = 0;
+        break;
+      }
+      char* buf = NULL;
+      Py_ssize_t len = 0;
+      if (PyBytes_AsStringAndSize(blob, &buf, &len) != 0) {
+        PyErr_Print();
+        parsed = i + 1;
+        ok = 0;
+        break;
+      }
+      o->data = malloc((size_t)len);
+      memcpy(o->data, buf, (size_t)len);
+      o->numel = (int64_t)((size_t)len / itemsize);
+      o->ndim = (int)PyList_Size(shape);
+      for (int d = 0; d < o->ndim && d < 16; d++)
+        o->shape[d] = PyLong_AsLongLong(PyList_GetItem(shape, d));
+      parsed = i + 1;
+    }
+    if (!ok) {
+      for (int i = 0; i < parsed; i++) {
+        free(staged[i].name);
+        free(staged[i].data);
+      }
+      free(staged);
+      goto done;
+    }
+    free_outputs(p);
+    p->outs = staged;
+    p->n_out = n_new;
+  }
+  rc = 0;
+done:
+  Py_XDECREF(py_names);
+  Py_XDECREF(py_blobs);
+  Py_XDECREF(py_shapes);
+  Py_XDECREF(py_dtypes);
+  Py_XDECREF(result);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int PD_GetOutputCount(PD_Predictor* p) { return p == NULL ? 0 : p->n_out; }
+
+const void* PD_GetOutputData(PD_Predictor* p, int i, int64_t* numel) {
+  if (p == NULL || i < 0 || i >= p->n_out) return NULL;
+  if (numel != NULL) *numel = p->outs[i].numel;
+  return p->outs[i].data;
+}
+
+PD_DType PD_GetOutputDType(PD_Predictor* p, int i) {
+  if (p == NULL || i < 0 || i >= p->n_out) return PD_FLOAT32;
+  return p->outs[i].dtype;
+}
+
+int PD_GetOutputShape(PD_Predictor* p, int i, int64_t* shape,
+                      int max_ndim) {
+  if (p == NULL || i < 0 || i >= p->n_out) return 0;
+  pd_output* o = &p->outs[i];
+  for (int d = 0; d < o->ndim && d < max_ndim; d++) shape[d] = o->shape[d];
+  return o->ndim;
+}

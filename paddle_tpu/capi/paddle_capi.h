@@ -1,0 +1,67 @@
+/* C predictor API over the paddle_tpu inference surface.
+ *
+ * ref: the reference's legacy C API (legacy/capi/ — paddle_matrix over a
+ * GradientMachine) and C++ embedding demo (fluid/train/demo/
+ * demo_trainer.cc:1).  TPU-native redesign: the compiled engine below
+ * Python is PJRT/XLA, so this shim EMBEDS CPython (one interpreter per
+ * process) rather than reimplementing the runtime; the caller needs no
+ * Python of its own — link libpaddle_capi.so and go.
+ *
+ * Threading: every entry point takes the GIL internally; calls are
+ * serialized per process.  Output buffers returned by PD_GetOutput* stay
+ * valid until the next PD_Run on the same predictor or PD_DeletePredictor.
+ *
+ * Environment: if the paddle_tpu package is not on the default sys.path,
+ * set PADDLE_TPU_ROOT to the repository/site-packages directory before the
+ * first PD_NewPredictor.  Set PADDLE_CAPI_PLATFORM=cpu to pin the CPU
+ * backend (e.g. machines without a TPU).
+ */
+#ifndef PADDLE_CAPI_H
+#define PADDLE_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+typedef enum {
+  PD_FLOAT32 = 0,
+  PD_INT64 = 1,
+  PD_INT32 = 2,
+} PD_DType;
+
+/* Load a saved inference model (fluid.io.save_inference_model layout).
+ * use_tpu != 0 places compute on the accelerator; 0 pins CPU.
+ * Returns NULL on failure (diagnostics on stderr). */
+PD_Predictor* PD_NewPredictor(const char* model_dir, int use_tpu);
+
+void PD_DeletePredictor(PD_Predictor* p);
+
+int PD_GetInputNum(PD_Predictor* p);
+/* Pointer valid until PD_DeletePredictor. */
+const char* PD_GetInputName(PD_Predictor* p, int i);
+int PD_GetOutputNum(PD_Predictor* p);
+const char* PD_GetOutputName(PD_Predictor* p, int i);
+
+/* Run one batch.  Inputs are C-contiguous buffers described by
+ * (name, data, shape[ndim], ndim, dtype) tuples, one per feed.
+ * Returns 0 on success, -1 on error (diagnostics on stderr). */
+int PD_Run(PD_Predictor* p, const char* const* names,
+           const void* const* data, const int64_t* const* shapes,
+           const int* ndims, const PD_DType* dtypes, int n_inputs);
+
+/* Outputs of the LAST PD_Run. */
+int PD_GetOutputCount(PD_Predictor* p);
+/* Raw buffer + element count; dtype via PD_GetOutputDType. */
+const void* PD_GetOutputData(PD_Predictor* p, int i, int64_t* numel);
+PD_DType PD_GetOutputDType(PD_Predictor* p, int i);
+/* Writes up to max_ndim dims into shape; returns the actual ndim. */
+int PD_GetOutputShape(PD_Predictor* p, int i, int64_t* shape, int max_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_CAPI_H */

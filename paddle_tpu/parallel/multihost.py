@@ -34,6 +34,15 @@ def is_initialized() -> bool:
     return _initialized
 
 
+def _local_device_ids_from_env() -> Optional[list]:
+    """PADDLE_LOCAL_DEVICE_IDS="0,1,2,3" -> [0, 1, 2, 3]; blank entries
+    (trailing commas from shell templating) are skipped like the
+    PADDLE_PSERVER_EPS list handling below."""
+    ids = os.environ.get("PADDLE_LOCAL_DEVICE_IDS", "")
+    parsed = [int(x) for x in ids.split(",") if x.strip()]
+    return parsed or None
+
+
 def init(coordinator_addr: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
@@ -52,6 +61,8 @@ def init(coordinator_addr: Optional[str] = None,
         num_processes = int(os.environ.get("PADDLE_TRAINERS", "1"))
     if process_id is None:
         process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if local_device_ids is None:
+        local_device_ids = _local_device_ids_from_env()
     if num_processes <= 1:
         return process_id, num_processes
     if _initialized:

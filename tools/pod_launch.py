@@ -1,0 +1,175 @@
+"""Pod/cluster launch plan generator for the PADDLE_* multihost contract.
+
+TPU-native replacement for the reference's cluster launchers
+(ref: benchmark/fluid/kube_gen_job.py:1 — pserver/trainer k8s yaml pairs;
+tools/aws_benchmarking/ — EC2 cluster bring-up).  There are no pservers
+here: every process is a symmetric trainer that joins ONE
+jax.distributed coordination service (paddle_tpu.parallel.multihost), so
+the launcher's whole job is to hand each host the same command with the
+right four env vars:
+
+    PADDLE_TRAINER_ID        this process's rank            (0..N-1)
+    PADDLE_TRAINERS          world size N
+    PADDLE_COORDINATOR_ADDR  host0:port — the coordination service
+    PADDLE_LOCAL_DEVICE_IDS  optional comma list pinning local chips
+
+Library surface (used by tests/test_dist_4proc.py-style subprocess
+oracles so the launch plan itself is exercised):
+
+    make_launch_plan(hosts, entry, port=12355, devices_per_host=None)
+        -> [{"host", "trainer_id", "env": {...}, "cmd": [...]}, ...]
+
+CLI:
+
+    python tools/pod_launch.py --hosts tpu-a,tpu-b --entry "python train.py"
+    python tools/pod_launch.py --hosts ... --format k8s   # Job manifests
+    python tools/pod_launch.py --hosts ... --format ssh   # ssh one-liners
+
+`--format env` (default) prints per-host `env VAR=... cmd` lines;
+`k8s` emits one YAML Job per host as an indexed StatefulSet-style list
+(mirroring kube_gen_job.py's per-role manifests, minus the pserver half);
+`ssh` prints ready-to-paste ssh lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+from typing import Dict, List, Optional, Sequence
+
+
+def make_launch_plan(hosts: Sequence[str], entry: str,
+                     port: int = 12355,
+                     devices_per_host: Optional[int] = None,
+                     extra_env: Optional[Dict[str, str]] = None) -> List[dict]:
+    """One plan entry per host: rank i, coordinator = hosts[0]:port.
+
+    The coordinator address uses the FIRST host for every rank (including
+    rank 0 itself) — the same convention as the reference's PSERVER_EPS
+    first-endpoint fallback (paddle_tpu.parallel.multihost.init).
+    """
+    hosts = [h.strip() for h in hosts if h.strip()]
+    if not hosts:
+        raise ValueError("pod_launch: empty host list")
+    coordinator = f"{hosts[0]}:{port}"
+    plan = []
+    for i, host in enumerate(hosts):
+        env = {
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_TRAINERS": str(len(hosts)),
+            "PADDLE_COORDINATOR_ADDR": coordinator,
+        }
+        if devices_per_host:
+            env["PADDLE_LOCAL_DEVICE_IDS"] = ",".join(
+                str(d) for d in range(devices_per_host))
+        if extra_env:
+            env.update(extra_env)
+        plan.append({"host": host, "trainer_id": i, "env": env,
+                     "cmd": shlex.split(entry)})
+    return plan
+
+
+def format_env(plan: List[dict]) -> str:
+    lines = []
+    for p in plan:
+        envs = " ".join(f"{k}={v}" for k, v in sorted(p["env"].items()))
+        cmd = " ".join(shlex.quote(c) for c in p["cmd"])
+        lines.append(f"# host {p['host']} (rank {p['trainer_id']})")
+        lines.append(f"env {envs} {cmd}")
+    return "\n".join(lines)
+
+
+def format_ssh(plan: List[dict]) -> str:
+    lines = []
+    for p in plan:
+        envs = " ".join(f"{k}={v}" for k, v in sorted(p["env"].items()))
+        cmd = " ".join(shlex.quote(c) for c in p["cmd"])
+        lines.append(f"ssh {p['host']} {shlex.quote(f'env {envs} {cmd}')}")
+    return "\n".join(lines)
+
+
+def format_k8s(plan: List[dict], jobname: str = "paddlejob",
+               image: str = "paddle-tpu:latest",
+               cpu: int = 4, memory_gi: int = 8) -> str:
+    """One k8s Job per rank (the trainer half of kube_gen_job.py's output;
+    there is no pserver role).  Hostnames in the plan become the
+    coordinator service DNS name for rank routing; the rank-0 Job also
+    carries the coordinator port so a headless Service can target it."""
+    docs = []
+    port = plan[0]["env"]["PADDLE_COORDINATOR_ADDR"].rsplit(":", 1)[1]
+    for p in plan:
+        env_list = [{"name": k, "value": v}
+                    for k, v in sorted(p["env"].items())]
+        container = {
+            "name": f"{jobname}-trainer",
+            "image": image,
+            "command": p["cmd"],
+            "env": env_list,
+            "resources": {"requests": {"cpu": str(cpu),
+                                       "memory": f"{memory_gi}Gi"}},
+        }
+        if p["trainer_id"] == 0:
+            container["ports"] = [{"containerPort": int(port),
+                                   "name": "coordinator"}]
+        docs.append({
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": f"{jobname}-{p['trainer_id']}",
+                         "labels": {"paddle-job": jobname,
+                                    "rank": str(p["trainer_id"])}},
+            "spec": {"template": {
+                "metadata": {"labels": {"paddle-job": jobname}},
+                "spec": {"restartPolicy": "Never",
+                         "nodeSelector": {"kubernetes.io/hostname":
+                                          p["host"]},
+                         "containers": [container]}}},
+        })
+    # plain-JSON YAML subset: json is valid YAML, one doc per Job
+    return "\n---\n".join(json.dumps(d, indent=2) for d in docs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Generate per-host launch commands for the PADDLE_* "
+                    "multihost contract (no pservers: symmetric trainers "
+                    "joining one jax.distributed coordinator).")
+    ap.add_argument("--hosts", required=True,
+                    help="comma-separated host list; hosts[0] is the "
+                         "coordinator")
+    ap.add_argument("--entry", default="python train.py",
+                    help="training command each host runs")
+    ap.add_argument("--port", type=int, default=12355,
+                    help="coordination-service port on hosts[0]")
+    ap.add_argument("--devices-per-host", type=int, default=None,
+                    help="pin PADDLE_LOCAL_DEVICE_IDS=0..D-1 on every host")
+    ap.add_argument("--env", action="append", default=[],
+                    metavar="K=V", help="extra env var(s) for every host")
+    ap.add_argument("--format", choices=("env", "ssh", "k8s"),
+                    default="env")
+    ap.add_argument("--jobname", default="paddlejob")
+    ap.add_argument("--image", default="paddle-tpu:latest")
+    args = ap.parse_args(argv)
+
+    extra = {}
+    for kv in args.env:
+        if "=" not in kv:
+            ap.error(f"--env wants K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        extra[k] = v
+    plan = make_launch_plan(args.hosts.split(","), args.entry,
+                            port=args.port,
+                            devices_per_host=args.devices_per_host,
+                            extra_env=extra or None)
+    fmt = {"env": format_env, "ssh": format_ssh,
+           "k8s": lambda p: format_k8s(p, args.jobname, args.image)}
+    try:
+        print(fmt[args.format](plan))
+    except BrokenPipeError:  # output piped into head/grep that closed early
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
