@@ -42,8 +42,11 @@ def _compile(cmd) -> Optional[str]:
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True,
                        timeout=120)
-    except (FileNotFoundError, subprocess.TimeoutExpired):
-        return None
+    except FileNotFoundError:
+        return None  # genuinely no gcc: callers (tests) skip
+    except subprocess.TimeoutExpired as exc:
+        raise RuntimeError(
+            f"paddle_capi build timed out: {' '.join(cmd)}") from exc
     except subprocess.CalledProcessError as exc:
         raise RuntimeError(
             f"paddle_capi build failed: {' '.join(cmd)}\n{exc.stderr}")

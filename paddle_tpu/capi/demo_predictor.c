@@ -57,14 +57,22 @@ int main(int argc, char** argv) {
   }
   for (int i = 0; i < PD_GetOutputCount(pred); i++) {
     int64_t n = 0;
-    const float* out = (const float*)PD_GetOutputData(pred, i, &n);
+    const void* out = PD_GetOutputData(pred, i, &n);
+    PD_DType dt = PD_GetOutputDType(pred, i);
     int64_t oshape[16];
     int nd = PD_GetOutputShape(pred, i, oshape, 16);
     printf("out[%d] %s shape=[", i, PD_GetOutputName(pred, i));
     for (int d = 0; d < nd; d++)
       printf("%s%lld", d ? "," : "", (long long)oshape[d]);
     printf("] first=");
-    for (int64_t j = 0; j < (n < 5 ? n : 5); j++) printf(" %g", out[j]);
+    for (int64_t j = 0; j < (n < 5 ? n : 5); j++) {
+      if (dt == PD_FLOAT32)
+        printf(" %g", ((const float*)out)[j]);
+      else if (dt == PD_INT64)
+        printf(" %lld", (long long)((const int64_t*)out)[j]);
+      else
+        printf(" %d", ((const int32_t*)out)[j]);
+    }
     printf("\n");
   }
   free(x);

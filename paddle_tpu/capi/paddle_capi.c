@@ -4,6 +4,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -15,8 +16,11 @@
 /* ------------------------------------------------------------------ */
 
 static PyObject* g_embed = NULL; /* paddle_tpu.capi._embed module */
+/* serializes first-time interpreter init: the GIL cannot protect
+ * Py_InitializeEx because it does not exist yet */
+static pthread_mutex_t g_init_mutex = PTHREAD_MUTEX_INITIALIZER;
 
-static int ensure_interpreter(void) {
+static int ensure_interpreter_locked(void) {
   if (g_embed != NULL) return 0;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
@@ -57,6 +61,13 @@ static int ensure_interpreter(void) {
   }
   PyGILState_Release(st);
   return g_embed == NULL ? -1 : 0;
+}
+
+static int ensure_interpreter(void) {
+  pthread_mutex_lock(&g_init_mutex);
+  int rc = ensure_interpreter_locked();
+  pthread_mutex_unlock(&g_init_mutex);
+  return rc;
 }
 
 /* ------------------------------------------------------------------ */

@@ -378,13 +378,18 @@ def run_op(op, env: Dict[str, object], rng_box=None):
     outputs_spec = {slot: list(names) for slot, names in op.outputs.items() if names}
     ctx = _reg.ExecContext(op.type, inputs, outputs_spec, op.attrs, rng_box)
 
-    if is_grad:
-        if opdef.grad_fn is not None:
-            raw = opdef.grad_fn(ctx)
+    # the scope name lands in XLA HLO metadata (op_name="jit(..)/<type>/..")
+    # so device profiles attribute per-HLO-op time back to framework ops
+    # (ref: platform/device_tracer.h:49 correlation_id -> op role; here the
+    # correlation is carried by the compiler instead of CUPTI ids)
+    with jax.named_scope(op.type):
+        if is_grad:
+            if opdef.grad_fn is not None:
+                raw = opdef.grad_fn(ctx)
+            else:
+                raw = _reg.run_grad_generic(opdef, ctx)
         else:
-            raw = _reg.run_grad_generic(opdef, ctx)
-    else:
-        raw = opdef.fn(ctx)
+            raw = opdef.fn(ctx)
 
     # split off "<slot>@LOD" returns (each a list of lods parallel to the
     # slot's output names) before array normalization

@@ -1,0 +1,35 @@
+"""Shared timing harness for the TPU probes (conv_fusion_probe,
+train_step_probe).
+
+The timed region ends with an explicit D2H materialization of the final
+scalar: over the axon tunnel, ``block_until_ready`` on some result types
+has been observed to return early (a pytree 'step' timed at 0.06 ms),
+while a host numpy read provably drains the device execution queue — the
+same deferred-fetch discipline bench.py uses.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def run_timed(kind, fn, args, flops, steps, loss_of=lambda r: r):
+    """Print one probe JSON line: compile+settle, time ``steps`` dispatches,
+    drain via D2H on loss_of(result); asserts the value is finite."""
+    import jax
+
+    float(np.asarray(loss_of(fn(*args))))  # compile + settle
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(steps):
+        r = fn(*args)
+    last = float(np.asarray(loss_of(r)))
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(last), f"non-finite probe output {last}"
+    print(json.dumps({"variant": kind,
+                      "tflops": round(flops / dt / 1e12, 1),
+                      "ms_per_step": round(dt * 1e3, 2),
+                      "device": jax.devices()[0].platform}), flush=True)

@@ -95,15 +95,9 @@ def flops(kind):
 
 
 def run(kind, fn, x):
-    fn(x).block_until_ready()  # compile
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        r = fn(x)
-    r.block_until_ready()
-    dt = (time.perf_counter() - t0) / STEPS
-    print(json.dumps({"variant": kind, "tflops": round(flops(kind) / dt / 1e12, 1),
-                      "ms_per_step": round(dt * 1e3, 2),
-                      "device": jax.devices()[0].platform}), flush=True)
+    from _probe_timing import run_timed
+
+    run_timed(kind, fn, (x,), flops(kind), STEPS)
 
 
 def main():

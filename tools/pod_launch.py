@@ -121,7 +121,11 @@ def format_k8s(plan: List[dict], jobname: str = "paddlejob",
                                     "rank": str(p["trainer_id"])}},
             "spec": {"template": {
                 "metadata": {"labels": {"paddle-job": jobname}},
+                # hostNetwork: the coordinator address is hosts[0]:port (a
+                # NODE name); without host networking the rank-0 listener
+                # binds a pod IP that the address never resolves to
                 "spec": {"restartPolicy": "Never",
+                         "hostNetwork": True,
                          "nodeSelector": {"kubernetes.io/hostname":
                                           p["host"]},
                          "containers": [container]}}},

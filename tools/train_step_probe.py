@@ -1,0 +1,136 @@
+"""Train-step structure ablation probe (round-5 MFU isolation).
+
+The r5 conv-fusion probe proved elementwise-after-conv is fused and the
+tunnel sustains ~147 TFLOPs on pure bf16 conv chains, yet the full
+ResNet-50 train step achieves only ~21.5.  This probe walks from the conv
+chain TOWARD the train step one structural ingredient at a time, so the
+expensive ingredient names itself:
+
+  fwd                  conv(+relu) chain, forward only        (= r5 probe)
+  fwd_bn               + training-mode BN (batch stats, fp32 params)
+  grad                 value_and_grad of the chain, SGD update fused
+  grad_bn              backward through conv+BN+relu, SGD update
+  grad_bn_momentum     + momentum accumulators (the bench optimizer)
+  grad_bn_mixed_dims   channel widths vary 64->256 like a real stage
+
+All convs bf16 with fp32 params (AMP pattern: params fp32, cast to bf16
+at use; grads come back fp32 via the cast's transpose).  FLOPs counted as
+fwd=1x, grad=3x conv FLOPs (the standard train accounting the bench uses).
+
+Usage: python tools/train_step_probe.py [N_LAYERS HW CH BATCH]
+Emits one JSON line per variant.  PROBE_PLATFORM=cpu for smoke runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("PROBE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N_LAYERS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+HW = int(sys.argv[2]) if len(sys.argv) > 2 else 56
+CH = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+BATCH = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+STEPS = 8
+DN = ("NCHW", "OIHW", "NCHW")
+
+
+def conv(x, w):
+    return lax.conv_general_dilated(x, w.astype(jnp.bfloat16), (1, 1),
+                                    "SAME", dimension_numbers=DN)
+
+
+def make_params(key, chans):
+    params = []
+    for cin, cout in zip(chans[:-1], chans[1:]):
+        key, k1 = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(k1, (cout, cin, 3, 3), jnp.float32) * 0.05,
+            "gamma": jnp.ones((cout,), jnp.float32),
+            "beta": jnp.zeros((cout,), jnp.float32),
+        })
+    return params
+
+
+def fwd_chain(params, x, use_bn):
+    for p in params:
+        y = conv(x, p["w"])
+        if use_bn:
+            # training-mode BN: batch statistics over N,H,W in fp32
+            yf = jnp.float32(y)
+            mean = yf.mean(axis=(0, 2, 3), keepdims=True)
+            var = yf.var(axis=(0, 2, 3), keepdims=True)
+            yn = (yf - mean) * lax.rsqrt(var + 1e-5)
+            y = (yn * p["gamma"][None, :, None, None]
+                 + p["beta"][None, :, None, None]).astype(jnp.bfloat16)
+        x = jax.nn.relu(y)
+    return jnp.float32(x).mean()
+
+
+def chain_flops(chans, hw, batch):
+    return sum(2 * batch * hw * hw * cin * cout * 9
+               for cin, cout in zip(chans[:-1], chans[1:]))
+
+
+def run(kind, fn, args, flops):
+    from _probe_timing import run_timed
+
+    run_timed(kind, fn, args, flops, STEPS,
+              loss_of=lambda r: r[0] if isinstance(r, tuple) else r)
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    chans = [CH] * (N_LAYERS + 1)
+    params = make_params(key, chans)
+    x = jax.random.normal(key, (BATCH, CH, HW, HW), jnp.bfloat16) * 0.1
+    f1 = chain_flops(chans, HW, BATCH)
+
+    fwd = jax.jit(functools.partial(fwd_chain, use_bn=False))
+    run("fwd", fwd, (params, x), f1)
+    fwd_bn = jax.jit(functools.partial(fwd_chain, use_bn=True))
+    run("fwd_bn", fwd_bn, (params, x), f1)
+
+    def train_step(params, x, use_bn, momentum):
+        loss, grads = jax.value_and_grad(
+            lambda p: fwd_chain(p, x, use_bn))(params)
+        new = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+        if momentum is not None:
+            momentum = jax.tree.map(lambda m, g: 0.9 * m + g,
+                                    momentum, grads)
+            new = jax.tree.map(lambda p, m: p - 0.1 * m, params, momentum)
+        return loss, new, momentum
+
+    grad = jax.jit(functools.partial(train_step, use_bn=False,
+                                     momentum=None))
+    run("grad", grad, (params, x), 3 * f1)
+    grad_bn = jax.jit(functools.partial(train_step, use_bn=True,
+                                        momentum=None))
+    run("grad_bn", grad_bn, (params, x), 3 * f1)
+    mom = jax.tree.map(jnp.zeros_like, params)
+    grad_bn_m = jax.jit(lambda p, x, m: train_step(p, x, True, m))
+    run("grad_bn_momentum", grad_bn_m, (params, x, mom), 3 * f1)
+
+    # realistic stage mix: widths change through the chain
+    mixed = [64, 64, 128, 128, 256, 256, 256, 256, 256][: N_LAYERS + 1]
+    params2 = make_params(key, mixed)
+    x2 = jax.random.normal(key, (BATCH, mixed[0], HW, HW), jnp.bfloat16)
+    f2 = chain_flops(mixed, HW, BATCH)
+    grad_mixed = jax.jit(functools.partial(train_step, use_bn=True,
+                                           momentum=None))
+    run("grad_bn_mixed_dims", grad_mixed, (params2, x2), 3 * f2)
+
+
+if __name__ == "__main__":
+    main()
