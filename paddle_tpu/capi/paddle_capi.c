@@ -17,7 +17,11 @@
 
 static PyObject* g_embed = NULL; /* paddle_tpu.capi._embed module */
 /* serializes first-time interpreter init: the GIL cannot protect
- * Py_InitializeEx because it does not exist yet */
+ * Py_InitializeEx because it does not exist yet.  Lock-order caveat for
+ * MIXED hosts that already run Python: the first PD_NewPredictor must be
+ * called WITHOUT the GIL held (init takes g_init_mutex then the GIL;
+ * a GIL-holding caller racing another first-caller could deadlock).
+ * Pure C hosts — the API's target — have no GIL to hold. */
 static pthread_mutex_t g_init_mutex = PTHREAD_MUTEX_INITIALIZER;
 
 static int ensure_interpreter_locked(void) {
@@ -64,6 +68,7 @@ static int ensure_interpreter_locked(void) {
 }
 
 static int ensure_interpreter(void) {
+  if (g_embed != NULL) return 0; /* steady-state: set once, never cleared */
   pthread_mutex_lock(&g_init_mutex);
   int rc = ensure_interpreter_locked();
   pthread_mutex_unlock(&g_init_mutex);

@@ -228,16 +228,19 @@ def device_op_table(trace_dir=None, hlo_text=None, print_table=True):
 
 
 def lower_program_hlo(program, feed, fetch_list, scope=None,
-                      optimized=True):
+                      optimized=True, feed_lods=None):
     """Compile a Program's block the way the Executor would and return the
     (optimized) HLO text — instruction metadata carries the per-op
     named_scope labels, so this is the join key for device_op_table.
 
     ``feed`` maps name -> ndarray (concrete shapes pick the specialization);
-    ``optimized=False`` returns the pre-optimization stable-HLO lowering."""
+    ``feed_lods`` maps name -> offsets-form LoD for sequence feeds (state
+    LoDs recorded by earlier runs come from the scope, as in
+    Executor.run); ``optimized=False`` returns the pre-optimization
+    stable-HLO lowering."""
     import jax
 
-    from .executor import BlockPlan, global_scope, trace_block
+    from .executor import LOD_SUFFIX, BlockPlan, global_scope, trace_block
     from .framework import RNG_STATE_VAR, Variable
 
     scope = scope or global_scope()
@@ -249,9 +252,17 @@ def lower_program_hlo(program, feed, fetch_list, scope=None,
         import jax.random as jrandom
 
         state[RNG_STATE_VAR] = jrandom.PRNGKey(program.random_seed or 0)
+    # sequence programs read '<name>@LOD' static metadata; mirror
+    # Executor.run's state_lods + feed_lods env (executor.py:624)
+    all_lods = {n: lod for n, lod in getattr(scope, "_lods", {}).items()
+                if lod and program.global_block()._has_var_recursive(n)}
+    all_lods.update(feed_lods or {})
+    static_env = {k + LOD_SUFFIX: tuple(tuple(level) for level in lod)
+                  for k, lod in all_lods.items()}
 
     def fn(feed_vals, state_vals):
-        return trace_block(program, 0, plan, feed_vals, state_vals)
+        return trace_block(program, 0, plan, feed_vals, state_vals,
+                           static_env=static_env)
 
     lowered = jax.jit(fn).lower(feed, state)
     if not optimized:

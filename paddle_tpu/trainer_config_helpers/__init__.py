@@ -596,3 +596,20 @@ __all__ += [
     "SumPooling", "memory", "recurrent_group", "mixed_layer",
     "full_matrix_projection", "identity_projection",
 ]
+
+
+# --- evaluators (ref: trainer_config_helpers/evaluators.py; the config
+# DSL star-imports them so a legacy config calls them bare) --------------
+from .evaluators import (auc_evaluator, chunk_evaluator,  # noqa: E402
+                         classification_error_evaluator,
+                         column_sum_evaluator, ctc_error_evaluator,
+                         get_evaluators, pnpair_evaluator,
+                         precision_recall_evaluator, reset_evaluators,
+                         sum_evaluator, value_printer_evaluator)
+
+__all__ += [
+    "classification_error_evaluator", "auc_evaluator", "pnpair_evaluator",
+    "precision_recall_evaluator", "ctc_error_evaluator", "chunk_evaluator",
+    "sum_evaluator", "column_sum_evaluator", "value_printer_evaluator",
+    "get_evaluators", "reset_evaluators",
+]

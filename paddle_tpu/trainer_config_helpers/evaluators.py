@@ -1,0 +1,178 @@
+"""v2 evaluator DSL (ref: python/paddle/trainer_config_helpers/
+evaluators.py — evaluator_base:71 attaches Evaluator config entries that
+the swig GradientMachine evaluates each batch/pass).
+
+Redesign: there is no separate evaluator machine — each evaluator lowers
+to Fluid metric ops INSIDE the same program (accuracy/auc/edit_distance/
+chunk_eval/precision_recall), and registers its output variable so the v2
+trainer fetches it alongside the cost and reports it on
+EndIteration/EndPass events (paddle_tpu.v2.trainer).  The declarative
+call-it-and-forget-it surface of the reference is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+__all__ = [
+    "classification_error_evaluator", "auc_evaluator", "pnpair_evaluator",
+    "precision_recall_evaluator", "ctc_error_evaluator", "chunk_evaluator",
+    "sum_evaluator", "column_sum_evaluator", "value_printer_evaluator",
+    "get_evaluators", "reset_evaluators",
+]
+
+# (name, fluid Variable, cumulative) registered in declaration order; the
+# v2 trainer fetches every entry belonging to the program it runs.
+# cumulative=True marks evaluators whose fetched value is already a
+# running accumulation across batches (stateful persistables, e.g. auc) —
+# the pass-level report takes the LAST value, not the batch mean.
+_EVALUATORS: List[Tuple[str, object, bool]] = []
+
+
+def get_evaluators():
+    return list(_EVALUATORS)
+
+
+def reset_evaluators():
+    del _EVALUATORS[:]
+
+
+def _register(name, default, var, cumulative=False):
+    base = name or default
+    taken = {n for n, _, _ in _EVALUATORS}
+    unique = base
+    i = 0
+    while unique in taken:  # two same-type evaluators must not collide
+        i += 1
+        unique = f"{base}_{i}"
+    _EVALUATORS.append((unique, var, cumulative))
+    return var
+
+
+def _as_label(label):
+    from . import _as_label as base_as_label
+
+    return base_as_label(label)
+
+
+def classification_error_evaluator(input, label, name=None, top_k=1,
+                                   **kwargs):
+    """ref evaluators.py:220 — error rate = 1 - top-k accuracy."""
+    from ..fluid import layers
+
+    acc = layers.accuracy(input=input, label=_as_label(label), k=top_k)
+    err = layers.elementwise_sub(layers.fill_constant([1], "float32", 1.0),
+                                 acc)
+    return _register(name, "classification_error_evaluator", err)
+
+
+def auc_evaluator(input, label, name=None, **kwargs):
+    """ref evaluators.py:272 — ROC-AUC over the positive-class score.
+    Stateful across batches (StatPos/StatNeg persistables accumulate),
+    like the reference's pass-level AUC."""
+    from ..fluid import layers
+
+    auc_out, *_ = layers.auc(input=input, label=_as_label(label))
+    return _register(name, "auc_evaluator", auc_out, cumulative=True)
+
+
+def pnpair_evaluator(input, label, query_id, weight=None, name=None,
+                     **kwargs):
+    """ref evaluators.py:306 — positive/negative pair ordering stat per
+    query group; reports the pos/neg ratio (the reference's headline)."""
+    from ..fluid import layers
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("pnpair_evaluator")
+    pos = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    neg = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    neu = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    inputs = {"Score": [input], "Label": [_as_label(label)],
+              "QueryID": [query_id]}
+    if weight is not None:
+        inputs["Weight"] = [weight]
+    helper.append_op(type="positive_negative_pair", inputs=inputs,
+                     outputs={"PositivePair": [pos], "NegativePair": [neg],
+                              "NeutralPair": [neu]})
+    ratio = layers.elementwise_div(
+        pos, layers.elementwise_max(
+            neg, layers.fill_constant([1], "float32", 1.0)))
+    return _register(name, "pnpair_evaluator", ratio)
+
+
+def precision_recall_evaluator(input, label, positive_label=None,
+                               weight=None, name=None, **kwargs):
+    """ref evaluators.py:353 — reports macro-F1 (BatchMetrics[2]);
+    positive_label restricts to one class in the reference, here the
+    macro average is reported either way."""
+    from ..fluid import layers
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("precision_recall_evaluator")
+    probs, idx = layers.topk(input, k=1)
+    batch = helper.create_variable_for_type_inference("float64",
+                                                      stop_gradient=True)
+    accum = helper.create_variable_for_type_inference("float64",
+                                                      stop_gradient=True)
+    states = helper.create_variable_for_type_inference("float32",
+                                                       stop_gradient=True)
+    inputs = {"MaxProbs": [probs], "Indices": [idx],
+              "Labels": [_as_label(label)]}
+    if weight is not None:
+        inputs["Weights"] = [weight]
+    helper.append_op(type="precision_recall", inputs=inputs,
+                     outputs={"BatchMetrics": [batch],
+                              "AccumMetrics": [accum],
+                              "AccumStatesInfo": [states]},
+                     attrs={"class_number": int(input.shape[-1])})
+    f1 = layers.slice(batch, axes=[0], starts=[2], ends=[3])
+    return _register(name, "precision_recall_evaluator", f1)
+
+
+def ctc_error_evaluator(input, label, name=None, **kwargs):
+    """ref evaluators.py:398 — normalized edit distance between the CTC
+    best path and the label sequence."""
+    from ..fluid import layers
+
+    dist, _ = layers.edit_distance(input=input, label=label,
+                                   normalized=True)
+    return _register(name, "ctc_error_evaluator", layers.mean(dist))
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None, **kwargs):
+    """ref evaluators.py:425 — chunking F1 (IOB/IOE/IOBES schemes)."""
+    from ..fluid import layers
+
+    precision, recall, f1, *_ = layers.chunk_eval(
+        input=input, label=label, chunk_scheme=chunk_scheme,
+        num_chunk_types=num_chunk_types,
+        excluded_chunk_types=excluded_chunk_types)
+    return _register(name, "chunk_evaluator", f1)
+
+
+def sum_evaluator(input, name=None, weight=None, **kwargs):
+    """ref evaluators.py:532 — sum of the input over the batch."""
+    from ..fluid import layers
+
+    val = input if weight is None else layers.elementwise_mul(input, weight)
+    return _register(name, "sum_evaluator", layers.reduce_sum(val))
+
+
+def column_sum_evaluator(input, name=None, weight=None, **kwargs):
+    """ref evaluators.py:558 — per-column sum over the batch dim."""
+    from ..fluid import layers
+
+    val = input if weight is None else layers.elementwise_mul(input, weight)
+    return _register(name, "column_sum_evaluator",
+                     layers.reduce_sum(val, dim=0))
+
+
+def value_printer_evaluator(input, name=None, **kwargs):
+    """ref evaluators.py:589 — print the layer value each evaluation."""
+    from ..fluid import layers
+
+    return layers.Print(input, message=name or "value_printer")

@@ -17,11 +17,12 @@ from ..trainer_config_helpers import (AdamOptimizer, AvgPooling,
                                       MomentumOptimizer, ReluActivation,
                                       SigmoidActivation, SoftmaxActivation,
                                       TanhActivation)
-from . import activation, data_type, event, layer, optimizer, parameters, \
-    pooling, trainer
+from . import activation, data_type, evaluator, event, layer, optimizer, \
+    parameters, pooling, trainer
 
 __all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
-           "data_type", "event", "optimizer", "parameters", "trainer"]
+           "data_type", "evaluator", "event", "optimizer", "parameters",
+           "trainer"]
 
 
 def init(use_gpu=False, trainer_count=1, **kwargs):

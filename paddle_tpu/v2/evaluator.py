@@ -1,0 +1,24 @@
+"""v2 evaluator namespace (ref: python/paddle/v2/evaluator.py — wraps each
+trainer_config_helpers evaluator, dropping the ``_evaluator`` suffix:
+``paddle.v2.evaluator.classification_error(input=.., label=..)``).
+
+Calling one inside a topology registers a metric variable the v2 trainer
+fetches each batch; values arrive on ``event.metrics``.
+"""
+
+from __future__ import annotations
+
+from ..trainer_config_helpers import evaluators as _evs
+
+__all__ = []
+
+
+def _initialize():
+    for ev_name in [n for n in _evs.__all__ if n.endswith("_evaluator")]:
+        new_name = ev_name[: -len("_evaluator")]
+        fn = getattr(_evs, ev_name)
+        globals()[new_name] = fn
+        __all__.append(new_name)
+
+
+_initialize()

@@ -62,36 +62,84 @@ class SGD:
             feed[v.name] = arr
         return feed
 
+    def _evaluator_fetches(self):
+        """Evaluator entries registered in THIS program's topology
+        (trainer_config_helpers.evaluators registry; stale entries from
+        other sessions' programs are ignored)."""
+        from ..trainer_config_helpers.evaluators import get_evaluators
+
+        return [(n, v, cum) for n, v, cum in get_evaluators()
+                if v.block.program is self._program]
+
+    @staticmethod
+    def _metric_value(out):
+        """Scalar metrics report as float; vector metrics (column sums)
+        keep their full value."""
+        arr = np.asarray(out).reshape(-1)
+        return float(arr[0]) if arr.size == 1 else arr
+
     def train(self, reader, num_passes=1, event_handler=None, feeding=None):
         """ref trainer.py:137: for each pass, for each batch: feed,
-        one train step, fire events."""
+        one train step, fire events.  Evaluators declared in the topology
+        are fetched alongside the cost; batch values ride
+        EndIteration.metrics, pass values ride EndPass.metrics (the
+        reference's batch_evaluator / pass_evaluator pair: per-batch
+        metrics average over the pass, cumulative ones report their final
+        accumulated value)."""
         event_handler = event_handler or (lambda e: None)
+        evals = self._evaluator_fetches()
+        fetch = [self._cost] + [v for _, v, _ in evals]
+        cumulative = {n for n, _, cum in evals if cum}
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
+            pass_sums, pass_n = {}, 0
             for batch_id, data_batch in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                (cost_val,) = self._exe.run(
+                outs = self._exe.run(
                     self._program, feed=self._feed(data_batch, feeding),
-                    fetch_list=[self._cost])
+                    fetch_list=fetch)
+                metrics = {n: self._metric_value(o)
+                           for (n, _, _), o in zip(evals, outs[1:])}
+                pass_n += 1
+                for n, val in metrics.items():
+                    pass_sums[n] = (val if n in cumulative
+                                    else pass_sums.get(n, 0.0) + val)
                 event_handler(v2_event.EndIteration(
                     pass_id, batch_id,
-                    float(np.asarray(cost_val).reshape(-1)[0])))
-            event_handler(v2_event.EndPass(pass_id))
+                    float(np.asarray(outs[0]).reshape(-1)[0]),
+                    metrics=metrics))
+            event_handler(v2_event.EndPass(
+                pass_id, metrics={
+                    n: (s if n in cumulative else s / max(pass_n, 1))
+                    for n, s in pass_sums.items()}))
 
     def test(self, reader, feeding=None):
         """ref trainer.py:216: forward-only pass over the reader; returns
-        the average cost as a TestResult."""
+        the average cost plus declared evaluators' values as a
+        TestResult (the reference evaluates them during the test pass)."""
         if self._test_program is None:
             self._test_program = self._program.clone(for_test=True)
+        evals = self._evaluator_fetches()
+        fetch = [self._cost] + [v for _, v, _ in evals]
+        cumulative = {n for n, _, cum in evals if cum}
         costs, n = [], 0
+        sums, batches = {}, 0
         for data_batch in reader():
-            (cost_val,) = self._exe.run(
+            outs = self._exe.run(
                 self._test_program, feed=self._feed(data_batch, feeding),
-                fetch_list=[self._cost])
-            costs.append(float(np.asarray(cost_val).reshape(-1)[0])
+                fetch_list=fetch)
+            costs.append(float(np.asarray(outs[0]).reshape(-1)[0])
                          * len(data_batch))
             n += len(data_batch)
-        return v2_event.TestResult(cost=sum(costs) / max(n, 1))
+            batches += 1
+            for (name, _, _), o in zip(evals, outs[1:]):
+                val = self._metric_value(o)
+                sums[name] = (val if name in cumulative
+                              else sums.get(name, 0.0) + val)
+        metrics = {name: (s if name in cumulative else s / max(batches, 1))
+                   for name, s in sums.items()}
+        return v2_event.TestResult(cost=sum(costs) / max(n, 1),
+                                   metrics=metrics)
 
     def save_parameter_to_tar(self, f):
         self._parameters.to_tar(f)

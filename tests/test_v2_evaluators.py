@@ -1,0 +1,110 @@
+"""v2 evaluator surface (VERDICT r4 missing #2 tail): evaluators declared
+in a v2 topology lower to Fluid metric ops, ride the trainer's fetch list,
+and report on EndIteration/EndPass events — the reference's
+batch_evaluator/pass_evaluator loop (ref: python/paddle/v2/trainer.py:165,
+trainer_config_helpers/evaluators.py:220 classification_error_evaluator).
+"""
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle_v2
+from paddle_tpu.trainer_config_helpers import evaluators as evs
+
+
+def test_v2_trainer_reports_evaluator_metrics():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 61
+    evs.reset_evaluators()
+    seen = {"iter": [], "pass": []}
+    with fluid.program_guard(main, startup):
+        paddle_v2.init()
+        images = paddle_v2.layer.data(
+            name="pixel", type=paddle_v2.data_type.dense_vector(784))
+        label = paddle_v2.layer.data(
+            name="label", type=paddle_v2.data_type.integer_value(10))
+        predict = paddle_v2.layer.fc(input=images, size=10,
+                                     act=paddle_v2.activation.Softmax())
+        cost = paddle_v2.layer.classification_cost(input=predict,
+                                                   label=label)
+        paddle_v2.evaluator.classification_error(input=predict, label=label)
+        paddle_v2.evaluator.precision_recall(input=predict, label=label)
+        parameters = paddle_v2.parameters.create(cost)
+        optimizer = paddle_v2.optimizer.Momentum(momentum=0.9,
+                                                 learning_rate=0.1)
+        trainer = paddle_v2.trainer.SGD(cost=cost, parameters=parameters,
+                                        update_equation=optimizer)
+
+        def handler(e):
+            if isinstance(e, paddle_v2.event.EndIteration):
+                seen["iter"].append(dict(e.metrics))
+            elif isinstance(e, paddle_v2.event.EndPass):
+                seen["pass"].append(dict(e.metrics))
+
+        reader = paddle_v2.batch(paddle_tpu.dataset.mnist.train(), 32)
+
+        def limited():
+            for i, b in enumerate(reader()):
+                if i >= 12:
+                    return
+                yield b
+
+        trainer.train(reader=limited, num_passes=2, event_handler=handler,
+                      feeding={"pixel": 0, "label": 1})
+
+    assert len(seen["iter"]) == 24 and len(seen["pass"]) == 2
+    for m in seen["iter"]:
+        assert set(m) == {"classification_error_evaluator",
+                          "precision_recall_evaluator"}, m
+        assert 0.0 <= m["classification_error_evaluator"] <= 1.0
+        # fp32 metric math can overshoot 1.0 by an ulp after the f64 cast
+        assert 0.0 <= m["precision_recall_evaluator"] <= 1.0 + 1e-5
+    # training on the synthetic set must improve the error: the second
+    # pass's mean error is below the first's
+    p0, p1 = seen["pass"]
+    assert p1["classification_error_evaluator"] < \
+        p0["classification_error_evaluator"]
+
+
+def test_evaluator_ops_compute_sane_values():
+    """The non-trainer evaluators produce correct values through a bare
+    executor run (sum/column_sum/auc/chunk against hand-computable data)."""
+    import paddle_tpu.fluid.framework as fw
+
+    fw.fresh_session()
+    evs.reset_evaluators()
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    score = fluid.layers.data(name="score", shape=[1], dtype="float32")
+    lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+    s = evs.sum_evaluator(x)
+    c = evs.column_sum_evaluator(x)
+    a = evs.auc_evaluator(
+        fluid.layers.concat(
+            [fluid.layers.elementwise_sub(
+                fluid.layers.fill_constant([4, 1], "float32", 1.0), score),
+             score], axis=1), lbl)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    # perfectly separable scores -> AUC 1.0
+    sv = np.array([[0.9], [0.8], [0.1], [0.2]], np.float32)
+    lv = np.array([[1], [1], [0], [0]], np.int64)
+    sval, cval, aval = exe.run(
+        fluid.default_main_program(),
+        feed={"x": xv, "score": sv, "lbl": lv}, fetch_list=[s, c, a])
+    assert float(np.asarray(sval)) == xv.sum()
+    np.testing.assert_allclose(np.asarray(cval).reshape(-1), xv.sum(axis=0))
+    assert abs(float(np.asarray(aval).reshape(-1)[0]) - 1.0) < 1e-3
+    names = [n for n, _, _ in evs.get_evaluators()]
+    assert names[-3:] == ["sum_evaluator", "column_sum_evaluator",
+                          "auc_evaluator"]
+    # duplicate declarations get uniquified names, not silently dropped
+    evs.sum_evaluator(x)
+    names = [n for n, _, _ in evs.get_evaluators()]
+    assert names.count("sum_evaluator") == 1 and "sum_evaluator_1" in names
+    # column_sum reports the full vector through the trainer's converter
+    from paddle_tpu.v2.trainer import SGD
+
+    vec = SGD._metric_value(np.array([[1.0, 2.0, 3.0]]))
+    np.testing.assert_allclose(vec, [1.0, 2.0, 3.0])

@@ -21,10 +21,8 @@ Each variant runs in a subprocess-friendly way (single process, sequential)
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 import jax
 

@@ -105,11 +105,12 @@ def main():
     def train_step(params, x, use_bn, momentum):
         loss, grads = jax.value_and_grad(
             lambda p: fwd_chain(p, x, use_bn))(params)
-        new = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
         if momentum is not None:
             momentum = jax.tree.map(lambda m, g: 0.9 * m + g,
                                     momentum, grads)
             new = jax.tree.map(lambda p, m: p - 0.1 * m, params, momentum)
+        else:
+            new = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
         return loss, new, momentum
 
     grad = jax.jit(functools.partial(train_step, use_bn=False,
