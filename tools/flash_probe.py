@@ -1,0 +1,146 @@
+"""Probe: does Mosaic/Pallas compile over the axon tunnel?
+
+Tiny flash_attention forward + backward vs the jnp reference, then a
+timed bench-shaped call (transformer-base head geometry) against the XLA
+attention it would replace.  Emits one JSON line per stage; first failure
+emits {"stage": ..., "ok": false, "error": ...} and exits nonzero so the
+bench gate (BENCH_FLASH) stays off.
+
+Usage: python tools/flash_probe.py   (PROBE_PLATFORM=cpu for smoke)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("PROBE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_tpu.ops.pallas_flash import flash_attention  # noqa: E402
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def ref_attention(q, k, v, causal=False):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", jnp.float32(q),
+                        jnp.float32(k)) * scale
+    if causal:
+        tq, tk = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, jnp.float32(v)).astype(q.dtype)
+
+
+def stage(name, fn):
+    t0 = time.time()
+    try:
+        out = fn()
+    except Exception as e:  # noqa: BLE001 — probe must report, not crash
+        emit(stage=name, ok=False, secs=round(time.time() - t0, 2),
+             error=f"{type(e).__name__}: {e}"[:400])
+        sys.exit(1)
+    emit(stage=name, ok=True, secs=round(time.time() - t0, 2), **(out or {}))
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+
+    # --- tiny correctness: fwd ---
+    b, h, t, d = 2, 4, 256, 64
+    q = jax.random.normal(kq, (b, h, t, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, t, d), jnp.bfloat16)
+
+    def tiny_fwd():
+        out = jax.jit(flash_attention)(q, k, v).block_until_ready()
+        ref = ref_attention(q, k, v)
+        err = float(jnp.max(jnp.abs(jnp.float32(out) - jnp.float32(ref))))
+        assert err < 0.05, f"fwd max err {err}"
+        return {"max_err": round(err, 5)}
+
+    stage("tiny_fwd", tiny_fwd)
+
+    def tiny_causal():
+        fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        out = fa(q, k, v).block_until_ready()
+        ref = ref_attention(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(jnp.float32(out) - jnp.float32(ref))))
+        assert err < 0.05, f"causal max err {err}"
+        return {"max_err": round(err, 5)}
+
+    stage("tiny_causal", tiny_causal)
+
+    # --- tiny backward ---
+    def tiny_bwd():
+        def loss_flash(q, k, v):
+            return jnp.float32(flash_attention(q, k, v)).sum()
+
+        def loss_ref(q, k, v):
+            return jnp.float32(ref_attention(q, k, v)).sum()
+
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        errs = [float(jnp.max(jnp.abs(jnp.float32(a) - jnp.float32(b))))
+                for a, b in zip(gf, gr)]
+        assert max(errs) < 0.1, f"bwd max errs {errs}"
+        return {"max_err": round(max(errs), 5)}
+
+    stage("tiny_bwd", tiny_bwd)
+
+    # --- bench-shaped timing: transformer-base geometry ---
+    b, h, t, d = 64, 8, 256, 64
+    q = jax.random.normal(kq, (b, h, t, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, t, d), jnp.bfloat16)
+    # attention FLOPs: 2 matmuls of 2*b*h*t*t*d each; train ~3x fwd
+    flops = 2 * 2 * b * h * t * t * d
+
+    def timed(fn, n=20):
+        fn()  # compile + warm
+        t0 = time.time()
+        for _ in range(n):
+            r = fn()
+        jax.tree.map(lambda a: a.block_until_ready(), r)
+        return (time.time() - t0) / n
+
+    def bench_pair():
+        def train_flash(q, k, v):
+            return jax.grad(
+                lambda q: jnp.float32(flash_attention(q, k, v)).sum())(q)
+
+        def train_ref(q, k, v):
+            return jax.grad(
+                lambda q: jnp.float32(ref_attention(q, k, v)).sum())(q)
+
+        jf = jax.jit(train_flash)
+        jr = jax.jit(train_ref)
+        sf = timed(lambda: jf(q, k, v))
+        sr = timed(lambda: jr(q, k, v))
+        return {
+            "flash_ms": round(sf * 1e3, 3),
+            "xla_ms": round(sr * 1e3, 3),
+            "flash_tflops": round(3 * flops / sf / 1e12, 2),
+            "xla_tflops": round(3 * flops / sr / 1e12, 2),
+            "speedup": round(sr / sf, 3),
+        }
+
+    stage("bench_train_shape", bench_pair)
+
+
+if __name__ == "__main__":
+    main()
