@@ -38,17 +38,36 @@ import os
 
 _SUPPORTED = ("bfloat16", "float16")
 
-_state = {"dtype": None}
+_state = {"dtype": None, "keep": False}
 
 
-def enable(dtype: str = "bfloat16") -> None:
+def enable(dtype: str = "bfloat16", keep_activations=None) -> None:
+    """Enable mixed precision.
+
+    ``keep_activations=True`` selects the pure-low-precision activation
+    regime: contraction outputs STAY in the compute dtype instead of being
+    cast back to fp32, so inter-layer activations (the dominant HBM
+    traffic of conv nets at scale) move at half the bytes.  Numerics keep
+    the master-fp32 discipline everywhere it matters: parameters,
+    optimizer state and gradients stay fp32 (the cast's transpose upcasts
+    cotangents), batch_norm/layer_norm compute statistics in fp32, and
+    softmax/cross-entropy upcast at the loss boundary.  This is the
+    standard production-TPU training recipe (measured on the round-5
+    tunnel: ~2x ResNet-50 step throughput — docs/PERF.md).
+    Default: the PADDLE_TPU_AMP_KEEP env var, else False.
+    """
     if dtype not in _SUPPORTED:
         raise ValueError(f"amp dtype must be one of {_SUPPORTED}, got {dtype!r}")
     _state["dtype"] = dtype
+    if keep_activations is None:
+        keep_activations = os.environ.get(
+            "PADDLE_TPU_AMP_KEEP", "").strip().lower() in ("1", "true")
+    _state["keep"] = bool(keep_activations)
 
 
 def disable() -> None:
     _state["dtype"] = None
+    _state["keep"] = False
 
 
 def is_enabled() -> bool:
@@ -60,27 +79,43 @@ def compute_dtype():
     return _state["dtype"]
 
 
+def keep_low_activations() -> bool:
+    """True when AMP is on in the pure-low-activation regime."""
+    return _state["dtype"] is not None and _state["keep"]
+
+
+def is_low_float(dtype) -> bool:
+    """True for sub-32-bit float dtypes (bf16/fp16) — THE predicate ops use
+    to decide 'compute this norm/loss internally in fp32'.  Centralized so
+    the regime's dtype policy has one definition."""
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(dtype, jnp.floating) and jnp.finfo(dtype).bits < 32
+
+
 @contextlib.contextmanager
-def amp_guard(dtype: str = "bfloat16"):
-    prev = _state["dtype"]
-    enable(dtype)
+def amp_guard(dtype: str = "bfloat16", keep_activations=None):
+    prev = dict(_state)
+    enable(dtype, keep_activations=keep_activations)
     try:
         yield
     finally:
-        _state["dtype"] = prev
+        _state.update(prev)
 
 
 def matmul(a, b):
-    """``a @ b`` in the AMP compute dtype with the result restored to the
-    fp32 activation contract; identity when AMP is off.  The shared helper
-    for code that contracts OUTSIDE the op library (stacked transformer,
-    ring attention) — one policy, every path."""
+    """``a @ b`` in the AMP compute dtype; identity when AMP is off.  The
+    result is restored to fp32 in the default regime, or LEFT in the
+    compute dtype under keep_activations.  The shared helper for code that
+    contracts OUTSIDE the op library (stacked transformer, ring
+    attention) — one policy, every path."""
     a2, b2, back = cast_operands(a, b)
     return restore_astype(a2 @ b2, back)
 
 
 def einsum(spec, a, b):
-    """Two-operand einsum under the same AMP recipe as :func:`matmul`."""
+    """Two-operand einsum under the same AMP recipe (and keep_activations
+    behavior) as :func:`matmul`."""
     import jax.numpy as jnp
 
     a2, b2, back = cast_operands(a, b)
@@ -90,21 +125,37 @@ def einsum(spec, a, b):
 def cast_operands(*arrays):
     """Cast fp32 contraction operands to the AMP dtype.
 
-    Returns ``(arrays..., restore_dtype)``.  When AMP is off (or any operand
-    is not fp32) the operands pass through unchanged and restore_dtype is
-    None.  Otherwise the caller computes the contraction in the low dtype
-    and casts its result back with ``restore_astype`` — NOT via
-    ``preferred_element_type``, whose vjp rules reject mixed
-    cotangent/operand dtypes for convs.  On the MXU this costs nothing:
-    bf16 matmuls accumulate in fp32 internally; the explicit cast just
-    restores the fp32 activation contract for the rest of the graph.
+    Returns ``(arrays..., restore_dtype)``.  Default regime: when AMP is
+    off (or any operand is not fp32) the operands pass through unchanged
+    and restore_dtype is None; otherwise the caller computes the
+    contraction in the low dtype and casts its result back with
+    ``restore_astype`` — NOT via ``preferred_element_type``, whose vjp
+    rules reject mixed cotangent/operand dtypes for convs.  On the MXU
+    this costs nothing: bf16 matmuls accumulate in fp32 internally.
+
+    keep_activations regime: operands may arrive fp32 (params/feeds) or
+    already in the compute dtype (upstream activations); fp32 ones are
+    cast down, restore_dtype is None, and the result STAYS low — the
+    whole point of the regime (half the inter-layer HBM bytes).
     """
     import jax.numpy as jnp
 
     d = _state["dtype"]
-    if d is None or any(a is None or a.dtype != jnp.float32 for a in arrays):
+    if d is None:
         return (*arrays, None)
     cd = jnp.bfloat16 if d == "bfloat16" else jnp.float16
+    if _state["keep"]:
+        # pure-low-activation regime: operands may arrive fp32 (params,
+        # feeds) or already in the compute dtype (upstream activations);
+        # cast the fp32 ones down and DON'T restore — the contraction
+        # result stays low so downstream layers read half the bytes.
+        if any(a is None or a.dtype not in (jnp.float32, cd)
+               for a in arrays):
+            return (*arrays, None)
+        return (*(a.astype(cd) if a.dtype == jnp.float32 else a
+                  for a in arrays), None)
+    if any(a is None or a.dtype != jnp.float32 for a in arrays):
+        return (*arrays, None)
     return (*(a.astype(cd) for a in arrays), jnp.float32)
 
 

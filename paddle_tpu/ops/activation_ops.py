@@ -130,7 +130,15 @@ def prelu(ctx):
 
 @register_op("softmax")
 def softmax(ctx):
-    return {"Out": jax.nn.softmax(ctx.input("X"), axis=-1)}
+    x = ctx.input("X")
+    from ..fluid import amp
+
+    if amp.is_low_float(x.dtype):
+        # exp/renormalize in fp32 (bf16 exponentials lose the tail mass);
+        # restore the input dtype so attention maps stay low-precision
+        return {"Out": jax.nn.softmax(x.astype(jnp.float32),
+                                      axis=-1).astype(x.dtype)}
+    return {"Out": jax.nn.softmax(x, axis=-1)}
 
 
 @register_op("log_softmax")

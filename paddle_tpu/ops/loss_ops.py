@@ -24,7 +24,11 @@ def _hard_xent(probs, label, ignore_index=-100):
 
 @register_op("cross_entropy", no_grad_inputs=("Label",))
 def cross_entropy(ctx):
+    from ..fluid import amp
+
     x = ctx.input("X")  # probabilities [N, C]
+    if amp.is_low_float(x.dtype):
+        x = x.astype(jnp.float32)  # log() at the loss boundary is fp32
     label = ctx.input("Label")
     if ctx.attr("soft_label", False):
         loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), -1, keepdims=True)
@@ -36,7 +40,12 @@ def cross_entropy(ctx):
 def softmax_with_cross_entropy(ctx):
     logits = ctx.input("Logits")
     label = ctx.input("Label")
-    sm = jax.nn.softmax(logits, axis=-1)
+    from ..fluid import amp
+
+    in_dtype = logits.dtype
+    if amp.is_low_float(in_dtype):
+        logits = logits.astype(jnp.float32)  # fp32 at the loss boundary
+    sm = jax.nn.softmax(logits, axis=-1).astype(in_dtype)
     logp = jax.nn.log_softmax(logits, axis=-1)
     if ctx.attr("soft_label", False):
         loss = -jnp.sum(label * logp, -1, keepdims=True)

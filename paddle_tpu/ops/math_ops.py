@@ -79,6 +79,15 @@ def _elementwise(name, fn):
     def _impl(ctx, _fn=fn):
         x, y = ctx.input("X"), ctx.input("Y")
         y = _bcast_y(x, y, ctx.attr("axis", -1))
+        from ..fluid import amp
+
+        if (amp.keep_low_activations() and x.dtype != y.dtype
+                and jnp.issubdtype(x.dtype, jnp.floating)
+                and jnp.issubdtype(y.dtype, jnp.floating)):
+            # pure-low-activation regime: the broadcast operand (fp32
+            # bias/scale params) follows the main operand's dtype so a
+            # bias add can't silently re-promote activations to fp32
+            y = y.astype(x.dtype)
         return {"Out": _fn(x, y)}
     return _impl
 

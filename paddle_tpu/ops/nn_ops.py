@@ -158,19 +158,28 @@ def batch_norm(ctx):
     else:
         axes = tuple(range(x.ndim - 1))
         bshape = (1,) * (x.ndim - 1) + (-1,)
+    # low-precision inputs (AMP keep-activations regime): statistics and
+    # normalization in fp32, output restored to the input dtype — the
+    # master-fp32 discipline for norms
+    from ..fluid import amp
+
+    low = amp.is_low_float(x.dtype)
+    xf = x.astype(jnp.float32) if low else x
     if is_test:
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var
         mean_out, var_out = mean, var
     else:
-        use_mean = jnp.mean(x, axes)
-        use_var = jnp.var(x, axes)
+        use_mean = jnp.mean(xf, axes)
+        use_var = jnp.var(xf, axes)
         saved_mean, saved_var = use_mean, use_var
         mean_out = momentum * mean + (1.0 - momentum) * use_mean
         var_out = momentum * var + (1.0 - momentum) * use_var
     inv = jax.lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
         scale.reshape(bshape) + bias.reshape(bshape)
+    if low:
+        y = y.astype(x.dtype)
     return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
             "SavedMean": saved_mean, "SavedVariance": inv}
 
@@ -182,14 +191,20 @@ def layer_norm(ctx):
     axis = ctx.attr("begin_norm_axis", 1)
     eps = ctx.attr("epsilon", 1e-5)
     axes = tuple(range(axis, x.ndim))
-    mean = jnp.mean(x, axes, keepdims=True)
-    var = jnp.var(x, axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    from ..fluid import amp
+
+    low = amp.is_low_float(x.dtype)
+    xf = x.astype(jnp.float32) if low else x
+    mean = jnp.mean(xf, axes, keepdims=True)
+    var = jnp.var(xf, axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
     rest = int(np.prod(x.shape[axis:]))
     if scale is not None:
         y = y * scale.reshape((1,) * axis + x.shape[axis:])
     if bias is not None:
         y = y + bias.reshape((1,) * axis + x.shape[axis:])
+    if low:
+        y = y.astype(x.dtype)
     return {"Y": y, "Mean": mean.reshape(-1), "Variance": var.reshape(-1)}
 
 

@@ -1,0 +1,154 @@
+"""AMP keep-low-activations regime (fluid.amp.enable(keep_activations=True)).
+
+The pure-bf16-activation recipe: contraction outputs stay bf16 (inter-layer
+HBM traffic halves), while params/grads/optimizer state, norm statistics and
+the loss boundary remain fp32.  These tests pin the numerics contract:
+models still train, losses track the fp32-restore regime closely, and the
+dtype rules (norms restore input dtype, losses upcast, elementwise broadcast
+follows the main operand) hold.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import amp
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    amp.disable()
+
+
+def _train_resnet(keep, steps=6):
+    from paddle_tpu.fluid import framework as fw
+
+    with fw.program_guard(fw.Program(), fw.Program()):
+        with fluid.scope_guard(fluid.Scope()):
+            amp.enable("bfloat16", keep_activations=keep)
+            from paddle_tpu.models import resnet
+
+            img, label, pred, loss, acc = resnet.build(
+                class_dim=10, depth=50, image_shape=(3, 32, 32), lr=0.1)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            rng = np.random.RandomState(0)
+            feed = {"img": rng.normal(size=(8, 3, 32, 32)).astype(np.float32),
+                    "label": rng.randint(0, 10, size=(8, 1)).astype(np.int64)}
+            losses = []
+            for _ in range(steps):
+                (l,) = exe.run(fluid.default_main_program(), feed=feed,
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+            amp.disable()
+            return losses
+
+
+def test_resnet_trains_and_tracks_fp32_restore_regime():
+    keep = _train_resnet(True)
+    assert all(np.isfinite(keep)), keep
+    assert keep[-1] < keep[0], keep
+    base = _train_resnet(False)
+    # same seeds, same arch: the two AMP regimes should follow the same
+    # trajectory to bf16 rounding (loose: few-step loss curves amplify)
+    assert abs(keep[0] - base[0]) < 0.15 * max(1.0, abs(base[0]))
+    assert abs(keep[-1] - base[-1]) < 0.3 * max(1.0, abs(base[-1]))
+
+
+def test_transformer_trains_under_keep_mode():
+    amp.enable("bfloat16", keep_activations=True)
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.tiny_config()
+    src, tgt, lbl, loss = transformer.build(cfg, src_len=16, tgt_len=16,
+                                            lr=1e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"src_word": rng.randint(
+                1, cfg.src_vocab_size, size=(2, 16)).astype(np.int64),
+            "tgt_word": rng.randint(
+                1, cfg.tgt_vocab_size, size=(2, 16)).astype(np.int64),
+            "lbl_word": rng.randint(
+                1, cfg.tgt_vocab_size, size=(2, 16, 1)).astype(np.int64)}
+    losses = []
+    for _ in range(5):
+        (l,) = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_cast_operands_keep_regime():
+    import jax.numpy as jnp
+
+    amp.enable("bfloat16", keep_activations=True)
+    a = jnp.ones((4, 4), jnp.float32)
+    b = jnp.ones((4, 4), jnp.bfloat16)
+    a2, b2, back = amp.cast_operands(a, b)
+    assert a2.dtype == jnp.bfloat16 and b2.dtype == jnp.bfloat16
+    assert back is None  # result stays low
+    # non-fp32/bf16 operand: whole contraction passes through untouched
+    c = jnp.ones((4, 4), jnp.int32)
+    a3, c3, back = amp.cast_operands(a, c)
+    assert a3.dtype == jnp.float32 and c3.dtype == jnp.int32 and back is None
+    # legacy regime restores fp32
+    amp.enable("bfloat16", keep_activations=False)
+    a4, b4, back = amp.cast_operands(a, jnp.ones((4, 4), jnp.float32))
+    assert a4.dtype == jnp.bfloat16 and back == jnp.float32
+
+
+def test_norms_and_losses_keep_dtype_contract():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.registry import ExecContext, get_op_def
+
+    x = jnp.linspace(-2, 2, 2 * 3 * 4 * 4, dtype=jnp.float32)
+    x = x.reshape(2, 3, 4, 4).astype(jnp.bfloat16)
+    ctx = ExecContext("batch_norm", {
+        "X": [x], "Scale": [jnp.ones((3,), jnp.float32)],
+        "Bias": [jnp.zeros((3,), jnp.float32)],
+        "Mean": [jnp.zeros((3,), jnp.float32)],
+        "Variance": [jnp.ones((3,), jnp.float32)]}, {},
+        {"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+         "data_layout": "NCHW"})
+    out = get_op_def("batch_norm").fn(ctx)
+    assert out["Y"].dtype == jnp.bfloat16          # activations stay low
+    assert out["MeanOut"].dtype == jnp.float32     # running stats fp32
+    assert out["SavedMean"].dtype == jnp.float32   # batch stats fp32
+
+    probs = jnp.full((4, 8), 0.125, jnp.bfloat16)
+    ctx = ExecContext("cross_entropy", {
+        "X": [probs], "Label": [jnp.zeros((4, 1), jnp.int64)]}, {}, {})
+    y = get_op_def("cross_entropy").fn(ctx)["Y"]
+    assert y.dtype == jnp.float32                  # loss boundary upcasts
+    np.testing.assert_allclose(np.asarray(y), np.log(8.0), rtol=1e-2)
+
+    logits = jnp.linspace(-1, 1, 4 * 8, dtype=jnp.float32)
+    logits = logits.reshape(4, 8).astype(jnp.bfloat16)
+    ctx = ExecContext("softmax_with_cross_entropy", {
+        "Logits": [logits], "Label": [jnp.zeros((4, 1), jnp.int64)]},
+        {}, {})
+    out = get_op_def("softmax_with_cross_entropy").fn(ctx)
+    assert out["Loss"].dtype == jnp.float32
+    assert out["Softmax"].dtype == jnp.bfloat16
+
+
+def test_elementwise_broadcast_follows_main_operand():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.registry import ExecContext, get_op_def
+
+    amp.enable("bfloat16", keep_activations=True)
+    x = jnp.ones((2, 5), jnp.bfloat16)
+    bias = jnp.ones((5,), jnp.float32)
+    ctx = ExecContext("elementwise_add", {"X": [x], "Y": [bias]}, {},
+                      {"axis": -1})
+    out = get_op_def("elementwise_add").fn(ctx)["Out"]
+    assert out.dtype == jnp.bfloat16  # bias add must not re-promote
+    # keep mode off: ordinary numpy promotion applies
+    amp.disable()
+    out = get_op_def("elementwise_add").fn(ctx)["Out"]
+    assert out.dtype == jnp.float32

@@ -1,0 +1,228 @@
+"""Hand-JAX vs framework ResNet-50 train step (round-5 MFU isolation #2).
+
+The train-step structure probe cleared BN/backward/momentum (all sustain
+130-175 TFLOPs on the tunnel), so the 21.5-TFLOP full step must lose its
+6x either to the REAL ResNet-50 geometry (224px stem, strides, 1x1
+bottlenecks, small-channel early stages) or to the framework's lowered
+program (extra casts/copies, layout, non-donated buffers).  This probe
+separates the two by timing, identically:
+
+  hand        a pure-JAX ResNet-50 bottleneck train step written directly
+              (NCHW, bf16 convs w/ fp32 master params, train-mode BN,
+              momentum SGD, softmax CE) — the best this geometry can do
+  framework   the fluid-built program through Executor.run with AMP, the
+              exact bench path
+
+Same batch/shape/steps/timing discipline (async dispatches, block on the
+last loss).  TFLOPs use the bench's accounting (3 x 3.86 GFLOP/img).
+XLA's own cost_analysis FLOP count is reported for the hand step so the
+accounting can be cross-checked against what the compiler thinks.
+
+Usage: python tools/resnet_hand_probe.py [BATCH STEPS]
+PROBE_PLATFORM=cpu for smoke runs (tiny shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("PROBE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SMOKE = os.environ.get("PROBE_PLATFORM") == "cpu"
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else (4 if SMOKE else 256)
+STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else (2 if SMOKE else 12)
+HW = 64 if SMOKE else 224
+CLASSES = 100 if SMOKE else 1000
+DN = ("NCHW", "OIHW", "NCHW")
+BLOCKS = [3, 4, 6, 3]
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+# ---------------- hand-written ResNet-50 ----------------
+
+def conv(x, w, stride):
+    return lax.conv_general_dilated(
+        x, w.astype(jnp.bfloat16), (stride, stride), "SAME",
+        dimension_numbers=DN)
+
+
+def bn_relu(x, p, relu=True):
+    xf = jnp.float32(x)
+    mean = xf.mean(axis=(0, 2, 3), keepdims=True)
+    var = xf.var(axis=(0, 2, 3), keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + 1e-5)
+    y = y * p["gamma"][None, :, None, None] + p["beta"][None, :, None, None]
+    y = y.astype(jnp.bfloat16)
+    return jax.nn.relu(y) if relu else y
+
+
+def make_conv_bn(key, cin, cout, k):
+    kw, key = jax.random.split(key)
+    fan = cin * k * k
+    return {
+        "w": jax.random.normal(kw, (cout, cin, k, k), jnp.float32)
+        * np.sqrt(2.0 / fan),
+        "gamma": jnp.ones((cout,), jnp.float32),
+        "beta": jnp.zeros((cout,), jnp.float32),
+    }, key
+
+
+def make_params(key):
+    params = {}
+    params["stem"], key = make_conv_bn(key, 3, 64, 7)
+    cin = 64
+    for si, (n, width) in enumerate(zip(BLOCKS, [64, 128, 256, 512])):
+        for bi in range(n):
+            blk = {}
+            blk["c1"], key = make_conv_bn(key, cin, width, 1)
+            blk["c2"], key = make_conv_bn(key, width, width, 3)
+            blk["c3"], key = make_conv_bn(key, width, width * 4, 1)
+            if bi == 0:
+                blk["sc"], key = make_conv_bn(key, cin, width * 4, 1)
+            params[f"s{si}b{bi}"] = blk
+            cin = width * 4
+    kfc, key = jax.random.split(key)
+    params["fc_w"] = jax.random.normal(
+        kfc, (2048, CLASSES), jnp.float32) * 0.01
+    params["fc_b"] = jnp.zeros((CLASSES,), jnp.float32)
+    return params
+
+
+def forward(params, img):
+    x = conv(img.astype(jnp.bfloat16), params["stem"]["w"], 2)
+    x = bn_relu(x, params["stem"])
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
+                          "SAME")
+    for si, (n, width) in enumerate(zip(BLOCKS, [64, 128, 256, 512])):
+        for bi in range(n):
+            blk = params[f"s{si}b{bi}"]
+            stride = 2 if (bi == 0 and si > 0) else 1
+            short = x
+            if "sc" in blk:
+                short = bn_relu(conv(x, blk["sc"]["w"], stride), blk["sc"],
+                                relu=False)
+            y = bn_relu(conv(x, blk["c1"]["w"], stride), blk["c1"])
+            y = bn_relu(conv(y, blk["c2"]["w"], 1), blk["c2"])
+            y = bn_relu(conv(y, blk["c3"]["w"], 1), blk["c3"], relu=False)
+            x = jax.nn.relu(short + y)
+    x = jnp.float32(x).mean(axis=(2, 3))  # global avg pool
+    return x @ params["fc_w"] + params["fc_b"]
+
+
+def loss_fn(params, img, label):
+    logits = forward(params, img)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, label, axis=1).mean()
+
+
+def train_step(params, vel, img, label):
+    loss, grads = jax.value_and_grad(loss_fn)(params, img, label)
+    vel = jax.tree.map(lambda v, g: 0.9 * v + g, vel, grads)
+    params = jax.tree.map(lambda p, v: p - 0.1 * v, params, vel)
+    return loss, params, vel
+
+
+def timed(step, n):
+    out = None
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = step()
+    loss = float(np.asarray(out[0] if isinstance(out, tuple) else out)
+                 .reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), loss
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.normal(size=(BATCH, 3, HW, HW)).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, CLASSES, size=(BATCH, 1)))
+    gflop_img = 3 * 3.86 * (HW / 224.0) ** 2  # bench accounting
+    tflop_step = gflop_img * BATCH / 1e3
+
+    # --- hand step ---
+    params = make_params(jax.random.PRNGKey(0))
+    vel = jax.tree.map(jnp.zeros_like, params)
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    t0 = time.time()
+    lowered = step.lower(params, vel, img, label)
+    compiled = lowered.compile()
+    compile_s = time.time() - t0
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        xla_flops = float(ca.get("flops", 0.0))
+    except Exception:
+        xla_flops = 0.0
+
+    state = {"p": params, "v": vel}
+
+    def run_hand():
+        loss, state["p"], state["v"] = compiled(state["p"], state["v"],
+                                                img, label)
+        return loss
+
+    run_hand()  # warm
+    dt = timed(run_hand, STEPS)
+    emit(variant="hand_jax", ms_per_step=round(dt / STEPS * 1e3, 2),
+         tflops=round(tflop_step * STEPS / dt, 1),
+         imgs_per_sec=round(BATCH * STEPS / dt, 1),
+         xla_counted_tflop_per_step=round(xla_flops / 1e12, 3),
+         compile_s=round(compile_s, 1),
+         device=jax.devices()[0].platform)
+    del state, params, vel
+
+    # --- framework step (the bench path) ---
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    if not SMOKE:
+        fluid.amp.enable("bfloat16")
+    _, _, _, loss, _ = resnet.build(
+        class_dim=CLASSES, depth=50, image_shape=(3, HW, HW), lr=0.1)
+    place = fluid.CPUPlace() if SMOKE else fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    feed = {"img": np.asarray(img), "label": np.asarray(label)}
+    if not SMOKE:
+        from paddle_tpu.fluid import core as _core
+        dev = _core.get_jax_device(place)
+        feed = {k: jax.device_put(v, dev) for k, v in feed.items()}
+
+    def run_fw():
+        (out,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                         return_numpy=False)
+        return out
+
+    t0 = time.time()
+    run_fw()
+    fw_compile_s = time.time() - t0
+    run_fw()
+    dt = timed(run_fw, STEPS)
+    emit(variant="framework", ms_per_step=round(dt / STEPS * 1e3, 2),
+         tflops=round(tflop_step * STEPS / dt, 1),
+         imgs_per_sec=round(BATCH * STEPS / dt, 1),
+         first_run_s=round(fw_compile_s, 1),
+         amp=fluid.amp.compute_dtype() or "off")
+
+
+if __name__ == "__main__":
+    main()
