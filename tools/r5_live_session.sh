@@ -1,0 +1,87 @@
+#!/bin/bash
+# Round-5 scripted live-tunnel session (VERDICT r4 next-round #1/#9).
+#
+# Waits for the TPU tunnel to answer, then runs the queued perf stages in
+# priority order, re-checking liveness between stages so a mid-session
+# wedge stops cleanly instead of stacking work on a dead tunnel.  Every
+# stage appends JSONL to docs/ so partial sessions still leave committed
+# evidence.  Safe to re-run: stages that already have a result line in
+# their sink are skipped (delete the sink line to re-measure).
+#
+# Usage: nohup bash tools/r5_live_session.sh > .live_session.log 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+LOG() { echo "[$(date -u +%FT%TZ)] $*"; }
+
+alive() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+x = jnp.ones((256,256), jnp.bfloat16)
+(x@x).block_until_ready()
+" >/dev/null 2>&1
+}
+
+wait_alive() {
+  local n=0
+  while ! alive; do
+    n=$((n+1))
+    LOG "tunnel wedged (attempt $n); sleeping 420s"
+    echo "wedged $(date -u +%FT%TZ) $n" > .tpu_status
+    sleep 420
+  done
+  echo "alive $(date -u +%FT%TZ)" > .tpu_status
+  LOG "tunnel ALIVE"
+}
+
+have() { [ -s "$1" ] && grep -q "$2" "$1"; }
+
+HVF=docs/PROBE_r05_hand_vs_framework.jsonl
+
+wait_alive
+
+# Stage 1a: hand-JAX ResNet-50 step (the geometry ceiling).
+if have "$HVF" hand_jax; then LOG "skip hand_jax (already captured)"; else
+  LOG "stage hand_jax"
+  PROBE_VARIANT=hand PROBE_SINK="$HVF" timeout 1500 \
+    python tools/resnet_hand_probe.py
+  LOG "stage hand_jax rc=$?"
+  wait_alive
+fi
+
+# Stage 1b: framework ResNet-50 step at identical shapes.
+if have "$HVF" framework; then LOG "skip framework (already captured)"; else
+  LOG "stage framework"
+  PROBE_VARIANT=framework PROBE_SINK="$HVF" timeout 1500 \
+    python tools/resnet_hand_probe.py
+  LOG "stage framework rc=$?"
+  wait_alive
+fi
+
+# Stage 2: does Mosaic/Pallas compile over the tunnel?
+if [ -s docs/PROBE_r05_flash.jsonl ]; then LOG "skip flash probe"; else
+  LOG "stage flash"
+  timeout 900 python tools/flash_probe.py 2>/dev/null \
+    | grep '^{' >> docs/PROBE_r05_flash.jsonl
+  LOG "stage flash rc=$?"
+  wait_alive
+fi
+
+# Stage 3: run_steps dispatch-amortization re-measure on live hardware
+# (VERDICT r4 next-round #9): default dispatch vs K=8 scan.
+if [ -s docs/PROBE_r05_run_steps.jsonl ]; then LOG "skip run_steps"; else
+  LOG "stage run_steps (BENCH_SPD=8 resnet)"
+  D=$(BENCH_MODEL=resnet timeout 1500 python bench.py 2>/dev/null | tail -1)
+  S=$(BENCH_MODEL=resnet BENCH_SPD=8 timeout 1500 python bench.py 2>/dev/null | tail -1)
+  { echo "{\"mode\": \"default\", \"line\": ${D:-null}}"
+    echo "{\"mode\": \"spd8\", \"line\": ${S:-null}}" ; } \
+    >> docs/PROBE_r05_run_steps.jsonl
+  LOG "stage run_steps done"
+  wait_alive
+fi
+
+# Stage 4: full default bench capture (resnet + transformer) for the log.
+LOG "stage bench (full default)"
+timeout 2400 python bench.py 2>/dev/null | tail -1 >> docs/BENCH_live_r05.jsonl
+LOG "bench done rc=$?"
+LOG "session complete"

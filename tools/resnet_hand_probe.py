@@ -20,6 +20,9 @@ accounting can be cross-checked against what the compiler thinks.
 
 Usage: python tools/resnet_hand_probe.py [BATCH STEPS]
 PROBE_PLATFORM=cpu for smoke runs (tiny shapes).
+PROBE_VARIANT=hand|framework|both (default both) — run one side only so a
+short tunnel alive-window still captures something.
+PROBE_SINK=path.jsonl — also append emitted lines there (survives kills).
 """
 
 from __future__ import annotations
@@ -51,7 +54,19 @@ BLOCKS = [3, 4, 6, 3]
 
 
 def emit(**kw):
-    print(json.dumps(kw), flush=True)
+    line = json.dumps(kw)
+    print(line, flush=True)
+    sink = os.environ.get("PROBE_SINK")
+    if sink:
+        try:
+            with open(sink, "a") as f:
+                f.write(line + "\n")
+        except OSError as e:
+            print(f"# PROBE_SINK write failed: {e}", flush=True)
+
+
+def note(msg):
+    print(f"# {msg} [{time.strftime('%H:%M:%S')}]", flush=True)
 
 
 # ---------------- hand-written ResNet-50 ----------------
@@ -150,21 +165,18 @@ def timed(step, n):
     return dt
 
 
-def main():
-    rng = np.random.RandomState(0)
-    img = jnp.asarray(rng.normal(size=(BATCH, 3, HW, HW)).astype(np.float32))
-    label = jnp.asarray(rng.randint(0, CLASSES, size=(BATCH, 1)))
-    gflop_img = 3 * 3.86 * (HW / 224.0) ** 2  # bench accounting
-    tflop_step = gflop_img * BATCH / 1e3
-
-    # --- hand step ---
+def run_hand_variant(img, label, tflop_step):
+    note("hand: building params")
     params = make_params(jax.random.PRNGKey(0))
     vel = jax.tree.map(jnp.zeros_like, params)
     step = jax.jit(train_step, donate_argnums=(0, 1))
+    note("hand: lowering + compiling (full ResNet-50 — can take minutes "
+         "over the tunnel)")
     t0 = time.time()
     lowered = step.lower(params, vel, img, label)
     compiled = lowered.compile()
     compile_s = time.time() - t0
+    note(f"hand: compiled in {compile_s:.1f}s; warming")
     try:
         ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
@@ -180,6 +192,7 @@ def main():
         return loss
 
     run_hand()  # warm
+    note("hand: timing")
     dt = timed(run_hand, STEPS)
     emit(variant="hand_jax", ms_per_step=round(dt / STEPS * 1e3, 2),
          tflops=round(tflop_step * STEPS / dt, 1),
@@ -187,14 +200,19 @@ def main():
          xla_counted_tflop_per_step=round(xla_flops / 1e12, 3),
          compile_s=round(compile_s, 1),
          device=jax.devices()[0].platform)
-    del state, params, vel
 
-    # --- framework step (the bench path) ---
+
+def run_framework_variant(img, label, tflop_step):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import resnet
 
     if not SMOKE:
-        fluid.amp.enable("bfloat16")
+        # Match the bench regime exactly: keep-low activations defaults ON
+        # there (BENCH_AMP_KEEP/PADDLE_TPU_AMP_KEEP default "1").
+        keep = os.environ.get("PADDLE_TPU_AMP_KEEP", "1").strip().lower() \
+            not in ("0", "false")
+        fluid.amp.enable("bfloat16", keep_activations=keep)
+    note("framework: building program")
     _, _, _, loss, _ = resnet.build(
         class_dim=CLASSES, depth=50, image_shape=(3, HW, HW), lr=0.1)
     place = fluid.CPUPlace() if SMOKE else fluid.TPUPlace()
@@ -212,9 +230,11 @@ def main():
                          return_numpy=False)
         return out
 
+    note("framework: tracing + compiling (first run)")
     t0 = time.time()
     run_fw()
     fw_compile_s = time.time() - t0
+    note(f"framework: first run in {fw_compile_s:.1f}s; timing")
     run_fw()
     dt = timed(run_fw, STEPS)
     emit(variant="framework", ms_per_step=round(dt / STEPS * 1e3, 2),
@@ -222,6 +242,24 @@ def main():
          imgs_per_sec=round(BATCH * STEPS / dt, 1),
          first_run_s=round(fw_compile_s, 1),
          amp=fluid.amp.compute_dtype() or "off")
+
+
+def main():
+    which = os.environ.get("PROBE_VARIANT", "both")
+    if which not in ("hand", "framework", "both"):
+        raise SystemExit(f"PROBE_VARIANT must be hand|framework|both, "
+                         f"got {which!r}")
+
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.normal(size=(BATCH, 3, HW, HW)).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, CLASSES, size=(BATCH, 1)))
+    gflop_img = 3 * 3.86 * (HW / 224.0) ** 2  # bench accounting
+    tflop_step = gflop_img * BATCH / 1e3
+
+    if which in ("hand", "both"):
+        run_hand_variant(img, label, tflop_step)
+    if which in ("framework", "both"):
+        run_framework_variant(img, label, tflop_step)
 
 
 if __name__ == "__main__":
