@@ -549,10 +549,30 @@ def _register_named(name, var):
         _rnn_ctx["named"][name] = var
 
 
+def _set_gen_ctx(read_state, restore=None):
+    """Install a GENERATION-mode rnn context (memory() reads decoder
+    state instead of a DynamicRNN memory — see _generation.py), or
+    restore a previous context when read_state is None.  Returns the
+    context that was active before the call."""
+    global _rnn_ctx
+    prev = _rnn_ctx
+    _rnn_ctx = restore if read_state is None else \
+        {"mode": "gen", "named": {}, "read_state": read_state}
+    return prev
+
+
+def _current_gen_named():
+    if _rnn_ctx is None or _rnn_ctx.get("mode") != "gen":
+        raise ValueError("no generation context is active")
+    return _rnn_ctx["named"]
+
+
 def memory(name, size, boot_layer=None, **kw):
     if _rnn_ctx is None:
         raise ValueError("memory() is only meaningful inside a "
-                         "recurrent_group step function")
+                         "recurrent_group or beam_search step function")
+    if _rnn_ctx.get("mode") == "gen":
+        return _rnn_ctx["read_state"](name, int(size), boot_layer)
     rnn = _rnn_ctx["rnn"]
     # need_reorder: a v2 boot tensor is batch-ordered; DynamicRNN runs
     # sequences in length-sorted order, so the init must be reordered or
@@ -728,6 +748,14 @@ from ._layers_ext import __all__ as _ext_all  # noqa: E402
 
 __all__ += list(_ext_all)
 
+
+# --- v2 generation machinery (beam_search / StaticInput / GeneratedInput
+# — ref layers.py beam_search; lowered onto the contrib decoder) ---------
+from ._generation import (GeneratedInput, GenerationResult,  # noqa: E402
+                          StaticInput, beam_search)
+
+__all__ += ["beam_search", "StaticInput", "GeneratedInput",
+            "GenerationResult"]
 
 # Reference-compatible submodule import paths (paddle.trainer_config_
 # helpers.{layers,networks,activations,poolings,attrs,optimizers}).

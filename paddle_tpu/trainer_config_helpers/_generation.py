@@ -1,0 +1,272 @@
+"""v2 beam-search generation facade (ref: python/paddle/trainer_config_
+helpers/layers.py beam_search / GeneratedInput / StaticInput; usage:
+demo/seqToseq gen).  The v2 contract: the SAME step function that trained
+inside recurrent_group drives generation — each step receives the static
+inputs plus the embedding of the previously generated token, and returns
+the vocab softmax; memory() state is carried across steps and beams.
+
+Here the facade lowers onto the fluid contrib decoder machinery
+(fluid/contrib/decoder/beam_search_decoder.py): a discovery pass records
+the step's memory() declarations, a StateCell carries them (plus the
+score), and a custom BeamSearchDecoder.decode() loop feeds the previous
+token's embedding back in — the step's own softmax scores the beams (the
+base decoder would add a second projection).  `paddle_tpu.v2.inference
+.infer` recognises the returned GenerationResult and auto-feeds the
+bos-seeded init tensors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import layers as _fl
+from ..fluid import unique_name
+from ..fluid.contrib.decoder import BeamSearchDecoder, InitState, StateCell
+
+__all__ = ["StaticInput", "GeneratedInput", "beam_search",
+           "GenerationResult"]
+
+
+class StaticInput:
+    """A per-source input replayed at every generation step (expanded to
+    the live beam width by the decoder)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = bool(is_seq)
+        self.size = size
+
+
+class GeneratedInput:
+    """The fed-back token: embedding of the previous step's output.
+    ``embedding_name`` shares the parameter with the training-time target
+    embedding so trained weights drive generation."""
+
+    def __init__(self, size, embedding_name=None, embedding_size=None):
+        self.size = int(size)                  # vocab size
+        self.embedding_name = embedding_name
+        self.embedding_size = int(embedding_size or 0)
+
+
+class GenerationResult:
+    """What beam_search returns: the decode program's output vars plus
+    the init-feed contract (consumed by paddle_tpu.v2.inference.infer)."""
+
+    def __init__(self, ids, scores, init_ids_name, init_scores_name,
+                 bos_id, eos_id, beam_size, n_results=0):
+        self.ids = ids
+        self.scores = scores
+        self.init_ids_name = init_ids_name
+        self.init_scores_name = init_scores_name
+        self.bos_id = int(bos_id)
+        self.eos_id = int(eos_id)
+        self.beam_size = int(beam_size)
+        self.n_results = int(n_results or 0)  # 0 = all beam_size hyps
+
+    @property
+    def block(self):  # duck-type Variable enough for program lookup
+        return self.ids.block
+
+    def init_feeds(self, batch_size):
+        """The bos-seeded [N*1] lod2 init tensors the loop starts from."""
+        from ..fluid import create_lod_tensor
+        lod2 = [[1] * batch_size, [1] * batch_size]
+        ids = create_lod_tensor(
+            np.full((batch_size, 1), self.bos_id, np.int64), lod2)
+        scores = create_lod_tensor(
+            np.zeros((batch_size, 1), np.float32), lod2)
+        return {self.init_ids_name: ids, self.init_scores_name: scores}
+
+
+def _discover_memories(step, arg_builders):
+    """Run the step once in a throwaway program (fresh unique-name scope,
+    so the real build's parameter names are untouched) to learn which
+    memories it declares: [(name, size, has_boot)]."""
+    from . import _set_gen_ctx
+    from ..fluid import framework
+
+    mems = []
+
+    def read_state(name, size, boot):
+        mems.append((name, int(size), boot))
+        return _fl.fill_constant(shape=[1, int(size)], dtype="float32",
+                                 value=0.0)
+
+    scratch_main, scratch_startup = framework.Program(), framework.Program()
+    with unique_name.guard():
+        with framework.program_guard(scratch_main, scratch_startup):
+            ctx = _set_gen_ctx(read_state)
+            try:
+                step(*[b() for b in arg_builders])
+            finally:
+                _set_gen_ctx(None, restore=ctx)
+    return mems
+
+
+class _V2BeamSearchDecoder(BeamSearchDecoder):
+    """The base loop, except the cell's own softmax scores the beams (v2
+    step functions return the vocab distribution themselves) and the
+    fed-back embedding can share the training-time parameter by name."""
+
+    def __init__(self, *args, emb_param_name=None, **kw):
+        self._emb_param_name = emb_param_name
+        super().__init__(*args, **kw)
+
+    def decode(self):
+        cell = self._state_cell
+        with self.block():
+            prev_ids = self.read_array(init=self._init_ids, is_ids=True)
+            prev_scores = self.read_array(init=self._init_scores,
+                                          is_scores=True)
+            prev_emb = _fl.embedding(
+                prev_ids, size=[self._target_dict_dim, self._word_dim],
+                dtype="float32", is_sparse=self._sparse_emb,
+                param_attr=self._emb_param_name)
+
+            feeds = {}
+            tracked_inputs = {}
+            for name, var in self._input_var_dict.items():
+                stored = self.read_array(init=var)
+                tracked_inputs[name] = stored
+                feeds[name] = _fl.sequence_expand(stored, prev_scores)
+            for name in cell._inputs:
+                if name not in feeds:
+                    feeds[name] = prev_emb
+            for sname in cell._init_states:
+                cell.set_state(
+                    sname,
+                    _fl.sequence_expand(cell.get_state(sname),
+                                        prev_scores))
+
+            cell.compute_state(inputs=feeds)
+            # the step's own softmax IS the score — no extra projection
+            prob = _fl.lod_reset(x=cell.out_state(), y=prev_scores)
+            topk_scores, topk_indices = _fl.topk(prob, k=self._topk_size)
+            accu = _fl.elementwise_add(
+                x=_fl.log(topk_scores),
+                y=_fl.reshape(prev_scores, shape=[-1]), axis=0)
+            sel_ids, sel_scores = _fl.beam_search(
+                prev_ids, prev_scores, topk_indices, accu,
+                self._beam_size, end_id=self._end_id, level=0)
+
+            with _fl.Switch() as switch:
+                with switch.case(_fl.is_empty(sel_ids)):
+                    self.early_stop()
+                with switch.default():
+                    cell.update_states()
+                    self.update_array(prev_ids, sel_ids)
+                    self.update_array(prev_scores, sel_scores)
+                    for name, stored in tracked_inputs.items():
+                        self.update_array(stored, feeds[name])
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size=5, max_length=30,
+                num_results_per_sample=None, name=None):
+    """ref layers.py beam_search: generate with the training step
+    function.  ``input`` mixes StaticInput wrappers and exactly one
+    GeneratedInput; returns a GenerationResult for v2 inference."""
+    from . import _set_gen_ctx
+
+    ins = list(input) if isinstance(input, (list, tuple)) else [input]
+    gens = [i for i in ins if isinstance(i, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput "
+                         f"among its inputs, got {len(gens)}")
+    gen = gens[0]
+    if not gen.embedding_size:
+        raise ValueError("GeneratedInput needs embedding_size")
+    if not gen.embedding_name:
+        raise ValueError(
+            "GeneratedInput needs embedding_name (the training-time "
+            "target-embedding parameter name) — without it generation "
+            "would embed tokens with fresh random weights")
+    prefix = name or unique_name.generate("v2_beam")
+
+    init_ids = _fl.data(name=f"{prefix}_init_ids", shape=[1],
+                        dtype="int64", lod_level=2)
+    init_scores = _fl.data(name=f"{prefix}_init_scores", shape=[1],
+                           dtype="float32", lod_level=2)
+
+    # positional arg builders for the discovery pass (dummies for the
+    # generated word; the real static vars only lend their shapes)
+    arg_builders = []
+    static_names = {}
+    for idx, item in enumerate(ins):
+        if isinstance(item, GeneratedInput):
+            arg_builders.append(
+                lambda g=gen: _fl.fill_constant(
+                    shape=[1, g.embedding_size], dtype="float32",
+                    value=0.0))
+        else:
+            v = item.input if isinstance(item, StaticInput) else item
+            static_names[idx] = f"static_{idx}"
+            arg_builders.append(lambda v=v: v)
+    mems = _discover_memories(step, arg_builders)
+    if not mems:
+        raise ValueError("the step function declares no memory(); "
+                         "beam_search needs recurrent state to carry")
+
+    # cell states: every memory + the score the step returns
+    states = {}
+    for mname, msize, boot in mems:
+        if boot is not None:
+            states[mname] = InitState(init=boot, need_reorder=True)
+        else:
+            states[mname] = InitState(init=_fl.fill_constant_batch_size_like(
+                input=init_scores, shape=[-1, msize], dtype="float32",
+                value=0.0))
+    states["__score__"] = InitState(init=_fl.fill_constant_batch_size_like(
+        input=init_scores, shape=[-1, gen.size], dtype="float32",
+        value=0.0))
+
+    cell_inputs = {n: None for n in static_names.values()}
+    cell_inputs["__word__"] = None
+    cell = StateCell(inputs=cell_inputs, states=states,
+                     out_state="__score__")
+    mem_names = [m[0] for m in mems]
+
+    @cell.state_updater
+    def updater(c):
+        def read_state(sname, size, boot):
+            return c.get_state(sname)
+
+        ctx = _set_gen_ctx(read_state)
+        try:
+            args = []
+            for idx, item in enumerate(ins):
+                if isinstance(item, GeneratedInput):
+                    args.append(c.get_input("__word__"))
+                else:
+                    args.append(c.get_input(static_names[idx]))
+            prob = step(*args)
+            from . import _current_gen_named
+            named = _current_gen_named()
+            for mname in mem_names:
+                tgt = named.get(mname)
+                if tgt is None:
+                    raise ValueError(
+                        f"memory(name={mname!r}) has no layer of that "
+                        f"name in the step function to link to")
+                c.set_state(mname, tgt)
+        finally:
+            _set_gen_ctx(None, restore=ctx)
+        c.set_state("__score__", prob)
+
+    input_var_dict = {static_names[i]: (ins[i].input
+                                        if isinstance(ins[i], StaticInput)
+                                        else ins[i])
+                      for i in static_names}
+    bsd = _V2BeamSearchDecoder(
+        cell, init_ids, init_scores, target_dict_dim=gen.size,
+        word_dim=gen.embedding_size, input_var_dict=input_var_dict,
+        topk_size=min(gen.size, max(50, int(beam_size))),
+        sparse_emb=False,
+        max_len=int(max_length), beam_size=int(beam_size),
+        end_id=int(eos_id), emb_param_name=gen.embedding_name)
+    bsd.decode()
+    out_ids, out_scores = bsd()
+    return GenerationResult(out_ids, out_scores,
+                            init_ids.name, init_scores.name,
+                            bos_id=bos_id, eos_id=eos_id,
+                            beam_size=beam_size,
+                            n_results=num_results_per_sample)

@@ -5,10 +5,10 @@ front ends.  Helpers follow the reference's v2 conventions: costs return
 batch-mean scalars, image layers recover NCHW geometry from flat data
 layers, and projection/operator markers are consumed by mixed_layer.
 
-Deliberately absent (documented, not stubbed): the v2 beam-generation
-machinery (beam_search / GeneratedInput / StaticInput / BeamInput /
-cross_entropy_over_beam) — generation on this substrate is the Fluid
-contrib decoder DSL and the jitted `JitBeamSearchDecoder`; conv
+The v2 beam-generation machinery (beam_search / GeneratedInput /
+StaticInput) lives in _generation.py, lowered onto the contrib decoder.
+Deliberately absent (documented, not stubbed): beam-aware TRAINING
+(BeamInput / cross_entropy_over_beam / SubsequenceInput); conv
 projections/operators inside mixed_layer; 3-D image layers; and the
 listwise lambda_cost — all raise a clear error naming the replacement.
 """
@@ -745,13 +745,12 @@ def vgg_16_network(input_image, num_channels, num_classes=1000, **kw):
 # ---------------- documented absences ----------------
 
 _ABSENT = {
-    "beam_search": "generation is fluid.contrib.decoder "
-                   "(BeamSearchDecoder / JitBeamSearchDecoder)",
-    "GeneratedInput": "generation is fluid.contrib.decoder",
-    "StaticInput": "generation is fluid.contrib.decoder",
-    "SubsequenceInput": "generation is fluid.contrib.decoder",
-    "BeamInput": "generation is fluid.contrib.decoder",
-    "cross_entropy_over_beam": "generation is fluid.contrib.decoder",
+    "SubsequenceInput": "nested-sequence generation has no counterpart; "
+                        "use beam_search with flat sequences",
+    "BeamInput": "beam-feedback training has no counterpart; use "
+                 "fluid.contrib.decoder TrainingDecoder",
+    "cross_entropy_over_beam": "beam-aware training cost has no "
+                               "counterpart; train teacher-forced",
     "lambda_cost": "listwise LTR cost has no fluid-era op; use rank_cost",
     "conv_operator": "compose img_conv_layer into mixed inputs instead",
     "conv_projection": "compose img_conv_layer into mixed inputs instead",

@@ -17,12 +17,13 @@ from ..trainer_config_helpers import (AdamOptimizer, AvgPooling,
                                       MomentumOptimizer, ReluActivation,
                                       SigmoidActivation, SoftmaxActivation,
                                       TanhActivation)
-from . import activation, data_type, evaluator, event, layer, optimizer, \
-    parameters, pooling, trainer
+from . import activation, data_type, evaluator, event, inference, layer, \
+    optimizer, parameters, pooling, trainer
+from .inference import infer
 
 __all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
            "data_type", "evaluator", "event", "optimizer", "parameters",
-           "trainer"]
+           "trainer", "inference", "infer"]
 
 
 def init(use_gpu=False, trainer_count=1, **kwargs):

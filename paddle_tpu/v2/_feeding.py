@@ -1,0 +1,49 @@
+"""Shared v2 feeding/device helpers (Trainer and Inference build feeds
+from reader rows with the same DataFeeder contract — ref trainer.py:137,
+inference.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def accel() -> bool:
+    from ..fluid import core
+
+    return core.is_compiled_with_tpu()
+
+
+def build_feed(program, data_batch, feeding, skip=()):
+    """feeding: {data_layer_name: column index}.  Without it, columns map
+    to the program's data layers in declaration order.  lod_level>0 data
+    layers take ragged rows (variable-length 1-D arrays) and are packed
+    into a LoDTensor; dense layers stack to [N, -1]."""
+    from ..fluid import create_lod_tensor
+
+    gb = program.global_block()
+    data_vars = [v for v in gb.vars.values()
+                 if getattr(v, "is_data", False) and v.name not in skip]
+    if feeding is None:
+        feeding = {v.name: i for i, v in enumerate(data_vars)}
+    feed = {}
+    for v in data_vars:
+        col = feeding.get(v.name)
+        if col is None:
+            continue
+        is_int = v.dtype is not None and "int" in str(v.dtype)
+        if getattr(v, "lod_level", 0):
+            rows = [np.atleast_1d(np.asarray(r[col])) for r in data_batch]
+            lens = [len(r) for r in rows]
+            flat = np.concatenate(rows)
+            flat = flat.astype(np.int64).reshape(-1, 1) if is_int \
+                else flat.astype(np.float32).reshape(-1, int(v.shape[-1]))
+            feed[v.name] = create_lod_tensor(flat, [lens])
+        else:
+            vals = [np.asarray(row[col]) for row in data_batch]
+            arr = np.stack(vals)
+            # scalar class labels become [N, 1]; integer SEQUENCES
+            # (n-gram windows etc.) keep all their columns
+            arr = arr.astype(np.int64 if is_int else np.float32) \
+                .reshape(len(vals), -1)
+            feed[v.name] = arr
+    return feed

@@ -1,0 +1,89 @@
+"""v2 inference (ref: python/paddle/v2/inference.py — paddle.infer runs a
+topology's output layer over input batches with trained parameters).
+
+Two modes, like the reference:
+ - plain output layers: feed the input batch, fetch the outputs
+   (field="id" returns per-row argmax ids like the reference);
+ - a trainer_config_helpers GenerationResult (from beam_search):
+   auto-feed the bos-seeded init tensors and return the decoded
+   hypotheses as (nested ids per source, scores), honoring
+   num_results_per_sample.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import fluid
+from ..trainer_config_helpers import GenerationResult
+from ._feeding import accel as _accel
+from ._feeding import build_feed
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters=None):
+        outs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self._gen = outs[0] if isinstance(outs[0], GenerationResult) \
+            else None
+        self._outputs = list(outs)
+        first = self._gen.ids if self._gen is not None else outs[0]
+        self._program = first.block.program
+        self._place = fluid.CPUPlace() if not _accel() else fluid.TPUPlace()
+        self._exe = fluid.Executor(self._place)
+        # parameters may already live in the global scope (same-process
+        # train->infer); an explicit Parameters object is copied in
+        if parameters is not None and hasattr(parameters, "names"):
+            from ..fluid.executor import global_scope
+
+            scope = global_scope()
+            for n in parameters.names():
+                scope.set(n, np.asarray(parameters.get(n)))
+
+    def _feed(self, input, feeding):
+        skip = ()
+        if self._gen is not None:
+            skip = (self._gen.init_ids_name, self._gen.init_scores_name)
+        return build_feed(self._program, input, feeding, skip=skip)
+
+    def run(self, input, feeding=None, field="value"):
+        feed = self._feed(input, feeding)
+        if self._gen is not None:
+            feed.update(self._gen.init_feeds(len(input)))
+            ids_t, scores_t = self._exe.run(
+                self._program, feed=feed,
+                fetch_list=[self._gen.ids, self._gen.scores],
+                return_numpy=False)
+            seq_lens = ids_t.recursive_sequence_lengths()
+            src_counts, hyp_lens = seq_lens[0], seq_lens[-1]
+            flat = np.asarray(ids_t).ravel().tolist()
+            sflat = np.asarray(scores_t).ravel().tolist()
+            hyps, scores, off = [], [], 0
+            for ln in hyp_lens:
+                hyps.append(flat[off:off + ln])
+                scores.append(sflat[off + ln - 1] if ln else 0.0)
+                off += ln
+            # group hypotheses per source by the decode LoD's own counts
+            grouped, gscores, h = [], [], 0
+            keep = self._gen.n_results or None
+            for cnt in src_counts:
+                grouped.append(hyps[h:h + cnt][:keep])
+                gscores.append(scores[h:h + cnt][:keep])
+                h += cnt
+            return (grouped, gscores) if field != "id" else grouped
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._outputs)
+        if field == "id":
+            return [np.argmax(np.asarray(o), axis=-1) for o in outs] \
+                if len(outs) > 1 else np.argmax(np.asarray(outs[0]),
+                                                axis=-1)
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters=None, input=None, feeding=None,
+          field="value"):
+    """ref v2/inference.py infer()."""
+    return Inference(output_layer, parameters).run(input, feeding=feeding,
+                                                   field=field)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import fluid
+from ._feeding import accel as _accel
 from . import event as v2_event
 from . import optimizer as v2_optimizer
 from .parameters import Parameters
@@ -41,26 +42,9 @@ class SGD:
         """feeding: {data_layer_name: column index} (ref trainer.py:137
         DataFeeder contract).  Without it, columns map to the program's
         data layers in declaration order."""
-        gb = self._program.global_block()
-        data_vars = [v for v in gb.vars.values()
-                     if getattr(v, "is_data", False)]
-        if feeding is None:
-            feeding = {v.name: i for i, v in enumerate(data_vars)}
-        feed = {}
-        for v in data_vars:
-            col = feeding.get(v.name)
-            if col is None:
-                continue
-            vals = [np.asarray(row[col]) for row in data_batch]
-            arr = np.stack(vals)
-            if v.dtype is not None and "int" in str(v.dtype):
-                # scalar class labels become [N, 1]; integer SEQUENCES
-                # (n-gram windows etc.) keep all their columns
-                arr = arr.astype(np.int64).reshape(len(vals), -1)
-            else:
-                arr = arr.astype(np.float32).reshape(len(vals), -1)
-            feed[v.name] = arr
-        return feed
+        from ._feeding import build_feed
+
+        return build_feed(self._program, data_batch, feeding)
 
     def _evaluator_fetches(self):
         """Evaluator entries registered in THIS program's topology
@@ -145,7 +129,3 @@ class SGD:
         self._parameters.to_tar(f)
 
 
-def _accel() -> bool:
-    from ..fluid import core
-
-    return core.is_compiled_with_tpu()

@@ -364,8 +364,8 @@ def test_vision_helpers_shapes():
 
 
 def test_documented_absences_fail_loudly():
-    with pytest.raises(NotImplementedError, match="contrib.decoder"):
-        tch.beam_search
+    with pytest.raises(NotImplementedError, match="TrainingDecoder"):
+        tch.BeamInput
     with pytest.raises(NotImplementedError, match="rank_cost"):
         tch.lambda_cost
     with pytest.raises(NotImplementedError):
