@@ -18,12 +18,14 @@ from ..trainer_config_helpers import (AdamOptimizer, AvgPooling,
                                       SigmoidActivation, SoftmaxActivation,
                                       TanhActivation)
 from . import activation, data_type, evaluator, event, inference, layer, \
-    optimizer, parameters, pooling, trainer
+    master, optimizer, parameters, plot, pooling, topology, trainer
 from .inference import infer
+from .topology import Topology
 
 __all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
            "data_type", "evaluator", "event", "optimizer", "parameters",
-           "trainer", "inference", "infer"]
+           "trainer", "inference", "infer", "master", "plot", "topology",
+           "Topology"]
 
 
 def init(use_gpu=False, trainer_count=1, **kwargs):

@@ -82,8 +82,21 @@ class Inference:
         return outs[0] if len(outs) == 1 else outs
 
 
+_INFER_CACHE = {}
+
+
 def infer(output_layer, parameters=None, input=None, feeding=None,
           field="value"):
-    """ref v2/inference.py infer()."""
-    return Inference(output_layer, parameters).run(input, feeding=feeding,
-                                                   field=field)
+    """ref v2/inference.py infer().  Repeated calls with the same output
+    layer(s) and parameters reuse one Inference — the executor's jit
+    cache is per-instance, so a fresh instance per batch would retrace
+    and recompile the whole program every call."""
+    outs = output_layer if isinstance(output_layer, (list, tuple)) \
+        else [output_layer]
+    key = (tuple(id(o) for o in outs), id(parameters))
+    inf = _INFER_CACHE.get(key)
+    if inf is None:
+        if len(_INFER_CACHE) > 8:
+            _INFER_CACHE.clear()
+        inf = _INFER_CACHE[key] = Inference(output_layer, parameters)
+    return inf.run(input, feeding=feeding, field=field)

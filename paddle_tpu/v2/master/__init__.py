@@ -1,0 +1,3 @@
+from .client import client  # noqa: F401
+
+__all__ = ["client"]

@@ -1,0 +1,98 @@
+"""v2 master client facade (ref: python/paddle/v2/master/client.py — a
+ctypes shim over the Go master's C library: set_dataset partitions
+recordio chunks on an etcd-backed task queue, next_record streams records
+with fault-tolerant task accounting).
+
+Here the fault-tolerant task queue is the in-process TaskDispatcher
+(parallel/master.py — timeout requeue, failure caps, snapshot/recover:
+the go/master service redesigned for the jax.distributed world, where
+coordination rides the distributed runtime rather than etcd).  The
+client keeps the reference's call surface so v2 reader loops run
+unchanged: set_dataset -> paddle_start_get_records(pass) ->
+next_record until (None, -1).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ...native import RecordIOScanner
+from ...parallel.master import TaskDispatcher
+
+__all__ = ["client"]
+
+
+class client:
+    def __init__(self, etcd_endpoints=None, timeout_sec=None, buf_size=0,
+                 chunks_per_task=1, snapshot_path=None):
+        self._dispatcher = None
+        self._chunks_per_task = int(chunks_per_task)
+        self._snapshot_path = snapshot_path
+        self._task = None
+        self._scanner = None
+        self._chunk_idx = 0
+        # save-model arbitration window (per client ≡ per master, the
+        # reference's scope — one Go master per job)
+        self._save_lock = threading.Lock()
+        self._save_until = 0.0
+
+    def set_dataset(self, paths):
+        """Partition recordio files into dispatcher tasks (ref
+        paddle_set_dataset; the Go master splits by chunk — files here,
+        the dispatcher's own unit)."""
+        self._dispatcher = TaskDispatcher(
+            list(paths), chunks_per_task=self._chunks_per_task,
+            snapshot_path=self._snapshot_path)
+
+    def paddle_start_get_records(self, pass_id):
+        if self._dispatcher is None:
+            raise ValueError("set_dataset must be called first")
+        if pass_id > 0:
+            self._dispatcher.start_new_pass()
+
+    def next_record(self):
+        """(record_bytes, 0) per record; ("", 0) for an empty record;
+        (None, -1) once the pass is drained (the reference's
+        end-of-pass error code)."""
+        if self._dispatcher is None:
+            raise ValueError("set_dataset must be called first")
+        while True:
+            if self._scanner is not None:
+                try:
+                    rec = next(self._scanner)
+                    return (rec, 0)
+                except StopIteration:
+                    self._scanner.close()
+                    self._scanner = None
+                    self._chunk_idx += 1
+            if self._task is not None:
+                if self._chunk_idx < len(self._task.chunks):
+                    self._scanner = iter(
+                        RecordIOScanner(self._task.chunks[self._chunk_idx]))
+                    continue
+                self._dispatcher.task_finished(self._task.task_id)
+                self._task = None
+            t = self._dispatcher.get_task()
+            if t is None:
+                return (None, -1)
+            self._task = t
+            self._chunk_idx = 0
+
+    def request_save_model(self, trainer_id, block_ms):
+        """First caller in a block window saves; others are told no (ref
+        paddle_request_save_model semantics, single-process scope)."""
+        import time
+
+        with self._save_lock:
+            now = time.time()
+            if now >= self._save_until:
+                self._save_until = now + float(block_ms) / 1000.0
+                return 1
+            return 0
+
+    def release(self):
+        if self._scanner is not None:
+            self._scanner.close()
+            self._scanner = None
+        self._dispatcher = None
+        self._save_until = 0.0

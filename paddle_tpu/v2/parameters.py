@@ -61,8 +61,18 @@ class Parameters:
 
 
 class _LoadedParameters(dict):
+    """from_tar result: a plain name->ndarray mapping that also answers
+    the Parameters surface (names/get) so infer(parameters=...) installs
+    it into the scope like a live Parameters object."""
+
     def get(self, key):  # noqa: A003 - v2 API name
         return self[key]
+
+    def names(self):
+        return list(self.keys())
+
+    def has_key(self, key):
+        return key in self
 
 
 def create(cost):

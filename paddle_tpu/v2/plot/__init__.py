@@ -1,0 +1,3 @@
+from .plot import PlotData, Ploter  # noqa: F401
+
+__all__ = ["PlotData", "Ploter"]

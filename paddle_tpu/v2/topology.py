@@ -1,0 +1,50 @@
+"""v2 Topology (ref: python/paddle/v2/topology.py — wraps the parsed
+ModelConfig proto: serialize for the trainer, enumerate data layers for
+feeding).  The Fluid Program IS the model config on this substrate, so
+Topology wraps the output layers' program and answers the same
+questions: proto() -> the serialized program, data_layers() ->
+name-ordered feed layers, get_layer_proto(name) -> the op/var desc."""
+
+from __future__ import annotations
+
+__all__ = ["Topology"]
+
+
+class Topology:
+    def __init__(self, layers, extra_layers=None):
+        if not isinstance(layers, (list, tuple)):
+            layers = [layers]
+        self.layers = list(layers)
+        self.extra_layers = list(extra_layers or [])
+        programs = {v.block.program for v in
+                    self.layers + self.extra_layers}
+        if len(programs) != 1:
+            raise ValueError("all topology layers must come from one "
+                             "program")
+        self._program = programs.pop()
+
+    @property
+    def program(self):
+        return self._program
+
+    def proto(self):
+        """The serialized model config (the reference returns the
+        ModelConfig proto bytes; here the program desc)."""
+        return self._program.to_string()
+
+    def data_layers(self):
+        """name -> data Variable, in declaration order (ref returns the
+        input layer configs used to build the DataFeeder)."""
+        gb = self._program.global_block()
+        return {v.name: v for v in gb.vars.values()
+                if getattr(v, "is_data", False)}
+
+    def data_type(self):
+        """[(name, dtype)] for the feed layers."""
+        return [(name, str(v.dtype))
+                for name, v in self.data_layers().items()]
+
+    def get_layer_proto(self, name):
+        gb = self._program.global_block()
+        v = gb.vars.get(name)
+        return v

@@ -1,0 +1,118 @@
+"""v2 auxiliary surface (ref: python/paddle/v2/{topology,plot,master} —
+Topology over output layers, the Ploter data collector, and the master
+client's fault-tolerant record streaming, here over the in-process
+TaskDispatcher + recordio)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle_v2
+
+
+def test_topology_wraps_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=3, act="softmax")
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=y))
+    topo = paddle_v2.Topology(cost)
+    assert topo.program is main
+    assert list(topo.data_layers()) == ["x", "y"]
+    assert dict(topo.data_type())["y"] == "int64"
+    assert "fc" in topo.proto()
+    import pytest
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        other = fluid.layers.data(name="z", shape=[1], dtype="float32")
+    with pytest.raises(ValueError, match="one"):
+        paddle_v2.Topology([cost, other])
+
+
+def test_ploter_collects_headless(tmp_path):
+    p = paddle_v2.plot.Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+    p.append("test", 0, 0.5)
+    assert p.__plot_data__["train"].step == [0, 1, 2, 3, 4]
+    out = str(tmp_path / "curve.png")
+    p.plot(out)  # Agg backend or collector-only; must not raise
+    p.reset()
+    assert p.__plot_data__["train"].step == []
+
+
+def test_infer_from_tar_parameters(tmp_path):
+    """Parameters.from_tar -> infer installs the checkpoint weights (the
+    canonical fresh-process v2 workflow)."""
+    import paddle_tpu.fluid.executor as _executor
+    from paddle_tpu.fluid import unique_name
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    params = paddle_v2.parameters.Parameters(main)
+    w = np.full((4, 3), 0.25, np.float32)
+    params.set(params.names()[0], w)
+    tar = str(tmp_path / "params.npz")
+    with open(tar, "wb") as f:
+        params.to_tar(f)
+    x_np = np.ones((2, 4), np.float32)
+    (want,) = exe.run(main, feed={"x": x_np}, fetch_list=[pred])
+
+    loaded = paddle_v2.parameters.Parameters.from_tar(tar)
+    with fluid.scope_guard(_executor.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        got = paddle_v2.infer(output_layer=pred, parameters=loaded,
+                              input=[(row,) for row in x_np])
+        ids = paddle_v2.infer(output_layer=pred, parameters=loaded,
+                              input=[(row,) for row in x_np], field="id")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5)
+    assert np.asarray(ids).shape == (2,)
+
+
+def test_master_client_streams_records(tmp_path):
+    from paddle_tpu.fluid.recordio_writer import create_recordio_writer
+
+    paths = []
+    want = []
+    for f in range(3):
+        path = str(tmp_path / f"part-{f}.recordio")
+        with create_recordio_writer(path) as w:
+            for r in range(4):
+                rec = f"rec-{f}-{r}".encode()
+                w.write(rec)
+                want.append(rec)
+        paths.append(path)
+
+    c = paddle_v2.master.client(chunks_per_task=1)
+    c.set_dataset(paths)
+    got = []
+    c.paddle_start_get_records(0)
+    while True:
+        rec, err = c.next_record()
+        if err < 0:
+            break
+        got.append(rec)
+    assert sorted(got) == sorted(want)
+
+    # a second pass streams the full dataset again
+    c.paddle_start_get_records(1)
+    got2 = []
+    while True:
+        rec, err = c.next_record()
+        if err < 0:
+            break
+        got2.append(rec)
+    assert sorted(got2) == sorted(want)
+
+    # save-model arbitration: first caller wins the block window
+    assert c.request_save_model(0, 60_000) == 1
+    assert c.request_save_model(1, 60_000) == 0
+    c.release()
