@@ -1,0 +1,137 @@
+"""v2 image utilities (ref: python/paddle/v2/image.py — load / resize /
+crop / flip / simple_transform over HWC ndarrays; the reference backs
+them with cv2, here PIL handles decode+resize and numpy does the rest,
+so the no-cv2 environment keeps the same surface)."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+__all__ = [
+    "batch_images_from_tar", "load_image_bytes", "load_image",
+    "resize_short", "to_chw", "center_crop", "random_crop",
+    "left_right_flip", "simple_transform", "load_and_transform",
+]
+
+
+def _pil():
+    from PIL import Image
+
+    return Image
+
+
+def load_image_bytes(bytes, is_color=True):  # noqa: A002 - v2 API name
+    im = _pil().open(io.BytesIO(bytes))
+    im = im.convert("RGB" if is_color else "L")
+    arr = np.asarray(im)
+    return arr
+
+
+def load_image(file, is_color=True):  # noqa: A002 - v2 API name
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color=is_color)
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge is ``size`` (HWC, bicubic like the
+    reference's INTER_CUBIC)."""
+    h, w = im.shape[:2]
+    if h > w:
+        h_new, w_new = int(size * h / w), int(size)
+    else:
+        h_new, w_new = int(size), int(size * w / h)
+    pim = _pil().fromarray(np.ascontiguousarray(im))
+    pim = pim.resize((w_new, h_new), _pil().BICUBIC)
+    return np.asarray(pim)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1, :] if len(im.shape) == 3 else im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short -> (random crop + coin-flip mirror | center crop) ->
+    CHW float32, optionally mean-subtracted (per-channel or
+    elementwise)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """ref image.py batch_images_from_tar: read images out of a tar,
+    pickle (image-bytes, label) batches next to it, return the meta
+    file path."""
+    import pickle
+    import os
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    meta = os.path.join(out_path, "batch_meta")
+    if os.path.exists(meta):
+        return meta
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id, names = [], [], 0, []
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                batch_name = os.path.join(out_path, f"batch_{file_id}")
+                with open(batch_name, "wb") as f:
+                    pickle.dump({"data": data, "label": labels}, f)
+                names.append(batch_name)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        batch_name = os.path.join(out_path, f"batch_{file_id}")
+        with open(batch_name, "wb") as f:
+            pickle.dump({"data": data, "label": labels}, f)
+        names.append(batch_name)
+    with open(meta, "w") as f:
+        f.write("\n".join(names))
+    return meta

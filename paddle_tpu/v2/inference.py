@@ -33,8 +33,14 @@ class Inference:
         self._program = first.block.program
         self._place = fluid.CPUPlace() if not _accel() else fluid.TPUPlace()
         self._exe = fluid.Executor(self._place)
-        # parameters may already live in the global scope (same-process
-        # train->infer); an explicit Parameters object is copied in
+        self._install(parameters)
+
+    @staticmethod
+    def _install(parameters):
+        """Copy an explicit Parameters/from_tar mapping into the scope.
+        Runs on every run() call (like the reference, which owns a
+        GradientMachine initialized from the parameters) so training in
+        between cannot silently change what infer uses."""
         if parameters is not None and hasattr(parameters, "names"):
             from ..fluid.executor import global_scope
 
@@ -88,15 +94,19 @@ _INFER_CACHE = {}
 def infer(output_layer, parameters=None, input=None, feeding=None,
           field="value"):
     """ref v2/inference.py infer().  Repeated calls with the same output
-    layer(s) and parameters reuse one Inference — the executor's jit
-    cache is per-instance, so a fresh instance per batch would retrace
-    and recompile the whole program every call."""
+    layer(s) reuse one Inference — the executor's jit cache is
+    per-instance, so a fresh instance per batch would retrace and
+    recompile the whole program every call.  Parameters are re-installed
+    into the scope on every call (the cache key holds the output vars
+    alive, so their ids cannot be recycled)."""
     outs = output_layer if isinstance(output_layer, (list, tuple)) \
         else [output_layer]
-    key = (tuple(id(o) for o in outs), id(parameters))
+    key = tuple(id(o) for o in outs)
     inf = _INFER_CACHE.get(key)
     if inf is None:
         if len(_INFER_CACHE) > 8:
             _INFER_CACHE.clear()
         inf = _INFER_CACHE[key] = Inference(output_layer, parameters)
+    else:
+        Inference._install(parameters)
     return inf.run(input, feeding=feeding, field=field)

@@ -36,10 +36,21 @@ class client:
         self._save_lock = threading.Lock()
         self._save_until = 0.0
 
+    def _drop_cursor(self):
+        """Abandon any in-flight task/scanner (dataset or pass changed
+        mid-stream; the old cursor must not leak records into the new
+        configuration)."""
+        if self._scanner is not None:
+            self._scanner.close()
+            self._scanner = None
+        self._task = None
+        self._chunk_idx = 0
+
     def set_dataset(self, paths):
         """Partition recordio files into dispatcher tasks (ref
         paddle_set_dataset; the Go master splits by chunk — files here,
         the dispatcher's own unit)."""
+        self._drop_cursor()
         self._dispatcher = TaskDispatcher(
             list(paths), chunks_per_task=self._chunks_per_task,
             snapshot_path=self._snapshot_path)
@@ -47,6 +58,7 @@ class client:
     def paddle_start_get_records(self, pass_id):
         if self._dispatcher is None:
             raise ValueError("set_dataset must be called first")
+        self._drop_cursor()
         if pass_id > 0:
             self._dispatcher.start_new_pass()
 
@@ -65,6 +77,18 @@ class client:
                     self._scanner.close()
                     self._scanner = None
                     self._chunk_idx += 1
+                except Exception:
+                    # corrupt chunk: report the task failed so the
+                    # dispatcher's failure-cap machinery engages (requeue
+                    # up to failure_max, then discard) instead of
+                    # wedging this client on the same broken scanner
+                    self._scanner.close()
+                    self._scanner = None
+                    if self._task is not None:
+                        self._dispatcher.task_failed(self._task.task_id)
+                        self._task = None
+                        self._chunk_idx = 0
+                    continue
             if self._task is not None:
                 if self._chunk_idx < len(self._task.chunks):
                     self._scanner = iter(
@@ -91,8 +115,6 @@ class client:
             return 0
 
     def release(self):
-        if self._scanner is not None:
-            self._scanner.close()
-            self._scanner = None
+        self._drop_cursor()
         self._dispatcher = None
         self._save_until = 0.0

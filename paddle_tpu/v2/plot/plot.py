@@ -34,7 +34,15 @@ class Ploter:
             try:
                 import matplotlib
 
-                matplotlib.use("Agg")  # headless-safe
+                # headless-safe WITHOUT hijacking an interactive
+                # session's backend: only switch to Agg when the current
+                # backend needs a display that is not there (a notebook's
+                # inline backend has no DISPLAY either and must be kept)
+                bk = matplotlib.get_backend().lower()
+                needs_display = any(k in bk for k in
+                                    ("tk", "qt", "gtk", "wx", "macosx"))
+                if needs_display and not os.environ.get("DISPLAY"):
+                    matplotlib.use("Agg")
                 import matplotlib.pyplot as plt
 
                 self.plt = plt

@@ -77,6 +77,24 @@ def test_infer_from_tar_parameters(tmp_path):
     assert np.asarray(ids).shape == (2,)
 
 
+def test_image_transforms():
+    """v2.image: resize_short/center/random crop/flip/simple_transform
+    keep the reference's HWC->CHW float32 contract (PIL+numpy backed)."""
+    rng = np.random.RandomState(0)
+    im = (rng.rand(40, 60, 3) * 255).astype(np.uint8)
+    r = paddle_v2.image.resize_short(im, 32)
+    assert r.shape[:2] == (32, 48)  # shorter edge = 32, aspect kept
+    c = paddle_v2.image.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    f = paddle_v2.image.left_right_flip(r)
+    assert np.array_equal(f[:, ::-1], r)
+    t = paddle_v2.image.simple_transform(im, 40, 32, is_train=False,
+                                         mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+    chw = paddle_v2.image.to_chw(c)
+    assert chw.shape == (3, 32, 32)
+
+
 def test_master_client_streams_records(tmp_path):
     from paddle_tpu.fluid.recordio_writer import create_recordio_writer
 
