@@ -107,6 +107,11 @@ def infer(output_layer, parameters=None, input=None, feeding=None,
         if len(_INFER_CACHE) > 8:
             _INFER_CACHE.clear()
         inf = _INFER_CACHE[key] = Inference(output_layer, parameters)
-    else:
+        inf._last_params = parameters
+    elif parameters is not inf._last_params:
+        # a DIFFERENT parameters object: install it.  (A live Parameters
+        # is a view over the scope — re-installing the same object is a
+        # no-op; only a detached from_tar mapping carries new values.)
         Inference._install(parameters)
+        inf._last_params = parameters
     return inf.run(input, feeding=feeding, field=field)

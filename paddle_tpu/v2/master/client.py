@@ -91,8 +91,15 @@ class client:
                     continue
             if self._task is not None:
                 if self._chunk_idx < len(self._task.chunks):
-                    self._scanner = iter(
-                        RecordIOScanner(self._task.chunks[self._chunk_idx]))
+                    try:
+                        self._scanner = iter(RecordIOScanner(
+                            self._task.chunks[self._chunk_idx]))
+                    except Exception:
+                        # unreadable chunk: same failure path as a
+                        # corrupt record mid-scan
+                        self._dispatcher.task_failed(self._task.task_id)
+                        self._task = None
+                        self._chunk_idx = 0
                     continue
                 self._dispatcher.task_finished(self._task.task_id)
                 self._task = None

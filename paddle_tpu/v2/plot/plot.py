@@ -39,9 +39,11 @@ class Ploter:
                 # backend needs a display that is not there (a notebook's
                 # inline backend has no DISPLAY either and must be kept)
                 bk = matplotlib.get_backend().lower()
-                needs_display = any(k in bk for k in
-                                    ("tk", "qt", "gtk", "wx", "macosx"))
-                if needs_display and not os.environ.get("DISPLAY"):
+                # macosx uses Cocoa (no X11), qt/gtk may ride Wayland
+                needs_x11 = any(k in bk for k in ("tk", "qt", "gtk", "wx"))
+                headless = not os.environ.get("DISPLAY") and \
+                    not os.environ.get("WAYLAND_DISPLAY")
+                if needs_x11 and headless:
                     matplotlib.use("Agg")
                 import matplotlib.pyplot as plt
 
