@@ -80,8 +80,33 @@ if [ -s docs/PROBE_r05_run_steps.jsonl ]; then LOG "skip run_steps"; else
   wait_alive
 fi
 
-# Stage 4: full default bench capture (resnet + transformer) for the log.
+# Stage 4: jitted beam decode on silicon, fp32 and int8 weights
+# (VERDICT r4 next-round #7: decode+int8 composition numbers).
+if [ -s docs/PROBE_r05_decode.jsonl ]; then LOG "skip decode"; else
+  LOG "stage decode (jit, then +int8)"
+  DJ=$(BENCH_MODEL=decode timeout 1200 python bench.py 2>/dev/null | tail -1)
+  DI=$(BENCH_MODEL=decode BENCH_INT8=1 timeout 1200 python bench.py 2>/dev/null | tail -1)
+  { echo "{\"mode\": \"decode_jit\", \"line\": ${DJ:-null}}"
+    echo "{\"mode\": \"decode_jit_int8\", \"line\": ${DI:-null}}" ; } \
+    >> docs/PROBE_r05_decode.jsonl
+  LOG "stage decode done"
+  wait_alive
+fi
+
+# Stage 5: full default bench capture (resnet + transformer) for the log.
 LOG "stage bench (full default)"
 timeout 2400 python bench.py 2>/dev/null | tail -1 >> docs/BENCH_live_r05.jsonl
 LOG "bench done rc=$?"
+wait_alive
+
+# Stage 6: if the flash probe compiled clean (every stage ok — the probe
+# stops at its first failure, so any ok:false line means broken), capture
+# the transformer with the Pallas path enabled for comparison.
+if grep -q '"ok": true' docs/PROBE_r05_flash.jsonl 2>/dev/null \
+   && ! grep -q '"ok": false' docs/PROBE_r05_flash.jsonl; then
+  LOG "stage bench (BENCH_FLASH=1 transformer)"
+  F=$(BENCH_MODEL=transformer BENCH_FLASH=1 timeout 1500 python bench.py 2>/dev/null | tail -1)
+  echo "{\"mode\": \"transformer_flash\", \"line\": ${F:-null}}" \
+    >> docs/BENCH_live_r05.jsonl
+fi
 LOG "session complete"
