@@ -373,11 +373,16 @@ def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75, name=None,
 
 def concat_layer(input, act=None, name=None, layer_attr=None,
                  bias_attr=None):
-    """Channel concat (ref layers.py:3527; default IdentityActivation)."""
-    out = _fl.concat(list(input), axis=1)
+    """Channel concat (ref layers.py:3527; default IdentityActivation).
+    Accepts projection markers (conv_projection etc.) like the
+    reference's concat."""
+    parts = [_lower_projection(p, None) if isinstance(p, tuple) else p
+             for p in _as_proj_list(input)]
+    out = _fl.concat(parts, axis=1)
     a = _act_name(act)
     if a:
         out = getattr(_fl, a)(out)
+    _register_named(name, out)
     return out
 
 
@@ -619,79 +624,141 @@ def recurrent_group(step, input, reverse=False, name=None):
 
 def full_matrix_projection(input, size=None, param_attr=None):
     """ref layers.py full_matrix_projection — a marker consumed by
-    mixed_layer (the projection's weight is the mixed layer's)."""
-    return ("fmp", input, _param_name(param_attr))
+    mixed_layer/concat_layer (the marker carries its own size so a
+    size-less consumer like concat can still lower it)."""
+    return ("fmp", input, {"size": size, "name": _param_name(param_attr)})
 
 
 def identity_projection(input, **kw):
     return ("idp", input, None)
 
 
+_PROJ_KINDS = ("fmp", "idp", "dmp", "scp", "tbp", "slp", "dop", "tfmp",
+               "cvp", "cvo")
+
+
+def _lower_projection(p, size):
+    """Turn one projection/operator marker (or a bare Variable ≡ fmp)
+    into its summand Variable (shared by mixed_layer and concat_layer)."""
+    kind, x, extra = p if isinstance(p, tuple) else ("fmp", p, None)
+    if kind == "idp":
+        return x
+    if kind == "dmp":  # dotmul: learned per-feature weight
+        w = _fl.create_parameter([int(x.shape[-1])], "float32",
+                                 name=extra)
+        return _fl.elementwise_mul(x, w, axis=1)
+    if kind == "scp":  # scaling: learned scalar
+        w = _fl.create_parameter([1], "float32", name=extra)
+        return _fl.elementwise_mul(x, w)
+    if kind == "tbp":  # table: embedding lookup of an id sequence
+        tsize, pname = extra
+        if tsize is None and size is None:
+            raise ValueError("mixed_layer needs size= (or "
+                             "table_projection size=) for "
+                             "table_projection inputs")
+        width = int(tsize or size)
+        return _fl.embedding(input=_as_id_sequence(x),
+                             size=[_vocab_guess(x), width],
+                             param_attr=pname)
+    if kind == "slp":  # slice columns [(start, end), ...]
+        pieces = [_fl.slice(x, axes=[1], starts=[int(s)], ends=[int(e)])
+                  for s, e in extra]
+        return pieces[0] if len(pieces) == 1 else _fl.concat(pieces,
+                                                             axis=1)
+    if kind == "dop":  # dotmul_operator: a ⊙ b * scale
+        a_in, b_in = x
+        out = _fl.elementwise_mul(a_in, b_in)
+        if extra != 1.0:
+            out = _fl.scale(out, scale=extra)
+        return out
+    if kind == "tfmp":
+        # x @ W^T where the tied W has the PARTNER's [size, d] shape,
+        # so a name-shared full_matrix_projection weight really is
+        # used transposed (the reference's tied-autoencoder pattern)
+        psize, pname = _proj_size_name(extra, size)
+        if psize is None:
+            raise ValueError("trans_full_matrix_projection needs size= "
+                             "(on the projection or its mixed_layer)")
+        w = _fl.create_parameter([int(psize), int(x.shape[-1])],
+                                 "float32", name=pname)
+        return _fl.matmul(x, w, transpose_y=True)
+    if kind == "cvp":  # conv_projection: learned-filter conv, flattened
+        cfg = dict(extra)
+        img, _ = _to_nchw(x, cfg.pop("num_channels"))
+        out = _fl.conv2d(input=img, act=None, bias_attr=False, **cfg)
+        return _fl.reshape(out, [-1, _prod(out.shape[1:])])
+    if kind == "cvo":  # conv_operator: the FILTER comes from a layer
+        img_in, filt = x
+        cfg = dict(extra)
+        img, cin = _to_nchw(img_in, cfg.pop("num_channels"))
+        nf, k, ky = cfg["num_filters"], cfg["filter_size"], \
+            cfg["filter_size_y"]
+        w = _fl.reshape(filt, [int(nf), int(cin), int(ky), int(k)])
+        out = _conv_with_filter_var(img, w, stride=cfg["stride"],
+                                    padding=cfg["padding"])
+        return _fl.reshape(out, [-1, _prod(out.shape[1:])])
+    if kind == "fmp":
+        psize, pname = _proj_size_name(extra, size)
+        if psize is None:
+            raise ValueError("full_matrix_projection needs size= (on "
+                             "the projection or its mixed_layer)")
+        return _fl.fc(input=x, size=int(psize), act=None,
+                      param_attr=pname, bias_attr=False)
+    raise ValueError(f"unknown projection kind {kind!r}")
+
+
+def _proj_size_name(extra, consumer_size):
+    """fmp/tfmp markers carry {'size', 'name'}; a bare Variable
+    shorthand arrives with extra=None.  The projection's own size wins,
+    else the consumer's (mixed_layer size=)."""
+    if isinstance(extra, dict):
+        return (extra.get("size") if extra.get("size") is not None
+                else consumer_size), extra.get("name")
+    return consumer_size, extra
+
+
+def _conv_with_filter_var(img, w, stride=(1, 1), padding=(0, 0)):
+    """conv2d whose Filter is an arbitrary Variable (the conv2d OP takes
+    any var; only the layers.conv2d wrapper insists on creating a
+    parameter) — the cudnn conv_op role (ref layers.py conv_operator).
+    stride/padding are (y, x) pairs."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("conv2d")
+    out = helper.create_variable_for_type_inference(dtype=img.dtype)
+    n, _, h, wd = img.shape
+    nf, _, ky, kx = w.shape
+    (sy, sx), (py, px) = ([int(v) for v in stride],
+                          [int(v) for v in padding])
+    out.shape = (n, int(nf), (int(h) + 2 * py - int(ky)) // sy + 1,
+                 (int(wd) + 2 * px - int(kx)) // sx + 1)
+    helper.append_op(
+        type="conv2d", inputs={"Input": [img], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": [sy, sx], "paddings": [py, px],
+               "dilations": [1, 1], "groups": 1, "use_cudnn": False})
+    return out
+
+
+def _prod(xs):
+    return math.prod(int(v) for v in xs)
+
+
+def _as_proj_list(input):
+    """A single bare projection marker, a list, or a single Variable."""
+    if (isinstance(input, tuple) and len(input) == 3
+            and input[0] in _PROJ_KINDS):
+        return [input]
+    if isinstance(input, (list, tuple)):
+        return list(input)
+    return [input]
+
+
 def mixed_layer(size=None, input=None, act=None, bias_attr=None,
                 name=None, layer_attr=None):
-    """ref layers.py mixed_layer: sum of projections + activation.  Only
-    the full_matrix/identity projections the rnn-era configs use."""
+    """ref layers.py mixed_layer: sum of projections + activation."""
     act = _default_act(act, LinearActivation())
-    _KINDS = ("fmp", "idp", "dmp", "scp", "tbp", "slp", "dop", "tfmp")
-    if (isinstance(input, tuple) and len(input) == 3
-            and input[0] in _KINDS):
-        projs = [input]  # a single bare projection marker
-    elif isinstance(input, (list, tuple)):
-        projs = list(input)
-    else:
-        projs = [input]
-    parts = []
-    for p in projs:
-        kind, x, extra = p if isinstance(p, tuple) else ("fmp", p, None)
-        if kind == "idp":
-            parts.append(x)
-        elif kind == "dmp":  # dotmul: learned per-feature weight
-            w = _fl.create_parameter([int(x.shape[-1])], "float32",
-                                        name=extra)
-            parts.append(_fl.elementwise_mul(x, w, axis=1))
-        elif kind == "scp":  # scaling: learned scalar
-            w = _fl.create_parameter([1], "float32", name=extra)
-            parts.append(_fl.elementwise_mul(x, w))
-        elif kind == "tbp":  # table: embedding lookup of an id sequence
-            tsize, pname = extra
-            if tsize is None and size is None:
-                raise ValueError("mixed_layer needs size= (or "
-                                 "table_projection size=) for "
-                                 "table_projection inputs")
-            width = int(tsize or size)
-            parts.append(_fl.embedding(
-                input=_as_id_sequence(x),
-                size=[_vocab_guess(x), width], param_attr=pname))
-        elif kind == "slp":  # slice columns [(start, end), ...]
-            pieces = [_fl.slice(x, axes=[1], starts=[int(s)],
-                                   ends=[int(e)]) for s, e in extra]
-            parts.append(pieces[0] if len(pieces) == 1
-                         else _fl.concat(pieces, axis=1))
-        elif kind == "dop":  # dotmul_operator: a ⊙ b * scale
-            a_in, b_in = x
-            out = _fl.elementwise_mul(a_in, b_in)
-            if extra != 1.0:
-                out = _fl.scale(out, scale=extra)
-            parts.append(out)
-        elif kind == "tfmp":
-            # x @ W^T where the tied W has the PARTNER's [size, d] shape,
-            # so a name-shared full_matrix_projection weight really is
-            # used transposed (the reference's tied-autoencoder pattern)
-            if size is None:
-                raise ValueError("mixed_layer needs size= for "
-                                 "trans_full_matrix_projection inputs")
-            w = _fl.create_parameter([int(size), int(x.shape[-1])],
-                                     "float32", name=extra)
-            parts.append(_fl.matmul(x, w, transpose_y=True))
-        elif kind == "fmp":
-            if size is None:
-                raise ValueError("mixed_layer needs size= for "
-                                 "full_matrix_projection inputs")
-            parts.append(_fl.fc(input=x, size=int(size), act=None,
-                                   param_attr=extra,
-                                   bias_attr=False))
-        else:
-            raise ValueError(f"unknown projection kind {kind!r}")
+    parts = [_lower_projection(p, size) for p in _as_proj_list(input)]
     out = parts[0]
     for other in parts[1:]:
         out = _fl.elementwise_add(out, other)

@@ -8,9 +8,9 @@ layers, and projection/operator markers are consumed by mixed_layer.
 The v2 beam-generation machinery (beam_search / GeneratedInput /
 StaticInput) lives in _generation.py, lowered onto the contrib decoder.
 Deliberately absent (documented, not stubbed): beam-aware TRAINING
-(BeamInput / cross_entropy_over_beam / SubsequenceInput); conv
-projections/operators inside mixed_layer; 3-D image layers; and the
-listwise lambda_cost — all raise a clear error naming the replacement.
+(BeamInput / cross_entropy_over_beam / SubsequenceInput); 3-D image
+layers; context_projection; and the listwise lambda_cost — all raise a
+clear error naming the replacement.
 """
 
 from __future__ import annotations
@@ -50,6 +50,7 @@ __all__ = [
     "grumemory", "simple_gru", "recurrent_layer", "gru_step_layer",
     "dotmul_projection", "scaling_projection", "table_projection",
     "trans_full_matrix_projection", "slice_projection", "dotmul_operator",
+    "conv_projection", "conv_operator",
     # networks composites
     "simple_attention", "sequence_conv_pool", "vgg_16_network",
 ]
@@ -673,7 +674,8 @@ def table_projection(input, size=None, param_attr=None, **kw):
 
 
 def trans_full_matrix_projection(input, size=None, param_attr=None, **kw):
-    return ("tfmp", input, _param_name(param_attr))
+    return ("tfmp", input, {"size": size,
+                            "name": _param_name(param_attr)})
 
 
 def slice_projection(input, slices, **kw):
@@ -682,6 +684,42 @@ def slice_projection(input, slices, **kw):
 
 def dotmul_operator(a=None, b=None, scale=1.0, **kw):
     return ("dop", (a, b), float(scale))
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, filter_size_y=None, stride_y=None,
+                    padding_y=None, groups=1, param_attr=None, trans=False,
+                    **kw):
+    """Learned-filter conv inside mixed/concat (ref layers.py
+    conv_projection); output is the flattened feature map."""
+    return ("cvp", input, {
+        "num_channels": num_channels,
+        "num_filters": int(num_filters),
+        "filter_size": (int(filter_size_y or filter_size),
+                        int(filter_size)),
+        "stride": (int(stride_y or stride), int(stride)),
+        "padding": (int(padding_y if padding_y is not None else padding),
+                    int(padding)),
+        "groups": int(groups),
+        "param_attr": _param_name(param_attr),
+    })
+
+
+def conv_operator(img, filter, filter_size, num_filters,  # noqa: A002
+                  num_channels=None, stride=1, padding=0,
+                  filter_size_y=None, stride_y=None, padding_y=None,
+                  trans=False, **kw):
+    """Conv whose FILTER comes from another layer (ref layers.py
+    conv_operator — the two-input cudnn conv op)."""
+    return ("cvo", (img, filter), {
+        "num_channels": num_channels,
+        "num_filters": int(num_filters),
+        "filter_size": int(filter_size),
+        "filter_size_y": int(filter_size_y or filter_size),
+        "stride": (int(stride_y or stride), int(stride)),
+        "padding": (int(padding_y if padding_y is not None else padding),
+                    int(padding)),
+    })
 
 
 # ---------------- networks composites ----------------
@@ -752,8 +790,6 @@ _ABSENT = {
     "cross_entropy_over_beam": "beam-aware training cost has no "
                                "counterpart; train teacher-forced",
     "lambda_cost": "listwise LTR cost has no fluid-era op; use rank_cost",
-    "conv_operator": "compose img_conv_layer into mixed inputs instead",
-    "conv_projection": "compose img_conv_layer into mixed inputs instead",
     "context_projection": "use fluid layers.sequence_conv",
     "img_conv3d_layer": "use fluid layers.conv3d",
     "img_pool3d_layer": "use fluid layers.pool3d",

@@ -290,6 +290,39 @@ def test_mixed_layer_projection_kinds():
     assert v.shape == (3, 4) and np.isfinite(v).all()
 
 
+def test_conv_projection_and_operator():
+    """conv_projection (learned filter) and conv_operator (filter from a
+    layer) inside mixed/concat match a direct conv lowering."""
+    rng = np.random.RandomState(13)
+    img_np = rng.rand(2, 27).astype(np.float32)  # 3ch 3x3
+    filt_np = rng.rand(1, 2 * 3 * 2 * 2).astype(np.float32)
+    with _fresh():
+        img = tch.data_layer("img", 27, height=3, width=3)
+        filt = fluid.layers.data(name="filt", shape=[2 * 3 * 2 * 2],
+                                 dtype="float32")
+        proj_out = tch.mixed_layer(
+            input=tch.conv_projection(img, filter_size=3, num_filters=2,
+                                      num_channels=3, padding=1),
+            bias_attr=False)
+        op_out = tch.concat_layer([
+            tch.conv_operator(img=img, filter=filt, filter_size=2,
+                              num_filters=2, num_channels=3)])
+        p, o = _run({"img": img_np, "filt": filt_np}, [proj_out, op_out])
+    assert p.shape == (2, 2 * 3 * 3)  # 2 filters, SAME-ish padded 3x3
+    assert o.shape == (2, 2 * 2 * 2)  # 2 filters, valid 2x2 out
+    # numpy check of the dynamic-filter conv
+    x = img_np.reshape(2, 3, 3, 3)
+    w = filt_np.reshape(2, 3, 2, 2)
+    want = np.zeros((2, 2, 2, 2), np.float32)
+    for n in range(2):
+        for f in range(2):
+            for i in range(2):
+                for j in range(2):
+                    want[n, f, i, j] = np.sum(
+                        x[n, :, i:i + 2, j:j + 2] * w[f])
+    np.testing.assert_allclose(o, want.reshape(2, -1), rtol=1e-4)
+
+
 def test_trans_full_matrix_projection_ties_transposed():
     """fmp + tfmp sharing one ParamAttr name use W and W^T of the SAME
     parameter (the reference tied-autoencoder pattern)."""
@@ -368,6 +401,6 @@ def test_documented_absences_fail_loudly():
         tch.BeamInput
     with pytest.raises(NotImplementedError, match="rank_cost"):
         tch.lambda_cost
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(NotImplementedError, match="sequence_conv"):
         from paddle_tpu.trainer_config_helpers import _layers_ext
-        _layers_ext.conv_operator
+        _layers_ext.context_projection
