@@ -283,6 +283,7 @@ def data_layer(name, size, height=None, width=None, depth=None):
     is detected at the cost layer, not here."""
     v = _fl.data(name=name, shape=[int(size)], dtype="float32")
     v._v2_geom = (height, width)
+    v._v2_depth = depth
     return v
 
 
@@ -634,7 +635,7 @@ def identity_projection(input, **kw):
 
 
 _PROJ_KINDS = ("fmp", "idp", "dmp", "scp", "tbp", "slp", "dop", "tfmp",
-               "cvp", "cvo")
+               "cvp", "cvo", "ctp")
 
 
 def _lower_projection(p, size):
@@ -697,6 +698,11 @@ def _lower_projection(p, size):
         out = _conv_with_filter_var(img, w, stride=cfg["stride"],
                                     padding=cfg["padding"])
         return _fl.reshape(out, [-1, _prod(out.shape[1:])])
+    if kind == "ctp":  # context window concat per sequence step
+        from ._layers_ext import _lower_context_projection
+
+        context_len, start = extra
+        return _lower_context_projection(x, context_len, start)
     if kind == "fmp":
         psize, pname = _proj_size_name(extra, size)
         if psize is None:

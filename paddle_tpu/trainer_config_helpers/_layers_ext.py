@@ -8,9 +8,8 @@ layers, and projection/operator markers are consumed by mixed_layer.
 The v2 beam-generation machinery (beam_search / GeneratedInput /
 StaticInput) lives in _generation.py, lowered onto the contrib decoder.
 Deliberately absent (documented, not stubbed): beam-aware TRAINING
-(BeamInput / cross_entropy_over_beam / SubsequenceInput); 3-D image
-layers; context_projection; and the listwise lambda_cost — all raise a
-clear error naming the replacement.
+(BeamInput / cross_entropy_over_beam / SubsequenceInput) and the
+listwise lambda_cost — both raise a clear error naming the replacement.
 """
 
 from __future__ import annotations
@@ -50,7 +49,8 @@ __all__ = [
     "grumemory", "simple_gru", "recurrent_layer", "gru_step_layer",
     "dotmul_projection", "scaling_projection", "table_projection",
     "trans_full_matrix_projection", "slice_projection", "dotmul_operator",
-    "conv_projection", "conv_operator",
+    "conv_projection", "conv_operator", "context_projection",
+    "img_conv3d_layer", "img_pool3d_layer",
     # networks composites
     "simple_attention", "sequence_conv_pool", "vgg_16_network",
 ]
@@ -597,6 +597,96 @@ def resize_layer(input, size, name=None, **kw):
 # ---------------- rnn / projections / operators ----------------
 
 
+def _to_ncdhw(input, num_channels):
+    """Recover [N, C, D, H, W] from a flat v2 data layer: declared
+    height/width (+ depth, else derived from the size) win; otherwise a
+    cube."""
+    shape = input.shape
+    if shape is not None and len(shape) >= 5:
+        return input, int(shape[1])
+    size = int(shape[-1])
+    geom = getattr(input, "_v2_geom", None) or (None, None)
+    depth = getattr(input, "_v2_depth", None)
+    c = num_channels if num_channels is not None else \
+        (3 if size % 3 == 0 else 1)
+    if geom[0]:
+        h, w = int(geom[0]), int(geom[1] or geom[0])
+        d = int(depth) if depth else size // (int(c) * h * w)
+    else:
+        d = h = w = round((size // c) ** (1.0 / 3.0))
+    if int(c) * d * h * w != size:
+        raise ValueError(
+            f"cannot recover [C,D,H,W] from size {size} with "
+            f"channels={c} depth={d} height={h} width={w}")
+    return layers.reshape(input, [-1, int(c), d, h, w]), int(c)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, name=None,
+                     num_channels=None, act=None, groups=1, stride=1,
+                     padding=0, bias_attr=None, param_attr=None,
+                     trans=False, layer_attr=None, **kw):
+    """ref layers.py img_conv3d_layer -> fluid conv3d (NCDHW)."""
+    x, _ = _to_ncdhw(input, num_channels)
+    out = layers.conv3d(
+        input=x, num_filters=int(num_filters), filter_size=filter_size,
+        stride=stride, padding=padding, groups=groups,
+        act=_act_name(_default_act(act, ReluActivation())),
+        bias_attr=bias_attr, param_attr=_param_name(param_attr))
+    _register_named(name, out)
+    return out
+
+
+def img_pool3d_layer(input, pool_size, name=None, num_channels=None,
+                     pool_type=None, stride=1, padding=0,
+                     layer_attr=None, **kw):
+    """ref layers.py img_pool3d_layer -> fluid pool3d."""
+    from . import _pool_name
+    x, _ = _to_ncdhw(input, num_channels)
+    out = layers.pool3d(input=x, pool_size=pool_size,
+                        pool_type=_pool_name(pool_type),
+                        pool_stride=stride, pool_padding=padding)
+    _register_named(name, out)
+    return out
+
+
+def context_projection(input, context_len=None, context_start=None,
+                       padding_attr=False, **kw):
+    """Concat a window of neighboring steps per position (ref layers.py
+    context_projection; math/context_project.h): out[t] =
+    [in[t+start], ..., in[t+start+len-1]] with zero padding at sequence
+    boundaries.  Lowered via the sequence_conv op with an identity
+    filter (see _lower_context_projection)."""
+    if context_len is None:
+        raise ValueError("context_projection needs context_len")
+    if padding_attr not in (False, None):
+        raise NotImplementedError(
+            "context_projection trainable boundary padding "
+            "(padding_attr) is not supported; boundaries are zero-padded")
+    start = -(int(context_len) // 2) if context_start is None \
+        else int(context_start)
+    return ("ctp", input, (int(context_len), start))
+
+
+def _lower_context_projection(x, context_len, start):
+    """The sequence_conv op IS context_project + matmul (ref
+    math/context_project.h); an identity Filter constant turns it into
+    the bare windowed concat with zero boundary padding."""
+    import numpy as np
+
+    d = int(x.shape[-1])
+    width = context_len * d
+    eye = layers.assign(np.eye(width, dtype=np.float32))
+    helper = LayerHelper("sequence_conv")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.shape = (x.shape[0], width)
+    helper.append_op(
+        type="sequence_conv", inputs={"X": [x], "Filter": [eye]},
+        outputs={"Out": [out]},
+        attrs={"contextStride": 1, "contextStart": int(start),
+               "contextLength": int(context_len)})
+    return out
+
+
 def grumemory(input, name=None, reverse=False, act=None, gate_act=None,
               param_attr=None, bias_attr=None, **kw):
     """ref layers.py grumemory: input is the pre-projected [*, 3h]
@@ -790,9 +880,6 @@ _ABSENT = {
     "cross_entropy_over_beam": "beam-aware training cost has no "
                                "counterpart; train teacher-forced",
     "lambda_cost": "listwise LTR cost has no fluid-era op; use rank_cost",
-    "context_projection": "use fluid layers.sequence_conv",
-    "img_conv3d_layer": "use fluid layers.conv3d",
-    "img_pool3d_layer": "use fluid layers.pool3d",
 }
 
 

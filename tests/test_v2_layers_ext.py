@@ -323,6 +323,49 @@ def test_conv_projection_and_operator():
     np.testing.assert_allclose(o, want.reshape(2, -1), rtol=1e-4)
 
 
+def test_context_projection_matches_numpy():
+    """context_projection: window concat with zero boundary padding."""
+    x_np = np.arange(10, dtype=np.float32).reshape(5, 2)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        ctx = tch.mixed_layer(
+            input=tch.context_projection(x, context_len=3), bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(x_np, [[2, 3]], fluid.CPUPlace())
+        (v,) = exe.run(fluid.default_main_program(), feed={"x": t},
+                       fetch_list=[ctx], return_numpy=False)
+    v = np.asarray(v)
+    assert v.shape == (5, 6)
+    # seq0 rows [0,1]: window [-1,0,1] with zeros at the boundary
+    z = np.zeros(2, np.float32)
+    np.testing.assert_allclose(
+        v[0], np.concatenate([z, x_np[0], x_np[1]]), rtol=1e-6)
+    np.testing.assert_allclose(
+        v[1], np.concatenate([x_np[0], x_np[1], z]), rtol=1e-6)
+    # seq1 rows [2,3,4]
+    np.testing.assert_allclose(
+        v[3], np.concatenate([x_np[2], x_np[3], x_np[4]]), rtol=1e-6)
+    np.testing.assert_allclose(
+        v[4], np.concatenate([x_np[3], x_np[4], z]), rtol=1e-6)
+
+
+def test_3d_image_layers():
+    rng = np.random.RandomState(14)
+    img_np = rng.rand(2, 2 * 4 * 4 * 4).astype(np.float32)
+    with _fresh():
+        img = tch.data_layer("vox", 2 * 4 * 4 * 4, height=4, width=4,
+                             depth=4)
+        conv = tch.img_conv3d_layer(img, filter_size=3, num_filters=3,
+                                    num_channels=2, padding=1)
+        pool = tch.img_pool3d_layer(conv, pool_size=2, stride=2)
+        c, p = _run({"vox": img_np}, [conv, pool])
+    assert c.shape == (2, 3, 4, 4, 4)
+    assert p.shape == (2, 3, 2, 2, 2)
+    assert np.isfinite(c).all() and np.isfinite(p).all()
+
+
 def test_trans_full_matrix_projection_ties_transposed():
     """fmp + tfmp sharing one ParamAttr name use W and W^T of the SAME
     parameter (the reference tied-autoencoder pattern)."""
@@ -401,6 +444,6 @@ def test_documented_absences_fail_loudly():
         tch.BeamInput
     with pytest.raises(NotImplementedError, match="rank_cost"):
         tch.lambda_cost
-    with pytest.raises(NotImplementedError, match="sequence_conv"):
+    with pytest.raises(NotImplementedError, match="TrainingDecoder"):
         from paddle_tpu.trainer_config_helpers import _layers_ext
-        _layers_ext.context_projection
+        _layers_ext.BeamInput
