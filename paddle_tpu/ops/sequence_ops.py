@@ -367,9 +367,11 @@ def lod_reset(ctx):
 def sequence_conv(ctx):
     """ref: sequence_conv_op.cc + math/context_project.h — gather a
     [contextLength] window of rows around each position (zero outside the
-    sequence) and project: Out = im2col(X) @ Filter."""
+    sequence) and project: Out = im2col(X) @ Filter.  Without a Filter
+    input the op returns the bare windowed concat (the context_project
+    role alone — v2 context_projection)."""
     x = ctx.input("X")
-    filt = ctx.input("Filter")
+    filt = ctx.input("Filter") if ctx.has_input("Filter") else None
     off = np.asarray(ctx.seq_offsets("X"))
     ctx_len = int(ctx.attr("contextLength"))
     ctx_start = int(ctx.attr("contextStart", -((ctx_len - 1) // 2)))
@@ -389,7 +391,7 @@ def sequence_conv(ctx):
         valid = (j >= starts) & (j < ends)
         pieces.append(xp[jnp.asarray(np.where(valid, j, total))])
     cols = jnp.concatenate(pieces, axis=1)  # [total, ctx_len*d]
-    return {"Out": cols @ filt}
+    return {"Out": cols if filt is None else cols @ filt}
 
 
 @register_op("row_conv")

@@ -287,20 +287,46 @@ def data_layer(name, size, height=None, width=None, depth=None):
     return v
 
 
-def _to_nchw(input, num_channels):
-    """Recover [N, C, H, W] from a flat v2 data layer when needed."""
+def _to_spatial(input, num_channels, rank):
+    """Recover [N, C, (D,) H, W] from a flat v2 data layer: declared
+    height/width (+depth) win, with the channel count derived from the
+    declared geometry when not given; otherwise square/cube guesses with
+    the reference's 3-channel heuristic."""
     shape = input.shape
-    if shape is not None and len(shape) >= 4:
+    if shape is not None and len(shape) >= 2 + rank:
         return input, int(shape[1])
     size = int(shape[-1])
     geom = getattr(input, "_v2_geom", None) or (None, None)
-    if num_channels is None:
-        num_channels = 3 if size % 3 == 0 else 1
+    depth = getattr(input, "_v2_depth", None)
+    c = num_channels
     if geom[0]:
         h, w = int(geom[0]), int(geom[1] or geom[0])
+        if rank == 3:
+            spatial = [int(depth) if depth else None, h, w]
+        else:
+            spatial = [h, w]
+        known = math.prod(v for v in spatial if v)
+        if c is None:
+            c = size // known if None not in spatial else \
+                (3 if size % 3 == 0 else 1)
+        missing = size // (int(c) * known)
+        spatial = [v if v else missing for v in spatial]
     else:
-        h = w = int(math.isqrt(size // num_channels))
-    return _fl.reshape(input, [-1, num_channels, h, w]), num_channels
+        if c is None:
+            c = 3 if size % 3 == 0 else 1
+        edge = int(math.isqrt(size // c)) if rank == 2 else \
+            round((size // c) ** (1.0 / 3.0))
+        spatial = [edge] * rank
+    if int(c) * math.prod(spatial) != size:
+        raise ValueError(
+            f"cannot recover [C,{'D,' if rank == 3 else ''}H,W] from "
+            f"size {size} with channels={c} spatial={spatial}")
+    return _fl.reshape(input, [-1, int(c)] + spatial), int(c)
+
+
+def _to_nchw(input, num_channels):
+    """Recover [N, C, H, W] from a flat v2 data layer when needed."""
+    return _to_spatial(input, num_channels, 2)
 
 
 # the reference DSL wraps every layer in @wrap_act_default; configs rely
@@ -325,11 +351,12 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
                    trans=False, layer_attr=None):
     act = _default_act(act, ReluActivation())
     x, _ = _to_nchw(input, num_channels)
-    return _fl.conv2d(input=x, num_filters=int(num_filters),
-                         filter_size=filter_size, stride=stride,
-                         padding=padding, groups=groups,
-                         act=_act_name(act), bias_attr=bias_attr,
-                         param_attr=_param_name(param_attr), name=name)
+    conv = _fl.conv2d_transpose if trans else _fl.conv2d
+    return conv(input=x, num_filters=int(num_filters),
+                filter_size=filter_size, stride=stride,
+                padding=padding, groups=groups,
+                act=_act_name(act), bias_attr=bias_attr,
+                param_attr=_param_name(param_attr), name=name)
 
 
 def img_pool_layer(input, pool_size, name=None, num_channels=None,

@@ -598,36 +598,22 @@ def resize_layer(input, size, name=None, **kw):
 
 
 def _to_ncdhw(input, num_channels):
-    """Recover [N, C, D, H, W] from a flat v2 data layer: declared
-    height/width (+ depth, else derived from the size) win; otherwise a
-    cube."""
-    shape = input.shape
-    if shape is not None and len(shape) >= 5:
-        return input, int(shape[1])
-    size = int(shape[-1])
-    geom = getattr(input, "_v2_geom", None) or (None, None)
-    depth = getattr(input, "_v2_depth", None)
-    c = num_channels if num_channels is not None else \
-        (3 if size % 3 == 0 else 1)
-    if geom[0]:
-        h, w = int(geom[0]), int(geom[1] or geom[0])
-        d = int(depth) if depth else size // (int(c) * h * w)
-    else:
-        d = h = w = round((size // c) ** (1.0 / 3.0))
-    if int(c) * d * h * w != size:
-        raise ValueError(
-            f"cannot recover [C,D,H,W] from size {size} with "
-            f"channels={c} depth={d} height={h} width={w}")
-    return layers.reshape(input, [-1, int(c), d, h, w]), int(c)
+    """Recover [N, C, D, H, W] from a flat v2 data layer (shared
+    geometry recovery — see _to_spatial in __init__)."""
+    from . import _to_spatial
+
+    return _to_spatial(input, num_channels, 3)
 
 
 def img_conv3d_layer(input, filter_size, num_filters, name=None,
                      num_channels=None, act=None, groups=1, stride=1,
                      padding=0, bias_attr=None, param_attr=None,
                      trans=False, layer_attr=None, **kw):
-    """ref layers.py img_conv3d_layer -> fluid conv3d (NCDHW)."""
+    """ref layers.py img_conv3d_layer -> fluid conv3d (NCDHW);
+    trans=True lowers onto conv3d_transpose (the deconv3d path)."""
     x, _ = _to_ncdhw(input, num_channels)
-    out = layers.conv3d(
+    conv = layers.conv3d_transpose if trans else layers.conv3d
+    out = conv(
         input=x, num_filters=int(num_filters), filter_size=filter_size,
         stride=stride, padding=padding, groups=groups,
         act=_act_name(_default_act(act, ReluActivation())),
@@ -668,19 +654,16 @@ def context_projection(input, context_len=None, context_start=None,
 
 
 def _lower_context_projection(x, context_len, start):
-    """The sequence_conv op IS context_project + matmul (ref
-    math/context_project.h); an identity Filter constant turns it into
-    the bare windowed concat with zero boundary padding."""
-    import numpy as np
-
+    """The sequence_conv op without a Filter input IS context_project
+    (ref math/context_project.h): the bare windowed concat with zero
+    boundary padding."""
     d = int(x.shape[-1])
     width = context_len * d
-    eye = layers.assign(np.eye(width, dtype=np.float32))
     helper = LayerHelper("sequence_conv")
     out = helper.create_variable_for_type_inference(dtype=x.dtype)
     out.shape = (x.shape[0], width)
     helper.append_op(
-        type="sequence_conv", inputs={"X": [x], "Filter": [eye]},
+        type="sequence_conv", inputs={"X": [x]},
         outputs={"Out": [out]},
         attrs={"contextStride": 1, "contextStart": int(start),
                "contextLength": int(context_len)})

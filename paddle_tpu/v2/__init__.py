@@ -17,15 +17,16 @@ from ..trainer_config_helpers import (AdamOptimizer, AvgPooling,
                                       MomentumOptimizer, ReluActivation,
                                       SigmoidActivation, SoftmaxActivation,
                                       TanhActivation)
-from . import activation, data_type, evaluator, event, image, inference, \
-    layer, master, optimizer, parameters, plot, pooling, topology, trainer
+from . import activation, attr, data_type, evaluator, event, image, \
+    inference, layer, master, op, optimizer, parameters, plot, pooling, \
+    topology, trainer
 from .inference import infer
 from .topology import Topology
 
 __all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
            "data_type", "evaluator", "event", "optimizer", "parameters",
            "trainer", "inference", "infer", "master", "plot", "topology",
-           "Topology", "image"]
+           "Topology", "image", "attr", "op"]
 
 
 def init(use_gpu=False, trainer_count=1, **kwargs):

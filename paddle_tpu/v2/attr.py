@@ -1,0 +1,23 @@
+"""v2 attr namespace (ref: python/paddle/v2/attr.py — Param/Extra/Hook
+aliases over trainer_config_helpers.attrs)."""
+
+from ..trainer_config_helpers.attrs import (ExtraAttr,  # noqa: F401
+                                            ExtraLayerAttribute,
+                                            ParameterAttribute, ParamAttr)
+
+Param = ParamAttr
+Extra = ExtraAttr
+
+
+class Hook:
+    """ref attrs.py HookAttribute (pruning hooks) — accepted for config
+    compatibility; the Fluid substrate has no parameter-hook stage."""
+
+    def __init__(self, type=None, **kwargs):  # noqa: A002
+        self.type = type
+
+
+HookAttribute = Hook
+
+__all__ = ["Param", "Extra", "Hook", "ParamAttr", "ParameterAttribute",
+           "ExtraAttr", "ExtraLayerAttribute", "HookAttribute"]

@@ -1,0 +1,35 @@
+"""v2 op namespace (ref: python/paddle/v2/op.py — elementwise math over
+layer outputs; the reference registers unary math ops and patches
+arithmetic onto LayerOutput).  Layer outputs here are fluid Variables,
+whose arithmetic is already patched (fluid math_op_patch); the unary
+functions delegate to the fluid activation layers."""
+
+from ..fluid import layers as _fl
+
+__all__ = ["exp", "log", "abs", "sigmoid", "tanh", "square", "relu",
+           "sqrt", "ceil", "floor", "reciprocal", "softmax"]
+
+
+def _unary(name):
+    fn = getattr(_fl, name)
+
+    def op(x):
+        return fn(x)
+
+    op.__name__ = name
+    op.__doc__ = f"Elementwise {name} over a layer output (ref v2/op.py)."
+    return op
+
+
+exp = _unary("exp")
+log = _unary("log")
+abs = _unary("abs")  # noqa: A001 - v2 API name
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+square = _unary("square")
+relu = _unary("relu")
+sqrt = _unary("sqrt")
+ceil = _unary("ceil")
+floor = _unary("floor")
+reciprocal = _unary("reciprocal")
+softmax = _unary("softmax")

@@ -77,6 +77,24 @@ def test_infer_from_tar_parameters(tmp_path):
     assert np.asarray(ids).shape == (2,)
 
 
+def test_attr_and_op_namespaces():
+    """v2.attr Param/Extra/Hook aliases and v2.op math over layer
+    outputs (ref v2/attr.py, v2/op.py)."""
+    assert paddle_v2.attr.Param(name="w").name == "w"
+    assert paddle_v2.attr.Extra(drop_rate=0.3).drop_rate == 0.3
+    paddle_v2.attr.Hook(type="pruning")  # accepted, inert
+    x_np = np.array([[0.5, 1.0, 2.0]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = paddle_v2.op.exp(x) + paddle_v2.op.square(x) * 2.0 - x
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (v,) = exe.run(main, feed={"x": x_np}, fetch_list=[y])
+    np.testing.assert_allclose(v, np.exp(x_np) + 2 * x_np ** 2 - x_np,
+                               rtol=1e-5)
+
+
 def test_image_transforms():
     """v2.image: resize_short/center/random crop/flip/simple_transform
     keep the reference's HWC->CHW float32 contract (PIL+numpy backed)."""

@@ -360,10 +360,13 @@ def test_3d_image_layers():
         conv = tch.img_conv3d_layer(img, filter_size=3, num_filters=3,
                                     num_channels=2, padding=1)
         pool = tch.img_pool3d_layer(conv, pool_size=2, stride=2)
-        c, p = _run({"vox": img_np}, [conv, pool])
+        deconv = tch.img_conv3d_layer(pool, filter_size=2, num_filters=2,
+                                      stride=2, trans=True)
+        c, p, dc = _run({"vox": img_np}, [conv, pool, deconv])
     assert c.shape == (2, 3, 4, 4, 4)
     assert p.shape == (2, 3, 2, 2, 2)
-    assert np.isfinite(c).all() and np.isfinite(p).all()
+    assert dc.shape == (2, 2, 4, 4, 4)  # trans=True upsamples back
+    assert all(np.isfinite(v).all() for v in (c, p, dc))
 
 
 def test_trans_full_matrix_projection_ties_transposed():
