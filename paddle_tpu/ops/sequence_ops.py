@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_grad, register_op
 
 
 def _lengths(off) -> np.ndarray:
@@ -437,3 +437,89 @@ def sequence_erase(ctx):
         new_off.append(new_off[-1] + int(keep[s:e].sum()))
     out = x[keep]
     return {"Out": jnp.asarray(out), "Out@LOD": (tuple(new_off),)}
+
+
+# ---------------------------------------------------------------------------
+# lambda_cost (LambdaRank)
+# ---------------------------------------------------------------------------
+
+
+def _lambda_per_seq(out_s, lab_s, ndcg_num, sort_size):
+    """Reference LambdaCost math for ONE sequence (legacy
+    gserver/layers/CostLayer.cpp LambdaCost::calcNDCG/calcGrad),
+    vectorized in jnp.  Returns (ndcg_scalar, lambda_grads)."""
+    m = out_s.shape[0]
+    k = min(int(ndcg_num), m)
+    ss = m if sort_size in (-1, None) else min(int(sort_size), m)
+    discounts = 1.0 / jnp.log(jnp.arange(m, dtype=jnp.float32) + 2.0)
+
+    # NDCG: gains of the top-k BY MODEL OUTPUT over the ideal top-k
+    order_by_out = jnp.argsort(-out_s)
+    gains = jnp.power(2.0, lab_s) - 1.0
+    dcg = jnp.sum((gains[order_by_out] * discounts)[:k])
+    ideal = jnp.sort(gains)[::-1]
+    max_dcg = jnp.sum((ideal * discounts)[:k])
+    # all-zero relevance: the list carries no ranking signal — NDCG 0
+    # and zero lambdas (the legacy layer CHECKs; a data guard is kinder)
+    safe_max = jnp.where(max_dcg > 0, max_dcg, 1.0)
+    ndcg = jnp.where(max_dcg > 0, dcg / safe_max, 0.0)
+
+    # lambdas: pairs (i < j) in LABEL-sorted order
+    order = jnp.argsort(-lab_s)
+    g = jnp.power(2.0, lab_s[order])          # 2^label, sorted desc
+    o = out_s[order]
+    dii = discounts[:, None] - discounts[None, :]
+    dcg_dif = (g[:, None] - g[None, :]) * dii
+    if ss < m:
+        # pairs whose j falls outside the sorted window use only 1/ln(i+2)
+        tail = (g[:, None] - g[None, :]) * discounts[:, None]
+        col = jnp.arange(m)
+        dcg_dif = jnp.where(col[None, :] >= ss, tail, dcg_dif)
+    lam = -jnp.abs(dcg_dif) / (1.0 + jnp.exp(o[:, None] - o[None, :]))
+    row = jnp.arange(m)
+    mask = (row[:, None] < ss) & (row[None, :] > row[:, None])
+    lam = jnp.where(mask & (max_dcg > 0), lam, 0.0) / safe_max
+    grad_sorted = lam.sum(axis=1) - lam.sum(axis=0)
+    inv = jnp.zeros(m, jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+    return ndcg, grad_sorted[inv]
+
+
+@register_op("lambda_cost", no_grad_inputs=("Label",))
+def lambda_cost(ctx):
+    """LambdaRank (ref legacy CostLayer.cpp LambdaCost; v2 layers.py
+    lambda_cost — absent from the fluid op set, a beyond-fluid op here).
+    Forward emits each sequence's NDCG@k replicated per row; the
+    gradient is the hand-crafted lambda pair update, attached below."""
+    x = ctx.input("X").reshape(-1)
+    lab = ctx.input("Label").reshape(-1).astype(jnp.float32)
+    off = np.asarray(ctx.seq_offsets("X"))
+    k = int(ctx.attr("NDCG_num", 5))
+    ss = int(ctx.attr("max_sort_size", -1))
+    rows = []
+    for s, e in zip(off[:-1], off[1:]):
+        s, e = int(s), int(e)
+        ndcg, _ = _lambda_per_seq(x[s:e], lab[s:e], k, ss)
+        rows.append(jnp.full((e - s,), ndcg))
+    return {"Out": jnp.concatenate(rows).reshape(-1, 1)}
+
+
+@register_grad("lambda_cost")
+def lambda_cost_grad(ctx):
+    """The reference injects the lambda gradients directly (backward
+    ignores the NDCG's own derivative).  Deviation noted: each
+    sequence's lambdas are scaled by the SUM of its rows' incoming
+    grads — the reference's implicit weight-1-per-row convention, so a
+    mean()-reduced cost weights sequences by their length over the
+    batch total."""
+    x = ctx.input("X").reshape(-1)
+    lab = ctx.input("Label").reshape(-1).astype(jnp.float32)
+    dout = ctx.input("Out@GRAD").reshape(-1)
+    off = np.asarray(ctx.seq_offsets("X"))
+    k = int(ctx.attr("NDCG_num", 5))
+    ss = int(ctx.attr("max_sort_size", -1))
+    grads = []
+    for s, e in zip(off[:-1], off[1:]):
+        s, e = int(s), int(e)
+        _, lam = _lambda_per_seq(x[s:e], lab[s:e], k, ss)
+        grads.append(lam * jnp.mean(dout[s:e]) * (e - s))
+    return {"X@GRAD": jnp.concatenate(grads).reshape(-1, 1)}

@@ -8,8 +8,8 @@ layers, and projection/operator markers are consumed by mixed_layer.
 The v2 beam-generation machinery (beam_search / GeneratedInput /
 StaticInput) lives in _generation.py, lowered onto the contrib decoder.
 Deliberately absent (documented, not stubbed): beam-aware TRAINING
-(BeamInput / cross_entropy_over_beam / SubsequenceInput) and the
-listwise lambda_cost — both raise a clear error naming the replacement.
+(BeamInput / cross_entropy_over_beam / SubsequenceInput) — raises a
+clear error naming the replacement.
 """
 
 from __future__ import annotations
@@ -19,7 +19,7 @@ from ..fluid.layer_helper import LayerHelper
 from ..fluid.param_attr import ParamAttr as _FluidParamAttr
 from . import (LinearActivation, ReluActivation,
                SigmoidActivation, _act_name, _default_act, _param_name,
-               _register_named, _to_nchw)
+               _register_named, _to_nchw, _to_spatial)
 
 __all__ = [
     # math / elementwise
@@ -37,7 +37,8 @@ __all__ = [
     # costs
     "regression_cost", "square_error_cost", "rank_cost",
     "huber_regression_cost", "huber_classification_cost", "smooth_l1_cost",
-    "sum_cost", "multi_binary_label_cross_entropy", "crf_layer",
+    "sum_cost", "multi_binary_label_cross_entropy", "lambda_cost",
+    "crf_layer",
     "crf_decoding_layer", "ctc_layer", "warp_ctc_layer", "hsigmoid",
     "nce_layer",
     # vision
@@ -436,6 +437,24 @@ def _crf_param_name(input, param_attr):
     return _param_name(param_attr) or f"crf_transition@{input.name}"
 
 
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
+                **kw):
+    """LambdaRank (ref layers.py lambda_cost; legacy CostLayer.cpp
+    LambdaCost).  ``input`` is the model's per-document score sequence,
+    ``score`` the relevance labels.  Forward reports the per-sequence
+    NDCG@k (mean over rows); the backward applies the reference's
+    hand-crafted lambda pair gradients (lambda_cost op)."""
+    helper = LayerHelper("lambda_cost", name=name)
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    out.shape = (input.shape[0], 1)
+    helper.append_op(
+        type="lambda_cost", inputs={"X": [input], "Label": [score]},
+        outputs={"Out": [out]},
+        attrs={"NDCG_num": int(NDCG_num),
+               "max_sort_size": int(max_sort_size)})
+    return _mean(out)
+
+
 def crf_layer(input, label, size=None, param_attr=None, name=None, **kw):
     """Linear-chain CRF negative log-likelihood; the transition matrix is
     name-shared with crf_decoding_layer on the same emission input."""
@@ -600,8 +619,6 @@ def resize_layer(input, size, name=None, **kw):
 def _to_ncdhw(input, num_channels):
     """Recover [N, C, D, H, W] from a flat v2 data layer (shared
     geometry recovery — see _to_spatial in __init__)."""
-    from . import _to_spatial
-
     return _to_spatial(input, num_channels, 3)
 
 
@@ -862,7 +879,6 @@ _ABSENT = {
                  "fluid.contrib.decoder TrainingDecoder",
     "cross_entropy_over_beam": "beam-aware training cost has no "
                                "counterpart; train teacher-forced",
-    "lambda_cost": "listwise LTR cost has no fluid-era op; use rank_cost",
 }
 
 
