@@ -444,27 +444,34 @@ def sequence_erase(ctx):
 # ---------------------------------------------------------------------------
 
 
-def _lambda_per_seq(out_s, lab_s, ndcg_num, sort_size):
-    """Reference LambdaCost math for ONE sequence (legacy
-    gserver/layers/CostLayer.cpp LambdaCost::calcNDCG/calcGrad),
-    vectorized in jnp.  Returns (ndcg_scalar, lambda_grads)."""
-    m = out_s.shape[0]
-    k = min(int(ndcg_num), m)
-    ss = m if sort_size in (-1, None) else min(int(sort_size), m)
+def _lambda_max_dcg(lab_s, k, m):
+    """Ideal (max) DCG@k plus its zero-relevance-safe divisor."""
     discounts = 1.0 / jnp.log(jnp.arange(m, dtype=jnp.float32) + 2.0)
-
-    # NDCG: gains of the top-k BY MODEL OUTPUT over the ideal top-k
-    order_by_out = jnp.argsort(-out_s)
     gains = jnp.power(2.0, lab_s) - 1.0
-    dcg = jnp.sum((gains[order_by_out] * discounts)[:k])
     ideal = jnp.sort(gains)[::-1]
     max_dcg = jnp.sum((ideal * discounts)[:k])
     # all-zero relevance: the list carries no ranking signal — NDCG 0
     # and zero lambdas (the legacy layer CHECKs; a data guard is kinder)
-    safe_max = jnp.where(max_dcg > 0, max_dcg, 1.0)
-    ndcg = jnp.where(max_dcg > 0, dcg / safe_max, 0.0)
+    return max_dcg, jnp.where(max_dcg > 0, max_dcg, 1.0), discounts, gains
 
-    # lambdas: pairs (i < j) in LABEL-sorted order
+
+def _lambda_ndcg(out_s, lab_s, ndcg_num):
+    """Reference LambdaCost::calcNDCG for ONE sequence."""
+    m = out_s.shape[0]
+    k = min(int(ndcg_num), m)
+    max_dcg, safe_max, discounts, gains = _lambda_max_dcg(lab_s, k, m)
+    order_by_out = jnp.argsort(-out_s)
+    dcg = jnp.sum((gains[order_by_out] * discounts)[:k])
+    return jnp.where(max_dcg > 0, dcg / safe_max, 0.0)
+
+
+def _lambda_grads(out_s, lab_s, ndcg_num, sort_size):
+    """Reference LambdaCost::calcGrad for ONE sequence, vectorized:
+    pair lambdas over (i < j) in LABEL-sorted order."""
+    m = out_s.shape[0]
+    k = min(int(ndcg_num), m)
+    ss = m if sort_size in (-1, None) else min(int(sort_size), m)
+    max_dcg, safe_max, discounts, _ = _lambda_max_dcg(lab_s, k, m)
     order = jnp.argsort(-lab_s)
     g = jnp.power(2.0, lab_s[order])          # 2^label, sorted desc
     o = out_s[order]
@@ -481,7 +488,7 @@ def _lambda_per_seq(out_s, lab_s, ndcg_num, sort_size):
     lam = jnp.where(mask & (max_dcg > 0), lam, 0.0) / safe_max
     grad_sorted = lam.sum(axis=1) - lam.sum(axis=0)
     inv = jnp.zeros(m, jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
-    return ndcg, grad_sorted[inv]
+    return grad_sorted[inv]
 
 
 @register_op("lambda_cost", no_grad_inputs=("Label",))
@@ -494,11 +501,10 @@ def lambda_cost(ctx):
     lab = ctx.input("Label").reshape(-1).astype(jnp.float32)
     off = np.asarray(ctx.seq_offsets("X"))
     k = int(ctx.attr("NDCG_num", 5))
-    ss = int(ctx.attr("max_sort_size", -1))
     rows = []
     for s, e in zip(off[:-1], off[1:]):
         s, e = int(s), int(e)
-        ndcg, _ = _lambda_per_seq(x[s:e], lab[s:e], k, ss)
+        ndcg = _lambda_ndcg(x[s:e], lab[s:e], k)
         rows.append(jnp.full((e - s,), ndcg))
     return {"Out": jnp.concatenate(rows).reshape(-1, 1)}
 
@@ -520,6 +526,6 @@ def lambda_cost_grad(ctx):
     grads = []
     for s, e in zip(off[:-1], off[1:]):
         s, e = int(s), int(e)
-        _, lam = _lambda_per_seq(x[s:e], lab[s:e], k, ss)
+        lam = _lambda_grads(x[s:e], lab[s:e], k, ss)
         grads.append(lam * jnp.mean(dout[s:e]) * (e - s))
     return {"X@GRAD": jnp.concatenate(grads).reshape(-1, 1)}

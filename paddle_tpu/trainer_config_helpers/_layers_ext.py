@@ -18,8 +18,8 @@ from ..fluid import layers
 from ..fluid.layer_helper import LayerHelper
 from ..fluid.param_attr import ParamAttr as _FluidParamAttr
 from . import (LinearActivation, ReluActivation,
-               SigmoidActivation, _act_name, _default_act, _param_name,
-               _register_named, _to_nchw, _to_spatial)
+               SigmoidActivation, TanhActivation, _act_name, _default_act,
+               _param_name, _register_named, _to_nchw, _to_spatial)
 
 __all__ = [
     # math / elementwise
@@ -51,7 +51,11 @@ __all__ = [
     "dotmul_projection", "scaling_projection", "table_projection",
     "trans_full_matrix_projection", "slice_projection", "dotmul_operator",
     "conv_projection", "conv_operator", "context_projection",
-    "img_conv3d_layer", "img_pool3d_layer",
+    "img_conv3d_layer", "img_pool3d_layer", "conv_shift_layer",
+    "linear_comb_layer", "convex_comb_layer",
+    "cross_entropy_with_selfnorm", "lstm_step_layer",
+    "gru_step_naive_layer", "selective_fc_layer",
+    "detection_output_layer", "multibox_loss_layer",
     # networks composites
     "simple_attention", "sequence_conv_pool", "vgg_16_network",
 ]
@@ -812,6 +816,190 @@ def conv_operator(img, filter, filter_size, num_filters,  # noqa: A002
     })
 
 
+def conv_shift_layer(a, b, name=None, **kw):
+    """Circular convolution out[i] = Σ_j b[j] · a[(i+j-⌊Nb/2⌋) mod Na]
+    (ref layers.py conv_shift_layer; Nb odd).  Lowered as a sum of
+    statically rolled copies of ``a`` weighted by ``b``'s columns."""
+    na, nb = int(a.shape[-1]), int(b.shape[-1])
+    if nb % 2 != 1:
+        raise ValueError(f"conv_shift_layer needs odd filter width, "
+                         f"got {nb}")
+    out = None
+    for j in range(nb):
+        shift = (j - nb // 2) % na
+        rolled = a if shift == 0 else layers.concat(
+            [layers.slice(a, axes=[1], starts=[shift], ends=[na]),
+             layers.slice(a, axes=[1], starts=[0], ends=[shift])], axis=1)
+        bj = layers.slice(b, axes=[1], starts=[j], ends=[j + 1])
+        term = layers.elementwise_mul(rolled, bj, axis=0)
+        out = term if out is None else layers.elementwise_add(out, term)
+    _register_named(name, out)
+    return out
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None, **kw):
+    """out = Σ_i w_i · v_i with weights [N, k] and vectors [N, k·size]
+    (ref layers.py linear_comb_layer)."""
+    k = int(weights.shape[-1])
+    if size is None:
+        size = int(vectors.shape[-1]) // k
+    v = layers.reshape(vectors, [-1, k, int(size)])
+    w = layers.reshape(weights, [-1, k, 1])
+    out = layers.reduce_sum(layers.elementwise_mul(v, w), dim=1)
+    _register_named(name, out)
+    return out
+
+
+convex_comb_layer = linear_comb_layer  # ref: deprecated alias
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1, **kw):
+    """CE + log(Z) + α·log(Z)² with Z the row sum of the (self-
+    normalized, not exactly summing to 1) softmax output (ref legacy
+    CostLayer.cpp MultiClassCrossEntropyWithSelfNorm:113-124); the
+    backward is the plain autodiff of this forward, which matches the
+    reference's hand-written gradient."""
+    from . import _as_label
+
+    z = layers.reduce_sum(input, dim=1, keep_dim=True)
+    logz = layers.log(z)
+    ce = layers.cross_entropy(input=input, label=_as_label(label))
+    cost = layers.elementwise_add(
+        layers.elementwise_add(ce, logz),
+        layers.scale(layers.square(logz),
+                     scale=float(softmax_selfnorm_alpha)))
+    out = _mean(cost)
+    if coeff != 1.0:
+        out = layers.scale(out, scale=float(coeff))
+    return out
+
+
+def lstm_step_layer(input, state, size=None, act=None, name=None,
+                    gate_act=None, state_act=None, bias_attr=None, **kw):
+    """One LSTM step inside a recurrent_group (ref layers.py
+    lstm_step_layer): ``input`` is the [N, 4h] pre-projection, ``state``
+    the previous CELL.  Gate layout [i, f, c, o] (self-consistent:
+    training and generation both build through this helper; loading
+    legacy C++ weights is not supported anyway).  Returns the hidden;
+    the new cell rides get_output_layer(..., 'state')."""
+    h = int(size) if size else int(state.shape[-1])
+    gate_a = _act_name(gate_act) or "sigmoid"
+    cand_a = _act_name(act) or "tanh"
+    cell_a = _act_name(state_act) or "tanh"
+    chunks = [layers.slice(input, axes=[1], starts=[k * h],
+                           ends=[(k + 1) * h]) for k in range(4)]
+    i_g = getattr(layers, gate_a)(chunks[0])
+    f_g = getattr(layers, gate_a)(chunks[1])
+    cand = getattr(layers, cand_a)(chunks[2])
+    o_g = getattr(layers, gate_a)(chunks[3])
+    new_cell = layers.elementwise_add(
+        layers.elementwise_mul(f_g, state),
+        layers.elementwise_mul(i_g, cand))
+    hidden = layers.elementwise_mul(
+        o_g, getattr(layers, cell_a)(new_cell))
+    hidden._v2_outputs = {"state": new_cell}
+    _register_named(name, hidden)
+    return hidden
+
+
+def gru_step_naive_layer(input, output_mem, size=None, name=None,
+                         act=None, gate_act=None, bias_attr=None,
+                         param_attr=None, **kw):
+    """ref layers.py gru_step_naive_layer — same math as gru_step_layer
+    (the reference variants differ only in kernel strategy)."""
+    return gru_step_layer(input, output_mem, size=size, act=act,
+                          gate_act=gate_act, name=name,
+                          param_attr=param_attr, bias_attr=bias_attr)
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       param_attr=None, bias_attr=None, **kw):
+    """ref layers.py selective_fc_layer: an fc whose output is only
+    meaningful (and, there, only computed) at selected columns.  Here
+    the full fc runs — XLA's batched matmul beats sparse gathers on
+    TPU — and the selection mask zeroes the rest, which is
+    output-equivalent."""
+    out = layers.fc(input=input, size=int(size),
+                    act=_act_name(_default_act(act, TanhActivation())),
+                    param_attr=_param_name(param_attr))
+    if select is not None:
+        out = layers.elementwise_mul(out, select)
+    _register_named(name, out)
+    return out
+
+
+def _stack_heads(parts, last_dim):
+    """Concat per-scale SSD head outputs [N, Np_i*d] into [N, Np, d].
+    Np is computed statically from the head widths so downstream
+    consumers (ssd_loss's num_prior) see a concrete prior count even
+    with a dynamic batch dimension."""
+    xs = parts if isinstance(parts, (list, tuple)) else [parts]
+    cat = xs[0] if len(xs) == 1 else layers.concat(list(xs), axis=1)
+    width = sum(int(x.shape[-1]) for x in xs)
+    return layers.reshape(cat, [-1, width // int(last_dim),
+                                int(last_dim)])
+
+
+def _priorbox_pair(priorbox):
+    """Flatten the (boxes, variances) pair from priorbox_layer (fluid
+    prior_box emits [H, W, P, 4]) into the [Np, 4] the ssd machinery
+    takes."""
+    if isinstance(priorbox, (list, tuple)) and len(priorbox) == 2:
+        boxes, variances = priorbox
+        boxes = layers.reshape(boxes, [-1, 4])
+        variances = layers.reshape(variances, [-1, 4])
+        boxes.stop_gradient = variances.stop_gradient = True
+        return boxes, variances
+    raise ValueError("priorbox must be the (boxes, variances) pair "
+                     "returned by priorbox_layer")
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           background_id=0, name=None, **kw):
+    """ref layers.py detection_output_layer -> fluid detection_output
+    (decode + class-wise NMS)."""
+    boxes, variances = _priorbox_pair(priorbox)
+    loc = _stack_heads(input_loc, 4)
+    # scores must be CLASS-major [N, C, Np] (multiclass_nms contract,
+    # ops/detection_ops.py Scores layout; the reference fluid
+    # detection_output applies the same transpose)
+    conf = layers.transpose(
+        layers.softmax(_stack_heads(input_conf, num_classes)),
+        perm=[0, 2, 1])
+    return layers.detection_output(
+        loc, conf, boxes, variances, background_label=int(background_id),
+        nms_threshold=float(nms_threshold), nms_top_k=int(nms_top_k),
+        keep_top_k=int(keep_top_k),
+        score_threshold=float(confidence_threshold))
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label,
+                        num_classes, overlap_threshold=0.5,
+                        neg_pos_ratio=3.0, neg_overlap=0.5,
+                        background_id=0, name=None, **kw):
+    """ref layers.py multibox_loss_layer -> fluid ssd_loss.  ``label``
+    is the LoD ground-truth [Ng, 5] rows of (class, x1, y1, x2, y2) —
+    the v2 data convention."""
+    boxes, variances = _priorbox_pair(priorbox)
+    loc = _stack_heads(input_loc, 4)
+    conf = _stack_heads(input_conf, num_classes)
+    gt_label = layers.cast(
+        layers.slice(label, axes=[1], starts=[0], ends=[1]), "int64")
+    gt_box = layers.slice(label, axes=[1], starts=[1], ends=[5])
+    gt_box = layers.lod_reset(gt_box, y=label)
+    gt_label = layers.lod_reset(gt_label, y=label)
+    loss = layers.ssd_loss(
+        loc, conf, gt_box, gt_label, boxes, variances,
+        background_label=int(background_id),
+        overlap_threshold=float(overlap_threshold),
+        neg_pos_ratio=float(neg_pos_ratio),
+        neg_overlap=float(neg_overlap))
+    return _mean(loss)
+
+
 # ---------------- networks composites ----------------
 
 
@@ -879,6 +1067,13 @@ _ABSENT = {
                  "fluid.contrib.decoder TrainingDecoder",
     "cross_entropy_over_beam": "beam-aware training cost has no "
                                "counterpart; train teacher-forced",
+    "sub_nested_seq_layer": "nested (lod_level=2) sequence selection has "
+                            "no counterpart; flatten with seq ops",
+    "scale_sub_region_layer": "per-sample sub-region scaling has no "
+                              "counterpart; compose a mask with compare "
+                              "ops if needed",
+    "upsample_layer": "mask-driven unpooling rides the fluid unpool op "
+                      "directly (ops/nn_ops.py)",
 }
 
 

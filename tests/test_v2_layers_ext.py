@@ -442,6 +442,119 @@ def test_vision_helpers_shapes():
     np.testing.assert_allclose(vals[4], norm, rtol=1e-4, atol=1e-5)
 
 
+def test_conv_shift_linear_comb_selfnorm():
+    rng = np.random.RandomState(15)
+    a_np = rng.rand(2, 5).astype(np.float32)
+    b_np = rng.rand(2, 3).astype(np.float32)
+    w_np = rng.rand(2, 3).astype(np.float32)
+    v_np = rng.rand(2, 12).astype(np.float32)
+    p_np = rng.rand(3, 4).astype(np.float32) + 0.1
+    y_np = rng.randint(0, 4, size=(3, 1)).astype(np.int64)
+    with _fresh():
+        a = fluid.layers.data(name="a", shape=[5], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[3], dtype="float32")
+        w = fluid.layers.data(name="w", shape=[3], dtype="float32")
+        v = fluid.layers.data(name="v", shape=[12], dtype="float32")
+        p = fluid.layers.data(name="p", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cs = tch.conv_shift_layer(a, b)
+        lc = tch.linear_comb_layer(w, v, size=4)
+        sn = tch.cross_entropy_with_selfnorm(p, y, softmax_selfnorm_alpha=0.2)
+        vals = _run({"a": a_np, "b": b_np, "w": w_np, "v": v_np,
+                     "p": p_np, "y": y_np}, [cs, lc, sn])
+    # circular conv reference
+    want_cs = np.zeros_like(a_np)
+    for i in range(5):
+        for j in range(3):
+            want_cs[:, i] += b_np[:, j] * a_np[:, (i + j - 1) % 5]
+    np.testing.assert_allclose(vals[0], want_cs, rtol=1e-5)
+    want_lc = (v_np.reshape(2, 3, 4) * w_np[:, :, None]).sum(1)
+    np.testing.assert_allclose(vals[1], want_lc, rtol=1e-5)
+    z = p_np.sum(1)
+    want_sn = (-np.log(p_np[np.arange(3), y_np.ravel()] / 1.0)
+               + np.log(z) + 0.2 * np.log(z) ** 2).mean()
+    np.testing.assert_allclose(vals[2], want_sn, rtol=1e-3)
+
+
+def test_lstm_step_inside_recurrent_group():
+    """lstm_step_layer carries cell state across steps via memory()."""
+    rng = np.random.RandomState(16)
+    h = 4
+    x_np = rng.rand(6, 4 * h).astype(np.float32)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[4 * h], dtype="float32",
+                              lod_level=1)
+
+        def step(xt):
+            cell_prev = tch.memory("cell", h)
+            hid = tch.lstm_step_layer(xt, cell_prev, size=h)
+            # link the cell memory to this step's new cell
+            tch._register_named("cell",
+                                tch.get_output_layer(hid, "state"))
+            return hid
+
+        out = tch.recurrent_group(step, input=x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        t = fluid.create_lod_tensor(x_np, [[3, 3]], fluid.CPUPlace())
+        (v,) = exe.run(fluid.default_main_program(), feed={"x": t},
+                       fetch_list=[out], return_numpy=False)
+    v = np.asarray(v)
+    assert v.shape == (6, h) and np.isfinite(v).all()
+    # numpy LSTM with the documented [i, f, c, o] layout
+    def np_step(seq):
+        c = np.zeros(h, np.float32)
+        outs = []
+        for t_ in seq:
+            i, f, cand, o = (t_[:h], t_[h:2 * h], t_[2 * h:3 * h],
+                             t_[3 * h:])
+            sig = lambda u: 1 / (1 + np.exp(-u))
+            c = sig(f) * c + sig(i) * np.tanh(cand)
+            outs.append(sig(o) * np.tanh(c))
+        return np.stack(outs)
+    want = np.concatenate([np_step(x_np[:3]), np_step(x_np[3:])])
+    np.testing.assert_allclose(v, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ssd_v2_wrappers_build_and_run():
+    """priorbox -> multibox_loss / detection_output through the v2
+    wrappers (fluid ssd machinery underneath)."""
+    rng = np.random.RandomState(17)
+    n, c, hw, ncls = 2, 8, 4, 3
+    feat_np = rng.rand(n, c * hw * hw).astype(np.float32)
+    img_np = rng.rand(n, 3 * 16 * 16).astype(np.float32)
+    with _fresh():
+        feat = tch.data_layer("feat", c * hw * hw, height=hw, width=hw)
+        img = tch.data_layer("img", 3 * 16 * 16, height=16, width=16)
+        label = fluid.layers.data(name="gt", shape=[5], dtype="float32",
+                                  lod_level=1)
+        pb = tch.priorbox_layer(feat, img, aspect_ratio=[1.0, 2.0],
+                                variance=[0.1, 0.1, 0.2, 0.2],
+                                min_size=[4.0], max_size=[8.0])
+        np_prior = hw * hw * 3  # 1 min + 1 max + 1 extra ratio
+        loc = tch.fc_layer(feat, np_prior * 4, act=tch.LinearActivation())
+        conf = tch.fc_layer(feat, np_prior * ncls,
+                            act=tch.LinearActivation())
+        loss = tch.multibox_loss_layer(loc, conf, pb, label, ncls)
+        det = tch.detection_output_layer(loc, conf, pb, ncls)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        gt = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                       [2, 0.5, 0.5, 0.9, 0.9],
+                       [1, 0.2, 0.2, 0.7, 0.7]], np.float32)
+        feed = {"feat": feat_np, "img": img_np,
+                "gt": fluid.create_lod_tensor(gt, [[2, 1]],
+                                              fluid.CPUPlace())}
+        l, d = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[loss, det], return_numpy=False)
+    assert np.isfinite(np.asarray(l)).all()
+    d = np.asarray(d)
+    assert d.shape[-1] == 6  # [label, score, x1,y1,x2,y2]
+    if d.size:  # labels are class ids, scores are post-softmax probs
+        assert d[:, 0].max() < ncls, d[:, 0]
+        assert 0.0 <= d[:, 1].min() and d[:, 1].max() <= 1.0
+
+
 def test_documented_absences_fail_loudly():
     with pytest.raises(NotImplementedError, match="TrainingDecoder"):
         tch.BeamInput
