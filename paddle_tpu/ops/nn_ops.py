@@ -514,3 +514,26 @@ def print_op(ctx):
 
 
 _PRINT_COUNTS: dict = {}
+
+
+@register_op("scale_sub_region", no_grad_inputs=("Indices",))
+def scale_sub_region(ctx):
+    """ref: legacy ScaleSubRegionLayer (v2 scale_sub_region_layer) —
+    multiply a per-sample [C, H, W] sub-box by ``scale``.  Indices rows
+    are the reference's 1-based inclusive (c1, c2, h1, h2, w1, w2)."""
+    x = ctx.input("X")              # [N, C, H, W]
+    ind = ctx.input("Indices").astype(jnp.float32)  # [N, 6]
+    scale = float(ctx.attr("scale", 1.0))
+    n, c, h, w = x.shape
+    cg = jnp.arange(c, dtype=jnp.float32)[None, :, None, None]
+    hg = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    wg = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    lo = ind[:, 0::2] - 1.0         # [N, 3] zero-based lower bounds
+    hi = ind[:, 1::2] - 1.0
+    mask = ((cg >= lo[:, 0, None, None, None])
+            & (cg <= hi[:, 0, None, None, None])
+            & (hg >= lo[:, 1, None, None, None])
+            & (hg <= hi[:, 1, None, None, None])
+            & (wg >= lo[:, 2, None, None, None])
+            & (wg <= hi[:, 2, None, None, None]))
+    return {"Out": jnp.where(mask, x * scale, x)}

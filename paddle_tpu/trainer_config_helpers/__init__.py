@@ -158,6 +158,12 @@ class SquareRootNPooling:
     fluid_name = "sqrt"
 
 
+class MaxWithMaskPooling:
+    """Max pooling that also emits the argmax mask (ref poolings.py
+    MaxWithMaskPooling) — pairs with upsample_layer's unpooling."""
+    fluid_name = "max_with_mask"
+
+
 CudnnMaxPooling = MaxPooling
 CudnnAvgPooling = AvgPooling
 
@@ -363,6 +369,35 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
                    pool_type=None, stride=1, padding=0, layer_attr=None,
                    **kwargs):
     x, _ = _to_nchw(input, num_channels)
+    if _pool_name(pool_type) == "max_with_mask":
+        # max pool + argmax mask (for upsample_layer's unpooling)
+        from ..fluid.layer_helper import LayerHelper
+
+        def _pair(v, v_y):
+            if isinstance(v, (list, tuple)):
+                return [int(v[0]), int(v[-1])]
+            return [int(v_y if v_y is not None else v), int(v)]
+
+        ky, kx = _pair(pool_size, kwargs.get("pool_size_y"))
+        sy, sx = _pair(stride, kwargs.get("stride_y"))
+        py, px = _pair(padding, kwargs.get("padding_y"))
+        helper = LayerHelper("max_pool2d_with_index", name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        mask = helper.create_variable_for_type_inference(dtype="int64")
+        mask.stop_gradient = True
+        n, c, h, w = x.shape
+        oshape = (n, c, (int(h) + 2 * py - ky) // sy + 1,
+                  (int(w) + 2 * px - kx) // sx + 1)
+        out.shape = mask.shape = oshape
+        helper.append_op(
+            type="max_pool2d_with_index", inputs={"X": [x]},
+            outputs={"Out": [out], "Mask": [mask]},
+            attrs={"ksize": [ky, kx], "strides": [sy, sx],
+                   "paddings": [py, px]})
+        out._v2_outputs = {"mask": mask}
+        out._v2_pool_geom = (int(h), int(w))
+        _register_named(name, out)
+        return out
     return _fl.pool2d(input=x, pool_size=pool_size,
                          pool_type=_pool_name(pool_type),
                          pool_stride=stride, pool_padding=padding)
@@ -837,7 +872,7 @@ __all__ += [
     "AbsActivation", "SquareActivation", "SqrtActivation",
     "ReciprocalActivation", "BReluActivation", "SoftReluActivation",
     "STanhActivation", "SquareRootNPooling", "CudnnMaxPooling",
-    "CudnnAvgPooling",
+    "CudnnAvgPooling", "MaxWithMaskPooling",
 ]
 
 # --- extended layer surface (costs, seq ops, vision, projections, ---
@@ -851,11 +886,12 @@ __all__ += list(_ext_all)
 
 # --- v2 generation machinery (beam_search / StaticInput / GeneratedInput
 # — ref layers.py beam_search; lowered onto the contrib decoder) ---------
-from ._generation import (GeneratedInput, GenerationResult,  # noqa: E402
-                          StaticInput, beam_search)
+from ._generation import (BaseGeneratedInput, GeneratedInput,  # noqa: E402
+                          GenerationResult, StaticInput, beam_search)
+from .framework_types import LayerOutput  # noqa: E402
 
 __all__ += ["beam_search", "StaticInput", "GeneratedInput",
-            "GenerationResult"]
+            "BaseGeneratedInput", "GenerationResult", "LayerOutput"]
 
 # Reference-compatible submodule import paths (paddle.trainer_config_
 # helpers.{layers,networks,activations,poolings,attrs,optimizers}).

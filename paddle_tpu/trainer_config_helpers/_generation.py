@@ -23,8 +23,8 @@ from ..fluid import layers as _fl
 from ..fluid import unique_name
 from ..fluid.contrib.decoder import BeamSearchDecoder, InitState, StateCell
 
-__all__ = ["StaticInput", "GeneratedInput", "beam_search",
-           "GenerationResult"]
+__all__ = ["StaticInput", "GeneratedInput", "BaseGeneratedInput",
+           "beam_search", "GenerationResult"]
 
 
 class StaticInput:
@@ -37,7 +37,12 @@ class StaticInput:
         self.size = size
 
 
-class GeneratedInput:
+class BaseGeneratedInput:
+    """Base marker for generated inputs (ref layers.py
+    BaseGeneratedInput)."""
+
+
+class GeneratedInput(BaseGeneratedInput):
     """The fed-back token: embedding of the previous step's output.
     ``embedding_name`` shares the parameter with the training-time target
     embedding so trained weights drive generation."""

@@ -55,7 +55,10 @@ __all__ = [
     "linear_comb_layer", "convex_comb_layer",
     "cross_entropy_with_selfnorm", "lstm_step_layer",
     "gru_step_naive_layer", "selective_fc_layer",
-    "detection_output_layer", "multibox_loss_layer",
+    "detection_output_layer", "multibox_loss_layer", "upsample_layer",
+    "scale_sub_region_layer",
+    # structural markers
+    "LayerType", "AggregateLevel", "ExpandLevel", "layer_support",
     # networks composites
     "simple_attention", "sequence_conv_pool", "vgg_16_network",
 ]
@@ -1000,6 +1003,108 @@ def multibox_loss_layer(input_loc, input_conf, priorbox, label,
     return _mean(loss)
 
 
+def upsample_layer(input, name=None, scale=None, scale_y=None,
+                   upsample_size=None, upsample_size_y=None,
+                   pad_out_x=False, pad_out_y=False, **kw):
+    """The DePooling process (ref layers.py upsample_layer): input is
+    [data_layer, max-with-mask pool layer]; each pooled value scatters
+    back to the position its max came from (fluid unpool op).  The mask
+    encodes flat positions in the POOL-INPUT plane, so that plane is the
+    only valid output geometry — a mismatching scale/upsample_size/pad
+    request raises instead of silently corrupting the scatter."""
+    data, pooled = input
+    mask = getattr(pooled, "_v2_outputs", {}).get("mask")
+    geom = getattr(pooled, "_v2_pool_geom", None)
+    if mask is None or geom is None:
+        raise ValueError(
+            "upsample_layer's second input must be an img_pool_layer "
+            "with pool_type=MaxWithMaskPooling()")
+    in_h, in_w = geom
+    if upsample_size is not None:
+        req_h = int(upsample_size_y or upsample_size)
+        req_w = int(upsample_size)
+    elif scale is not None:
+        req_h = int(data.shape[2]) * int(scale_y or scale) \
+            + (1 if pad_out_y else 0)
+        req_w = int(data.shape[3]) * int(scale) + (1 if pad_out_x else 0)
+    else:
+        req_h, req_w = in_h, in_w
+    if (req_h, req_w) != (in_h, in_w):
+        raise ValueError(
+            f"upsample_layer output must match the pool input plane "
+            f"({in_h}x{in_w}) that the mask indexes; the given scale/"
+            f"upsample_size/pad_out imply {req_h}x{req_w}")
+    helper = LayerHelper("unpool", name=name)
+    out = helper.create_variable_for_type_inference(dtype=data.dtype)
+    out.shape = (data.shape[0], data.shape[1], in_h, in_w)
+    helper.append_op(
+        type="unpool", inputs={"X": [data], "Indices": [mask]},
+        outputs={"Out": [out]},
+        attrs={"unpooled_height": in_h, "unpooled_width": in_w})
+    _register_named(name, out)
+    return out
+
+
+def scale_sub_region_layer(input, indices, value, name=None, **kw):
+    """Scale a per-sample [C, H, W] sub-box by ``value`` (ref layers.py
+    scale_sub_region_layer; indices rows are the reference's 1-based
+    inclusive (c1, c2, h1, h2, w1, w2))."""
+    x, _ = _to_nchw(input, None)
+    helper = LayerHelper("scale_sub_region", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.shape = tuple(x.shape)
+    helper.append_op(
+        type="scale_sub_region", inputs={"X": [x], "Indices": [indices]},
+        outputs={"Out": [out]}, attrs={"scale": float(value)})
+    _register_named(name, out)
+    return out
+
+
+# ---------------- structural markers (ref layers.py __all__) ----------
+
+
+class LayerType:
+    """Layer-type name constants (ref layers.py LayerType).  The fluid
+    substrate types layers by their emitted ops; the names survive for
+    config compatibility."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    CONV_LAYER = "conv"
+    POOL_LAYER = "pool"
+    BATCH_NORM_LAYER = "batch_norm"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    COST = "cost"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str)
+
+
+class AggregateLevel:
+    """Sequence aggregation level (ref layers.py AggregateLevel)."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_TIMESTEP = TO_NO_SEQUENCE   # deprecated alias
+    EACH_SEQUENCE = TO_SEQUENCE      # deprecated alias
+
+
+class ExpandLevel:
+    """Expansion level (ref layers.py ExpandLevel)."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE  # deprecated alias
+
+
+def layer_support(*attrs):
+    """ref layers.py layer_support decorator — attribute-support
+    bookkeeping for the proto generator; behavior rides the helpers
+    themselves here, so this is the identity decorator."""
+    def deco(fn):
+        return fn
+    return deco
+
+
 # ---------------- networks composites ----------------
 
 
@@ -1069,11 +1174,6 @@ _ABSENT = {
                                "counterpart; train teacher-forced",
     "sub_nested_seq_layer": "nested (lod_level=2) sequence selection has "
                             "no counterpart; flatten with seq ops",
-    "scale_sub_region_layer": "per-sample sub-region scaling has no "
-                              "counterpart; compose a mask with compare "
-                              "ops if needed",
-    "upsample_layer": "mask-driven unpooling rides the fluid unpool op "
-                      "directly (ops/nn_ops.py)",
 }
 
 

@@ -1,0 +1,8 @@
+"""v2 structural type aliases.  The reference's LayerOutput (config_base
+.py) is the handle every layer helper returns; on this substrate the
+handle IS the fluid Variable, so the name is a true alias — isinstance
+checks in ported configs keep working."""
+
+from ..fluid.framework import Variable as LayerOutput  # noqa: F401
+
+__all__ = ["LayerOutput"]

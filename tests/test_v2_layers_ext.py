@@ -555,6 +555,56 @@ def test_ssd_v2_wrappers_build_and_run():
         assert 0.0 <= d[:, 1].min() and d[:, 1].max() <= 1.0
 
 
+def test_upsample_and_scale_sub_region():
+    """MaxWithMask pooling -> upsample (unpool) round-trips max values
+    to their argmax positions; scale_sub_region scales per-sample
+    boxes."""
+    rng = np.random.RandomState(18)
+    img_np = rng.rand(2, 1 * 4 * 4).astype(np.float32)
+    idx_np = np.array([[1, 1, 1, 2, 1, 2],      # c1..w2, 1-based incl.
+                       [1, 1, 3, 4, 3, 4]], np.float32)
+    with _fresh():
+        img = tch.data_layer("img", 16, height=4, width=4)
+        pooled = tch.img_pool_layer(img, pool_size=2, stride=2,
+                                    num_channels=1,
+                                    pool_type=tch.MaxWithMaskPooling())
+        up = tch.upsample_layer([pooled, pooled], scale=2)
+        ind = fluid.layers.data(name="ind", shape=[6], dtype="float32")
+        ssr = tch.scale_sub_region_layer(img, ind, value=3.0)
+        p, u, s = _run({"img": img_np, "ind": idx_np}, [pooled, up, ssr])
+    x = img_np.reshape(2, 1, 4, 4)
+    # pooled max values scatter back to their argmax positions
+    assert u.shape == (2, 1, 4, 4)
+    assert np.allclose(np.sort(u[u != 0]), np.sort(p.ravel()))
+    # each 2x2 window's max survives at its original location
+    for n in range(2):
+        for i in range(2):
+            for j in range(2):
+                win = x[n, 0, 2*i:2*i+2, 2*j:2*j+2]
+                uw = u[n, 0, 2*i:2*i+2, 2*j:2*j+2]
+                assert np.isclose(uw.max(), win.max())
+    # scale_sub_region: sample 0 scales rows 0-1 x cols 0-1; sample 1
+    # scales rows 2-3 x cols 2-3
+    want = x.copy()
+    want[0, 0, 0:2, 0:2] *= 3.0
+    want[1, 0, 2:4, 2:4] *= 3.0
+    np.testing.assert_allclose(s, want, rtol=1e-6)
+
+
+def test_structural_markers():
+    assert tch.AggregateLevel.TO_SEQUENCE == "seq"
+    assert tch.ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
+    assert tch.LayerType.is_layer_type("fc")
+
+    @tch.layer_support("drop_rate")
+    def f(x):
+        return x
+    assert f(3) == 3
+    with _fresh():
+        x = tch.data_layer("x", 4)
+        assert isinstance(x, tch.LayerOutput)
+
+
 def test_documented_absences_fail_loudly():
     with pytest.raises(NotImplementedError, match="TrainingDecoder"):
         tch.BeamInput
