@@ -33,6 +33,8 @@ EAGER_OPS = {
     "multiclass_nms",
     # removes rows by VALUE: output row count depends on the data
     "sequence_erase",
+    # selects inner subsequences by runtime index values
+    "sub_nested_seq",
     # filesystem side effects need concrete values (save_op.cc etc.)
     "save", "load", "save_combine", "load_combine", "delete_var",
     # Faster-RCNN sampling/proposal ops: data-dependent counts + host RNG

@@ -529,3 +529,59 @@ def lambda_cost_grad(ctx):
         lam = _lambda_grads(x[s:e], lab[s:e], k, ss)
         grads.append(lam * jnp.mean(dout[s:e]) * (e - s))
     return {"X@GRAD": jnp.concatenate(grads).reshape(-1, 1)}
+
+
+@register_op("sub_nested_seq", no_grad_inputs=("SelectedIndices",))
+def sub_nested_seq(ctx):
+    """Trim a NESTED (2-level) sequence to the selected inner sequences
+    (ref: v2 sub_nested_seq_layer / legacy SubNestedSequenceLayer).  For
+    each outer sequence, SelectedIndices' row values pick which inner
+    subsequences survive, in the given order; the output is a plain
+    1-level sequence of the survivors.  Output row count depends on the
+    DATA, so this is an eager host op (array_ops.EAGER_OPS)."""
+    x = np.asarray(ctx.input("X"))
+    gather, new_off = _sub_nested_gather(ctx)
+    return {"Out": jnp.asarray(x[gather]),
+            "Out@LOD": (tuple(new_off),)}
+
+
+def _sub_nested_gather(ctx):
+    """Shared forward/backward index walk for sub_nested_seq."""
+    sel = np.asarray(ctx.input("SelectedIndices")).reshape(-1).astype(np.int64)
+    lod = ctx.in_lod("X")
+    if not lod or len(lod) < 2:
+        raise ValueError("sub_nested_seq: X must be a 2-level nested "
+                         "sequence (feed a LoDTensor with lod_level=2)")
+    outer, inner = np.asarray(lod[0]), np.asarray(lod[1])
+    sel_off = ctx.seq_offsets("SelectedIndices")
+    if len(sel_off) - 1 != len(outer) - 1:
+        raise ValueError(
+            f"sub_nested_seq: SelectedIndices has {len(sel_off) - 1} "
+            f"sequences but X has {len(outer) - 1} outer sequences")
+    rows, new_off = [], [0]
+    for o in range(len(outer) - 1):
+        n_inner = int(outer[o + 1] - outer[o])
+        for idx in sel[int(sel_off[o]):int(sel_off[o + 1])]:
+            if not 0 <= idx < n_inner:
+                raise ValueError(
+                    f"sub_nested_seq: index {int(idx)} out of range for "
+                    f"outer sequence {o} with {n_inner} subsequences")
+            g = int(outer[o]) + int(idx)
+            s, e = int(inner[g]), int(inner[g + 1])
+            rows.append(np.arange(s, e))
+            new_off.append(new_off[-1] + (e - s))
+    gather = np.concatenate(rows) if rows else np.zeros((0,), np.int64)
+    return gather, new_off
+
+
+@register_grad("sub_nested_seq")
+def sub_nested_seq_grad(ctx):
+    """Scatter the output grads back to the selected rows (the legacy
+    SubNestedSequenceLayer backprops through its gather the same way).
+    Runs eagerly like the forward, so the indices are concrete."""
+    x = np.asarray(ctx.input("X"))
+    dout = np.asarray(ctx.input("Out@GRAD"))
+    gather, _ = _sub_nested_gather(ctx)
+    dx = np.zeros_like(x)
+    np.add.at(dx, gather, dout)
+    return {"X@GRAD": jnp.asarray(dx)}

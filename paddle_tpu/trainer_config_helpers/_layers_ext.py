@@ -56,7 +56,7 @@ __all__ = [
     "cross_entropy_with_selfnorm", "lstm_step_layer",
     "gru_step_naive_layer", "selective_fc_layer",
     "detection_output_layer", "multibox_loss_layer", "upsample_layer",
-    "scale_sub_region_layer",
+    "scale_sub_region_layer", "sub_nested_seq_layer",
     # structural markers
     "LayerType", "AggregateLevel", "ExpandLevel", "layer_support",
     # networks composites
@@ -1172,8 +1172,6 @@ _ABSENT = {
                  "fluid.contrib.decoder TrainingDecoder",
     "cross_entropy_over_beam": "beam-aware training cost has no "
                                "counterpart; train teacher-forced",
-    "sub_nested_seq_layer": "nested (lod_level=2) sequence selection has "
-                            "no counterpart; flatten with seq ops",
 }
 
 
@@ -1187,3 +1185,19 @@ def _absent_getattr(attr):
 
 
 __getattr__ = _absent_getattr
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None, **kw):
+    """Trim a nested (lod_level=2) sequence to the inner subsequences
+    picked by ``selected_indices`` (ref layers.py sub_nested_seq_layer;
+    legacy SubNestedSequenceLayer).  Runs as an eager host op — the
+    output row count depends on the selection values."""
+    helper = LayerHelper("sub_nested_seq", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="sub_nested_seq",
+        inputs={"X": [input], "SelectedIndices": [selected_indices]},
+        outputs={"Out": [out]})
+    _register_named(name, out)
+    return out

@@ -591,6 +591,64 @@ def test_upsample_and_scale_sub_region():
     np.testing.assert_allclose(s, want, rtol=1e-6)
 
 
+def test_sub_nested_seq_selects_inner_sequences():
+    """lod_level=2 input trimmed to the selected subsequences per outer
+    sequence (eager host op — output rows depend on the selection)."""
+    x_np = np.arange(14, dtype=np.float32).reshape(7, 2)
+    # outer seq 0 has inner lens [2, 1]; outer seq 1 has [3, 1]
+    lod2 = [[2, 2], [2, 1, 3, 1]]
+    sel_np = np.array([[1], [0]], np.int64)  # pick inner#1 of 0, #0 of 1
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=2)
+        sel = fluid.layers.data(name="sel", shape=[1], dtype="int64",
+                                lod_level=1)
+        out = tch.sub_nested_seq_layer(x, sel)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"x": fluid.create_lod_tensor(x_np, lod2, fluid.CPUPlace()),
+                "sel": fluid.create_lod_tensor(sel_np, [[1, 1]],
+                                               fluid.CPUPlace())}
+        (v,) = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[out], return_numpy=False)
+    # inner#1 of outer 0 = row 2; inner#0 of outer 1 = rows 3,4,5
+    np.testing.assert_allclose(np.asarray(v), x_np[[2, 3, 4, 5]],
+                               rtol=1e-6)
+    assert v.recursive_sequence_lengths()[-1] == [1, 3]
+
+
+def test_sub_nested_seq_trains_through():
+    """Gradients flow back through the selection gather (the legacy
+    layer backprops; a parameterized producer must receive grads)."""
+    x_np = np.arange(14, dtype=np.float32).reshape(7, 2)
+    lod2 = [[2, 2], [2, 1, 3, 1]]
+    sel_np = np.array([[1], [0]], np.int64)
+    with _fresh():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=2)
+        sel = fluid.layers.data(name="sel", shape=[1], dtype="int64",
+                                lod_level=1)
+        h = fluid.layers.fc(x, size=2, bias_attr=False,
+                            param_attr="sns_w")
+        h = fluid.layers.lod_reset(h, y=x)
+        out = tch.sub_nested_seq_layer(h, sel)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+        gvar = fluid.default_main_program().global_block().var("sns_w@GRAD")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"x": fluid.create_lod_tensor(x_np, lod2, fluid.CPUPlace()),
+                "sel": fluid.create_lod_tensor(sel_np, [[1, 1]],
+                                               fluid.CPUPlace())}
+        l, g = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[loss, gvar])
+    g = np.asarray(g)
+    assert np.isfinite(np.asarray(l)).all()
+    # dL/dW = sum over SELECTED rows (2..5) of x_row outer 1/(4*2)
+    want = (x_np[[2, 3, 4, 5]].sum(0) / 8.0)[:, None] * np.ones((1, 2))
+    np.testing.assert_allclose(g, want, rtol=1e-5)
+
+
 def test_structural_markers():
     assert tch.AggregateLevel.TO_SEQUENCE == "seq"
     assert tch.ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
