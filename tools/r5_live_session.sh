@@ -58,6 +58,15 @@ if have "$HVF" framework; then LOG "skip framework (already captured)"; else
   wait_alive
 fi
 
+# Stage 1c: per-stage ResNet geometry probe (which stage loses MFU).
+if have docs/PROBE_r05_stages.jsonl head; then LOG "skip stage probe"; else
+  LOG "stage resnet stages"
+  PROBE_SINK=docs/PROBE_r05_stages.jsonl timeout 1500 \
+    python tools/resnet_stage_probe.py
+  LOG "stage resnet stages rc=$?"
+  wait_alive
+fi
+
 # Stage 2: does Mosaic/Pallas compile over the tunnel?
 if [ -s docs/PROBE_r05_flash.jsonl ]; then LOG "skip flash probe"; else
   LOG "stage flash"

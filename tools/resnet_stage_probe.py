@@ -1,0 +1,210 @@
+"""Per-stage ResNet-50 MFU probe (round-5 isolation #3).
+
+The train-step-structure ablation cleared BN/backward/momentum (66-89%
+MFU on synthetic uniform chains) and the conv-fusion probe cleared
+elementwise fusion (147 TFLOPs), yet the full ResNet-50 step sits at
+~21-27 TFLOPs.  The remaining suspects are the REAL geometry's stages.
+This probe jits each piece of the network in isolation — stem (7x7/2 +
+maxpool), stage1..4 bottleneck groups, head (pool+fc) — as its own
+fwd+bwd step at bs256, and reports per-stage TFLOPs against each
+stage's analytic FLOPs, so the MFU sink is localized to a stage (or
+shown to be none of them, pointing at whole-program scheduling).
+
+Usage: python tools/resnet_stage_probe.py [BATCH STEPS]
+PROBE_PLATFORM=cpu for smoke runs (tiny shapes).
+PROBE_SINK=path.jsonl appends result lines (survives kills).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("PROBE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SMOKE = os.environ.get("PROBE_PLATFORM") == "cpu"
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else (4 if SMOKE else 256)
+STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else (2 if SMOKE else 12)
+DN = ("NCHW", "OIHW", "NCHW")
+BLOCKS = [3, 4, 6, 3]
+WIDTHS = [64, 128, 256, 512]
+
+
+def emit(**kw):
+    line = json.dumps(kw)
+    print(line, flush=True)
+    sink = os.environ.get("PROBE_SINK")
+    if sink:
+        try:
+            with open(sink, "a") as f:
+                f.write(line + "\n")
+        except OSError as e:
+            print(f"# PROBE_SINK write failed: {e}", flush=True)
+
+
+def note(msg):
+    print(f"# {msg} [{time.strftime('%H:%M:%S')}]", flush=True)
+
+
+def conv(x, w, stride):
+    return lax.conv_general_dilated(
+        x, w.astype(jnp.bfloat16), (stride, stride), "SAME",
+        dimension_numbers=DN)
+
+
+def bn_relu(x, g, b, relu=True):
+    xf = jnp.float32(x)
+    mean = xf.mean(axis=(0, 2, 3), keepdims=True)
+    var = xf.var(axis=(0, 2, 3), keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + 1e-5)
+    y = (y * g[None, :, None, None] + b[None, :, None, None]).astype(
+        jnp.bfloat16)
+    return jax.nn.relu(y) if relu else y
+
+
+def make_cb(key, cin, cout, k):
+    kw, key = jax.random.split(key)
+    return {"w": jax.random.normal(kw, (cout, cin, k, k), jnp.float32)
+            * np.sqrt(2.0 / (cin * k * k)),
+            "g": jnp.ones((cout,), jnp.float32),
+            "b": jnp.zeros((cout,), jnp.float32)}, key
+
+
+def conv_flops(n, cin, cout, k, h_out, w_out):
+    return 2.0 * n * cin * cout * k * k * h_out * w_out
+
+
+def stage_fn(params, x, si):
+    for bi in range(BLOCKS[si]):
+        blk = params[f"b{bi}"]
+        stride = 2 if (bi == 0 and si > 0) else 1
+        short = x
+        if "sc" in blk:
+            short = bn_relu(conv(x, blk["sc"]["w"], stride),
+                            blk["sc"]["g"], blk["sc"]["b"], relu=False)
+        y = bn_relu(conv(x, blk["c1"]["w"], stride), blk["c1"]["g"],
+                    blk["c1"]["b"])
+        y = bn_relu(conv(y, blk["c2"]["w"], 1), blk["c2"]["g"],
+                    blk["c2"]["b"])
+        y = bn_relu(conv(y, blk["c3"]["w"], 1), blk["c3"]["g"],
+                    blk["c3"]["b"], relu=False)
+        x = jax.nn.relu(short + y)
+    return x
+
+
+def make_stage_params(key, si, cin):
+    width = WIDTHS[si]
+    params = {}
+    for bi in range(BLOCKS[si]):
+        blk = {}
+        blk["c1"], key = make_cb(key, cin, width, 1)
+        blk["c2"], key = make_cb(key, width, width, 3)
+        blk["c3"], key = make_cb(key, width, width * 4, 1)
+        if bi == 0:
+            blk["sc"], key = make_cb(key, cin, width * 4, 1)
+        params[f"b{bi}"] = blk
+        cin = width * 4
+    return params, key, cin
+
+
+def stage_flops(si, n, hw_in, cin):
+    """Analytic fwd conv FLOPs of stage si with input [n,cin,hw,hw]."""
+    total = 0.0
+    width = WIDTHS[si]
+    hw = hw_in
+    for bi in range(BLOCKS[si]):
+        stride = 2 if (bi == 0 and si > 0) else 1
+        hw_out = hw // stride
+        if bi == 0:
+            total += conv_flops(n, cin, width * 4, 1, hw_out, hw_out)
+        total += conv_flops(n, cin, width, 1, hw_out, hw_out)
+        total += conv_flops(n, width, width, 3, hw_out, hw_out)
+        total += conv_flops(n, width, width * 4, 1, hw_out, hw_out)
+        cin = width * 4
+        hw = hw_out
+    return total, hw, cin
+
+
+def timed_step(fn, params, x, flops_fwd, label):
+    def loss_fn(p, inp):
+        return jnp.float32(fn(p, inp)).mean()
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    t0 = time.time()
+    out = step(params, x)
+    jax.block_until_ready(out)
+    compile_s = time.time() - t0
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = step(params, x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    # train ≈ 3x fwd conv FLOPs (bench accounting)
+    tflops = 3.0 * flops_fwd * STEPS / dt / 1e12
+    emit(variant=label, ms_per_step=round(dt / STEPS * 1e3, 2),
+         tflops=round(tflops, 1), compile_s=round(compile_s, 1),
+         device=jax.devices()[0].platform)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    hw = 32 if SMOKE else 224
+    key = jax.random.PRNGKey(0)
+
+    # stem: 7x7/2 conv + 3x3/2 maxpool
+    note("stem")
+    stem, key = make_cb(key, 3, 64, 7)
+
+    def stem_fn(p, x):
+        y = bn_relu(conv(x, p["w"], 2), p["g"], p["b"])
+        return lax.reduce_window(y, -jnp.inf, lax.max, (1, 1, 3, 3),
+                                 (1, 1, 2, 2), "SAME")
+
+    x = jnp.asarray(rng.normal(size=(BATCH, 3, hw, hw)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    timed_step(stem_fn, stem, x,
+               conv_flops(BATCH, 3, 64, 7, hw // 2, hw // 2), "stem")
+
+    hw_s, cin = hw // 4, 64
+    for si in range(4):
+        note(f"stage{si + 1}")
+        params, key, cout = make_stage_params(key, si, cin)
+        x = jnp.asarray(rng.normal(
+            size=(BATCH, cin, hw_s, hw_s)).astype(np.float32)
+        ).astype(jnp.bfloat16)
+        flops, hw_out, _ = stage_flops(si, BATCH, hw_s, cin)
+        timed_step(functools.partial(stage_fn, si=si), params, x, flops,
+                   f"stage{si + 1}_{hw_s}px_c{cin}")
+        hw_s, cin = hw_out, cout
+
+    # head: global pool + fc
+    note("head")
+    kfc, key = jax.random.split(key)
+    head = {"w": jax.random.normal(kfc, (2048, 1000), jnp.float32) * 0.01}
+
+    def head_fn(p, x):
+        pooled = jnp.float32(x).mean(axis=(2, 3))
+        return pooled @ p["w"]
+
+    x = jnp.asarray(rng.normal(
+        size=(BATCH, 2048 if not SMOKE else cin, hw_s, hw_s))
+        .astype(np.float32)).astype(jnp.bfloat16)
+    if SMOKE:
+        head["w"] = jnp.zeros((cin, 10), jnp.float32)
+    timed_step(head_fn, head, x,
+               2.0 * BATCH * (2048 if not SMOKE else cin)
+               * (1000 if not SMOKE else 10), "head")
+
+
+if __name__ == "__main__":
+    main()
