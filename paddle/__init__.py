@@ -1,0 +1,62 @@
+"""Drop-in ``paddle`` namespace: reference scripts run UNCHANGED.
+
+The real package is ``paddle_tpu``; a meta-path finder aliases EVERY
+``paddle.X`` import to the already-imported ``paddle_tpu.X`` module
+object — the same instance, so module-level state (default programs,
+scopes, registries) is shared and ``import paddle.fluid.framework``
+can never re-execute the source as a duplicate module.
+"""
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import sys as _sys
+
+import paddle_tpu as _impl
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    """paddle.X -> the paddle_tpu.X module instance, for any depth."""
+
+    _prefix = "paddle."
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(self._prefix):
+            return None
+        return importlib.machinery.ModuleSpec(fullname, self,
+                                              is_package=True)
+
+    def create_module(self, spec):
+        target = "paddle_tpu." + spec.name[len(self._prefix):]
+        module = importlib.import_module(target)
+        # the import machinery rewrites __spec__/__loader__ on the module
+        # it gets back; stash the canonical identity so exec_module can
+        # restore it (the alias must not mutate the shared instance)
+        spec._alias_identity = (module.__spec__, module.__loader__,
+                                module.__package__, module.__name__)
+        return module
+
+    def exec_module(self, module):
+        spec = module.__spec__
+        ident = getattr(spec, "_alias_identity", None)
+        if ident is not None:
+            (module.__spec__, module.__loader__,
+             module.__package__, module.__name__) = ident
+
+
+_sys.meta_path.insert(0, _AliasFinder())
+
+from paddle_tpu import *  # noqa: E402,F401,F403
+
+# eager attributes for the paths scripts touch without an import statement
+fluid = importlib.import_module("paddle.fluid")
+v2 = importlib.import_module("paddle.v2")
+reader = importlib.import_module("paddle.reader")
+dataset = importlib.import_module("paddle.dataset")
+trainer_config_helpers = importlib.import_module(
+    "paddle.trainer_config_helpers")
+batch = _impl.batch
+
+__version__ = _impl.__version__
+init = v2.init
+infer = v2.infer

@@ -6,7 +6,7 @@ computations (jit/pjit); parallelism is SPMD over a ``jax.sharding.Mesh``
 with collectives over ICI.  See SURVEY.md for the layer-by-layer mapping.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.6.0"
 
 # Fluid's dtype contract is 64-bit-heavy (labels/ids are int64, VarDesc
 # promises int64/float64 kinds — ref framework.proto:104), and jax's default

@@ -1,0 +1,79 @@
+"""The drop-in ``paddle`` namespace: a script written against the
+reference imports (`import paddle.v2 as paddle`, `import paddle.fluid
+as fluid`, `from paddle.trainer_config_helpers import *`) runs with ZERO
+edits — not even an import swap."""
+
+import numpy as np
+
+
+def test_reference_style_v2_script_runs_unchanged():
+    import paddle.v2 as paddle
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        paddle.init(use_gpu=False, trainer_count=1)
+        images = paddle.layer.data(
+            name="pixel", type=paddle.data_type.dense_vector(64))
+        label = paddle.layer.data(
+            name="label", type=paddle.data_type.integer_value(4))
+        hidden = paddle.layer.fc(input=images, size=16,
+                                 act=paddle.activation.Relu())
+        predict = paddle.layer.fc(input=hidden, size=4,
+                                  act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(input=predict, label=label)
+        parameters = paddle.parameters.create(cost)
+        optimizer = paddle.optimizer.Momentum(momentum=0.9,
+                                              learning_rate=0.1)
+        trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                     update_equation=optimizer)
+        rng = np.random.RandomState(0)
+        w = rng.normal(size=(64, 4)).astype(np.float32)
+
+        def reader():
+            for _ in range(12):
+                batch = []
+                for _ in range(16):
+                    x = rng.normal(size=(64,)).astype(np.float32)
+                    batch.append((x, int(np.argmax(x @ w))))
+                yield batch
+
+        costs = []
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                costs.append(e.cost)
+
+        trainer.train(reader=reader, num_passes=2, event_handler=handler,
+                      feeding={"pixel": 0, "label": 1})
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_deep_imports_share_module_instances():
+    """Any-depth paddle.* import yields the SAME module instance as
+    paddle_tpu.* — no duplicated module state (default programs etc.)."""
+    import paddle  # noqa: F401
+    import paddle.fluid.framework as pf
+    import paddle_tpu.fluid.framework as tf
+    assert pf is tf
+    assert pf.default_main_program() is tf.default_main_program()
+    import paddle.fluid.contrib.decoder as pd
+    import paddle_tpu.fluid.contrib.decoder as td
+    assert pd is td
+    import paddle.fluid.core as pc
+    import paddle_tpu.fluid.core as tc
+    assert pc is tc
+
+
+def test_fluid_and_dsl_paths_resolve():
+    import paddle
+    import paddle.fluid as fluid
+    from paddle.fluid.layers import data  # noqa: F401
+    from paddle.trainer_config_helpers.layers import fc_layer  # noqa: F401
+    from paddle.trainer_config_helpers import networks  # noqa: F401
+    import paddle.dataset  # noqa: F401
+
+    import paddle_tpu
+    assert paddle.__version__ == paddle_tpu.__version__
+    assert hasattr(fluid, "Executor") and hasattr(fluid, "TPUPlace")
