@@ -748,7 +748,9 @@ def _lower_projection(p, size):
     if kind == "cvp":  # conv_projection: learned-filter conv, flattened
         cfg = dict(extra)
         img, _ = _to_nchw(x, cfg.pop("num_channels"))
-        out = _fl.conv2d(input=img, act=None, bias_attr=False, **cfg)
+        conv = _fl.conv2d_transpose if cfg.pop("trans", False) \
+            else _fl.conv2d
+        out = conv(input=img, act=None, bias_attr=False, **cfg)
         return _fl.reshape(out, [-1, _prod(out.shape[1:])])
     if kind == "cvo":  # conv_operator: the FILTER comes from a layer
         img_in, filt = x

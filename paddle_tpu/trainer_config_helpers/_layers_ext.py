@@ -799,6 +799,7 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
                     int(padding)),
         "groups": int(groups),
         "param_attr": _param_name(param_attr),
+        "trans": bool(trans),
     })
 
 
@@ -808,6 +809,11 @@ def conv_operator(img, filter, filter_size, num_filters,  # noqa: A002
                   trans=False, **kw):
     """Conv whose FILTER comes from another layer (ref layers.py
     conv_operator — the two-input cudnn conv op)."""
+    if trans:
+        raise NotImplementedError(
+            "conv_operator(trans=True): a dynamic-filter TRANSPOSED conv "
+            "has no lowering here; use conv_projection(trans=True) for a "
+            "learned-filter deconv")
     return ("cvo", (img, filter), {
         "num_channels": num_channels,
         "num_filters": int(num_filters),
