@@ -264,3 +264,13 @@ class EOFException(Exception):
 # host-side LoDTensor lives in fluid.lod_tensor; re-export for the pybind
 # parity surface (ref exposes core.LoDTensor, pybind.cc:160)
 from .lod_tensor import LoDTensor  # noqa: E402,F401
+
+
+def __getattr__(attr):
+    # ref pybind.cc:345 exposes core.Scope; ours lives in fluid.executor
+    # (imported lazily here — executor imports core at module load)
+    if attr == "Scope":
+        from .executor import Scope
+
+        return Scope
+    raise AttributeError(attr)

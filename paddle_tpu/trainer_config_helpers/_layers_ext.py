@@ -783,6 +783,13 @@ def dotmul_operator(a=None, b=None, scale=1.0, **kw):
     return ("dop", (a, b), float(scale))
 
 
+def _yx(v, v_y):
+    """Reference conv args accept int | [y, x]; normalize to (y, x)."""
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[-1]))
+    return (int(v_y if v_y is not None else v), int(v))
+
+
 def conv_projection(input, filter_size, num_filters, num_channels=None,
                     stride=1, padding=0, filter_size_y=None, stride_y=None,
                     padding_y=None, groups=1, param_attr=None, trans=False,
@@ -792,11 +799,9 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
     return ("cvp", input, {
         "num_channels": num_channels,
         "num_filters": int(num_filters),
-        "filter_size": (int(filter_size_y or filter_size),
-                        int(filter_size)),
-        "stride": (int(stride_y or stride), int(stride)),
-        "padding": (int(padding_y if padding_y is not None else padding),
-                    int(padding)),
+        "filter_size": _yx(filter_size, filter_size_y),
+        "stride": _yx(stride, stride_y),
+        "padding": _yx(padding, padding_y),
         "groups": int(groups),
         "param_attr": _param_name(param_attr),
         "trans": bool(trans),
@@ -814,14 +819,14 @@ def conv_operator(img, filter, filter_size, num_filters,  # noqa: A002
             "conv_operator(trans=True): a dynamic-filter TRANSPOSED conv "
             "has no lowering here; use conv_projection(trans=True) for a "
             "learned-filter deconv")
+    ky, kx = _yx(filter_size, filter_size_y)
     return ("cvo", (img, filter), {
         "num_channels": num_channels,
         "num_filters": int(num_filters),
-        "filter_size": int(filter_size),
-        "filter_size_y": int(filter_size_y or filter_size),
-        "stride": (int(stride_y or stride), int(stride)),
-        "padding": (int(padding_y if padding_y is not None else padding),
-                    int(padding)),
+        "filter_size": kx,
+        "filter_size_y": ky,
+        "stride": _yx(stride, stride_y),
+        "padding": _yx(padding, padding_y),
     })
 
 
