@@ -1,0 +1,22 @@
+"""Device-placement layer (ref: python/paddle/fluid/layers/device.py —
+get_places feeds ParallelDo's place list).  On this substrate the device
+list is the visible jax devices; the op (ops/misc_ops.py get_places)
+returns their count/kind for the ParallelDo disposition."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["get_places"]
+
+
+def get_places(device_count=None, device_type=None):
+    helper = LayerHelper("get_places")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    out.stop_gradient = True
+    attrs = {}
+    if device_count is not None:
+        attrs["device_count"] = int(device_count)
+    if device_type is not None:
+        attrs["device_type"] = str(device_type)
+    helper.append_op(type="get_places", outputs={"Out": [out]},
+                     attrs=attrs)
+    return out

@@ -109,4 +109,33 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
 
 
 def append_LARS(params_grads, learning_rate, weight_decay):
-    raise NotImplementedError("LARS is not implemented yet")
+    """LARS — layer-wise adaptive rate scaling (ref layers/
+    learning_rate_scheduler.py append_LARS): per parameter,
+    lr = global_lr * ||param|| / (||grad|| + weight_decay * ||param||),
+    stored back on param.optimize_attr for _create_param_lr to pick up."""
+    from . import nn as _nn
+    from . import ops as _ops
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return _nn.elementwise_add(grad_norm, param_norm)
+        return _nn.elementwise_add(
+            grad_norm, _nn.scale(param_norm, scale=float(weight_decay)))
+
+    for param, grad in params_grads:
+        if grad is None:
+            continue
+        attr = param.optimize_attr or {}
+        param_lr = attr.get("learning_rate", 1.0)
+        param_norm = _ops.sqrt(_nn.reduce_sum(_ops.square(param)))
+        grad_norm = _ops.sqrt(_nn.reduce_sum(_ops.square(grad)))
+        if isinstance(param_lr, (int, float)):
+            scaled = learning_rate if param_lr == 1.0 else \
+                _nn.scale(learning_rate, scale=float(param_lr))
+        else:  # a Variable (e.g. a prior LARS pass): compose, like the ref
+            scaled = _nn.elementwise_mul(learning_rate, param_lr)
+        decayed = _nn.elementwise_div(
+            _nn.elementwise_mul(scaled, param_norm),
+            _balanced_weight(param_norm, grad_norm))
+        attr["learning_rate"] = decayed
+        param.optimize_attr = attr

@@ -30,13 +30,15 @@ __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
 
 
 class Optimizer:
-    def __init__(self, learning_rate, regularization=None, name=None):
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 LARS_weight_decay=0.0):
         self._name = name
         self.regularization = regularization
         self._learning_rate = learning_rate
         self._learning_rate_map = {}
         self._accumulators = defaultdict(dict)
         self.helper = None
+        self._LARS_weight_decay = float(LARS_weight_decay)
 
     # -- learning rate plumbing --
     def _create_global_learning_rate(self):
@@ -61,6 +63,9 @@ class Optimizer:
     def _create_param_lr(self, param_and_grad):
         param = param_and_grad[0]
         param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if not isinstance(param_lr, (int, float)):
+            # a Variable: append_LARS already folded the global lr in
+            return param_lr
         base = self._global_learning_rate()
         if param_lr == 1.0:
             return base
@@ -111,6 +116,12 @@ class Optimizer:
                            default_startup_program()):
             self.helper = LayerHelper(self.__class__.__name__)
             self._create_global_learning_rate()
+            if self._LARS_weight_decay > 0.0:
+                from .layers.learning_rate_scheduler import append_LARS
+
+                append_LARS(parameters_and_grads,
+                            self._global_learning_rate(),
+                            self._LARS_weight_decay)
             self._create_accumulators(
                 program.global_block(),
                 [p for p, g in parameters_and_grads if g is not None])

@@ -784,9 +784,11 @@ def dotmul_operator(a=None, b=None, scale=1.0, **kw):
 
 
 def _yx(v, v_y):
-    """Reference conv args accept int | [y, x]; normalize to (y, x)."""
+    """Reference conv args accept int | [x, y] (the reference unpacks
+    sequences as (x, y) — layers.py conv_projection); normalize to the
+    fluid (y, x) order."""
     if isinstance(v, (list, tuple)):
-        return (int(v[0]), int(v[-1]))
+        return (int(v[-1]), int(v[0]))
     return (int(v_y if v_y is not None else v), int(v))
 
 

@@ -13,6 +13,8 @@ import os
 import pytest
 
 REF = "/root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py"
+REF_DIGITS = ("/root/reference/python/paddle/fluid/tests/book/"
+              "test_recognize_digits.py")
 
 
 @pytest.mark.skipif(not os.path.exists(REF),
@@ -31,3 +33,21 @@ def test_reference_fit_a_line_runs_verbatim(tmp_path, capsys):
     mod.infer(use_cuda=False, save_dirname=save)
     out = capsys.readouterr().out
     assert "infer" in out and "[" in out  # the script prints predictions
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DIGITS),
+                    reason="reference checkout not mounted")
+def test_reference_recognize_digits_runs_verbatim(tmp_path):
+    """The digits chapter exercises more surface verbatim: nets MLP,
+    Adam WITH LARS_weight_decay, test-program clone, accuracy loop,
+    save/reload/infer."""
+    spec = importlib.util.spec_from_file_location("ref_digits", REF_DIGITS)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    save = str(tmp_path / "digits.model")
+    # trains until ITS OWN test-accuracy threshold, then saves
+    mod.train(nn_type="mlp", use_cuda=False, parallel=False,
+              save_dirname=save, is_local=True)
+    assert os.path.exists(os.path.join(save, "__model__"))
+    mod.infer(use_cuda=False, save_dirname=save)
