@@ -9,12 +9,15 @@ from __future__ import annotations
 
 import numpy as np
 
-N_USERS = 400
-N_MOVIES = 300
+# the REAL ml-1m cardinalities (ref movielens meta), so scripts that
+# hardcode demo ids (book recommender infer: movie 783, titles ~4k)
+# stay in range of the synthetic tables; row COUNTS remain synthetic
+N_USERS = 6040
+N_MOVIES = 3952
 N_JOBS = 20
 N_AGES = 7
 N_CATEGORIES = 18
-TITLE_VOCAB = 500
+TITLE_VOCAB = 5177
 N_TRAIN = 6000
 N_TEST = 600
 
@@ -31,12 +34,23 @@ def max_job_id():
     return N_JOBS
 
 
-def age_table():
-    return [1, 18, 25, 35, 45, 50, 56]
+# module-level LIST like the reference (movielens.py:42) — scripts do
+# len(paddle.dataset.movielens.age_table)
+age_table = [1, 18, 25, 35, 45, 50, 56]
 
 
 def categories():
     return ["c%d" % i for i in range(N_CATEGORIES)]
+
+
+def movie_categories():
+    """ref movielens.py:225 — the category vocabulary."""
+    return categories()
+
+
+def get_movie_title_dict():
+    """ref movielens.py:178 — word -> id over the title vocabulary."""
+    return {("w%d" % i): i for i in range(TITLE_VOCAB)}
 
 
 def _rows(n, seed):

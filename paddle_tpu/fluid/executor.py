@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import core
-from .framework import (Program, RNG_STATE_VAR, Variable, default_main_program)
+from .framework import (OpRole, Program, RNG_STATE_VAR, Variable,
+                        default_main_program)
 from ..ops import registry as _reg
 
 
@@ -596,6 +597,9 @@ class Executor:
             if lod:
                 feed_lods[k] = lod
 
+        program = self._prune_for_unfed(program, feed_arrays, fetch_names,
+                                        scope)
+
         # lods recorded on persistable state vars by earlier runs re-enter
         # the trace as static metadata, exactly like feed lods
         state_lods = {n: lod for n, lod in scope._lods.items()
@@ -911,6 +915,52 @@ class Executor:
         env.update(side.get("host", {}))
         if rng_box is not None and new_key is not None:
             rng_box[0] = new_key
+
+    def _prune_for_unfed(self, program, feed_arrays, fetch_names, scope):
+        """Reference executors run whole mixed programs and tolerate
+        unfed data vars in NON-fetched branches (book decode_main reuses
+        the train program's default main; the C++ ops just see empty
+        tensors).  The static-shape equivalent: when an unfed data var
+        exists, prune to the fetch targets — dropping backward/optimize
+        ops like the reference's pruning (prune.cc honors op roles) so a
+        kept decode branch does not drag the train branch back in via
+        shared parameters.  If the unfed var is still needed after
+        pruning, keep the original program so the clear 'was not fed'
+        error fires."""
+        if not fetch_names:
+            return program
+        gb = program.global_block()
+        # cheap first: the (small) set of declared-but-unfed data vars
+        candidates = [v.name for v in gb.vars.values()
+                      if getattr(v, "is_data", False)
+                      and v.name not in feed_arrays
+                      and scope.get(v.name, None) is None]
+        if not candidates:
+            return program
+        consumed = set()
+        for op in gb.ops:
+            consumed.update(op.input_arg_names)
+        unfed = sorted(n for n in candidates if n in consumed)
+        if not unfed:
+            return program
+        cache = getattr(program, "_unfed_prune_cache", None)
+        if cache is None:
+            cache = program._unfed_prune_cache = {}
+        key = (program._version, tuple(fetch_names), tuple(unfed))
+        pruned = cache.get(key)
+        if pruned is None:
+            pruned = program._prune(
+                fetch_names,
+                drop_roles={OpRole.Backward, OpRole.Optimize,
+                            OpRole.Optimize | OpRole.LRSched,
+                            OpRole.Backward | OpRole.Loss})
+            still = set()
+            for op in pruned.global_block().ops:
+                still.update(op.input_arg_names)
+            if any(n in still for n in unfed):
+                pruned = program  # pruning cannot help; keep the error
+            cache[key] = pruned
+        return pruned
 
     def _gather_state(self, program, plan, scope):
         state = {}

@@ -372,16 +372,21 @@ class Program:
                          and op.attr(OpRole.KEY, OpRole.Forward) != OpRole.Optimize]
         return p
 
-    def _prune(self, targets) -> "Program":
-        """Keep only ops needed to produce target vars (ref: prune.cc)."""
+    def _prune(self, targets, drop_roles=()) -> "Program":
+        """Keep only ops needed to produce target vars (ref: prune.cc).
+        ``drop_roles``: op-role values removed before slicing (the
+        reference's pruning skips backward/optimize ops the same way)."""
         target_names = set()
         for t in targets:
             target_names.add(t.name if isinstance(t, Variable) else str(t))
+        drop = set(drop_roles)
         p = self.clone()
         gb = p.global_block()
         needed = set(target_names)
         kept = []
         for op in reversed(gb.ops):
+            if drop and op.attrs.get(OpRole.KEY, OpRole.Forward) in drop:
+                continue
             if any(n in needed for n in op.output_arg_names):
                 kept.append(op)
                 needed.update(op.input_arg_names)

@@ -1,6 +1,6 @@
 """fluid.layers namespace (ref: python/paddle/fluid/layers/__init__.py)."""
 
-from . import (control_flow, detection, io,
+from . import (control_flow, detection, device, io,
                layer_function_generator, math_op_patch, metric_op, nn,
                ops, tensor)
 from . import learning_rate_scheduler, sequence
@@ -9,6 +9,7 @@ from .detection import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
+from .device import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
@@ -18,6 +19,7 @@ from .layer_function_generator import (  # noqa: F401
 
 math_op_patch.monkey_patch_variable()
 
-__all__ = (control_flow.__all__ + detection.__all__ + io.__all__ + metric_op.__all__ + nn.__all__
+__all__ = (control_flow.__all__ + detection.__all__ + device.__all__
+           + io.__all__ + metric_op.__all__ + nn.__all__
            + ops.__all__ + tensor.__all__ + learning_rate_scheduler.__all__
            + sequence.__all__)

@@ -35,6 +35,52 @@ def test_reference_fit_a_line_runs_verbatim(tmp_path, capsys):
     assert "infer" in out and "[" in out  # the script prints predictions
 
 
+BOOK = "/root/reference/python/paddle/fluid/tests/book"
+
+
+def _load(name):
+    path = os.path.join(BOOK, f"test_{name}.py")
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not mounted")
+    spec = importlib.util.spec_from_file_location("ref_" + name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_reference_word2vec_runs_verbatim(tmp_path):
+    mod = _load("word2vec")
+    save = str(tmp_path / "w2v.model")
+    mod.train(use_cuda=False, is_sparse=False, is_parallel=False,
+              save_dirname=save)
+    mod.infer(use_cuda=False, save_dirname=save)
+
+
+def test_reference_recommender_runs_verbatim(tmp_path):
+    mod = _load("recommender_system")
+    save = str(tmp_path / "rec.model")
+    mod.train(use_cuda=False, save_dirname=save, is_local=True)
+    mod.infer(use_cuda=False, save_dirname=save)
+
+
+def test_reference_image_classification_runs_verbatim(tmp_path):
+    mod = _load("image_classification")
+    save = str(tmp_path / "img.model")
+    mod.train(net_type="vgg", use_cuda=False, save_dirname=save,
+              is_local=True)
+    mod.infer(use_cuda=False, save_dirname=save)
+
+
+def test_reference_machine_translation_runs_verbatim():
+    """The hardest chapter verbatim: DynamicRNN teacher-forced training,
+    then While+beam_search DECODE built into the SAME default program —
+    the executor prunes the un-fed train branch to the decode fetches
+    like the reference's whole-program run tolerates."""
+    mod = _load("machine_translation")
+    mod.train_main(use_cuda=False, is_sparse=False, is_local=True)
+    mod.decode_main(use_cuda=False, is_sparse=False)
+
+
 @pytest.mark.skipif(not os.path.exists(REF_DIGITS),
                     reason="reference checkout not mounted")
 def test_reference_recognize_digits_runs_verbatim(tmp_path):
