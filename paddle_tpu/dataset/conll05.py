@@ -24,8 +24,26 @@ def get_dict():
 
 
 def get_embedding():
-    rng = np.random.RandomState(5)
-    return rng.normal(scale=0.1, size=(WORD_VOCAB, 32)).astype(np.float32)
+    """ref conll05.py get_embedding: returns the PATH of the downloaded
+    binary fp32 emb file (consumers np.fromfile it, e.g. the book SRL
+    chapter's load_parameter).  Synthetic here, cached on disk once."""
+    import os
+    import tempfile
+
+    from .common import cached_path, must_mkdirs
+
+    path = cached_path("conll05", f"emb_{WORD_VOCAB}x32.bin")
+    if not os.path.exists(path):
+        must_mkdirs(os.path.dirname(path))
+        rng = np.random.RandomState(5)
+        arr = rng.normal(scale=0.1,
+                         size=(WORD_VOCAB, 32)).astype(np.float32)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        with os.fdopen(fd, "wb") as f:
+            f.write(b"\x00" * 16)  # the reference file's 16-byte header
+            arr.tofile(f)
+        os.replace(tmp, path)  # atomic publish; racers write their own tmp
+    return path
 
 
 def _samples(n, seed):

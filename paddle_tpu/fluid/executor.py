@@ -943,24 +943,75 @@ class Executor:
         unfed = sorted(n for n in candidates if n in consumed)
         if not unfed:
             return program
-        cache = getattr(program, "_unfed_prune_cache", None)
-        if cache is None:
-            cache = program._unfed_prune_cache = {}
-        key = (program._version, tuple(fetch_names), tuple(unfed))
+        # cache holds ONE version's entries; a program mutation replaces
+        # it wholesale (each entry pins a full clone)
+        cache_ver, cache = getattr(program, "_unfed_prune_cache",
+                                   (None, None))
+        if cache_ver != program._version:
+            cache = {}
+            program._unfed_prune_cache = (program._version, cache)
+        key = (tuple(fetch_names), tuple(unfed))
         pruned = cache.get(key)
         if pruned is None:
-            pruned = program._prune(
-                fetch_names,
-                drop_roles={OpRole.Backward, OpRole.Optimize,
-                            OpRole.Optimize | OpRole.LRSched,
-                            OpRole.Backward | OpRole.Loss})
-            still = set()
-            for op in pruned.global_block().ops:
-                still.update(op.input_arg_names)
-            if any(n in still for n in unfed):
-                pruned = program  # pruning cannot help; keep the error
+            pruned = self._try_prunes(program, fetch_names, unfed, scope,
+                                      feed_arrays)
             cache[key] = pruned
         return pruned
+
+    @staticmethod
+    def _try_prunes(program, fetch_names, unfed, scope, feed_arrays):
+        """Two attempts, most-conservative first:
+
+        A. liveness slice keeping persistable-writers (BlockPlan's rule)
+           — a TRAIN fetch keeps its optimizer while an unrelated unfed
+           decode branch drops away;
+        B. role-dropping slice (no backward/optimize, the reference's
+           inference pruning) — a DECODE fetch sheds the whole train
+           branch that shares its parameters.
+
+        Adopt an attempt only if it clears every unfed var AND still
+        produces all fetches; else the original program keeps the clear
+        'was not fed' error."""
+
+        def _viable(p):
+            produced, consumed = set(), set()
+            for op in p.global_block().ops:
+                produced.update(op.output_arg_names)
+                consumed.update(op.input_arg_names)
+            if any(n in consumed for n in unfed):
+                return False
+            for f in fetch_names:
+                if f not in produced and f not in feed_arrays \
+                        and scope.get(f, None) is None:
+                    return False
+            return True
+
+        # attempt A: keep persistable-writers
+        a = program.clone()
+        gb = a.global_block()
+
+        def _writes_persistable(op):
+            return any(gb._has_var_recursive(n)
+                       and gb._var_recursive(n).persistable
+                       for n in op.output_arg_names)
+
+        needed = set(fetch_names)
+        kept = []
+        for op in reversed(gb.ops):
+            if any(n in needed for n in op.output_arg_names) \
+                    or _writes_persistable(op):
+                kept.append(op)
+                needed.update(op.input_arg_names)
+        gb.ops = list(reversed(kept))
+        if _viable(a):
+            return a
+
+        # attempt B: drop backward/optimize like inference pruning
+        b = program._prune(fetch_names,
+                           drop_roles=(OpRole.Backward, OpRole.Optimize))
+        if _viable(b):
+            return b
+        return program  # pruning cannot help; keep the error
 
     def _gather_state(self, program, plan, scope):
         state = {}

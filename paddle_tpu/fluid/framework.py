@@ -379,13 +379,16 @@ class Program:
         target_names = set()
         for t in targets:
             target_names.add(t.name if isinstance(t, Variable) else str(t))
-        drop = set(drop_roles)
+        drop = 0
+        for r in drop_roles:
+            drop |= int(r)
         p = self.clone()
         gb = p.global_block()
         needed = set(target_names)
         kept = []
         for op in reversed(gb.ops):
-            if drop and op.attrs.get(OpRole.KEY, OpRole.Forward) in drop:
+            role = int(op.attrs.get(OpRole.KEY, OpRole.Forward))
+            if drop and (role & drop):
                 continue
             if any(n in needed for n in op.output_arg_names):
                 kept.append(op)
