@@ -45,7 +45,8 @@ from .lod_tensor import (LoDTensor, create_lod_tensor,
                          create_random_int_lodtensor)
 from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
-                      EndEpochEvent, BeginStepEvent, EndStepEvent)
+                      EndEpochEvent, BeginStepEvent, EndStepEvent,
+                      Inferencer)
 from . import evaluator
 from . import debugger
 from . import ir
@@ -65,5 +66,5 @@ __all__ = [
     "InferenceTranspiler", "memory_optimize", "release_memory",
     "LoDTensor", "create_lod_tensor", "create_random_int_lodtensor",
     "Trainer", "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
-    "BeginStepEvent", "EndStepEvent",
+    "BeginStepEvent", "EndStepEvent", "Inferencer",
 ]

@@ -23,14 +23,14 @@ import shutil
 
 import numpy as np
 
-from . import core, io
+from . import core, io, unique_name
 from .data_feeder import DataFeeder
-from .executor import Executor, Scope, global_scope
+from .executor import Executor, Scope, global_scope, scope_guard
 from .framework import Program, program_guard
 
 __all__ = [
     "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
-    "CheckpointConfig", "Trainer",
+    "CheckpointConfig", "Trainer", "Inferencer",
 ]
 
 
@@ -278,7 +278,10 @@ class Trainer:
 
         self.train_program = Program()
         self.startup_program = Program()
-        with program_guard(self.train_program, self.startup_program):
+        # fresh name counters: an Inferencer rebuilding the topology under
+        # its own guard must produce the SAME parameter names
+        with program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
             outs = train_func()
             if not isinstance(outs, (list, tuple)):
                 outs = [outs]
@@ -398,3 +401,40 @@ class Trainer:
                         self.train_program, trainer_args=args,
                         max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
                         background=self.checkpoint_cfg.async_save)
+
+
+class Inferencer:
+    """High-level inference API (ref: python/paddle/fluid/inferencer.py):
+    rebuild the inference topology with FRESH unique-name counters (so
+    parameter names align with a Trainer-built model saved via
+    save_params), load the params into a private scope, and answer
+    feed-dict queries."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.place = place if place is not None else core.CPUPlace()
+        build = Program()
+        startup = Program()
+        with program_guard(build, startup):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+        # test-mode semantics for dropout/batch-norm (the reference
+        # inferencer clones for_test the same way)
+        self.inference_program = build.clone(for_test=True)
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            # save_params writes PERSISTABLES (bn moving stats included);
+            # read them all back, not just Parameters
+            io.load_persistables(self.exe, param_path,
+                                 self.inference_program)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

@@ -98,6 +98,27 @@ def test_reference_rnn_encoder_decoder_runs_verbatim(tmp_path):
     mod.infer(use_cuda=False, save_dirname=save)
 
 
+def test_reference_high_level_fit_a_line_runs_verbatim(tmp_path):
+    """The reference's HIGH-LEVEL-API chapter verbatim: fluid.Trainer
+    event loop (EndStepEvent + trainer.test/save_params/
+    save_inference_model/stop) and fluid.Inferencer rebuilt with fresh
+    unique names over the saved params."""
+    path = os.path.join(BOOK, "high-level-api", "fit_a_line",
+                        "test_fit_a_line.py")
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not mounted")
+    spec = importlib.util.spec_from_file_location("ref_hl", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    params = str(tmp_path / "params")
+    infm = str(tmp_path / "inf")
+    mod.train(use_cuda=False, train_program=mod.train_program,
+              params_dirname=params, inference_model_dirname=infm)
+    mod.infer(use_cuda=False, inference_program=mod.inference_program,
+              params_dirname=params)
+    mod.infer_by_saved_model(use_cuda=False, save_dirname=infm)
+
+
 def test_unfed_branch_prune_keeps_training_live():
     """A mixed program where the TRAIN branch is fetched while an
     unrelated branch's data var is unfed: the optimizer must keep
