@@ -38,8 +38,10 @@ def test_reference_fit_a_line_runs_verbatim(tmp_path, capsys):
 BOOK = "/root/reference/python/paddle/fluid/tests/book"
 
 
-def _load(name):
-    path = os.path.join(BOOK, f"test_{name}.py")
+def _load(name, rel_path=None):
+    """Load a reference book script verbatim; ``rel_path`` for files not
+    following the flat test_<name>.py convention."""
+    path = os.path.join(BOOK, rel_path or f"test_{name}.py")
     if not os.path.exists(path):
         pytest.skip("reference checkout not mounted")
     spec = importlib.util.spec_from_file_location("ref_" + name, path)
@@ -103,13 +105,8 @@ def test_reference_high_level_fit_a_line_runs_verbatim(tmp_path):
     event loop (EndStepEvent + trainer.test/save_params/
     save_inference_model/stop) and fluid.Inferencer rebuilt with fresh
     unique names over the saved params."""
-    path = os.path.join(BOOK, "high-level-api", "fit_a_line",
-                        "test_fit_a_line.py")
-    if not os.path.exists(path):
-        pytest.skip("reference checkout not mounted")
-    spec = importlib.util.spec_from_file_location("ref_hl", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load("hl_fit_a_line",
+                rel_path="high-level-api/fit_a_line/test_fit_a_line.py")
     params = str(tmp_path / "params")
     infm = str(tmp_path / "inf")
     mod.train(use_cuda=False, train_program=mod.train_program,
@@ -117,6 +114,17 @@ def test_reference_high_level_fit_a_line_runs_verbatim(tmp_path):
     mod.infer(use_cuda=False, inference_program=mod.inference_program,
               params_dirname=params)
     mod.infer_by_saved_model(use_cuda=False, save_dirname=infm)
+
+
+def test_reference_high_level_digits_runs_verbatim(tmp_path):
+    mod = _load("hl_digits",
+                rel_path="high-level-api/recognize_digits/"
+                         "test_recognize_digits_mlp.py")
+    params = str(tmp_path / "params")
+    mod.train(use_cuda=False, train_program=mod.train_program,
+              params_dirname=params, parallel=False)
+    mod.infer(use_cuda=False, inference_program=mod.inference_program,
+              params_dirname=params, parallel=False)
 
 
 def test_unfed_branch_prune_keeps_training_live():
@@ -158,12 +166,7 @@ def test_unfed_branch_prune_keeps_training_live():
 def test_reference_understand_sentiment_runs_verbatim(tmp_path):
     """The reference keeps this chapter as notest_ (CI-disabled there);
     it runs here — conv text net through its own main()."""
-    path = os.path.join(BOOK, "notest_understand_sentiment.py")
-    if not os.path.exists(path):
-        pytest.skip("reference checkout not mounted")
-    spec = importlib.util.spec_from_file_location("ref_sent", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load("sent", rel_path="notest_understand_sentiment.py")
     import paddle
 
     word_dict = paddle.dataset.imdb.word_dict()
