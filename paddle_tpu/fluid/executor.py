@@ -490,7 +490,7 @@ class Executor:
             feed_arrays[k] = arr
         from . import amp as _amp
 
-        key = ("run_steps", id(program), program._version,
+        key = ("run_steps", program._cache_token, program._version,
                tuple(fetch_names), int(n_steps), bool(feed_per_step),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
@@ -549,6 +549,10 @@ class Executor:
             self._cache[key] = entry
         plan, fn = entry
 
+        from . import fault as _fault
+
+        if program._params_grads is not None:
+            self._step_boundary(_fault, n_steps)
         state_vals = self._gather_state(program, plan, scope)
         mut_names = set(plan.state_out)
         if plan.needs_rng:
@@ -560,6 +564,8 @@ class Executor:
         feed_dev = {k: self._put_feed(k, v, device)
                     for k, v in feed_arrays.items()}
         fetches, new_state = fn(feed_dev, const_state, mut_state)
+        if _fault.active() is not None:
+            new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():
             scope.set(name, val)
         self._check_nan_inf(list(new_state.items())
@@ -607,7 +613,7 @@ class Executor:
 
         from . import amp as _amp
 
-        key = (id(program), program._version, tuple(fetch_names),
+        key = (program._cache_token, program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
                tuple(sorted(feed_lods.items())),
@@ -633,6 +639,12 @@ class Executor:
                 self._cache[key] = entry
         plan, fn, lod_box = entry
 
+        from . import fault as _fault
+
+        if program._params_grads is not None:
+            # training-step boundary (programs built via optimizer.minimize;
+            # hook points for fault injection + elastic liveness)
+            self._step_boundary(_fault)
         state_vals = self._gather_state(program, plan, scope)
         device = core.get_jax_device(self.place)
         feed_dev = {k: self._put_feed(k, v, device)
@@ -659,6 +671,8 @@ class Executor:
                 _time.perf_counter() - t, start=t)
         else:
             fetches, new_state = fn(feed_dev, const_state, mut_state)
+        if _fault.active() is not None:
+            new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():
             scope.set(name, val)
             if name in lod_box:
@@ -685,6 +699,23 @@ class Executor:
         return out
 
     # -- helpers --
+    @staticmethod
+    def _step_boundary(_fault, n_steps=1):
+        """Training-step boundary: fires armed step faults (kill-at-step-N)
+        and emits an elastic-supervisor heartbeat when a heartbeat dir is
+        configured.  A fused run_steps dispatch advances the whole window at
+        once — a kill armed inside it fires before the dispatch."""
+        if _fault.active() is not None:
+            if n_steps == 1:
+                _fault.on_step()
+            else:
+                _fault.advance(n_steps)
+        hb_dir = os.environ.get("PADDLE_ELASTIC_HB_DIR")
+        if hb_dir:
+            from ..parallel.elastic import write_heartbeat
+
+            write_heartbeat(hb_dir, step=_fault.current_step())
+
     @staticmethod
     def _check_nan_inf(named_vals):
         """Debug mode (ref FLAGS_check_nan_inf, operator.cc:643): fault

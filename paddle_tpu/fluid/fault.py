@@ -1,0 +1,242 @@
+"""Deterministic fault injection for robustness testing.
+
+The reference stack's fault tolerance was *testable* because its Go master
+and pserver shipped with chaos hooks (go/master timeout requeue, pserver
+checkpoint-on-notify); this module is the TPU build's equivalent: a single
+place that can deterministically reproduce the failures a production pod
+actually sees — preempted workers, checkpoints killed mid-write, slow/wedged
+storage, silent NaNs, stalled collectives — so the recovery paths in
+``trainer``/``multihost``/``parallel.elastic`` are exercised by fast tests
+instead of discovered during multi-hour TPU wedges (VERDICT r5).
+
+Faults are armed either programmatically (``install(FaultPlan(...))``) or
+via environment flags, which is how the elastic supervisor injects them into
+worker processes:
+
+    PADDLE_FAULT_KILL_STEP=N      die at the step-N boundary (os._exit 137,
+                                  a SIGKILL stand-in: no atexit, no flush)
+    PADDLE_FAULT_RANK=r           restrict any armed fault to rank r
+                                  (default: every rank; rank source is
+                                  PADDLE_TRAINER_ID)
+    PADDLE_FAULT_CKPT_CRASH=before|after
+                                  crash during a checkpoint save, just
+                                  before / just after the _SUCCESS marker
+    PADDLE_FAULT_IO_DELAY_MS=t    sleep t ms inside every checkpoint write
+    PADDLE_FAULT_NAN_VAR=name     overwrite var `name` with NaN once
+    PADDLE_FAULT_NAN_STEP=N       ...at step N (default 0)
+    PADDLE_FAULT_BARRIER_STALL=s  sleep s seconds before the next collective
+                                  barrier (one-shot), simulating a wedged
+                                  host that trips the supervisor's timeout
+    PADDLE_FAULT_MODE=exit|raise  crash flavor: hard process exit (default)
+                                  or an InjectedFault raise (in-process
+                                  tests of the recovery path)
+
+Hook points (each a no-op costing one attribute read when nothing is
+armed): ``Executor.run``/``run_steps`` call :func:`on_step` at the training
+step boundary and :func:`corrupt_state` on the step's outputs;
+``trainer.save_checkpoint``/``multihost.save_sharded_serial`` call
+:func:`ckpt_crash_point` around their _SUCCESS writes and :func:`io_delay`
+in their write loops; ``multihost.barrier`` calls :func:`barrier_stall`.
+
+Determinism contract: a fault keyed to step N fires exactly at step N of
+the *caller-provided* step index when one is given (the elastic worker
+passes its global resume-aware step, so a restarted worker never re-fires a
+kill it already survived), else of an internal per-process counter.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+__all__ = [
+    "FaultPlan", "InjectedFault", "install", "clear", "active",
+    "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
+    "barrier_stall", "current_step", "KILL_EXIT_CODE",
+]
+
+#: exit code of an injected kill — 128+9, what a real SIGKILL reports
+KILL_EXIT_CODE = 137
+
+
+class InjectedFault(BaseException):
+    """Raise-mode crash.  A BaseException on purpose: recovery code that
+    catches ``Exception`` must treat an injected crash like a real process
+    death, not swallow it."""
+
+
+class FaultPlan:
+    """One armed fault scenario.  All fields optional; ``None``/0 disarms
+    the corresponding fault."""
+
+    def __init__(self, kill_step: Optional[int] = None,
+                 ckpt_crash: Optional[str] = None,
+                 io_delay_ms: float = 0.0,
+                 nan_var: Optional[str] = None, nan_step: int = 0,
+                 barrier_stall_s: float = 0.0,
+                 rank: Optional[int] = None, mode: str = "exit"):
+        if ckpt_crash not in (None, "before", "after"):
+            raise ValueError(
+                f"ckpt_crash must be 'before' or 'after' (the _SUCCESS "
+                f"marker), got {ckpt_crash!r}")
+        if mode not in ("exit", "raise"):
+            raise ValueError(f"mode must be 'exit' or 'raise', got {mode!r}")
+        self.kill_step = None if kill_step is None else int(kill_step)
+        self.ckpt_crash = ckpt_crash
+        self.io_delay_ms = float(io_delay_ms)
+        self.nan_var = nan_var
+        self.nan_step = int(nan_step)
+        self.barrier_stall_s = float(barrier_stall_s)
+        self.rank = None if rank is None else int(rank)
+        self.mode = mode
+        # one-shot disarm state
+        self._nan_fired = False
+        self._stall_fired = False
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultPlan"]:
+        """Parse the PADDLE_FAULT_* contract; None when nothing is armed."""
+        env = os.environ if env is None else env
+        if not any(k.startswith("PADDLE_FAULT_") and v.strip()
+                   for k, v in env.items()):
+            return None
+        getf = lambda k, d=0.0: float(env.get(k, "").strip() or d)  # noqa: E731
+        kill = env.get("PADDLE_FAULT_KILL_STEP", "").strip()
+        rank = env.get("PADDLE_FAULT_RANK", "").strip()
+        return cls(
+            kill_step=int(kill) if kill else None,
+            ckpt_crash=env.get("PADDLE_FAULT_CKPT_CRASH", "").strip() or None,
+            io_delay_ms=getf("PADDLE_FAULT_IO_DELAY_MS"),
+            nan_var=env.get("PADDLE_FAULT_NAN_VAR", "").strip() or None,
+            nan_step=int(getf("PADDLE_FAULT_NAN_STEP")),
+            barrier_stall_s=getf("PADDLE_FAULT_BARRIER_STALL"),
+            rank=int(rank) if rank else None,
+            mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
+        )
+
+    # -- firing --
+    def _applies_to_this_rank(self) -> bool:
+        if self.rank is None:
+            return True
+        return self.rank == int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def _crash(self, what: str):
+        if self.mode == "raise":
+            raise InjectedFault(what)
+        from .log import LOG
+
+        LOG(f"fault: injected crash ({what}) — exiting {KILL_EXIT_CODE}")
+        os._exit(KILL_EXIT_CODE)
+
+
+# module state: the armed plan (None = nothing armed; _UNSET = env not yet
+# consulted, so subprocesses that set PADDLE_FAULT_* before first use are
+# honored without an import-order dependency) and the step counter
+_UNSET = object()
+_plan = _UNSET
+_step = 0
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Arm a plan programmatically (overrides the env)."""
+    global _plan, _step
+    _plan = plan
+    _step = 0
+
+
+def clear() -> None:
+    """Disarm everything, including any env-derived plan."""
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    global _plan
+    if _plan is _UNSET:
+        _plan = FaultPlan.from_env()
+    return _plan
+
+
+def current_step() -> int:
+    return _step
+
+
+def on_step(step: Optional[int] = None) -> int:
+    """Training-step boundary, called BEFORE the step executes.  ``step``
+    pins the index explicitly (resume-aware callers); default is an
+    internal monotonic per-process counter.  Fires kill-at-step-N."""
+    global _step
+    if step is not None:
+        _step = int(step)
+    plan = active()
+    if plan is not None and plan.kill_step is not None \
+            and _step == plan.kill_step and plan._applies_to_this_rank():
+        plan._crash(f"kill at step {_step}")
+    fired = _step
+    if step is None:
+        _step += 1
+    else:
+        _step = int(step) + 1
+    return fired
+
+
+def advance(n: int) -> None:
+    """Bulk step advance for fused multi-step dispatches (run_steps): a
+    kill armed anywhere inside the window fires before the dispatch — the
+    finest kill granularity a single XLA dispatch allows."""
+    global _step
+    plan = active()
+    if plan is not None and plan.kill_step is not None \
+            and _step <= plan.kill_step < _step + n \
+            and plan._applies_to_this_rank():
+        plan._crash(f"kill inside step window [{_step}, {_step + n})")
+    _step += n
+
+
+def corrupt_state(named_vals: dict) -> dict:
+    """NaN-poison the armed var once its step arrives (one-shot).  Called
+    with a step's new state; returns it (possibly rewritten).  The injected
+    NaN then flows into the scope exactly like a real numerical blow-up, so
+    check_nan_inf / supervisor NaN policies see the genuine article."""
+    plan = active()
+    if plan is None or plan.nan_var is None or plan._nan_fired \
+            or _step <= plan.nan_step or not plan._applies_to_this_rank():
+        return named_vals
+    if plan.nan_var in named_vals:
+        import numpy as np
+
+        val = named_vals[plan.nan_var]
+        poisoned = np.asarray(val, dtype=np.result_type(val, np.float32))
+        poisoned = np.full_like(poisoned, np.nan)
+        named_vals = dict(named_vals)
+        named_vals[plan.nan_var] = poisoned
+        plan._nan_fired = True
+    return named_vals
+
+
+def ckpt_crash_point(where: str) -> None:
+    """Checkpoint-save crash hook; ``where`` is 'before' or 'after' the
+    _SUCCESS marker write."""
+    plan = active()
+    if plan is not None and plan.ckpt_crash == where \
+            and plan._applies_to_this_rank():
+        plan._crash(f"checkpoint crash {where} _SUCCESS")
+
+
+def io_delay() -> None:
+    """Slow-storage simulation: sleep inside checkpoint write paths."""
+    plan = active()
+    if plan is not None and plan.io_delay_ms > 0 \
+            and plan._applies_to_this_rank():
+        time.sleep(plan.io_delay_ms / 1000.0)
+
+
+def barrier_stall(tag: str = "") -> None:
+    """Wedged-collective simulation: one-shot sleep before a barrier, long
+    enough for the supervisor's heartbeat timeout to classify this process
+    as wedged."""
+    plan = active()
+    if plan is not None and plan.barrier_stall_s > 0 \
+            and not plan._stall_fired and plan._applies_to_this_rank():
+        plan._stall_fired = True
+        time.sleep(plan.barrier_stall_s)

@@ -297,11 +297,19 @@ class Program:
     trace/compile cache on (program, version, shape signature).
     """
 
+    _token_counter = 0
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
         self._version = 0
+        # monotonic process-wide identity token: Executor caches key on this
+        # instead of id(program), which a freed clone's recycled id could
+        # alias into a stale compiled entry (ADVICE r5).  clone()/_prune()
+        # build fresh Programs, so derived programs get their own token.
+        Program._token_counter += 1
+        self._cache_token = Program._token_counter
         self._seed_counter = 0
         # set by optimizer.minimize / append_backward for transpilers
         self._params_grads = None

@@ -71,7 +71,10 @@ def snapshot_vars(scope, var_list) -> dict:
 def write_var_files(dirname, snapshot: dict) -> None:
     """One file per var, np.save format — the single place that encodes
     the per-var on-disk layout (load_vars is its reader)."""
+    from . import fault as _fault
+
     for name, arr in snapshot.items():
+        _fault.io_delay()
         with open(os.path.join(dirname, name), "wb") as f:
             np.save(f, arr, allow_pickle=False)
 

@@ -218,11 +218,18 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
 
 def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
                        max_num_checkpoints):
+    from . import fault as _fault
+
     if trainer_args is not None:
         with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
             json.dump(trainer_args, f)
+    # fault hooks bracket the commit point: a crash 'before' leaves an
+    # unmarked dir restore must skip; 'after' leaves a complete serial a
+    # crash cannot un-commit
+    _fault.ckpt_crash_point("before")
     with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
         f.write("")
+    _fault.ckpt_crash_point("after")
     # scroll-delete: keep newest max_num_checkpoints complete serials,
     # only ever deleting COMPLETE ones older than the newest keepers (an
     # in-flight async serial has no _SUCCESS yet and must survive)
@@ -237,17 +244,39 @@ def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
 
 def load_checkpoint(executor, checkpoint_dir, main_program):
     """Restore the newest complete checkpoint; returns its trainer args
-    (or None when no checkpoint exists)."""
-    serial = _latest_complete_serial(checkpoint_dir)
-    if serial < 0:
-        return None
-    cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
-    io.load_persistables(executor, cur, main_program)
-    args_path = os.path.join(cur, TRAINER_ARGS_FILE)
-    if os.path.exists(args_path):
-        with open(args_path) as f:
-            return json.load(f)
-    return {}
+    (or None when no checkpoint exists).
+
+    Corruption fallback: a serial can carry _SUCCESS yet still be
+    unreadable (bit rot / truncation AFTER the marker was committed).
+    Rather than killing the restore, fall back serial-by-serial to the
+    newest complete checkpoint that actually loads — losing a few steps
+    beats losing the run.  Only if EVERY complete serial is unreadable does
+    the error surface (silently training from scratch would be worse)."""
+    complete = [s for s, name in _serial_dirs(checkpoint_dir)
+                if os.path.exists(os.path.join(
+                    checkpoint_dir, name, SUCCESS_MARK))]
+    last_exc = None
+    for serial in reversed(complete):
+        cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
+        try:
+            io.load_persistables(executor, cur, main_program)
+        except Exception as exc:
+            from .log import LOG
+
+            LOG(f"checkpoint {cur} is unreadable ({exc!r}); falling back "
+                f"to the previous complete serial")
+            last_exc = exc
+            continue
+        args_path = os.path.join(cur, TRAINER_ARGS_FILE)
+        if os.path.exists(args_path):
+            with open(args_path) as f:
+                return json.load(f)
+        return {}
+    if last_exc is not None:
+        raise IOError(
+            f"no loadable checkpoint under {checkpoint_dir}: every "
+            f"complete serial failed to read") from last_exc
+    return None
 
 
 def clean_checkpoint(checkpoint_dir, delete_dir=False):

@@ -1,0 +1,350 @@
+"""Elastic multihost supervisor: launch the pod, watch it, restart it.
+
+The reference stack survives trainer death because its Go master requeues
+the dead trainer's tasks and its pserver checkpoints shards to etcd
+(go/master/service.go:341 timeout requeue, go/pserver/service.go:346
+checkpoint) — but nothing there *supervises* the processes themselves; k8s
+does.  In the TPU build the pod is one gang-scheduled SPMD program: a
+single dead or wedged worker stalls every collective, so the supervisor's
+job is coarser and more total than the master's — detect the loss, tear
+the WHOLE pod down, re-form `jax.distributed` and resume from the newest
+complete sharded checkpoint (`multihost.save_sharded_serial`'s _SUCCESS
+protocol).
+
+Pieces:
+
+ - heartbeat files: each worker writes ``<hb_dir>/hb_<rank>`` (atomic
+   rename) from its training-step boundary — wired into ``Executor`` and
+   ``multihost.heartbeat`` via the ``PADDLE_ELASTIC_HB_DIR`` env var this
+   supervisor sets.  A worker that is alive-but-wedged (stalled
+   collective) keeps its process but stops heartbeating, which is the only
+   signal that distinguishes "slow" from "stuck".
+ - :class:`ElasticSupervisor`: launches N local worker processes from a
+   `tools.pod_launch.make_launch_plan` (same env contract as a real pod
+   launch), polls exit codes + heartbeats, and on failure tears down,
+   backs off (``master.Backoff``), and relaunches a fresh generation on a
+   fresh coordinator port.  Restarts are bounded; every decision lands in
+   a structured ``incidents.jsonl``.
+ - fault handoff: ``PADDLE_FAULT_*`` flags (see ``fluid.fault``) are
+   forwarded to generation 0 ONLY — a restarted generation must not
+   replay the injected fault it just recovered from.
+
+CLI::
+
+    python -m paddle_tpu.parallel.elastic --nproc 4 \
+        --entry "python train.py" --workdir /tmp/run --max-restarts 3
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .master import Backoff
+
+__all__ = [
+    "write_heartbeat", "read_heartbeat", "heartbeat_path",
+    "IncidentLog", "ElasticSupervisor",
+]
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat file protocol (worker side)
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_path(hb_dir: str, rank: int) -> str:
+    return os.path.join(hb_dir, f"hb_{int(rank)}")
+
+
+def write_heartbeat(hb_dir: str, step: Optional[int] = None,
+                    rank: Optional[int] = None) -> None:
+    """Atomically publish this worker's liveness (tmp + rename, so the
+    supervisor never reads a torn write).  Cheap enough for every step:
+    one small file per rank, rewritten in place."""
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    try:
+        os.makedirs(hb_dir, exist_ok=True)
+        path = heartbeat_path(hb_dir, rank)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "step": step, "rank": int(rank),
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        # liveness reporting must never kill the training it reports on
+        pass
+
+
+def read_heartbeat(hb_dir: str, rank: int) -> Optional[dict]:
+    try:
+        with open(heartbeat_path(hb_dir, rank)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Incident log
+# ---------------------------------------------------------------------------
+
+
+class IncidentLog:
+    """Append-only JSON-lines incident record (the etcd-event analogue of
+    the reference master's state transitions): one line per supervisor
+    decision, machine-parseable for postmortems."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.events: List[dict] = []
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def log(self, event: str, **fields) -> dict:
+        rec = {"ts": time.time(), "event": event}
+        rec.update(fields)
+        self.events.append(rec)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _tail(path: str, nbytes: int = 800) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - nbytes))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+class ElasticSupervisor:
+    """Supervise an N-process local pod with checkpoint auto-resume.
+
+    ``entry`` is the per-worker command line; workers receive the standard
+    PADDLE_* multihost env (fresh coordinator port per generation, so
+    ``jax.distributed`` re-forms cleanly after a teardown) plus
+    ``PADDLE_ELASTIC_HB_DIR`` / ``PADDLE_ELASTIC_GENERATION``.  Recovery
+    itself is the WORKER's job on startup — restore from the newest
+    complete sharded checkpoint (``multihost.load_sharded_latest``) and
+    resume from its meta step; the supervisor only guarantees the pod gets
+    that chance, boundedly many times.
+    """
+
+    def __init__(self, entry: str, nproc: int, workdir: str, *,
+                 hb_timeout: float = 120.0, poll_interval: float = 0.25,
+                 max_restarts: int = 3, backoff: Optional[Backoff] = None,
+                 devices_per_host: Optional[int] = None,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 fault_env: Optional[Dict[str, str]] = None,
+                 deadline: Optional[float] = None):
+        if nproc < 1:
+            raise ValueError("nproc must be >= 1")
+        self.entry = entry
+        self.nproc = int(nproc)
+        self.workdir = os.path.abspath(workdir)
+        self.hb_timeout = float(hb_timeout)
+        self.poll_interval = float(poll_interval)
+        self.max_restarts = int(max_restarts)
+        self.backoff = backoff or Backoff(base=0.5, factor=2.0, max_delay=30.0)
+        self.devices_per_host = devices_per_host
+        self.extra_env = dict(extra_env or {})
+        self.fault_env = dict(fault_env or {})
+        self.deadline = deadline
+        self.hb_dir = os.path.join(self.workdir, "heartbeats")
+        self.incidents = IncidentLog(
+            os.path.join(self.workdir, "incidents.jsonl"))
+
+    # -- public --
+    def run(self) -> dict:
+        """Run to completion.  Returns a summary dict::
+
+            {"status": "finished" | "failed", "generations": g,
+             "incidents": [...], "incident_log": path}
+        """
+        start = time.time()
+        generations = 0
+        for gen in range(self.max_restarts + 1):
+            if gen:
+                delay = self.backoff.delay(gen - 1)
+                self.incidents.log("backoff", generation=gen, delay_s=delay)
+                time.sleep(delay)
+            generations = gen + 1
+            procs, logs = self._launch(gen)
+            verdict = self._watch(procs, logs, gen, start)
+            self._teardown(procs, gen)
+            for lf in logs:
+                lf.close()
+            if verdict == "finished":
+                self.incidents.log("finished", generation=gen)
+                return self._summary("finished", generations)
+            if verdict == "deadline":
+                break  # no point relaunching into an expired budget
+        self.incidents.log("restart_budget_exhausted",
+                           max_restarts=self.max_restarts)
+        return self._summary("failed", generations)
+
+    # -- internals --
+    def _launch(self, gen: int):
+        try:
+            from tools.pod_launch import make_launch_plan
+        except ImportError:  # repo checkout not on sys.path (installed pkg)
+            repo = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            sys.path.insert(0, repo)
+            from tools.pod_launch import make_launch_plan
+
+        os.makedirs(self.hb_dir, exist_ok=True)
+        for rank in range(self.nproc):  # stale liveness must not mask death
+            try:
+                os.remove(heartbeat_path(self.hb_dir, rank))
+            except OSError:
+                pass
+        env = {"PADDLE_ELASTIC_HB_DIR": self.hb_dir,
+               "PADDLE_ELASTIC_GENERATION": str(gen)}
+        env.update(self.extra_env)
+        if gen == 0:
+            env.update(self.fault_env)
+        port = _free_port()
+        plan = make_launch_plan(["127.0.0.1"] * self.nproc, self.entry,
+                                port=port,
+                                devices_per_host=self.devices_per_host,
+                                extra_env=env)
+        procs, logs = [], []
+        for p in plan:
+            wenv = {k: v for k, v in os.environ.items()
+                    if not (gen and k.startswith("PADDLE_FAULT_"))}
+            wenv.update(p["env"])
+            log_path = os.path.join(
+                self.workdir, f"worker_g{gen}_r{p['trainer_id']}.log")
+            lf = open(log_path, "ab")
+            procs.append(subprocess.Popen(
+                p["cmd"], env=wenv, stdout=lf, stderr=subprocess.STDOUT,
+                cwd=self.workdir))
+            logs.append(lf)
+        self.incidents.log("generation_start", generation=gen, port=port,
+                           nproc=self.nproc,
+                           fault_env=sorted(self.fault_env) if gen == 0
+                           else [])
+        return procs, logs
+
+    def _watch(self, procs, logs, gen: int, start: float) -> str:
+        """Until success/failure: poll exits and heartbeats.
+        Returns 'finished' | 'failed' | 'deadline'."""
+        gen_start = time.time()
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc == 0 for rc in rcs):
+                return "finished"
+            bad = [(r, rc) for r, rc in enumerate(rcs)
+                   if rc is not None and rc != 0]
+            if bad:
+                rank, rc = bad[0]
+                self.incidents.log(
+                    "worker_exit", generation=gen, rank=rank, exit_code=rc,
+                    log_tail=_tail(logs[rank].name))
+                return "failed"
+            now = time.time()
+            if self.deadline is not None and now - start > self.deadline:
+                self.incidents.log("deadline_exceeded", generation=gen,
+                                   deadline_s=self.deadline)
+                return "deadline"
+            for rank, rc in enumerate(rcs):
+                if rc == 0:
+                    continue  # exited clean; its silence is not a wedge
+                hb = read_heartbeat(self.hb_dir, rank)
+                last = hb["ts"] if hb else gen_start
+                if now - last > self.hb_timeout:
+                    self.incidents.log(
+                        "heartbeat_timeout", generation=gen, rank=rank,
+                        stale_s=round(now - last, 3),
+                        last_step=hb.get("step") if hb else None,
+                        log_tail=_tail(logs[rank].name))
+                    return "failed"
+            time.sleep(self.poll_interval)
+
+    def _teardown(self, procs, gen: int) -> None:
+        """Kill the whole pod: one lost worker wedges every collective, so
+        partial survival has no value — the generation is the failure
+        domain (re-forming jax.distributed needs a full restart anyway)."""
+        alive = [p for p in procs if p.poll() is None]
+        for p in alive:
+            p.terminate()
+        grace_until = time.time() + 5.0
+        for p in alive:
+            try:
+                p.wait(timeout=max(0.0, grace_until - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for p in alive:
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        if alive:
+            self.incidents.log("teardown", generation=gen,
+                               killed=len(alive))
+
+    def _summary(self, status: str, generations: int) -> dict:
+        return {"status": status, "generations": generations,
+                "incidents": list(self.incidents.events),
+                "incident_log": self.incidents.path}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Supervise an N-process multihost training pod with "
+                    "heartbeat monitoring and checkpoint auto-resume.")
+    ap.add_argument("--entry", required=True,
+                    help="per-worker command line")
+    ap.add_argument("--nproc", type=int, required=True)
+    ap.add_argument("--workdir", required=True,
+                    help="heartbeats, incidents.jsonl and worker logs")
+    ap.add_argument("--hb-timeout", type=float, default=120.0)
+    ap.add_argument("--poll-interval", type=float, default=0.25)
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="overall wall-clock budget in seconds")
+    ap.add_argument("--devices-per-host", type=int, default=None)
+    ap.add_argument("--env", action="append", default=[], metavar="K=V")
+    args = ap.parse_args(argv)
+    extra = {}
+    for kv in args.env:
+        if "=" not in kv:
+            ap.error(f"--env wants K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        extra[k] = v
+    sup = ElasticSupervisor(
+        args.entry, args.nproc, args.workdir, hb_timeout=args.hb_timeout,
+        poll_interval=args.poll_interval, max_restarts=args.max_restarts,
+        deadline=args.deadline, devices_per_host=args.devices_per_host,
+        extra_env=extra or None)
+    result = sup.run()
+    print(json.dumps(result))
+    return 0 if result["status"] == "finished" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,24 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-__all__ = ["Task", "TaskDispatcher"]
+__all__ = ["Task", "TaskDispatcher", "Backoff"]
+
+
+@dataclass
+class Backoff:
+    """Deterministic exponential backoff with a cap — the retry pacing the
+    reference's master/pserver clients use between reconnect attempts
+    (ref go/master/client.go retry loop), shared by the elastic pod
+    supervisor between generation restarts.  ``delay(k)`` is the wait
+    before attempt k (k=0 -> base)."""
+
+    base: float = 1.0
+    factor: float = 2.0
+    max_delay: float = 30.0
+
+    def delay(self, attempt: int) -> float:
+        d = self.base * (self.factor ** max(0, int(attempt)))
+        return min(d, self.max_delay)
 
 
 @dataclass

@@ -112,6 +112,43 @@ def process_count() -> int:
     return jax.process_count() if _initialized else 1
 
 
+def barrier(tag: str = "barrier", timeout_s: float = 300.0) -> None:
+    """Pod-wide rendezvous (no-op in a 1-process world).  THE hook point
+    for the wedged-collective fault: an armed barrier stall sleeps here,
+    which is exactly where a real wedged host stops heartbeating from.
+
+    Prefers the coordination-service barrier (control-plane gRPC with a
+    real timeout — a dead peer surfaces as an error here instead of a
+    silent infinite hang, and no device computation is involved, so it
+    also works on hosts whose backend cannot run multiprocess XLA);
+    falls back to a device sync when no coordination client exists."""
+    from ..fluid import fault as _fault
+
+    _fault.barrier_stall(tag)
+    if not _initialized:
+        return
+    client = getattr(
+        __import__("jax._src.distributed", fromlist=["global_state"])
+        .global_state, "client", None)
+    if client is not None:
+        client.wait_at_barrier(tag, int(timeout_s * 1000))
+    else:
+        from jax.experimental import multihost_utils as mhu
+
+        mhu.sync_global_devices(tag)
+
+
+def heartbeat(step: Optional[int] = None) -> None:
+    """Emit an elastic-supervisor liveness heartbeat for this process when
+    a heartbeat dir is configured (PADDLE_ELASTIC_HB_DIR — set by
+    parallel.elastic when it launches the pod); no-op otherwise."""
+    hb_dir = os.environ.get("PADDLE_ELASTIC_HB_DIR")
+    if hb_dir:
+        from .elastic import write_heartbeat
+
+        write_heartbeat(hb_dir, step=step, rank=process_index())
+
+
 def global_mesh(axis_names: Sequence[str] = ("dp",),
                 mesh_shape: Optional[Sequence[int]] = None) -> Mesh:
     """Mesh over ALL processes' devices (ICI within a host, DCN across).
@@ -166,6 +203,7 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
     so checkpoint IO spreads across hosts instead of duplicating."""
     import json
 
+    from ..fluid import fault as _fault
     from ..fluid.transpiler.ps_dispatcher import assign_writer
 
     pid = process_index()
@@ -190,6 +228,7 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
             # host run): one blob, written by its assigned process
             if writer_of.get(name, 0) == pid or not _initialized:
                 fn = f"{_safe_name(name)}.full.npy"
+                _fault.io_delay()
                 np.save(os.path.join(d, fn), np.asarray(arr))
                 entry["shards"].append({"file": fn, "index": None})
         else:
@@ -209,6 +248,7 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
                     # empty index is trivially full): one assigned writer
                     continue
                 fn = f"{_safe_name(name)}.{i}.npy"
+                _fault.io_delay()
                 np.save(os.path.join(d, fn), np.asarray(sh.data))
                 entry["shards"].append({"file": fn,
                                         "index": [list(p) for p in idx]})
@@ -220,13 +260,14 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
         json.dump({"process_count": process_count(), "vars": manifest}, f)
 
 
-def load_sharded(ckpt_dir: str, mesh: Mesh, specs: dict) -> dict:
+def load_sharded(ckpt_dir: str, mesh: Optional[Mesh], specs: dict) -> dict:
     """Rebuild global arrays from every shard_*/ manifest under ckpt_dir.
 
     Requires the checkpoint directory to be readable by all processes
     (shared storage).  Arrays come back with NamedSharding(mesh,
     specs.get(name, P())), so restore works across a different process
-    count than the save ran with."""
+    count than the save ran with.  ``mesh=None`` skips device placement
+    and returns host numpy arrays (scope-level restore)."""
     import json
 
     # process 0's manifest is canonical for the world size: stale higher-
@@ -285,6 +326,8 @@ def load_sharded(ckpt_dir: str, mesh: Mesh, specs: dict) -> dict:
             raise IOError(
                 f"sharded checkpoint {ckpt_dir}: var '{name}' covers "
                 f"{covered[name]}/{host.size} elements — missing shards")
+    if mesh is None:
+        return assembled
     out = {}
     for name, host in assembled.items():
         spec = specs.get(name, P())
@@ -292,3 +335,126 @@ def load_sharded(ckpt_dir: str, mesh: Mesh, specs: dict) -> dict:
         out[name] = jax.make_array_from_callback(
             host.shape, sharding, lambda idx, h=host: h[idx])
     return out
+
+
+# ---------------------------------------------------------------------------
+# Serial-dir protocol over sharded checkpoints (the multihost face of
+# trainer.save_checkpoint's checkpoint_<n>/_SUCCESS convention, shared with
+# the elastic supervisor): every process writes its shards of
+# <root>/checkpoint_<n>/, a pod barrier proves all writers finished, then
+# process 0 alone commits the serial with meta.json + _SUCCESS.  A worker
+# preempted at ANY point leaves either a complete older serial or an
+# unmarked dir that restore skips/cleans — never a half-readable state.
+# ---------------------------------------------------------------------------
+
+SERIAL_PREFIX = "checkpoint"
+SUCCESS_MARK = "_SUCCESS"
+META_FILE = "meta.json"
+
+
+def _sharded_serial_dirs(root: str):
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if name.startswith(SERIAL_PREFIX + "_"):
+            try:
+                out.append((int(name.rsplit("_", 1)[1]), name))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def latest_complete_sharded(root: str) -> int:
+    """Newest serial whose _SUCCESS marker exists, or -1."""
+    for serial, name in reversed(_sharded_serial_dirs(root)):
+        if os.path.exists(os.path.join(root, name, SUCCESS_MARK)):
+            return serial
+    return -1
+
+
+def save_sharded_serial(state: dict, root: str, serial: int,
+                        meta: Optional[dict] = None,
+                        max_num: Optional[int] = None) -> str:
+    """Commit ``state`` as <root>/checkpoint_<serial>/ under the _SUCCESS
+    protocol.  ``serial`` is caller-assigned (typically the global step) so
+    every process independently derives the same value with no filesystem
+    race; restore hands the resume point back via ``meta``.
+
+    Ordering: shards -> barrier (all writers done) -> [p0] meta + _SUCCESS
+    -> barrier (everyone may now trust the serial) -> [p0] prune.  The
+    fault hooks bracket the _SUCCESS write exactly like the single-process
+    trainer checkpoint."""
+    import json as _json
+    import shutil
+
+    from ..fluid import fault as _fault
+
+    cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
+    os.makedirs(cur, exist_ok=True)
+    save_sharded(state, cur)
+    barrier(f"ckpt_shards_{serial}")
+    if process_index() == 0:
+        if meta is not None:
+            with open(os.path.join(cur, META_FILE), "w") as f:
+                _json.dump(meta, f)
+        _fault.ckpt_crash_point("before")
+        with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
+            f.write("")
+        _fault.ckpt_crash_point("after")
+    barrier(f"ckpt_commit_{serial}")
+    if process_index() == 0 and max_num is not None:
+        complete = [(s, n) for s, n in _sharded_serial_dirs(root)
+                    if os.path.exists(os.path.join(root, n, SUCCESS_MARK))]
+        for _, name in complete[:max(0, len(complete) - max_num)]:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    return cur
+
+
+def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
+                        clean_incomplete: bool = True):
+    """Restore the newest complete serial under ``root``.
+
+    Returns (serial, meta, state) or (-1, None, None) when no complete
+    checkpoint exists.  A complete-but-unreadable serial (truncated shard
+    after commit) falls back to the previous complete one, mirroring
+    trainer.load_checkpoint.  ``clean_incomplete`` removes unmarked serial
+    dirs left by a dead generation (process 0 only, behind a barrier) so a
+    resumed run re-using their serial numbers never mixes stale shards
+    with fresh ones."""
+    import json as _json
+    import shutil
+
+    if clean_incomplete:
+        if process_index() == 0:
+            for serial, name in _sharded_serial_dirs(root):
+                if not os.path.exists(os.path.join(root, name,
+                                                   SUCCESS_MARK)):
+                    shutil.rmtree(os.path.join(root, name),
+                                  ignore_errors=True)
+        barrier("ckpt_clean")
+    complete = [s for s, name in _sharded_serial_dirs(root)
+                if os.path.exists(os.path.join(root, name, SUCCESS_MARK))]
+    last_exc = None
+    for serial in reversed(complete):
+        cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
+        try:
+            state = load_sharded(cur, mesh, specs)
+        except Exception as exc:
+            from ..fluid.log import LOG
+
+            LOG(f"sharded checkpoint {cur} is unreadable ({exc!r}); "
+                f"falling back to the previous complete serial")
+            last_exc = exc
+            continue
+        meta = {}
+        meta_path = os.path.join(cur, META_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = _json.load(f)
+        return serial, meta, state
+    if last_exc is not None:
+        raise IOError(
+            f"no loadable sharded checkpoint under {root}: every complete "
+            f"serial failed to read") from last_exc
+    return -1, None, None

@@ -374,8 +374,10 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
         from ..fluid.layer_helper import LayerHelper
 
         def _pair(v, v_y):
+            # list args follow the reference's [x, y] convention; unpack
+            # to fluid's (y, x) order exactly like _layers_ext._yx
             if isinstance(v, (list, tuple)):
-                return [int(v[0]), int(v[-1])]
+                return [int(v[-1]), int(v[0])]
             return [int(v_y if v_y is not None else v), int(v)]
 
         ky, kx = _pair(pool_size, kwargs.get("pool_size_y"))

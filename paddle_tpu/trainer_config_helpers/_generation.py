@@ -165,7 +165,7 @@ class _V2BeamSearchDecoder(BeamSearchDecoder):
                         self.update_array(stored, feeds[name])
 
 
-def beam_search(step, input, bos_id, eos_id, beam_size=5, max_length=30,
+def beam_search(step, input, bos_id, eos_id, beam_size=5, max_length=500,
                 num_results_per_sample=None, name=None):
     """ref layers.py beam_search: generate with the training step
     function.  ``input`` mixes StaticInput wrappers and exactly one

@@ -33,12 +33,15 @@ class Inference:
         self._program = first.block.program
         self._place = fluid.CPUPlace() if not _accel() else fluid.TPUPlace()
         self._exe = fluid.Executor(self._place)
+        self._parameters = parameters
         self._install(parameters)
 
     @staticmethod
     def _install(parameters):
         """Copy an explicit Parameters/from_tar mapping into the scope.
-        Runs on every run() call (like the reference, which owns a
+        A live Parameters is a view over the scope, so installing it once
+        suffices; a DETACHED mapping (from_tar) carries its own values and
+        is re-installed on every run() (like the reference, which owns a
         GradientMachine initialized from the parameters) so training in
         between cannot silently change what infer uses."""
         if parameters is not None and hasattr(parameters, "names"):
@@ -55,6 +58,12 @@ class Inference:
         return build_feed(self._program, input, feeding, skip=skip)
 
     def run(self, input, feeding=None, field="value"):
+        from .parameters import _LoadedParameters
+
+        if isinstance(self._parameters, _LoadedParameters):
+            # detached values: the scope may have been retrained since the
+            # last call — every run must infer with the tar's weights
+            self._install(self._parameters)
         feed = self._feed(input, feeding)
         if self._gen is not None:
             feed.update(self._gen.init_feeds(len(input)))
@@ -114,4 +123,5 @@ def infer(output_layer, parameters=None, input=None, feeding=None,
         # no-op; only a detached from_tar mapping carries new values.)
         Inference._install(parameters)
         inf._last_params = parameters
+        inf._parameters = parameters  # run() re-installs detached mappings
     return inf.run(input, feeding=feeding, field=field)

@@ -1,0 +1,203 @@
+"""fluid.fault: deterministic fault injection through the real hook points
+(executor step boundary, trainer checkpoint path, multihost barrier)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import fault
+from paddle_tpu.fluid import core
+
+
+@pytest.fixture(autouse=True)
+def disarm():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _mlp():
+    fluid.default_main_program().random_seed = 5
+    fluid.default_startup_program().random_seed = 5
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.normal(size=(8, 4)).astype(np.float32),
+            "y": rng.normal(size=(8, 1)).astype(np.float32)}
+
+
+def test_env_contract_parsing():
+    plan = fault.FaultPlan.from_env({
+        "PADDLE_FAULT_KILL_STEP": "7", "PADDLE_FAULT_RANK": "2",
+        "PADDLE_FAULT_CKPT_CRASH": "before",
+        "PADDLE_FAULT_IO_DELAY_MS": "12.5",
+        "PADDLE_FAULT_NAN_VAR": "fc_0.w_0", "PADDLE_FAULT_NAN_STEP": "3",
+        "PADDLE_FAULT_BARRIER_STALL": "0.5",
+        "PADDLE_FAULT_MODE": "raise"})
+    assert plan.kill_step == 7 and plan.rank == 2
+    assert plan.ckpt_crash == "before" and plan.io_delay_ms == 12.5
+    assert plan.nan_var == "fc_0.w_0" and plan.nan_step == 3
+    assert plan.barrier_stall_s == 0.5 and plan.mode == "raise"
+    # nothing armed -> no plan (hooks must stay free)
+    assert fault.FaultPlan.from_env({}) is None
+    assert fault.FaultPlan.from_env({"PADDLE_FAULT_KILL_STEP": ""}) is None
+    with pytest.raises(ValueError):
+        fault.FaultPlan(ckpt_crash="sideways")
+    with pytest.raises(ValueError):
+        fault.FaultPlan(mode="explode")
+
+
+def test_kill_at_step_fires_through_executor():
+    """kill-at-step-N fires at the Nth TRAINING step boundary — startup
+    and eval runs don't tick the counter."""
+    exe, loss = _mlp()
+    fault.install(fault.FaultPlan(kill_step=2, mode="raise"))
+    exe.run(fluid.default_main_program(), feed=_feed(0), fetch_list=[loss])
+    exe.run(fluid.default_main_program(), feed=_feed(1), fetch_list=[loss])
+    with pytest.raises(fault.InjectedFault):
+        exe.run(fluid.default_main_program(), feed=_feed(2),
+                fetch_list=[loss])
+
+
+def test_kill_step_respects_rank_filter():
+    exe, loss = _mlp()
+    fault.install(fault.FaultPlan(kill_step=0, rank=3, mode="raise"))
+    # this process is rank 0 (no PADDLE_TRAINER_ID): fault is not ours
+    exe.run(fluid.default_main_program(), feed=_feed(0), fetch_list=[loss])
+    assert fault.current_step() == 1
+
+
+def test_resumed_worker_does_not_refire():
+    """A worker that resumes PAST the kill step (explicit step index, the
+    elastic worker's contract) must not re-fire the fault it died on."""
+    fault.install(fault.FaultPlan(kill_step=3, mode="raise"))
+    fault.on_step(4)
+    fault.on_step(5)
+    assert fault.current_step() == 6
+    # ...but an earlier explicit index still fires
+    with pytest.raises(fault.InjectedFault):
+        fault.on_step(3)
+
+
+def test_run_steps_window_kill():
+    """A fused multi-step dispatch kills before the dispatch when the armed
+    step falls anywhere inside its window."""
+    exe, loss = _mlp()
+    fault.install(fault.FaultPlan(kill_step=5, mode="raise"))
+    with pytest.raises(fault.InjectedFault):
+        exe.run_steps(fluid.default_main_program(), _feed(0), [loss],
+                      n_steps=8)
+
+
+def test_nan_injection_lands_in_scope_and_trips_checker():
+    exe, loss = _mlp()
+    fault.install(fault.FaultPlan(nan_var="fc_0.w_0", nan_step=0,
+                                  mode="raise"))
+    exe.run(fluid.default_main_program(), feed=_feed(0), fetch_list=[loss])
+    from paddle_tpu.fluid.executor import global_scope
+
+    w = np.asarray(global_scope().get("fc_0.w_0"))
+    assert np.isnan(w).all()
+    # one-shot: clean weights written next step stay clean
+    global_scope().set("fc_0.w_0", np.zeros_like(w))
+    exe.run(fluid.default_main_program(), feed=_feed(1), fetch_list=[loss])
+    # the injected NaN flowed through real state, so the debug checker
+    # sees the genuine article when re-armed
+    fault.install(fault.FaultPlan(nan_var="fc_0.w_0", nan_step=0,
+                                  mode="raise"))
+    fault.on_step(1)
+    core.GLOBAL_FLAGS["check_nan_inf"] = True
+    try:
+        with pytest.raises(FloatingPointError, match="fc_0.w_0"):
+            exe.run(fluid.default_main_program(), feed=_feed(2),
+                    fetch_list=[loss])
+    finally:
+        core.GLOBAL_FLAGS["check_nan_inf"] = False
+
+
+def test_io_delay_slows_checkpoint_write(tmp_path):
+    from paddle_tpu.fluid import trainer as tr
+
+    exe, loss = _mlp()
+    t0 = time.perf_counter()
+    tr.save_checkpoint(exe, str(tmp_path / "fast"),
+                       fluid.default_main_program())
+    fast = time.perf_counter() - t0
+    fault.install(fault.FaultPlan(io_delay_ms=40.0))
+    t0 = time.perf_counter()
+    tr.save_checkpoint(exe, str(tmp_path / "slow"),
+                       fluid.default_main_program())
+    slow = time.perf_counter() - t0
+    # >= 2 persistables x 40ms delay each
+    assert slow > fast + 0.06
+    # delayed writes are still correct writes
+    import os as _os
+
+    assert _os.path.exists(str(tmp_path / "slow" / "checkpoint_0" /
+                               "_SUCCESS"))
+
+
+def test_barrier_stall_is_one_shot():
+    from paddle_tpu.parallel import multihost
+
+    fault.install(fault.FaultPlan(barrier_stall_s=0.15))
+    t0 = time.perf_counter()
+    multihost.barrier("t1")  # 1-process world: only the stall
+    stalled = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    multihost.barrier("t2")
+    clean = time.perf_counter() - t0
+    assert stalled >= 0.14 and clean < 0.1
+
+
+def test_ckpt_crash_between_write_and_mark(tmp_path):
+    """The mid-commit crash: var files written, _SUCCESS not — the dir must
+    be invisible to restore while the previous serial stays loadable."""
+    from paddle_tpu.fluid import trainer as tr
+
+    exe, loss = _mlp()
+    ckpt = str(tmp_path / "ckpt")
+    exe.run(fluid.default_main_program(), feed=_feed(0), fetch_list=[loss])
+    tr.save_checkpoint(exe, ckpt, fluid.default_main_program(),
+                       trainer_args={"epoch_id": 0, "step_id": 0})
+    fault.install(fault.FaultPlan(ckpt_crash="before", mode="raise"))
+    with pytest.raises(fault.InjectedFault):
+        tr.save_checkpoint(exe, ckpt, fluid.default_main_program(),
+                           trainer_args={"epoch_id": 0, "step_id": 1})
+    fault.clear()
+    # the crashed serial exists on disk but is not complete
+    assert os.path.isdir(os.path.join(ckpt, "checkpoint_1"))
+    assert not os.path.exists(
+        os.path.join(ckpt, "checkpoint_1", "_SUCCESS"))
+    assert tr._latest_complete_serial(ckpt) == 0
+    args = tr.load_checkpoint(exe, ckpt, fluid.default_main_program())
+    assert args == {"epoch_id": 0, "step_id": 0}
+
+
+def test_ckpt_crash_after_mark_commits(tmp_path):
+    """A crash AFTER _SUCCESS is a committed checkpoint: restore sees it."""
+    from paddle_tpu.fluid import trainer as tr
+
+    exe, loss = _mlp()
+    ckpt = str(tmp_path / "ckpt")
+    fault.install(fault.FaultPlan(ckpt_crash="after", mode="raise"))
+    with pytest.raises(fault.InjectedFault):
+        tr.save_checkpoint(exe, ckpt, fluid.default_main_program(),
+                           trainer_args={"epoch_id": 2, "step_id": 7})
+    fault.clear()
+    assert tr._latest_complete_serial(ckpt) == 0
+    args = tr.load_checkpoint(exe, ckpt, fluid.default_main_program())
+    assert args == {"epoch_id": 2, "step_id": 7}

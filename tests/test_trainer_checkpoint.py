@@ -126,6 +126,69 @@ def test_incomplete_checkpoint_is_ignored(tmp_path):
     newest = serials[-1][1]
     os.remove(os.path.join(ckpt, newest, "_SUCCESS"))
     assert trainer_mod._latest_complete_serial(ckpt) == serials[-2][0]
+    # and load_checkpoint restores that previous serial's trainer args
+    _fresh()
+    t2 = fluid.Trainer(_train_func, _optimizer_func)
+    args = trainer_mod.load_checkpoint(t2.exe, ckpt, t2.train_program)
+    import json
+
+    with open(os.path.join(ckpt, f"checkpoint_{serials[-2][0]}",
+                           "trainer_args.json")) as f:
+        assert args == json.load(f)
+
+
+def test_truncated_param_file_falls_back_to_previous_serial(tmp_path):
+    """_SUCCESS present but a var file truncated (bit rot after commit):
+    restore must fall back to the previous complete serial, not die and
+    not half-load."""
+    import json
+
+    ckpt = str(tmp_path / "ckpt4")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, step_interval=2,
+                                 max_num_checkpoints=3)
+    t = fluid.Trainer(_train_func, _optimizer_func, checkpoint_config=cfg)
+    _collect_losses(t, _reader())
+    serials = trainer_mod._serial_dirs(ckpt)
+    assert len(serials) >= 2
+    newest_dir = os.path.join(ckpt, serials[-1][1])
+    prev_dir = os.path.join(ckpt, serials[-2][1])
+    assert os.path.exists(os.path.join(newest_dir, "_SUCCESS"))
+    # truncate one param file in the NEWEST complete serial
+    victim = os.path.join(newest_dir, "fc_0.w_0")
+    with open(victim, "r+b") as f:
+        f.truncate(8)
+
+    _fresh()
+    t2 = fluid.Trainer(_train_func, _optimizer_func)
+    args = trainer_mod.load_checkpoint(t2.exe, ckpt, t2.train_program)
+    with open(os.path.join(prev_dir, "trainer_args.json")) as f:
+        assert args == json.load(f)
+    # the restored weights are the PREVIOUS serial's, bit-for-bit
+    from paddle_tpu.fluid.executor import global_scope
+
+    want = np.load(os.path.join(prev_dir, "fc_0.w_0"))
+    np.testing.assert_array_equal(
+        np.asarray(global_scope().get("fc_0.w_0")), want)
+
+
+def test_all_serials_corrupt_raises_not_silently_fresh(tmp_path):
+    """If EVERY complete serial is unreadable the restore must fail loudly
+    — silently training from scratch would hide data loss."""
+    ckpt = str(tmp_path / "ckpt5")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt, step_interval=4,
+                                 max_num_checkpoints=1)
+    t = fluid.Trainer(_train_func, _optimizer_func, checkpoint_config=cfg)
+    _collect_losses(t, _reader())
+    serials = trainer_mod._serial_dirs(ckpt)
+    for _, name in serials:
+        victim = os.path.join(ckpt, name, "fc_0.w_0")
+        if os.path.exists(victim):
+            with open(victim, "r+b") as f:
+                f.truncate(4)
+    _fresh()
+    t2 = fluid.Trainer(_train_func, _optimizer_func)
+    with pytest.raises(IOError):
+        trainer_mod.load_checkpoint(t2.exe, ckpt, t2.train_program)
 
 
 def test_sharded_checkpoint_roundtrip():
@@ -171,6 +234,71 @@ def test_sharded_checkpoint_roundtrip():
         np.testing.assert_allclose(np.asarray(state[n]), np.asarray(back[n]),
                                    rtol=1e-6, atol=1e-6, err_msg=n)
         assert back[n].sharding.spec == (step.specs.get(n) or P()), n
+
+
+def test_sharded_serial_protocol(tmp_path):
+    """save_sharded_serial/load_sharded_latest: _SUCCESS commit, meta
+    round-trip, scroll-prune, corrupt-serial fallback and unmarked-dir
+    cleanup — the multihost face of the trainer serial-dir protocol."""
+    from paddle_tpu.parallel import multihost as mh
+
+    root = str(tmp_path / "root")
+    states = [{"w": np.arange(6, dtype=np.float32).reshape(2, 3) + i,
+               "b": np.full((3,), float(i), np.float32)} for i in range(3)]
+    for i, st in enumerate(states):
+        mh.save_sharded_serial(st, root, serial=i, meta={"step": i},
+                               max_num=2)
+    # scroll-prune kept the newest 2 complete serials
+    assert [s for s, _ in mh._sharded_serial_dirs(root)] == [1, 2]
+    assert mh.latest_complete_sharded(root) == 2
+    serial, meta, back = mh.load_sharded_latest(root, None, {})
+    assert serial == 2 and meta == {"step": 2}
+    np.testing.assert_array_equal(back["w"], states[2]["w"])
+    np.testing.assert_array_equal(back["b"], states[2]["b"])
+
+    # an unmarked serial dir (writer died mid-shards) is cleaned, not read
+    crashed = os.path.join(root, "checkpoint_3")
+    os.makedirs(os.path.join(crashed, "shard_0"))
+    with open(os.path.join(crashed, "shard_0", "junk.npy"), "wb") as f:
+        f.write(b"partial")
+    serial, meta, back = mh.load_sharded_latest(root, None, {})
+    assert serial == 2
+    assert not os.path.exists(crashed)
+
+    # newest complete serial turns unreadable (truncated shard after
+    # commit): restore falls back to the previous complete serial
+    victim = os.path.join(root, "checkpoint_2", "shard_0", "w.full.npy")
+    with open(victim, "r+b") as f:
+        f.truncate(4)
+    serial, meta, back = mh.load_sharded_latest(root, None, {})
+    assert serial == 1 and meta == {"step": 1}
+    np.testing.assert_array_equal(back["w"], states[1]["w"])
+
+
+def test_sharded_serial_crash_between_write_and_mark(tmp_path):
+    """A crash injected between the shard writes and the _SUCCESS mark
+    leaves the PREVIOUS serial loadable and the new one invisible."""
+    from paddle_tpu.fluid import fault
+    from paddle_tpu.parallel import multihost as mh
+
+    root = str(tmp_path / "root")
+    s0 = {"w": np.ones((4,), np.float32)}
+    s1 = {"w": np.full((4,), 2.0, np.float32)}
+    mh.save_sharded_serial(s0, root, serial=0, meta={"step": 0})
+    fault.install(fault.FaultPlan(ckpt_crash="before", mode="raise"))
+    try:
+        with pytest.raises(fault.InjectedFault):
+            mh.save_sharded_serial(s1, root, serial=1, meta={"step": 1})
+    finally:
+        fault.clear()
+    # shards of serial 1 are on disk, but it is not a checkpoint
+    assert os.path.isdir(os.path.join(root, "checkpoint_1"))
+    assert mh.latest_complete_sharded(root) == 0
+    serial, meta, back = mh.load_sharded_latest(root, None, {})
+    assert serial == 0 and meta == {"step": 0}
+    np.testing.assert_array_equal(back["w"], s0["w"])
+    # and the restore cleaned the crashed serial away
+    assert not os.path.exists(os.path.join(root, "checkpoint_1"))
 
 
 def test_assign_writer_deterministic_and_balanced():

@@ -72,7 +72,17 @@ def test_infer_from_tar_parameters(tmp_path):
                               input=[(row,) for row in x_np])
         ids = paddle_v2.infer(output_layer=pred, parameters=loaded,
                               input=[(row,) for row in x_np], field="id")
+        # a detached from_tar mapping is re-installed on EVERY run: scope
+        # mutation in between (training) must not leak into inference
+        from paddle_tpu.fluid.executor import global_scope
+
+        wname = loaded.names()[0]
+        global_scope().set(wname, np.zeros_like(np.asarray(w)))
+        again = paddle_v2.infer(output_layer=pred, parameters=loaded,
+                                input=[(row,) for row in x_np])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(again), np.asarray(want),
                                rtol=1e-5)
     assert np.asarray(ids).shape == (2,)
 

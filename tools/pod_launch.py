@@ -28,7 +28,9 @@ CLI:
 `--format env` (default) prints per-host `env VAR=... cmd` lines;
 `k8s` emits one YAML Job per host as an indexed StatefulSet-style list
 (mirroring kube_gen_job.py's per-role manifests, minus the pserver half);
-`ssh` prints ready-to-paste ssh lines.
+`ssh` prints ready-to-paste ssh lines; `elastic` emits a single
+`paddle_tpu.parallel.elastic` supervisor command that owns the whole
+(local) pod — launch, heartbeat watch, checkpoint auto-resume.
 """
 
 from __future__ import annotations
@@ -88,6 +90,24 @@ def format_ssh(plan: List[dict]) -> str:
         cmd = " ".join(shlex.quote(c) for c in p["cmd"])
         lines.append(f"ssh {p['host']} {shlex.quote(f'env {envs} {cmd}')}")
     return "\n".join(lines)
+
+
+def format_elastic(plan: List[dict], workdir: str = "./elastic_run") -> str:
+    """One supervisor line replacing N per-host lines: hand the pod to
+    ``paddle_tpu.parallel.elastic``, which relaunches it with this same
+    env contract, watches heartbeats, and auto-resumes from the newest
+    complete sharded checkpoint (docs/ROBUSTNESS.md).  Local
+    (single-machine) pods only — the k8s/ssh formats stay the multi-host
+    path, with the supervisor run per site."""
+    entry = " ".join(shlex.quote(c) for c in plan[0]["cmd"])
+    passthrough = [f"--env {shlex.quote(k + '=' + v)}"
+                   for k, v in sorted(plan[0]["env"].items())
+                   if k not in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS",
+                                "PADDLE_COORDINATOR_ADDR")]
+    parts = [f"python -m paddle_tpu.parallel.elastic --nproc {len(plan)}",
+             f"--entry {shlex.quote(entry)}",
+             f"--workdir {shlex.quote(workdir)}"] + passthrough
+    return " \\\n    ".join(parts)
 
 
 def format_k8s(plan: List[dict], jobname: str = "paddlejob",
@@ -150,10 +170,12 @@ def main(argv=None) -> int:
                     help="pin PADDLE_LOCAL_DEVICE_IDS=0..D-1 on every host")
     ap.add_argument("--env", action="append", default=[],
                     metavar="K=V", help="extra env var(s) for every host")
-    ap.add_argument("--format", choices=("env", "ssh", "k8s"),
+    ap.add_argument("--format", choices=("env", "ssh", "k8s", "elastic"),
                     default="env")
     ap.add_argument("--jobname", default="paddlejob")
     ap.add_argument("--image", default="paddle-tpu:latest")
+    ap.add_argument("--workdir", default="./elastic_run",
+                    help="supervisor workdir for --format elastic")
     args = ap.parse_args(argv)
 
     extra = {}
@@ -167,7 +189,8 @@ def main(argv=None) -> int:
                             devices_per_host=args.devices_per_host,
                             extra_env=extra or None)
     fmt = {"env": format_env, "ssh": format_ssh,
-           "k8s": lambda p: format_k8s(p, args.jobname, args.image)}
+           "k8s": lambda p: format_k8s(p, args.jobname, args.image),
+           "elastic": lambda p: format_elastic(p, args.workdir)}
     try:
         print(fmt[args.format](plan))
     except BrokenPipeError:  # output piped into head/grep that closed early
