@@ -27,6 +27,14 @@ worker processes:
     PADDLE_FAULT_BARRIER_STALL=s  sleep s seconds before the next collective
                                   barrier (one-shot), simulating a wedged
                                   host that trips the supervisor's timeout
+    PADDLE_FAULT_SERVE_DELAY_MS=t sleep t ms per serving-engine request
+                                  (slow-model / GC-pause simulation on the
+                                  inference path)
+    PADDLE_FAULT_SERVE_FAIL_EVERY=N
+                                  fail every Nth serving request with an
+                                  InjectedFault delivered on that request's
+                                  future (the engine must isolate it: the
+                                  rest of the batch still completes)
     PADDLE_FAULT_MODE=exit|raise  crash flavor: hard process exit (default)
                                   or an InjectedFault raise (in-process
                                   tests of the recovery path)
@@ -36,7 +44,9 @@ armed): ``Executor.run``/``run_steps`` call :func:`on_step` at the training
 step boundary and :func:`corrupt_state` on the step's outputs;
 ``trainer.save_checkpoint``/``multihost.save_sharded_serial`` call
 :func:`ckpt_crash_point` around their _SUCCESS writes and :func:`io_delay`
-in their write loops; ``multihost.barrier`` calls :func:`barrier_stall`.
+in their write loops; ``multihost.barrier`` calls :func:`barrier_stall`;
+``serving.ServingEngine`` calls :func:`serving_request` once per admitted
+request at batch formation.
 
 Determinism contract: a fault keyed to step N fires exactly at step N of
 the *caller-provided* step index when one is given (the elastic worker
@@ -53,7 +63,7 @@ from typing import Optional
 __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
-    "barrier_stall", "current_step", "KILL_EXIT_CODE",
+    "barrier_stall", "serving_request", "current_step", "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -75,6 +85,7 @@ class FaultPlan:
                  io_delay_ms: float = 0.0,
                  nan_var: Optional[str] = None, nan_step: int = 0,
                  barrier_stall_s: float = 0.0,
+                 serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -88,11 +99,14 @@ class FaultPlan:
         self.nan_var = nan_var
         self.nan_step = int(nan_step)
         self.barrier_stall_s = float(barrier_stall_s)
+        self.serve_delay_ms = float(serve_delay_ms)
+        self.serve_fail_every = int(serve_fail_every)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
         self._nan_fired = False
         self._stall_fired = False
+        self._serve_count = 0
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultPlan"]:
@@ -111,6 +125,8 @@ class FaultPlan:
             nan_var=env.get("PADDLE_FAULT_NAN_VAR", "").strip() or None,
             nan_step=int(getf("PADDLE_FAULT_NAN_STEP")),
             barrier_stall_s=getf("PADDLE_FAULT_BARRIER_STALL"),
+            serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
+            serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
             rank=int(rank) if rank else None,
             mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
         )
@@ -229,6 +245,25 @@ def io_delay() -> None:
     if plan is not None and plan.io_delay_ms > 0 \
             and plan._applies_to_this_rank():
         time.sleep(plan.io_delay_ms / 1000.0)
+
+
+def serving_request() -> None:
+    """Serving-path hook, called once per admitted request at batch
+    formation.  Applies the per-request injected delay, then fails every
+    Nth request by RAISING InjectedFault — always a raise regardless of
+    ``mode``, because a per-request fault models a failed request, not a
+    dead server (the engine delivers it on that request's future and the
+    rest of the batch must still complete)."""
+    plan = active()
+    if plan is None or not plan._applies_to_this_rank():
+        return
+    if plan.serve_delay_ms > 0:
+        time.sleep(plan.serve_delay_ms / 1000.0)
+    if plan.serve_fail_every > 0:
+        plan._serve_count += 1
+        if plan._serve_count % plan.serve_fail_every == 0:
+            raise InjectedFault(
+                f"injected serving failure (request #{plan._serve_count})")
 
 
 def barrier_stall(tag: str = "") -> None:

@@ -12,6 +12,10 @@ from __future__ import annotations
 
 class InferenceTranspiler:
     def transpile(self, program, place, scope=None):
+        """Returns the fused program.  Callers must install the RETURN
+        VALUE (the reference transpiler mutates its argument; here the
+        pass pipeline's ``to_program()`` owns the write-back, and relying
+        on aliasing would silently break the moment a pass clones)."""
         from ..executor import global_scope
         from ..ir import ConvBNFuse, Graph
 
@@ -20,5 +24,4 @@ class InferenceTranspiler:
             for op in block.ops:
                 if op.type in ("batch_norm", "dropout"):
                     op.attrs["is_test"] = True
-        ConvBNFuse(scope).apply(Graph(program, 0)).to_program()
-        return program
+        return ConvBNFuse(scope).apply(Graph(program, 0)).to_program()

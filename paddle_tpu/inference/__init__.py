@@ -63,6 +63,17 @@ class AnalysisConfig(NativeConfig):
     analysis pass; weight-only, so accuracy loss stays <1%)."""
     enable_ir_optim: bool = True
     enable_int8: bool = False
+    # engine-backed mode (paddle_tpu.serving): Run() routes through a
+    # shared dynamic-batching ServingEngine, so concurrent callers get
+    # batched dispatches and bucketed compiles for free.  The serving_*
+    # knobs seed the engine's ServingConfig; serving_warmup AOT-precompiles
+    # every batch bucket at predictor construction (docs/SERVING.md).
+    enable_serving: bool = False
+    serving_max_batch_size: int = 32
+    serving_max_wait_ms: float = 5.0
+    serving_max_queue_depth: int = 256
+    serving_warmup: bool = False
+    serving_batch_invariant: bool = False
 
 
 class PaddlePredictor:
@@ -94,13 +105,35 @@ class PaddlePredictor:
         if isinstance(config, AnalysisConfig) and config.enable_ir_optim:
             from paddle_tpu.fluid.transpiler import InferenceTranspiler
 
-            InferenceTranspiler().transpile(self._program, place,
-                                            scope=self._scope)
+            # install the RETURNED program: the transpile contract is
+            # "returns the fused program", not "mutates in place"
+            self._program = InferenceTranspiler().transpile(
+                self._program, place, scope=self._scope)
         if isinstance(config, AnalysisConfig) and config.enable_int8:
             from paddle_tpu.fluid.transpiler import Int8WeightTranspiler
 
+            # NOTE: returns the quantized weight NAMES, not a program —
+            # the int8 rewrite is in-place
             Int8WeightTranspiler().transpile(self._program, place,
                                              scope=self._scope)
+        self._engine = None
+        if isinstance(config, AnalysisConfig) and config.enable_serving:
+            from paddle_tpu.serving import ServingConfig, ServingEngine
+
+            self._engine = ServingEngine(self, ServingConfig(
+                max_batch_size=config.serving_max_batch_size,
+                max_wait_ms=config.serving_max_wait_ms,
+                max_queue_depth=config.serving_max_queue_depth,
+                batch_invariant=config.serving_batch_invariant))
+            if config.serving_warmup:
+                self._engine.warmup()
+
+    def close(self) -> None:
+        """Drain and stop the serving engine (engine-backed mode only);
+        a predictor without an engine has nothing to release."""
+        if self._engine is not None:
+            self._engine.shutdown()
+            self._engine = None
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)
@@ -110,8 +143,29 @@ class PaddlePredictor:
 
     def run(self, inputs: List[PaddleTensor],
             batch_size: int = -1) -> List[PaddleTensor]:
+        if self._engine is not None:
+            # engine-backed mode: Run() becomes a blocking submit to the
+            # shared dynamic batcher — concurrent callers coalesce into
+            # bucketed batch dispatches (docs/SERVING.md)
+            return self._engine.infer(inputs)
+        return self._run_direct(inputs)
+
+    def _run_direct(self, inputs: List[PaddleTensor]) -> List[PaddleTensor]:
+        """The un-batched executor path (also the serving engine's
+        backend — the engine calls this to avoid re-entering itself)."""
         from paddle_tpu.fluid.lod_tensor import LoDTensor
 
+        # positional fallback is only well-defined when the FULL feed list
+        # arrives in declaration order; a partial unnamed feed would bind
+        # self._feed_names[i] to the wrong tensor silently
+        if any(not t.name for t in inputs) \
+                and len(inputs) != len(self._feed_names):
+            raise ValueError(
+                f"unnamed PaddleTensors are fed positionally, which "
+                f"requires exactly the full feed list "
+                f"{self._feed_names} in declaration order; got "
+                f"{len(inputs)} tensors. Name the tensors to feed a "
+                f"subset.")
         feed = {}
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
@@ -164,6 +218,9 @@ class PaddlePredictor:
         c._program = self._program
         c._feed_names = list(self._feed_names)
         c._fetch_vars = list(self._fetch_vars)
+        # clones share the batcher: N cloned front ends all coalesce into
+        # the one engine, which is the point of engine-backed mode
+        c._engine = self._engine
         return c
 
 

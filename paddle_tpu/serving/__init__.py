@@ -1,0 +1,28 @@
+"""paddle_tpu.serving: production inference serving for saved models.
+
+The training half of the stack got its robustness subsystem in the fault/
+elastic PR; this package is the inference half's production layer — a
+dynamic-batching, bucket-compiled, backpressured serving engine over the
+``paddle_tpu.inference`` predictor surface.  See docs/SERVING.md.
+
+Quick start::
+
+    from paddle_tpu.inference import AnalysisConfig
+    from paddle_tpu.serving import create_serving_engine, ServingConfig
+
+    eng = create_serving_engine(
+        AnalysisConfig(model_dir="...", use_tpu=True),
+        ServingConfig(max_batch_size=32, max_wait_ms=5.0), warmup=True)
+    fut = eng.submit([PaddleTensor(name="img", data=row)])   # non-blocking
+    outs = fut.result()
+    print(eng.metrics.snapshot())
+    eng.shutdown()
+"""
+
+from .engine import (EngineClosed, EngineOverloaded, RequestTimeout,
+                     ServingConfig, ServingEngine, create_serving_engine)
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
+           "EngineOverloaded", "RequestTimeout", "EngineClosed",
+           "create_serving_engine"]

@@ -1,0 +1,493 @@
+"""TPU-native serving engine: dynamic batching over the inference path.
+
+Why this subsystem exists (ROADMAP north star: "serves heavy traffic from
+millions of users"): a bare ``PaddlePredictor.run()`` pays one executor
+dispatch per request, and on TPU that fixed cost — host→HBM transfer plus
+dispatch — dominates small-batch inference.  The fix is the continuous/
+dynamic-batching design of serving systems like Clipper and Orca: queue
+concurrent requests, flush a batch when it is full OR when the oldest
+request has waited ``max_wait_ms``, and run ONE dispatch for the whole
+batch.  Throughput scales with batch size while the latency SLO bounds the
+wait.
+
+Bucketing: XLA compiles one executable per input shape, so admitting
+arbitrary batch sizes would thrash the jit cache (a fresh multi-second
+compile per novel size).  Batches are therefore padded up to a small fixed
+set of power-of-two buckets (1, 2, 4, ... max_batch_size); ``warmup()``
+AOT-precompiles every bucket before traffic is admitted, after which the
+compile counter must stay flat — any growth under traffic is a bug
+(an unplanned shape reached the executor).
+
+Backpressure: the request queue is bounded.  When it is full, ``submit``
+fails FAST with :class:`EngineOverloaded` instead of blocking — under
+overload, queueing further only converts client timeouts into wasted work
+(the load shedding argument).  Per-request deadlines are honored at batch
+formation: a request whose deadline passed while queued is failed with
+:class:`RequestTimeout` without spending a dispatch on it.
+
+Threading model: ``submit`` may be called from any number of threads; one
+(configurable) worker thread owns batch formation and executor dispatch,
+so the jit cache sees a single writer.  Results travel back on
+``concurrent.futures.Future``s.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .metrics import ServingMetrics
+
+__all__ = ["ServingConfig", "ServingEngine", "EngineOverloaded",
+           "RequestTimeout", "EngineClosed", "create_serving_engine"]
+
+
+class EngineOverloaded(RuntimeError):
+    """Bounded queue is full: the request was shed at admission (fast-fail
+    backpressure — retry with client-side backoff or add capacity)."""
+
+
+class RequestTimeout(TimeoutError):
+    """The request's deadline expired while it waited in the queue."""
+
+
+class EngineClosed(RuntimeError):
+    """submit() after drain()/shutdown() began."""
+
+
+@dataclass
+class ServingConfig:
+    """Batching / queueing policy for a :class:`ServingEngine`.
+
+    ``max_batch_size``  flush a batch at this many rows (also the largest
+                        compile bucket);
+    ``max_wait_ms``     flush when the OLDEST queued request has waited
+                        this long (the batching latency SLO);
+    ``max_queue_depth`` pending requests beyond this are shed with
+                        :class:`EngineOverloaded`;
+    ``num_workers``     batcher/dispatch threads (1 keeps a single jit-cache
+                        writer; >1 only pays off when dispatches overlap);
+    ``default_timeout_ms``  per-request deadline applied when submit() gets
+                        none (None = no deadline);
+    ``require_warmup``  reject traffic until warmup() has precompiled the
+                        buckets (production posture: no compile storms on
+                        the serving path);
+    ``batch_invariant`` pad EVERY dispatch to the single max_batch_size
+                        bucket.  XLA reduction order differs between
+                        executables of different batch shapes (~1e-7 drift
+                        on f32), so with pow2 buckets a request's bits
+                        depend on what it happened to be batched with.
+                        One canonical bucket makes results bit-identical
+                        regardless of arrival pattern — deterministic
+                        serving, at the cost of padded FLOPs at low load.
+    """
+    max_batch_size: int = 32
+    max_wait_ms: float = 5.0
+    max_queue_depth: int = 256
+    num_workers: int = 1
+    default_timeout_ms: Optional[float] = None
+    require_warmup: bool = False
+    batch_invariant: bool = False
+
+    def buckets(self) -> List[int]:
+        """Power-of-two batch buckets up to max_batch_size (inclusive —
+        max_batch_size itself is always a bucket even when not a power of
+        two, so full batches never pad).  batch_invariant collapses the
+        set to the one canonical bucket."""
+        if self.batch_invariant:
+            return [self.max_batch_size]
+        bs = []
+        b = 1
+        while b < self.max_batch_size:
+            bs.append(b)
+            b *= 2
+        bs.append(self.max_batch_size)
+        return bs
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "sig", "future", "deadline", "t_submit")
+
+    def __init__(self, feed, rows, sig, future, deadline, t_submit):
+        self.feed = feed          # name -> ndarray, leading dim == rows
+        self.rows = rows
+        self.sig = sig            # (name, row-shape, dtype) batching key
+        self.future = future
+        self.deadline = deadline  # absolute perf_counter time or None
+        self.t_submit = t_submit
+
+
+class ServingEngine:
+    """Dynamic-batching front end over one loaded inference model.
+
+    Wraps a ``PaddlePredictor`` (program + private scope + executor); the
+    engine owns admission, batching, padding and result scatter, the
+    predictor owns execution.  Use as a context manager or call
+    ``shutdown()``; worker threads are daemon threads so a leaked engine
+    (e.g. an engine-backed predictor the caller never closes) does not
+    wedge interpreter exit.
+    """
+
+    def __init__(self, predictor, config: Optional[ServingConfig] = None):
+        self._pred = predictor
+        self.config = config or ServingConfig()
+        if self.config.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._feed_names = list(predictor.get_input_names())
+        self._fetch_names = list(predictor.get_output_names())
+        # engine-backed predictors route run() here; _run_direct is the
+        # un-routed executor path (see inference.PaddlePredictor)
+        self._run = getattr(predictor, "_run_direct", predictor.run)
+        self.metrics = ServingMetrics()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()
+        self._inflight = 0
+        self._draining = False
+        self._stopped = False
+        self._warm = not self.config.require_warmup
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"serving-worker-{i}")
+            for i in range(max(1, self.config.num_workers))]
+        for t in self._workers:
+            t.start()
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def submit(self, inputs: Sequence, timeout_ms: Optional[float] = None
+               ) -> Future:
+        """Enqueue one request (a list of PaddleTensors, leading dim =
+        rows); returns a Future of the fetch list.  Raises
+        :class:`EngineOverloaded` / :class:`EngineClosed` synchronously."""
+        feed, rows, sig = self._resolve(inputs)
+        if timeout_ms is None:
+            timeout_ms = self.config.default_timeout_ms
+        now = time.perf_counter()
+        deadline = now + timeout_ms / 1000.0 if timeout_ms else None
+        fut: Future = Future()
+        req = _Request(feed, rows, sig, fut, deadline, now)
+        with self._cond:
+            if self._stopped or self._draining:
+                raise EngineClosed("serving engine is draining/stopped")
+            if not self._warm:
+                raise EngineClosed(
+                    "engine requires warmup() before admitting traffic "
+                    "(ServingConfig.require_warmup)")
+            if len(self._queue) >= self.config.max_queue_depth:
+                self.metrics.inc("shed")
+                raise EngineOverloaded(
+                    f"queue full ({self.config.max_queue_depth} pending); "
+                    f"request shed")
+            self._queue.append(req)
+            self.metrics.inc("submitted")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify()
+        return fut
+
+    def infer(self, inputs: Sequence, timeout_ms: Optional[float] = None):
+        """Blocking submit: returns the fetch list or raises."""
+        return self.submit(inputs, timeout_ms=timeout_ms).result()
+
+    def _resolve(self, inputs) -> tuple:
+        """Validate one request into (name->array, rows, batching sig)."""
+        from ..inference import PaddleTensor
+
+        if not inputs:
+            raise ValueError("empty request")
+        named = [t for t in inputs if getattr(t, "name", "")]
+        if len(named) != len(inputs) and len(inputs) != len(self._feed_names):
+            raise ValueError(
+                f"unnamed inputs require exactly the full feed list "
+                f"{self._feed_names} in declaration order; got "
+                f"{len(inputs)} tensors")
+        feed: Dict[str, np.ndarray] = {}
+        for i, t in enumerate(inputs):
+            if not isinstance(t, PaddleTensor):
+                t = PaddleTensor(data=np.asarray(t))
+            if t.lod:
+                raise ValueError(
+                    "LoD (variable-length sequence) inputs cannot be "
+                    "dynamically batched; call the predictor directly")
+            name = t.name or self._feed_names[i]
+            if name not in self._feed_names:
+                raise ValueError(f"unknown feed '{name}'; model feeds are "
+                                 f"{self._feed_names}")
+            arr = np.asarray(t.data)
+            if arr.ndim == 0:
+                raise ValueError(f"feed '{name}' must have a leading batch "
+                                 f"dimension")
+            feed[name] = arr
+        if set(feed) != set(self._feed_names):
+            raise ValueError(f"request must feed all of {self._feed_names}; "
+                             f"got {sorted(feed)}")
+        rows = {a.shape[0] for a in feed.values()}
+        if len(rows) != 1:
+            raise ValueError(f"all feeds must share the leading (batch) "
+                             f"dim; got {sorted(rows)}")
+        n = rows.pop()
+        if n < 1:
+            raise ValueError("request has zero rows")
+        if n > self.config.max_batch_size:
+            raise ValueError(
+                f"request rows ({n}) exceed max_batch_size "
+                f"({self.config.max_batch_size}); split the request")
+        sig = tuple((name, feed[name].shape[1:], str(feed[name].dtype))
+                    for name in self._feed_names)
+        return feed, n, sig
+
+    # ------------------------------------------------------------------
+    # batching + dispatch
+    # ------------------------------------------------------------------
+
+    def _bucket(self, rows: int) -> int:
+        for b in self.config.buckets():
+            if rows <= b:
+                return b
+        return self.config.max_batch_size
+
+    def _worker_loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._dispatch(batch)
+            finally:
+                with self._cond:
+                    self._inflight -= len(batch)
+                    self._cond.notify_all()
+
+    def _take_batch(self) -> Optional[List[_Request]]:
+        """Block until a batch is ready: full (max_batch_size rows), or the
+        oldest request has waited max_wait_ms, or drain/stop flushes what
+        is there.  Only same-signature requests batch together (different
+        row shapes cannot concatenate)."""
+        with self._cond:
+            while not self._queue:
+                if self._stopped:
+                    return None
+                self._cond.wait(0.05)
+            first = self._queue.popleft()
+            # popped requests count as in-flight IMMEDIATELY: batch
+            # formation below waits with the lock released (cond.wait), and
+            # drain() must not conclude "all done" while the batcher holds
+            # requests that left the queue but have not dispatched yet
+            self._inflight += 1
+            batch, rows = [first], first.rows
+            flush_at = first.t_submit + self.config.max_wait_ms / 1000.0
+            while rows < self.config.max_batch_size:
+                if self._queue:
+                    nxt = self._queue[0]
+                    if nxt.sig != first.sig \
+                            or rows + nxt.rows > self.config.max_batch_size:
+                        break
+                    self._queue.popleft()
+                    self._inflight += 1
+                    batch.append(nxt)
+                    rows += nxt.rows
+                    continue
+                now = time.perf_counter()
+                # drain/stop: flush immediately rather than waiting out SLO
+                if now >= flush_at or self._stopped or self._draining:
+                    break
+                self._cond.wait(flush_at - now)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            return batch
+
+    def _dispatch(self, batch: List[_Request]):
+        from ..fluid import fault as _fault
+
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                self.metrics.inc("expired")
+                req.future.set_exception(RequestTimeout(
+                    f"deadline expired after "
+                    f"{(now - req.t_submit) * 1e3:.1f} ms in queue"))
+                continue
+            # robustness-harness hook (fluid.fault): per-request injected
+            # delay and/or every-Nth failure on the serving path
+            try:
+                _fault.serving_request()
+            except BaseException as exc:  # InjectedFault is a BaseException
+                self.metrics.inc("failed")
+                req.future.set_exception(exc)
+                continue
+            live.append(req)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        bucket = self._bucket(rows)
+        try:
+            outs, dur = self._run_bucket(
+                {name: np.concatenate([r.feed[name] for r in live], axis=0)
+                 for name in self._feed_names},
+                rows, bucket)
+        except BaseException as exc:
+            for req in live:
+                self.metrics.inc("failed")
+                req.future.set_exception(
+                    exc if isinstance(exc, Exception)
+                    else RuntimeError(repr(exc)))
+            return
+        self.metrics.inc("dispatches")
+        self.metrics.observe_batch(rows, bucket, seconds=dur)
+        # scatter: slice each batched fetch back to per-request spans
+        from ..inference import PaddleTensor
+
+        done = time.perf_counter()
+        start = 0
+        for req in live:
+            res = []
+            for o in outs:
+                data = np.asarray(o.data)
+                if data.ndim and data.shape[0] == bucket:
+                    data = data[start:start + req.rows]
+                res.append(PaddleTensor(name=o.name, data=data))
+            start += req.rows
+            self.metrics.inc("completed")
+            self.metrics.observe_latency(done - req.t_submit)
+            req.future.set_result(res)
+
+    def _run_bucket(self, feed: Dict[str, np.ndarray], rows: int,
+                    bucket: int):
+        """Pad ``feed`` (rows) up to ``bucket`` and run one dispatch.
+        Returns (fetch tensors, duration).  Compile-cache growth during the
+        run increments the bucket_compiles counter."""
+        from ..inference import PaddleTensor
+
+        if bucket > rows:
+            feed = {k: np.concatenate(
+                [v, np.zeros((bucket - rows,) + v.shape[1:], v.dtype)],
+                axis=0) for k, v in feed.items()}
+        exe_cache = getattr(getattr(self._pred, "_exe", None), "_cache", None)
+        before = len(exe_cache) if exe_cache is not None else 0
+        t = time.perf_counter()
+        outs = self._run([PaddleTensor(name=k, data=v)
+                          for k, v in feed.items()])
+        dur = time.perf_counter() - t
+        if exe_cache is not None and len(exe_cache) > before:
+            self.metrics.inc("bucket_compiles", len(exe_cache) - before)
+        return outs, dur
+
+    # ------------------------------------------------------------------
+    # warmup
+    # ------------------------------------------------------------------
+
+    def warmup(self, sample_inputs: Optional[Sequence] = None) -> List[int]:
+        """AOT-precompile every batch bucket before admitting traffic.
+
+        ``sample_inputs``: an optional single-row request used as the
+        template (required when the model's feed shapes have unknown
+        non-batch dims).  Without it, zero-filled rows are synthesized
+        from the program's feed var shapes/dtypes.  Returns the bucket
+        list.  Safe to call again (cached executables make it cheap)."""
+        if sample_inputs is not None:
+            feed, rows, _sig = self._resolve(sample_inputs)
+            if rows != 1:
+                feed = {k: v[:1] for k, v in feed.items()}
+            row_feed = feed
+        else:
+            row_feed = self._zero_rows()
+        for b in self.config.buckets():
+            feed_b = {k: np.concatenate([v] * b, axis=0)
+                      for k, v in row_feed.items()}
+            self._run_bucket(feed_b, b, b)
+            self.metrics.inc("warmup_dispatches")
+        with self._cond:
+            self._warm = True
+        return self.config.buckets()
+
+    def _zero_rows(self) -> Dict[str, np.ndarray]:
+        """One all-zero row per feed, shaped from the program's var descs."""
+        from ..fluid import core as _core
+
+        gb = self._pred._program.global_block()
+        rows = {}
+        for name in self._feed_names:
+            var = gb._var_recursive(name)
+            row_shape = tuple(var.shape)[1:]  # leading dim is batch
+            if any(d is None or int(d) < 0 for d in row_shape):
+                raise ValueError(
+                    f"feed '{name}' has unknown non-batch dims "
+                    f"{tuple(var.shape)}; pass warmup(sample_inputs=...)")
+            rows[name] = np.zeros((1,) + tuple(int(d) for d in row_shape),
+                                  dtype=_core.np_dtype(var.dtype))
+        return rows
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Stop admitting; wait until every queued and in-flight request
+        has resolved.  Returns True when fully drained."""
+        deadline = time.perf_counter() + timeout_s
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._queue or self._inflight:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def shutdown(self, timeout_s: float = 60.0) -> bool:
+        """drain() then stop and join the worker threads."""
+        ok = self.drain(timeout_s=timeout_s)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        for t in self._workers:
+            t.join(timeout=timeout_s)
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def create_serving_engine(config, serving_config: Optional[ServingConfig]
+                          = None, warmup: bool = False) -> ServingEngine:
+    """Build a ServingEngine from an inference config (NativeConfig /
+    AnalysisConfig): loads the saved model into a fresh predictor (private
+    scope) and wraps it.  ``AnalysisConfig`` serving_* fields seed the
+    ServingConfig unless ``serving_config`` overrides them; ``warmup=True``
+    (or config.serving_warmup) AOT-precompiles the buckets before
+    returning."""
+    import dataclasses
+
+    from .. import inference as _inf
+
+    cfg = config
+    if getattr(config, "enable_serving", False):
+        # avoid recursion: the predictor built here is the engine's
+        # backend, not another engine-backed front end
+        cfg = dataclasses.replace(config, enable_serving=False)
+    pred = _inf.PaddlePredictor(cfg)
+    if serving_config is None:
+        serving_config = ServingConfig(
+            max_batch_size=getattr(config, "serving_max_batch_size", 32),
+            max_wait_ms=getattr(config, "serving_max_wait_ms", 5.0),
+            max_queue_depth=getattr(config, "serving_max_queue_depth", 256),
+            batch_invariant=getattr(config, "serving_batch_invariant",
+                                    False),
+        )
+    eng = ServingEngine(pred, serving_config)
+    if warmup or getattr(config, "serving_warmup", False):
+        eng.warmup()
+    return eng

@@ -1,0 +1,111 @@
+"""Serving metrics: a lock-protected registry for the engine's counters,
+gauges and latency distribution.
+
+The reference stack exported serving health through each server's
+`/metrics`-style counters; here one in-process registry covers the single
+engine.  Everything is O(1) per observation: counters are plain ints,
+latencies go into a fixed-size ring buffer (percentiles are computed only
+at ``snapshot()`` time), and batch occupancy is tracked as two running
+sums (real rows / padded bucket rows).
+
+``snapshot()`` returns a plain dict so callers can json.dump it (the bench
+tool's BENCH-line format) or diff two snapshots.  Per-event wiring into
+``fluid.profiler.record_event`` means a ``fluid.profiler.profiler()``
+context around serving traffic gets ``serving_request`` /
+``serving_dispatch[...]`` rows in the standard aggregate table for free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Thread-safe counters + latency reservoir for one ServingEngine."""
+
+    #: counters every snapshot reports even when still zero
+    COUNTERS = ("submitted", "completed", "failed", "shed", "expired",
+                "dispatches", "bucket_compiles", "warmup_dispatches")
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
+        self._gauges: Dict[str, float] = {"queue_depth": 0}
+        # latency ring buffer, seconds; percentile accuracy degrades
+        # gracefully under sustained load instead of growing unboundedly
+        self._window = int(latency_window)
+        self._lat = [0.0] * self._window
+        self._lat_n = 0  # total observations ever (ring index = n % window)
+        self._rows_real = 0
+        self._rows_padded = 0
+        self._t0 = time.perf_counter()
+
+    # -- recording --
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe_latency(self, seconds: float) -> None:
+        """One completed request's queue+execute latency."""
+        with self._lock:
+            self._lat[self._lat_n % self._window] = float(seconds)
+            self._lat_n += 1
+        # profiler hook: no-op unless a profiler session is active
+        from ..fluid import profiler as _prof
+
+        _prof.record_event("serving_request", seconds)
+
+    def observe_batch(self, real_rows: int, bucket_rows: int,
+                      seconds: Optional[float] = None) -> None:
+        """One executor dispatch: ``real_rows`` request rows padded into a
+        ``bucket_rows`` executable."""
+        with self._lock:
+            self._rows_real += int(real_rows)
+            self._rows_padded += int(bucket_rows)
+        if seconds is not None:
+            from ..fluid import profiler as _prof
+
+            _prof.record_event(f"serving_dispatch[bs={bucket_rows}]", seconds)
+
+    # -- reading --
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def _percentiles(self, lat, qs):
+        if not lat:
+            return {f"p{int(q * 100)}_ms": None for q in qs}
+        s = sorted(lat)
+        out = {}
+        for q in qs:
+            idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+            out[f"p{int(q * 100)}_ms"] = round(s[idx] * 1e3, 3)
+        return out
+
+    def snapshot(self) -> dict:
+        """Point-in-time dict of every metric (safe to json.dump)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            n = min(self._lat_n, self._window)
+            lat = list(self._lat[:n])
+            rows_real, rows_padded = self._rows_real, self._rows_padded
+            elapsed = time.perf_counter() - self._t0
+        snap = dict(counters)
+        snap.update(gauges)
+        snap["elapsed_s"] = round(elapsed, 3)
+        snap["qps"] = round(counters.get("completed", 0) / elapsed, 3) \
+            if elapsed > 0 else 0.0
+        snap.update(self._percentiles(lat, (0.50, 0.95, 0.99)))
+        snap["latency_samples"] = n
+        snap["mean_batch_occupancy"] = (
+            round(rows_real / rows_padded, 4) if rows_padded else None)
+        return snap

@@ -109,6 +109,112 @@ def test_predictor_propagates_lod(tmp_path):
     np.testing.assert_allclose(out.data, np.asarray(ref), rtol=1e-5)
 
 
+def test_positional_partial_feed_raises(tmp_path):
+    """Unnamed tensors feed positionally, which is only well-defined for
+    the FULL feed list: a partial unnamed feed must raise instead of
+    silently binding self._feed_names[i] to the wrong tensor.  Named
+    partial feeds keep working (the executor prunes the unfed branch)."""
+    import pytest
+
+    from paddle_tpu.inference import (NativeConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    fluid.default_main_program().random_seed = 5
+    fluid.default_startup_program().random_seed = 5
+    a = fluid.layers.data(name="a", shape=[4], dtype="float32")
+    b = fluid.layers.data(name="b", shape=[4], dtype="float32")
+    out_a = fluid.layers.fc(a, size=2, act=None)
+    fluid.layers.fc(b, size=2, act=None)  # a second branch off feed 'b'
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # two declared feeds, but the saved target only needs 'a'
+    fluid.io.save_inference_model(str(tmp_path), ["a", "b"], [out_a], exe)
+    _executor._global_scope = _executor.Scope()
+    pred = create_paddle_predictor(
+        NativeConfig(model_dir=str(tmp_path), use_tpu=False))
+    assert pred.get_input_names() == ["a", "b"]
+    xa = np.ones((1, 4), np.float32)
+
+    # one unnamed tensor against two feeds: positional alignment is
+    # ambiguous — must fail loudly
+    with pytest.raises(ValueError, match="unnamed"):
+        pred.run([PaddleTensor(data=xa)])
+
+    # named partial feed still works (the target only consumes 'a')
+    (named_a,) = pred.run([PaddleTensor(name="a", data=xa)])
+    # full positional feed still works and matches
+    (full_a,) = pred.run([PaddleTensor(data=xa), PaddleTensor(data=xa)])
+    np.testing.assert_allclose(named_a.data, full_a.data, rtol=1e-6)
+
+
+def test_inference_transpiler_returns_fused_program(tmp_path):
+    """Regression (serving PR satellite): transpile() must RETURN the
+    fused program — callers install the return value, and that program
+    must have the BN op folded away."""
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    _train_and_save(tmp_path)
+    _executor._global_scope = _executor.Scope()
+    # load WITHOUT ir optim so the raw program still has its batch_norm
+    pred = create_paddle_predictor(
+        AnalysisConfig(model_dir=str(tmp_path), use_tpu=False,
+                       enable_ir_optim=False))
+    raw = pred._program
+    assert any(op.type == "batch_norm" for op in raw.global_block().ops)
+    fused = fluid.InferenceTranspiler().transpile(
+        raw, fluid.CPUPlace(), scope=pred._scope)
+    assert fused is not None
+    assert not any(op.type == "batch_norm"
+                   for op in fused.global_block().ops)
+
+
+def test_predictor_clone_concurrent_runs(tmp_path):
+    """The documented contract (paddle_inference_api.h:90): Run() is
+    thread-compatible per clone.  N threads each run their own clone
+    concurrently; every result must match the serial baseline."""
+    import threading
+
+    from paddle_tpu.inference import (NativeConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    _train_and_save(tmp_path)
+    _executor._global_scope = _executor.Scope()
+    pred = create_paddle_predictor(
+        NativeConfig(model_dir=str(tmp_path), use_tpu=False))
+
+    n_threads, n_runs = 8, 4
+    rng = np.random.RandomState(13)
+    xs = [rng.normal(size=(2, 1, 8, 8)).astype(np.float32)
+          for _ in range(n_threads)]
+    serial = [pred.run([PaddleTensor(name="img", data=x)])[0].data
+              for x in xs]
+
+    results = [[None] * n_runs for _ in range(n_threads)]
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i, clone):
+        try:
+            barrier.wait(timeout=30)
+            for j in range(n_runs):
+                (out,) = clone.run([PaddleTensor(name="img", data=xs[i])])
+                results[i][j] = out.data
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append((i, repr(exc)))
+
+    threads = [threading.Thread(target=worker, args=(i, pred.clone()))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for i in range(n_threads):
+        for j in range(n_runs):
+            # same executable (same shape) -> bitwise-equal results
+            assert np.array_equal(results[i][j], serial[i]), (i, j)
+
+
 def test_analysis_predictor_int8_weights(tmp_path):
     """Weight-only int8 (AnalysisConfig.enable_int8): matmul/conv weights
     live int8-in-HBM with per-channel scales and dequantize at the

@@ -1,0 +1,347 @@
+"""ServingEngine tests: dynamic batching, bucketed AOT compile cache,
+backpressure, deadlines, fault injection, and the engine-backed
+PaddlePredictor mode (docs/SERVING.md)."""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+from paddle_tpu.fluid import fault as _fault
+
+
+def _save_mlp(tmpdir, seed=11):
+    """Mnist-sized MLP (784 -> 32 -> 10 softmax), saved for inference."""
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    h = fluid.layers.fc(img, size=32, act="relu")
+    pred = fluid.layers.fc(h, size=10, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(str(tmpdir), ["img"], [pred], exe)
+    _executor._global_scope = _executor.Scope()
+
+
+def _rows(n, d=784, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.normal(size=(1, d)).astype(np.float32) for _ in range(n)]
+
+
+def _cfg(tmpdir, **kw):
+    from paddle_tpu.inference import AnalysisConfig
+
+    return AnalysisConfig(model_dir=str(tmpdir), use_tpu=False, **kw)
+
+
+def test_engine_e2e_dynamic_batching(tmp_path):
+    """Acceptance: 64 concurrent single-row requests through dynamic
+    batching, bit-identical to per-request PaddlePredictor.run(), at most
+    ceil(64/max_batch_size) dispatches, and zero compiles after warmup()."""
+    from paddle_tpu.inference import PaddleTensor, create_paddle_predictor
+
+    _save_mlp(tmp_path)
+    # engine-backed predictor in batch-invariant mode: every dispatch uses
+    # the ONE max_batch_size executable, so results cannot depend on what a
+    # request was batched with — the precondition for bit-identity
+    pred = create_paddle_predictor(_cfg(
+        tmp_path, enable_serving=True, serving_max_batch_size=16,
+        serving_max_wait_ms=60.0, serving_batch_invariant=True))
+    eng = pred._engine
+    assert eng is not None
+    eng.warmup()
+    m0 = eng.metrics.snapshot()
+    assert m0["bucket_compiles"] >= 1  # warmup really compiled
+
+    rows = _rows(64)
+    # per-request baseline: PaddlePredictor.run(), one request at a time
+    baseline = [pred.run([PaddleTensor(name="img", data=r)])[0].data
+                for r in rows]
+    m1 = eng.metrics.snapshot()
+    assert m1["bucket_compiles"] == m0["bucket_compiles"]
+
+    # 64 concurrent requests as futures: the batcher must coalesce them
+    # into full buckets — at most ceil(64/16) dispatches
+    futs = [eng.submit([PaddleTensor(name="img", data=r)]) for r in rows]
+    batched = [f.result(timeout=60)[0].data for f in futs]
+    m2 = eng.metrics.snapshot()
+    dispatches = m2["dispatches"] - m1["dispatches"]
+    assert dispatches <= math.ceil(64 / 16), dispatches
+    # no XLA recompile under traffic: the compile counter stays flat
+    assert m2["bucket_compiles"] == m0["bucket_compiles"]
+    for i in range(64):
+        assert np.array_equal(batched[i], baseline[i]), i
+
+    # same thing through 64 concurrent clone().run() callers (the
+    # documented thread-compatibility contract): all coalesce into the one
+    # shared batcher and stay bit-identical
+    results = [None] * 64
+    errors = []
+    barrier = threading.Barrier(64)
+
+    def call(i, p):
+        try:
+            barrier.wait(timeout=30)
+            (out,) = p.run([PaddleTensor(name="img", data=rows[i])])
+            results[i] = out.data
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=call, args=(i, pred.clone()))
+               for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    for i in range(64):
+        assert np.array_equal(results[i], baseline[i]), i
+
+    m3 = eng.metrics.snapshot()
+    assert m3["bucket_compiles"] == m0["bucket_compiles"]
+    assert m3["completed"] >= 192
+    pred.close()
+
+
+def test_engine_pow2_buckets_and_multirow(tmp_path):
+    """Default bucket policy: pow2 buckets each compile once; multi-row
+    requests pad to the enclosing bucket and unpad per request."""
+    from paddle_tpu.inference import (PaddleTensor, create_paddle_predictor)
+    from paddle_tpu.serving import ServingConfig, create_serving_engine
+
+    _save_mlp(tmp_path)
+    plain = create_paddle_predictor(_cfg(tmp_path))
+    eng = create_serving_engine(
+        _cfg(tmp_path),
+        ServingConfig(max_batch_size=8, max_wait_ms=30.0))
+    assert eng.config.buckets() == [1, 2, 4, 8]
+    eng.warmup()
+    compiles = eng.metrics.snapshot()["bucket_compiles"]
+    assert compiles >= len(eng.config.buckets())
+
+    rng = np.random.RandomState(3)
+    x3 = rng.normal(size=(3, 784)).astype(np.float32)
+    x5 = rng.normal(size=(5, 784)).astype(np.float32)
+    f3 = eng.submit([PaddleTensor(name="img", data=x3)])
+    f5 = eng.submit([PaddleTensor(name="img", data=x5)])
+    o3, o5 = f3.result()[0].data, f5.result()[0].data
+    assert o3.shape[0] == 3 and o5.shape[0] == 5
+    (ref3,) = plain.run([PaddleTensor(name="img", data=x3)])
+    (ref5,) = plain.run([PaddleTensor(name="img", data=x5)])
+    np.testing.assert_allclose(o3, ref3.data, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(o5, ref5.data, rtol=1e-5, atol=1e-6)
+    # 3+5 rows coalesced into the 8-bucket: no new executable compiled
+    assert eng.metrics.snapshot()["bucket_compiles"] == compiles
+    eng.shutdown()
+
+
+def test_backpressure_sheds_and_drain_completes(tmp_path):
+    """Acceptance: saturated bounded queue fast-fails EngineOverloaded (no
+    deadlock); drain() completes every accepted request before shutdown."""
+    from paddle_tpu.inference import PaddleTensor
+    from paddle_tpu.serving import (EngineClosed, EngineOverloaded,
+                                    ServingConfig, create_serving_engine)
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path),
+        ServingConfig(max_batch_size=2, max_wait_ms=1.0, max_queue_depth=4))
+    eng.warmup()
+    # slow every request 30ms so the queue saturates while workers lag
+    _fault.install(_fault.FaultPlan(serve_delay_ms=30.0, mode="raise"))
+    try:
+        accepted, shed = [], 0
+        for r in _rows(24, seed=7):
+            try:
+                accepted.append(eng.submit(
+                    [PaddleTensor(name="img", data=r)], timeout_ms=None))
+            except EngineOverloaded:
+                shed += 1
+        assert shed > 0, "queue never saturated"
+        assert eng.metrics.snapshot()["shed"] == shed
+        t0 = time.perf_counter()
+        assert eng.drain(timeout_s=60.0)
+        assert time.perf_counter() - t0 < 60
+        for f in accepted:  # every accepted request resolved, none dropped
+            assert f.done()
+            assert f.result()[0].data.shape == (1, 10)
+        with pytest.raises(EngineClosed):
+            eng.submit([PaddleTensor(name="img", data=_rows(1)[0])])
+    finally:
+        _fault.clear()
+        eng.shutdown()
+
+
+def test_request_deadline_expires_in_queue(tmp_path):
+    """A request whose deadline passes while queued fails with
+    RequestTimeout and costs no dispatch."""
+    from paddle_tpu.inference import PaddleTensor
+    from paddle_tpu.serving import (RequestTimeout, ServingConfig,
+                                    create_serving_engine)
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path),
+        ServingConfig(max_batch_size=8, max_wait_ms=80.0))
+    eng.warmup()
+    d0 = eng.metrics.snapshot()["dispatches"]
+    # 1ms deadline vs an 80ms batching window: expires before dispatch
+    fut = eng.submit([PaddleTensor(name="img", data=_rows(1)[0])],
+                     timeout_ms=1.0)
+    with pytest.raises(RequestTimeout):
+        fut.result(timeout=30)
+    snap = eng.metrics.snapshot()
+    assert snap["expired"] == 1
+    assert snap["dispatches"] == d0
+    eng.shutdown()
+
+
+def test_per_request_fault_injection(tmp_path):
+    """fluid.fault serving hook: every Nth request fails with InjectedFault
+    on ITS future; the rest of the batch still completes correctly."""
+    from paddle_tpu.inference import PaddleTensor, create_paddle_predictor
+    from paddle_tpu.serving import ServingConfig, create_serving_engine
+
+    _save_mlp(tmp_path)
+    plain = create_paddle_predictor(_cfg(tmp_path))
+    eng = create_serving_engine(
+        _cfg(tmp_path),
+        ServingConfig(max_batch_size=4, max_wait_ms=30.0))
+    eng.warmup()
+    _fault.install(_fault.FaultPlan(serve_fail_every=3, mode="raise"))
+    try:
+        rows = _rows(9, seed=5)
+        futs = [eng.submit([PaddleTensor(name="img", data=r)])
+                for r in rows]
+        failed = 0
+        for i, f in enumerate(futs):
+            try:
+                (out,) = f.result(timeout=30)
+                (ref,) = plain.run([PaddleTensor(name="img", data=rows[i])])
+                np.testing.assert_allclose(out.data, ref.data,
+                                           rtol=1e-5, atol=1e-6)
+            except _fault.InjectedFault:
+                failed += 1
+        assert failed == 3
+        assert eng.metrics.snapshot()["failed"] == 3
+    finally:
+        _fault.clear()
+        eng.shutdown()
+
+
+def test_require_warmup_gates_admission(tmp_path):
+    from paddle_tpu.inference import PaddleTensor
+    from paddle_tpu.serving import (EngineClosed, ServingConfig,
+                                    create_serving_engine)
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path),
+        ServingConfig(max_batch_size=4, max_wait_ms=5.0,
+                      require_warmup=True))
+    r = _rows(1)[0]
+    with pytest.raises(EngineClosed):
+        eng.submit([PaddleTensor(name="img", data=r)])
+    eng.warmup()
+    (out,) = eng.infer([PaddleTensor(name="img", data=r)])
+    assert out.data.shape == (1, 10)
+    eng.shutdown()
+
+
+def test_request_validation(tmp_path):
+    from paddle_tpu.inference import PaddleTensor
+    from paddle_tpu.serving import ServingConfig, create_serving_engine
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path), ServingConfig(max_batch_size=4, max_wait_ms=2.0))
+    r = _rows(1)[0]
+    with pytest.raises(ValueError):  # unknown feed name
+        eng.submit([PaddleTensor(name="nope", data=r)])
+    with pytest.raises(ValueError):  # rows exceed max_batch_size
+        eng.submit([PaddleTensor(
+            name="img", data=np.zeros((5, 784), np.float32))])
+    with pytest.raises(ValueError):  # LoD inputs cannot batch
+        eng.submit([PaddleTensor(name="img", data=r, lod=[[0, 1]])])
+    with pytest.raises(ValueError):  # empty request
+        eng.submit([])
+    # positional (unnamed) single tensor still works: full feed list
+    (out,) = eng.infer([PaddleTensor(data=r)])
+    assert out.data.shape == (1, 10)
+    eng.shutdown()
+
+
+def test_metrics_snapshot_shape(tmp_path):
+    from paddle_tpu.inference import PaddleTensor
+    from paddle_tpu.serving import ServingConfig, create_serving_engine
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path), ServingConfig(max_batch_size=4, max_wait_ms=2.0))
+    eng.warmup()
+    for r in _rows(6, seed=9):
+        eng.infer([PaddleTensor(name="img", data=r)])
+    snap = eng.metrics.snapshot()
+    for key in ("submitted", "completed", "failed", "shed", "expired",
+                "dispatches", "bucket_compiles", "warmup_dispatches",
+                "queue_depth", "qps", "p50_ms", "p95_ms", "p99_ms",
+                "mean_batch_occupancy", "elapsed_s", "latency_samples"):
+        assert key in snap, key
+    assert snap["completed"] == 6
+    assert snap["p50_ms"] is not None and snap["p50_ms"] >= 0
+    assert 0 < snap["mean_batch_occupancy"] <= 1
+    import json
+
+    json.dumps(snap)  # BENCH-style consumers json.dump this verbatim
+    eng.shutdown()
+
+
+@pytest.mark.slow
+def test_serving_soak_throughput(tmp_path):
+    """Soak: sustained concurrent traffic with mixed row counts for ~8s;
+    no errors, no recompiles, sane throughput accounting."""
+    from paddle_tpu.inference import PaddleTensor
+    from paddle_tpu.serving import (EngineOverloaded, ServingConfig,
+                                    create_serving_engine)
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path),
+        ServingConfig(max_batch_size=16, max_wait_ms=4.0,
+                      max_queue_depth=512))
+    eng.warmup()
+    compiles0 = eng.metrics.snapshot()["bucket_compiles"]
+    stop = time.perf_counter() + 8.0
+    errors = []
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while time.perf_counter() < stop:
+            n = int(rng.randint(1, 5))
+            x = rng.normal(size=(n, 784)).astype(np.float32)
+            try:
+                (out,) = eng.infer([PaddleTensor(name="img", data=x)])
+                if out.data.shape != (n, 10):
+                    errors.append(("shape", out.data.shape))
+            except EngineOverloaded:
+                time.sleep(0.005)  # client-side backoff, then retry
+            except Exception as exc:  # pragma: no cover
+                errors.append(("exc", repr(exc)))
+                return
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    snap = eng.metrics.snapshot()
+    assert not errors, errors[:5]
+    assert snap["completed"] > 100
+    assert snap["qps"] > 10
+    assert snap["bucket_compiles"] == compiles0  # flat under 8s of traffic
+    assert eng.drain(timeout_s=30)
+    eng.shutdown()

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serving engine (docs/SERVING.md).
+
+Open-loop means requests are fired on a fixed arrival schedule derived
+from --qps, NOT when the previous response returns — the generator never
+slows down to match the server, so queueing/shedding behavior under a
+genuinely offered load is visible (a closed-loop generator would hide
+overload by self-throttling, the classic coordinated-omission mistake).
+
+Builds a mnist-sized MLP in-process (or serves --model-dir), saves it,
+stands up a ServingEngine, warms the buckets, offers load for --duration
+seconds, and emits ONE BENCH-style JSON line on stdout:
+
+    {"metric": "serving_mlp784_openloop_cpu", "value": <qps>,
+     "unit": "req/s", "offered_qps": ..., "p50_ms": ..., "p95_ms": ...,
+     "p99_ms": ..., "mean_batch_occupancy": ..., "shed": ..., ...}
+
+Modes:
+    --smoke     2-second CPU sanity pass for CI (exit 0 + valid JSON is
+                the contract; tests/tier-2 can parse the line)
+    default     --duration/--qps as given; --device TPU serves from the
+                accelerator when one is attached
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _build_and_save(model_dir: str, hidden: int = 64) -> None:
+    """Train-free mnist-sized MLP (784 -> hidden -> 10 softmax)."""
+    import paddle_tpu.fluid as fluid
+
+    fluid.default_main_program().random_seed = 17
+    fluid.default_startup_program().random_seed = 17
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    h = fluid.layers.fc(img, size=hidden, act="relu")
+    pred = fluid.layers.fc(h, size=10, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(model_dir, ["img"], [pred], exe)
+
+
+def run_bench(args) -> dict:
+    import numpy as np
+
+    from paddle_tpu.inference import AnalysisConfig, PaddleTensor
+    from paddle_tpu.serving import (EngineOverloaded, ServingConfig,
+                                    create_serving_engine)
+
+    model_dir = args.model_dir
+    if not model_dir:
+        model_dir = tempfile.mkdtemp(prefix="bench_serving_")
+        _build_and_save(model_dir)
+
+    cfg = AnalysisConfig(model_dir=model_dir,
+                         use_tpu=(args.device.upper() == "TPU"))
+    eng = create_serving_engine(cfg, ServingConfig(
+        max_batch_size=args.max_batch_size,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth))
+    sample = [PaddleTensor(name=n, data=r) for n, r in zip(
+        eng._feed_names, _sample_rows(eng))] if args.model_dir else None
+    eng.warmup(sample_inputs=sample)
+    warm = eng.metrics.snapshot()
+
+    rng = np.random.RandomState(0)
+    # pre-generate a pool of request payloads so the generator's hot loop
+    # is submit-only (payload synthesis must not gate the offered rate)
+    pool = [[PaddleTensor(name=eng._feed_names[0],
+                          data=rng.normal(size=(1, 784)).astype(np.float32))]
+            for _ in range(256)] if not args.model_dir else \
+           [sample for _ in range(256)]
+
+    results = {"ok": 0, "shed": 0, "err": 0}
+    rlock = threading.Lock()
+
+    def on_done(fut):
+        with rlock:
+            if fut.exception() is None:
+                results["ok"] += 1
+            else:
+                results["err"] += 1
+
+    period = 1.0 / args.qps
+    t_end = time.perf_counter() + args.duration
+    next_fire = time.perf_counter()
+    sent = 0
+    while True:
+        now = time.perf_counter()
+        if now >= t_end:
+            break
+        if now < next_fire:
+            time.sleep(min(next_fire - now, 0.002))
+            continue
+        # open loop: the schedule advances by the period even when we fell
+        # behind, so the offered rate stays honest
+        next_fire += period
+        try:
+            eng.submit(pool[sent % len(pool)]).add_done_callback(on_done)
+            sent += 1
+        except EngineOverloaded:
+            with rlock:
+                results["shed"] += 1
+    eng.drain(timeout_s=60.0)
+    snap = eng.metrics.snapshot()
+    eng.shutdown()
+
+    served_window = snap["elapsed_s"] - warm["elapsed_s"]
+    out = {
+        "metric": f"serving_mlp784_openloop_{args.device.lower()}",
+        "value": round(results["ok"] / served_window, 2)
+        if served_window > 0 else 0.0,
+        "unit": "req/s",
+        "offered_qps": args.qps,
+        "duration_s": args.duration,
+        "sent": sent,
+        "completed": results["ok"],
+        "shed": results["shed"] + snap["shed"] - warm["shed"],
+        "errors": results["err"],
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "p99_ms": snap["p99_ms"],
+        "mean_batch_occupancy": snap["mean_batch_occupancy"],
+        "dispatches": snap["dispatches"] - warm["dispatches"],
+        "bucket_compiles": snap["bucket_compiles"],
+        "compiles_after_warmup":
+            snap["bucket_compiles"] - warm["bucket_compiles"],
+        "max_batch_size": args.max_batch_size,
+        "max_wait_ms": args.max_wait_ms,
+        "queue_depth": args.queue_depth,
+        "smoke": bool(args.smoke),
+    }
+    return out
+
+
+def _sample_rows(eng):
+    """Zero rows from the model's own feed shapes (for --model-dir)."""
+    return list(eng._zero_rows().values())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model-dir", default="",
+                   help="serve this saved inference model instead of the "
+                        "built-in mnist-sized MLP")
+    p.add_argument("--device", default="CPU", choices=["CPU", "TPU",
+                                                       "cpu", "tpu"])
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds of offered load")
+    p.add_argument("--qps", type=float, default=500.0,
+                   help="open-loop offered request rate")
+    p.add_argument("--max-batch-size", type=int, default=16)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--queue-depth", type=int, default=512)
+    p.add_argument("--smoke", action="store_true",
+                   help="2-second CPU sanity pass for CI")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.duration = 2.0
+        args.qps = min(args.qps, 200.0)
+        args.device = "CPU"
+
+    out = run_bench(args)
+    print(json.dumps(out))
+    # smoke contract: the pass fails loudly if nothing was actually served
+    if args.smoke and (out["completed"] == 0 or out["p50_ms"] is None):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
