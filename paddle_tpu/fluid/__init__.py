@@ -47,6 +47,9 @@ from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent,
                       Inferencer)
+from . import fault
+from . import guardian
+from .guardian import NumericsTripped
 from . import evaluator
 from . import debugger
 from . import ir
@@ -56,6 +59,7 @@ Tensor = framework.Variable
 
 __all__ = [
     "io", "initializer", "layers", "nets", "optimizer", "backward", "amp",
+    "fault", "guardian", "NumericsTripped",
     "regularizer", "metrics", "clip", "profiler", "unique_name",
     "Program", "Operator", "Parameter", "Variable",
     "default_main_program", "default_startup_program", "program_guard",

@@ -19,9 +19,16 @@ reject mixed cotangent/operand dtypes for convs).  Consequences:
    loss scaling needed; hardware fp32 accumulation makes operand rounding
    the only precision loss.
  - "float16": the contraction accumulates in fp16 with fp16's narrow
-   exponent range and NO loss scaling — experimental, can overflow on
-   real models.  The reference's fp16 transpiler targets *inference*
-   (float16_benchmark.md) for the same reason.
+   exponent range — usable for TRAINING because enabling it arms a
+   **dynamic loss scaler** by default: the backward seed is multiplied by
+   a persistable scale (so fp16 intermediate grads sit in representable
+   range), the raw grads are divided back by the scale before clip and
+   update (``clip.append_unscale_ops``), and the guarded executor step
+   (``fluid.guardian``) grows the scale x2 every ``growth_interval``
+   overflow-free steps, shrinks it /2 and SKIPS the update (device-side,
+   bit-exact revert) on overflow.  The reference's fp16 transpiler
+   targets *inference* (float16_benchmark.md); this is the training
+   story it lacked.
 
 Enable programmatically::
 
@@ -38,10 +45,19 @@ import os
 
 _SUPPORTED = ("bfloat16", "float16")
 
-_state = {"dtype": None, "keep": False}
+#: persistable scope vars carrying the dynamic loss-scale state; created by
+#: Optimizer.minimize (via create_loss_scaling_vars) when scaling is active
+#: at build time, updated device-side by guardian.fold_health every step
+LOSS_SCALE_VAR = "@LOSS_SCALE@"
+LOSS_SCALE_GOOD_VAR = "@LOSS_SCALE_GOOD@"
+
+_state = {"dtype": None, "keep": False, "dynamic_scaling": None,
+          "init_loss_scale": 2.0 ** 15, "scale_growth_interval": 1000}
 
 
-def enable(dtype: str = "bfloat16", keep_activations=None) -> None:
+def enable(dtype: str = "bfloat16", keep_activations=None,
+           dynamic_loss_scaling=None, init_loss_scale=None,
+           growth_interval=None) -> None:
     """Enable mixed precision.
 
     ``keep_activations=True`` selects the pure-low-precision activation
@@ -63,11 +79,58 @@ def enable(dtype: str = "bfloat16", keep_activations=None) -> None:
         keep_activations = os.environ.get(
             "PADDLE_TPU_AMP_KEEP", "").strip().lower() in ("1", "true")
     _state["keep"] = bool(keep_activations)
+    # dynamic loss scaling: None = auto (on for float16, pointless for
+    # bfloat16 whose exponent range matches fp32); True/False force it.
+    # Scaling is a BUILD-time decision — it threads scale vars and
+    # seed/unscale ops through Optimizer.minimize — so set it before
+    # building the train program.
+    _state["dynamic_scaling"] = dynamic_loss_scaling
+    if init_loss_scale is not None:
+        _state["init_loss_scale"] = float(init_loss_scale)
+    if growth_interval is not None:
+        _state["scale_growth_interval"] = max(1, int(growth_interval))
 
 
 def disable() -> None:
     _state["dtype"] = None
     _state["keep"] = False
+    _state["dynamic_scaling"] = None
+
+
+def dynamic_scaling_active() -> bool:
+    """True when programs built NOW should carry dynamic loss scaling."""
+    ds = _state["dynamic_scaling"]
+    if ds is not None:
+        return bool(ds) and _state["dtype"] is not None
+    return _state["dtype"] == "float16"
+
+
+def scaling_config():
+    """(init_loss_scale, growth_interval) for the scaler being built."""
+    return _state["init_loss_scale"], _state["scale_growth_interval"]
+
+
+def create_loss_scaling_vars(program, startup_program):
+    """Create (or reuse) the persistable loss-scale state vars in
+    ``program`` and record them on it for the guarded executor step.
+    Returns the scale Variable (read by the seed/unscale ops)."""
+    from .framework import program_guard
+    from .layers import tensor as _tensor
+
+    block = program.global_block()
+    with program_guard(program, startup_program):
+        if block.has_var(LOSS_SCALE_VAR):
+            scale = block.var(LOSS_SCALE_VAR)
+        else:
+            scale = _tensor.create_global_var(
+                shape=[1], value=_state["init_loss_scale"], dtype="float32",
+                persistable=True, name=LOSS_SCALE_VAR)
+            _tensor.create_global_var(
+                shape=[1], value=0, dtype="int32",
+                persistable=True, name=LOSS_SCALE_GOOD_VAR)
+    program._loss_scale_vars = (LOSS_SCALE_VAR, LOSS_SCALE_GOOD_VAR)
+    program._loss_scale_growth = _state["scale_growth_interval"]
+    return scale
 
 
 def is_enabled() -> bool:
@@ -172,3 +235,9 @@ if _env in ("bf16", "bfloat16", "1", "true"):
     enable("bfloat16")
 elif _env in ("fp16", "float16"):
     enable("float16")
+_env_scale = os.environ.get("PADDLE_TPU_AMP_INIT_SCALE", "").strip()
+if _env_scale:
+    _state["init_loss_scale"] = float(_env_scale)
+_env_interval = os.environ.get("PADDLE_TPU_AMP_SCALE_INTERVAL", "").strip()
+if _env_interval:
+    _state["scale_growth_interval"] = max(1, int(_env_interval))

@@ -58,13 +58,17 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
     # grad bookkeeping: fwd var name -> list of produced grad var names
     produced: Dict[str, List[str]] = {}
 
-    # seed: d loss / d loss = 1
+    # seed: d loss / d loss = 1.  The __loss_seed__ tag lets the executor
+    # fold a dynamic loss scale (and the guardian's grad-Inf fault
+    # injection) into the seed at trace time via the @LOSS_SEED_MUL@ env
+    # entry — see executor.run_op and guardian.seed_multiplier.
     loss_grad = grad_var_name(loss.name)
     _ensure_grad_var(block, loss.name, loss_grad)
     block.append_op(
         type="fill_any_like", inputs={"X": [loss.name]},
         outputs={"Out": [loss_grad]},
-        attrs={"value": 1.0, OpRole.KEY: OpRole.Backward | OpRole.Loss})
+        attrs={"value": 1.0, "__loss_seed__": True,
+               OpRole.KEY: OpRole.Backward | OpRole.Loss})
     produced[loss.name] = [loss_grad]
 
     def finalize_grad(name: str) -> Optional[str]:
@@ -165,6 +169,8 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                 op.attrs[OpRole.VAR_KEY] = rv
 
     program._params_grads = params_grads
+    # the guardian's numerics sentinel needs to know which var IS the loss
+    program._loss_name = loss.name
     return params_grads
 
 

@@ -9,7 +9,7 @@ from .framework import OpRole, default_main_program
 
 __all__ = ["ErrorClipByValue", "GradientClipByValue", "GradientClipByNorm",
            "GradientClipByGlobalNorm", "append_gradient_clip_ops",
-           "error_clip_callback", "set_gradient_clip"]
+           "append_unscale_ops", "error_clip_callback", "set_gradient_clip"]
 
 
 class BaseErrorClipAttr:
@@ -131,6 +131,31 @@ def set_gradient_clip(clip, param_list=None, program=None):
                   else p for p in param_list]
     for param in param_list:
         param.gradient_clip_attr = clip
+
+
+def append_unscale_ops(params_grads, loss_scale_var):
+    """Divide every raw grad by the dynamic loss scale (fluid.amp fp16
+    training).  Sits between append_backward and the clip ops, so norms
+    and clip thresholds see TRUE gradient magnitudes — the scale only
+    ever exists inside the backward pass.  Returns fresh (param, grad)
+    pairs; the raw (scaled) grads stay in ``program._params_grads``,
+    which is exactly what the guardian's overflow check wants to see."""
+    from .framework import program_guard
+    from .layers import nn as _nn
+
+    res = []
+    for p, g in params_grads:
+        if g is None:
+            res.append((p, g))
+            continue
+        block = p.block
+        with program_guard(block.program):
+            new_grad = _nn.elementwise_div(g, loss_scale_var)
+        # backward role: for_test clones and inference pruning must drop
+        # the unscale ops together with the rest of the backward graph
+        block.ops[-1].attrs[OpRole.KEY] = OpRole.Backward
+        res.append((p, new_grad))
+    return res
 
 
 def append_gradient_clip_ops(param_grad):

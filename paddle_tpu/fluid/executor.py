@@ -429,6 +429,24 @@ def run_op(op, env: Dict[str, object], rng_box=None):
             if lods is not None and i < len(lods) and lods[i] is not None:
                 env[name + LOD_SUFFIX] = tuple(tuple(l) for l in lods[i])
 
+    # backward-seed scaling (dynamic fp16 loss scale and/or the guardian's
+    # grad-Inf fault injection): the op append_backward tagged __loss_seed__
+    # has its output multiplied by the traced @LOSS_SEED_MUL@ scalar the
+    # guarded step placed in the env.  One dict lookup for every other op.
+    if "__loss_seed__" in op.attrs:
+        mul = env.get(_guardian_mod().LOSS_SEED_MUL)
+        if mul is not None:
+            for names in op.outputs.values():
+                for n in names:
+                    if n and n in env:
+                        env[n] = env[n] * jnp.asarray(mul, env[n].dtype)
+
+
+def _guardian_mod():
+    from . import guardian
+
+    return guardian
+
 
 # ---------------------------------------------------------------------------
 # Executor
@@ -478,6 +496,11 @@ class Executor:
 
         program = program or default_main_program()
         scope = scope or global_scope()
+        if getattr(program, "_loss_scale_vars", None) is not None:
+            raise RuntimeError(
+                "run_steps: this program was built with dynamic fp16 loss "
+                "scaling, whose per-step scale update and skip-on-overflow "
+                "gate live at the step boundary; use Executor.run per step")
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list or []]
         feed_arrays = {}
@@ -612,6 +635,12 @@ class Executor:
                       if lod and program.global_block()._has_var_recursive(n)}
 
         from . import amp as _amp
+        from . import guardian as _guardian
+
+        # guarded training step: the numerics sentinel / dynamic loss
+        # scaler fold a health reduction + conditional state commit into
+        # the same jitted program (guardian.py module docstring)
+        guard = _guardian.for_program(program)
 
         key = (program._cache_token, program._version, tuple(fetch_names),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
@@ -621,6 +650,7 @@ class Executor:
                self.place.device_type,
                # execution-mode toggles invalidate compiled fns
                _amp.compute_dtype(),
+               guard.cache_token() if guard is not None else None,
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
@@ -629,22 +659,50 @@ class Executor:
             VLOG(1, f"Executor: compiling block "
                     f"({len(program.global_block().ops)} ops, "
                     f"fetches={fetch_names})")
-            plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+            plan_fetches = list(fetch_names)
+            if guard is not None:
+                plan_fetches += guard.extra_fetch_names()
+            plan = BlockPlan(program, 0, list(feed_arrays), plan_fetches)
+            if guard is not None and plan.needs_eager:
+                if guard.scale_vars is not None:
+                    raise RuntimeError(
+                        "dynamic fp16 loss scaling is not supported for "
+                        "programs with data-dependent eager ops")
+                warnings.warn(
+                    "guardian: program contains data-dependent eager ops; "
+                    "the numerics sentinel is disabled for it")
+                guard = None
+                plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+            if guard is not None and guard.scale_vars:
+                # the good-steps counter is read/written only by the
+                # guarded wrapper (no IR op touches it), so liveness never
+                # saw it — gather it with the rest of the state
+                for n in guard.scale_vars:
+                    if n not in plan.state_in:
+                        plan.state_in.append(n)
             lod_box = {}
             all_lods = dict(state_lods)
             all_lods.update(feed_lods)
-            fn = self._build(program, plan, all_lods, lod_box)
-            entry = (plan, fn, lod_box)
+            fn = self._build(program, plan, all_lods, lod_box,
+                             guard=guard, n_user=len(fetch_names))
+            entry = (plan, fn, lod_box, guard)
             if use_program_cache:
                 self._cache[key] = entry
-        plan, fn, lod_box = entry
+        plan, fn, lod_box, guard = entry
 
         from . import fault as _fault
 
+        step_idx = 0
         if program._params_grads is not None:
             # training-step boundary (programs built via optimizer.minimize;
             # hook points for fault injection + elastic liveness)
-            self._step_boundary(_fault)
+            step_idx = self._step_boundary(_fault)
+        g = _guardian.current() if guard is not None else None
+        if g is not None:
+            # one-step-lag sentinel: observe the PREVIOUS step's health
+            # (its dispatch has retired — materializing two scalars is
+            # free) and apply policy BEFORE this step runs
+            g.on_boundary()
         state_vals = self._gather_state(program, plan, scope)
         device = core.get_jax_device(self.place)
         feed_dev = {k: self._put_feed(k, v, device)
@@ -658,19 +716,42 @@ class Executor:
         mut_state = {k: v for k, v in state_vals.items() if k in mut_names}
         const_state = {k: v for k, v in state_vals.items()
                        if k not in mut_names}
+        sentinel = None
+        dump_state = None
+        if guard is not None:
+            seed_mul, loss_mul = _fault.sentinel_injection(step_idx)
+            sentinel = {
+                "loss_cap": np.float32(g.loss_cap() if g is not None
+                                       else float("inf")),
+                "seed_mul": np.float32(seed_mul),
+                "loss_mul": np.float32(loss_mul),
+            }
+            dump_state = state_vals
+            if g is not None and g.config.policy == "dump_and_halt" \
+                    and device.platform != "cpu":
+                # donation invalidates mutated input buffers after the
+                # dispatch; dump mode keeps pre-step device copies alive
+                dump_state = {k: (jnp.array(v, copy=True) if k in mut_names
+                                  else v)
+                              for k, v in state_vals.items()}
         from . import profiler as _prof
 
-        if _prof.is_profiling():
-            import time as _time
+        health = None
+        import time as _time
 
-            t = _time.perf_counter()
+        t = _time.perf_counter()
+        if guard is not None:
+            fetches, new_state, health = fn(feed_dev, const_state,
+                                            mut_state, sentinel)
+        elif _prof.is_profiling():
             fetches, new_state = fn(feed_dev, const_state, mut_state)
             jax.block_until_ready(fetches)
+        else:
+            fetches, new_state = fn(feed_dev, const_state, mut_state)
+        if _prof.is_profiling():
             _prof.record_event(
                 f"executor_run[{len(plan.ops)}ops]",
                 _time.perf_counter() - t, start=t)
-        else:
-            fetches, new_state = fn(feed_dev, const_state, mut_state)
         if _fault.active() is not None:
             new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():
@@ -679,6 +760,12 @@ class Executor:
                 scope._lods[name] = lod_box[name]
         self._check_nan_inf(list(new_state.items())
                             + list(zip(plan.fetch_names, fetches)))
+        if g is not None and health is not None:
+            g.defer(guard, step_idx, health, {
+                "program": program, "feeds": feed_arrays,
+                "feed_lods": feed_lods, "fetch_names": fetch_names,
+                "state": dump_state, "sentinel": sentinel,
+                "duration_s": _time.perf_counter() - t})
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         from .lod_tensor import LoDTensor
@@ -704,17 +791,22 @@ class Executor:
         """Training-step boundary: fires armed step faults (kill-at-step-N)
         and emits an elastic-supervisor heartbeat when a heartbeat dir is
         configured.  A fused run_steps dispatch advances the whole window at
-        once — a kill armed inside it fires before the dispatch."""
+        once — a kill armed inside it fires before the dispatch.  Returns
+        the step index this dispatch executes (window start for fused)."""
+        fired = _fault.current_step()
         if _fault.active() is not None:
             if n_steps == 1:
-                _fault.on_step()
+                fired = _fault.on_step()
             else:
                 _fault.advance(n_steps)
+        else:
+            _fault._step += n_steps  # keep the index flowing for the guardian
         hb_dir = os.environ.get("PADDLE_ELASTIC_HB_DIR")
         if hb_dir:
             from ..parallel.elastic import write_heartbeat
 
             write_heartbeat(hb_dir, step=_fault.current_step())
+        return fired
 
     @staticmethod
     def _check_nan_inf(named_vals):
@@ -768,11 +860,33 @@ class Executor:
                                   prev_misses + 1 if ent is not None else 0]
         return dev_arr
 
-    def _build(self, program, plan, feed_lods=None, lod_box=None):
+    def _build(self, program, plan, feed_lods=None, lod_box=None,
+               guard=None, n_user=None):
         device = core.get_jax_device(self.place)
         donate = (2,) if device.platform == "tpu" else ()
         static_env = {k + LOD_SUFFIX: lod
                       for k, lod in (feed_lods or {}).items()}
+
+        if guard is not None:
+            from . import guardian as _g
+
+            def gfn(feed_vals, const_state, mut_state, sentinel):
+                state = dict(const_state)
+                state.update(mut_state)
+                feed_vals = dict(feed_vals)
+                # backward-seed multiplier (loss scale x fault injection),
+                # consumed by the __loss_seed__-tagged op in run_op
+                feed_vals[_g.LOSS_SEED_MUL] = _g.seed_multiplier(
+                    guard, state, sentinel)
+                fetches, new_state = trace_block(
+                    program, 0, plan, feed_vals, state,
+                    static_env=static_env, lod_box=lod_box)
+                new_state, health = _g.fold_health(
+                    guard, fetches[n_user:], new_state, mut_state, state,
+                    sentinel)
+                return fetches[:n_user], new_state, health
+
+            return jax.jit(gfn, donate_argnums=donate)
 
         def fn(feed_vals, const_state, mut_state):
             state = dict(const_state)

@@ -24,6 +24,19 @@ worker processes:
     PADDLE_FAULT_IO_DELAY_MS=t    sleep t ms inside every checkpoint write
     PADDLE_FAULT_NAN_VAR=name     overwrite var `name` with NaN once
     PADDLE_FAULT_NAN_STEP=N       ...at step N (default 0)
+    PADDLE_FAULT_GRAD_INF_STEP=N  poison step N's backward seed so every
+                                  gradient goes Inf IN-GRAPH (the guardian
+                                  sentinel and fp16 loss scaler's overflow
+                                  oracle; flows through the real grad ops,
+                                  so a replay bundle reproduces it)
+    PADDLE_FAULT_GRAD_INF_VALUE=v seed multiplier (default inf; a large
+                                  finite value like 1e30 models a partial
+                                  fp16 overflow instead)
+    PADDLE_FAULT_LOSS_SPIKE_STEP=N
+                                  multiply the observed loss at step N by
+                                  PADDLE_FAULT_LOSS_SPIKE_FACTOR (default
+                                  1e4) — the corrupt-batch oracle for the
+                                  guardian's spike detector
     PADDLE_FAULT_BARRIER_STALL=s  sleep s seconds before the next collective
                                   barrier (one-shot), simulating a wedged
                                   host that trips the supervisor's timeout
@@ -63,7 +76,8 @@ from typing import Optional
 __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
-    "barrier_stall", "serving_request", "current_step", "KILL_EXIT_CODE",
+    "barrier_stall", "serving_request", "sentinel_injection",
+    "current_step", "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -84,6 +98,10 @@ class FaultPlan:
                  ckpt_crash: Optional[str] = None,
                  io_delay_ms: float = 0.0,
                  nan_var: Optional[str] = None, nan_step: int = 0,
+                 grad_inf_step: Optional[int] = None,
+                 grad_inf_value: float = float("inf"),
+                 loss_spike_step: Optional[int] = None,
+                 loss_spike_factor: float = 1e4,
                  barrier_stall_s: float = 0.0,
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
                  rank: Optional[int] = None, mode: str = "exit"):
@@ -98,6 +116,11 @@ class FaultPlan:
         self.io_delay_ms = float(io_delay_ms)
         self.nan_var = nan_var
         self.nan_step = int(nan_step)
+        self.grad_inf_step = None if grad_inf_step is None else int(grad_inf_step)
+        self.grad_inf_value = float(grad_inf_value)
+        self.loss_spike_step = None if loss_spike_step is None \
+            else int(loss_spike_step)
+        self.loss_spike_factor = float(loss_spike_factor)
         self.barrier_stall_s = float(barrier_stall_s)
         self.serve_delay_ms = float(serve_delay_ms)
         self.serve_fail_every = int(serve_fail_every)
@@ -118,12 +141,19 @@ class FaultPlan:
         getf = lambda k, d=0.0: float(env.get(k, "").strip() or d)  # noqa: E731
         kill = env.get("PADDLE_FAULT_KILL_STEP", "").strip()
         rank = env.get("PADDLE_FAULT_RANK", "").strip()
+        ginf = env.get("PADDLE_FAULT_GRAD_INF_STEP", "").strip()
+        spike = env.get("PADDLE_FAULT_LOSS_SPIKE_STEP", "").strip()
         return cls(
             kill_step=int(kill) if kill else None,
             ckpt_crash=env.get("PADDLE_FAULT_CKPT_CRASH", "").strip() or None,
             io_delay_ms=getf("PADDLE_FAULT_IO_DELAY_MS"),
             nan_var=env.get("PADDLE_FAULT_NAN_VAR", "").strip() or None,
             nan_step=int(getf("PADDLE_FAULT_NAN_STEP")),
+            grad_inf_step=int(ginf) if ginf else None,
+            grad_inf_value=getf("PADDLE_FAULT_GRAD_INF_VALUE",
+                                float("inf")),
+            loss_spike_step=int(spike) if spike else None,
+            loss_spike_factor=getf("PADDLE_FAULT_LOSS_SPIKE_FACTOR", 1e4),
             barrier_stall_s=getf("PADDLE_FAULT_BARRIER_STALL"),
             serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
             serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
@@ -228,6 +258,28 @@ def corrupt_state(named_vals: dict) -> dict:
         named_vals[plan.nan_var] = poisoned
         plan._nan_fired = True
     return named_vals
+
+
+def sentinel_injection(step: int):
+    """Per-step numerics-fault multipliers for the guardian's sentinel:
+    ``(seed_mul, loss_mul)``, both 1.0 when nothing is armed for ``step``.
+
+    ``seed_mul`` scales the backward seed IN-GRAPH (the @LOSS_SEED_MUL@
+    entry the guarded executor step feeds into the tagged __loss_seed__
+    op), so a grad-Inf injection flows through the real gradient ops and
+    a dumped replay bundle reproduces it bit-for-bit.  ``loss_mul``
+    scales the observed loss (the corrupt-batch spike oracle).  Keyed on
+    exact step equality, so the injection is naturally one-shot per step
+    and a resumed run that re-executes the step re-fires it — which is
+    what a deterministic oracle should do."""
+    plan = active()
+    if plan is None or not plan._applies_to_this_rank():
+        return 1.0, 1.0
+    seed_mul = plan.grad_inf_value \
+        if plan.grad_inf_step == step else 1.0
+    loss_mul = plan.loss_spike_factor \
+        if plan.loss_spike_step == step else 1.0
+    return seed_mul, loss_mul
 
 
 def ckpt_crash_point(where: str) -> None:

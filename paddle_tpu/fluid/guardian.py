@@ -1,0 +1,668 @@
+"""Training guardian: async numerics sentinel, flight recorder, step replay.
+
+The elastic supervisor (``parallel.elastic``) answers *structural* failure —
+a dead rank restarts the pod from a checkpoint.  The failures that actually
+burn pod-hours are in-band: a NaN/Inf that silently poisons the weights
+thousands of steps before anyone reads the loss curve, an fp16 overflow, a
+loss spike from one corrupt batch.  The guardian detects these the step
+they happen and reacts by policy, without adding a per-step host sync:
+
+ - **sentinel**: the Executor folds a device-side health reduction (loss,
+   global grad-norm, an ``isfinite`` all-reduce over the raw grads) into
+   the jitted train step.  The host fetches the tiny health scalars with a
+   ONE-STEP LAG — by the next step boundary the previous dispatch has long
+   retired, so materializing them costs nothing on the hot path.
+ - **device-side commit gate**: the step's state update is committed with
+   ``jnp.where(ok, new, old)`` *inside* the same XLA program, where ``ok``
+   is "all grads and the loss are finite AND the loss is under the spike
+   cap".  A bad step therefore never touches parameters or optimizer
+   state — ``skip`` costs zero host round-trips and leaves the state
+   bit-identical to the previous step.
+ - **policy** per trip: ``skip`` (log + keep going), ``halt`` (raise
+   :class:`NumericsTripped`), ``dump_and_halt`` (write a replay bundle,
+   then raise).  A trip under an elastic supervisor also lands one line in
+   its ``incidents.jsonl`` (``PADDLE_ELASTIC_INCIDENTS``).
+ - **flight recorder**: a bounded ring of the last K steps' health records
+   (loss, grad-norm, loss scale, wall time).  On ``dump_and_halt`` it
+   writes a replay bundle: the bad step's feeds, pre-step state snapshot
+   (parameters, optimizer accumulators, RNG key), the pickled Program,
+   the sentinel inputs of that step, and the ring itself.
+ - **replay CLI**: ``python -m paddle_tpu.fluid.guardian replay <bundle>``
+   re-executes the recorded step on CPU (``JAX_PLATFORMS=cpu``), checks
+   the recomputed loss reproduces the recorded value bit-for-bit, then
+   walks the block op-by-op eagerly to bisect which variable first goes
+   non-finite.
+
+Enable programmatically (``guardian.enable(policy="skip")``) or via env::
+
+    PADDLE_TPU_GUARDIAN=skip|halt|dump_and_halt   arm the sentinel
+    PADDLE_TPU_GUARDIAN_SPIKE=f      loss-spike factor (0 disables; a step
+                                     whose loss exceeds f x the median of
+                                     the recent window trips)
+    PADDLE_TPU_GUARDIAN_WINDOW=w     spike window (default 32 steps)
+    PADDLE_TPU_GUARDIAN_RING=k       flight-recorder depth (default 128)
+    PADDLE_TPU_GUARDIAN_DIR=path     replay-bundle directory
+                                     (default ./guardian_dumps)
+
+The deterministic oracles live in ``fluid.fault``:
+``PADDLE_FAULT_GRAD_INF_STEP`` poisons the backward seed at a step (a real
+in-graph Inf that flows through every grad) and
+``PADDLE_FAULT_LOSS_SPIKE_STEP`` multiplies the observed loss.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import statistics
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "NumericsTripped", "GuardianConfig", "Guardian", "HealthRecord",
+    "FlightRecorder", "enable", "disable", "install", "current",
+    "for_program", "metrics", "flush", "replay",
+]
+
+POLICIES = ("skip", "halt", "dump_and_halt")
+
+#: reserved env name the guarded step uses to scale the backward seed
+#: (dynamic loss scale x fault injection); consumed by run_op on the op
+#: tagged ``__loss_seed__`` by append_backward
+LOSS_SEED_MUL = "@LOSS_SEED_MUL@"
+
+BUNDLE_META = "meta.json"
+BUNDLE_PROGRAM = "program.pkl"
+BUNDLE_FEEDS = "feeds.npz"
+BUNDLE_STATE = "state.npz"
+BUNDLE_RECORDS = "records.json"
+
+
+class NumericsTripped(RuntimeError):
+    """Raised by the ``halt``/``dump_and_halt`` policies.  Carries the
+    offending :class:`HealthRecord` and, when dumped, the bundle path."""
+
+    def __init__(self, record: "HealthRecord", bundle: Optional[str] = None):
+        self.record = record
+        self.bundle = bundle
+        msg = (f"numerics sentinel tripped at step {record.step}: "
+               f"loss={record.loss!r} grad_norm={record.grad_norm!r} "
+               f"finite={record.finite} spike={record.spike}")
+        if bundle:
+            msg += f" (replay bundle: {bundle})"
+        super().__init__(msg)
+
+
+class GuardianConfig:
+    def __init__(self, policy: str = "skip", spike_factor: float = 0.0,
+                 spike_window: int = 32, ring_size: int = 128,
+                 bundle_dir: Optional[str] = None):
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.spike_factor = float(spike_factor)
+        self.spike_window = max(2, int(spike_window))
+        self.ring_size = max(2, int(ring_size))
+        self.bundle_dir = bundle_dir or os.path.join(os.getcwd(),
+                                                     "guardian_dumps")
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["GuardianConfig"]:
+        env = os.environ if env is None else env
+        policy = env.get("PADDLE_TPU_GUARDIAN", "").strip().lower()
+        if not policy or policy in ("0", "off", "false"):
+            return None
+        if policy in ("1", "true", "on"):
+            policy = "skip"
+        return cls(
+            policy=policy,
+            spike_factor=float(env.get("PADDLE_TPU_GUARDIAN_SPIKE", "").strip()
+                               or 0.0),
+            spike_window=int(env.get("PADDLE_TPU_GUARDIAN_WINDOW", "").strip()
+                             or 32),
+            ring_size=int(env.get("PADDLE_TPU_GUARDIAN_RING", "").strip()
+                          or 128),
+            bundle_dir=env.get("PADDLE_TPU_GUARDIAN_DIR", "").strip() or None,
+        )
+
+
+class HealthRecord:
+    """One step's health, as observed (one step late) by the host."""
+
+    __slots__ = ("step", "loss", "grad_norm", "scale", "finite", "ok",
+                 "spike", "duration_s")
+
+    def __init__(self, step, loss, grad_norm, scale, finite, ok, spike,
+                 duration_s=0.0):
+        self.step = int(step)
+        self.loss = float(loss)
+        self.grad_norm = float(grad_norm)
+        self.scale = float(scale)
+        self.finite = bool(finite)
+        self.ok = bool(ok)
+        self.spike = bool(spike)
+        self.duration_s = float(duration_s)
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class FlightRecorder:
+    """Bounded ring of the last K health records + the spike statistics."""
+
+    def __init__(self, size: int, spike_window: int):
+        self.ring: deque = deque(maxlen=size)
+        self._recent_losses: deque = deque(maxlen=spike_window)
+
+    def append(self, rec: HealthRecord) -> None:
+        self.ring.append(rec)
+        if rec.ok and math.isfinite(rec.loss):
+            self._recent_losses.append(rec.loss)
+
+    def records(self) -> List[HealthRecord]:
+        return list(self.ring)
+
+    def loss_cap(self, spike_factor: float) -> float:
+        """Host-computed spike threshold fed INTO the next jitted step (so
+        the device commit gate can reject a spiked step without any host
+        sync).  inf until enough clean history exists."""
+        if spike_factor <= 0 or len(self._recent_losses) < 4:
+            return float("inf")
+        med = statistics.median(self._recent_losses)
+        if med <= 0 or not math.isfinite(med):
+            return float("inf")
+        return spike_factor * med
+
+
+# ---------------------------------------------------------------------------
+# Per-program guard spec (what the Executor folds into the jitted step)
+# ---------------------------------------------------------------------------
+
+
+class GuardSpec:
+    """Static description of how to guard one training Program."""
+
+    def __init__(self, loss_name: str, grad_names: List[str],
+                 scale_vars, growth_interval: int):
+        self.loss_name = loss_name
+        self.grad_names = list(grad_names)
+        self.scale_vars = tuple(scale_vars) if scale_vars else None
+        self.growth_interval = int(growth_interval)
+
+    def extra_fetch_names(self) -> List[str]:
+        return [self.loss_name] + self.grad_names
+
+    def cache_token(self):
+        """Part of the Executor's compile-cache key: anything that changes
+        the *compiled* guarded function (policy does not — it is host-side)."""
+        return ("guard", self.loss_name, tuple(self.grad_names),
+                self.scale_vars, self.growth_interval)
+
+
+def for_program(program) -> Optional[GuardSpec]:
+    """GuardSpec when this program should run guarded: it is a training
+    program (has params/grads + a recorded loss) AND either the guardian is
+    armed or the program was built with dynamic loss scaling."""
+    if getattr(program, "_params_grads", None) is None:
+        return None
+    loss_name = getattr(program, "_loss_name", None)
+    if not loss_name:
+        return None
+    scale_vars = getattr(program, "_loss_scale_vars", None)
+    if current() is None and scale_vars is None:
+        return None
+    grad_names = [g.name for _, g in program._params_grads if g is not None]
+    if not grad_names:
+        return None
+    return GuardSpec(loss_name, grad_names, scale_vars,
+                     getattr(program, "_loss_scale_growth", 1000))
+
+
+# ---------------------------------------------------------------------------
+# Device-side health fold (runs inside the Executor's jitted step)
+# ---------------------------------------------------------------------------
+
+
+def seed_multiplier(spec: GuardSpec, state: Dict, sentinel: Dict):
+    """The traced scalar the backward seed is multiplied by: dynamic loss
+    scale (when built in) x fault grad-Inf injection (normally 1.0)."""
+    import jax.numpy as jnp
+
+    mul = jnp.asarray(sentinel["seed_mul"], jnp.float32)
+    if spec.scale_vars is not None:
+        mul = mul * state[spec.scale_vars[0]].reshape(()).astype(jnp.float32)
+    return mul
+
+
+def fold_health(spec: GuardSpec, extra_fetches, new_state: Dict,
+                mut_state: Dict, state: Dict, sentinel: Dict):
+    """Pure-JAX health reduction + conditional commit + loss-scale update.
+
+    Called inside the Executor's jitted train step.  Returns
+    ``(new_state, health)`` where health is a dict of device scalars the
+    host will materialize one step later.
+    """
+    import jax.numpy as jnp
+
+    from .framework import RNG_STATE_VAR
+
+    f32 = jnp.float32
+    loss_raw = extra_fetches[0]
+    grads = extra_fetches[1:]
+
+    loss_scalar = jnp.asarray(loss_raw, f32).reshape(-1)[0]
+    # injected loss spike (fault oracle for the spike detector)
+    health_loss = loss_scalar * jnp.asarray(sentinel["loss_mul"], f32)
+
+    finite = jnp.isfinite(health_loss)
+    gn_sq = jnp.zeros((), f32)
+    for g in grads:
+        gf = g.astype(f32)
+        finite = finite & jnp.all(jnp.isfinite(gf))
+        gn_sq = gn_sq + jnp.sum(gf * gf)
+    grad_norm = jnp.sqrt(gn_sq)
+
+    if spec.scale_vars is not None:
+        scale_name, good_name = spec.scale_vars
+        scale = state[scale_name].reshape(()).astype(f32)
+        # raw grads carry the loss scale; report the true norm
+        grad_norm = grad_norm / scale
+    else:
+        scale = jnp.ones((), f32)
+
+    # commit gate: NaN loss compares False against any cap, so this single
+    # predicate covers both non-finite and spike trips
+    ok = finite & (health_loss <= jnp.asarray(sentinel["loss_cap"], f32))
+
+    skip_revert = {RNG_STATE_VAR}
+    if spec.scale_vars is not None:
+        skip_revert.update(spec.scale_vars)
+    committed = {}
+    for name, val in new_state.items():
+        old = mut_state.get(name)
+        if old is None or name in skip_revert:
+            # write-only vars (freshly derived, e.g. a decayed lr), the RNG
+            # key (always advances — replaying a mask is worse than losing
+            # one draw) and the scaler state (updated below) keep the new
+            # value; everything read-write reverts when the step is bad
+            committed[name] = val
+        else:
+            committed[name] = jnp.where(ok, val, old)
+
+    if spec.scale_vars is not None:
+        good = state[good_name].reshape(()).astype(jnp.int32)
+        new_good = jnp.where(finite, good + 1, 0)
+        grow = new_good >= spec.growth_interval
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, scale * 2.0, scale),
+            jnp.maximum(scale * 0.5, 1.0))
+        new_good = jnp.where(grow, 0, new_good)
+        committed[scale_name] = new_scale.reshape(
+            state[scale_name].shape).astype(state[scale_name].dtype)
+        committed[good_name] = new_good.reshape(
+            state[good_name].shape).astype(state[good_name].dtype)
+        scale = new_scale
+
+    health = {"loss": health_loss, "grad_norm": grad_norm,
+              "finite": finite, "ok": ok, "scale": scale}
+    return committed, health
+
+
+# ---------------------------------------------------------------------------
+# Host-side guardian (module singleton, env-armed like fluid.fault)
+# ---------------------------------------------------------------------------
+
+
+_UNSET = object()
+_guardian = _UNSET
+
+
+class Guardian:
+    def __init__(self, config: GuardianConfig):
+        self.config = config
+        self.recorder = FlightRecorder(config.ring_size, config.spike_window)
+        self.counters = {"steps": 0, "trips": 0, "skips": 0, "halts": 0,
+                         "spikes": 0, "nonfinite": 0}
+        self._pending = None  # (spec, step, health, ctx)
+        self.last_scale = 1.0
+
+    # -- step plumbing (called by the Executor) --
+    def loss_cap(self) -> float:
+        return self.recorder.loss_cap(self.config.spike_factor)
+
+    def on_boundary(self) -> None:
+        """Step boundary: observe the PREVIOUS step's health (its dispatch
+        has retired; the scalars are free to read) and apply policy before
+        the next step runs."""
+        self._check_pending()
+
+    def defer(self, spec, step, health, ctx) -> None:
+        self._pending = (spec, step, health, ctx)
+        self.counters["steps"] += 1
+
+    def flush(self) -> None:
+        """Force-check the deferred health record (call after the last
+        training step; the Trainer does this automatically)."""
+        self._check_pending()
+
+    # -- observation + policy --
+    def _check_pending(self) -> None:
+        if self._pending is None:
+            return
+        import numpy as np
+
+        spec, step, health, ctx = self._pending
+        self._pending = None
+        rec = HealthRecord(
+            step=step,
+            loss=float(np.asarray(health["loss"])),
+            grad_norm=float(np.asarray(health["grad_norm"])),
+            scale=float(np.asarray(health["scale"])),
+            finite=bool(np.asarray(health["finite"])),
+            ok=bool(np.asarray(health["ok"])),
+            spike=False,
+            duration_s=ctx.get("duration_s", 0.0),
+        )
+        rec.spike = rec.finite and not rec.ok
+        self.recorder.append(rec)
+        self.last_scale = rec.scale
+        from . import profiler as _prof
+
+        _prof.record_counter("guardian_steps")
+        _prof.record_counter("guardian_loss_scale", value=rec.scale)
+        if rec.ok:
+            return
+        self._trip(rec, spec, ctx)
+
+    def _trip(self, rec: HealthRecord, spec: GuardSpec, ctx: dict) -> None:
+        from .log import LOG
+        from . import profiler as _prof
+
+        self.counters["trips"] += 1
+        self.counters["nonfinite" if not rec.finite else "spikes"] += 1
+        _prof.record_counter("guardian_trips")
+        policy = self.config.policy
+        bundle = None
+        if policy == "dump_and_halt":
+            try:
+                bundle = self.dump_bundle(rec, spec, ctx)
+            except Exception as exc:
+                LOG(f"guardian: replay-bundle dump failed: {exc!r}")
+        self._incident(rec, policy, bundle)
+        if policy == "skip":
+            self.counters["skips"] += 1
+            _prof.record_counter("guardian_skips")
+            LOG(f"guardian: step {rec.step} tripped "
+                f"(loss={rec.loss!r}, grad_norm={rec.grad_norm!r}) — "
+                f"update dropped, training continues")
+            return
+        self.counters["halts"] += 1
+        _prof.record_counter("guardian_halts")
+        raise NumericsTripped(rec, bundle)
+
+    def _incident(self, rec: HealthRecord, policy: str,
+                  bundle: Optional[str]) -> None:
+        """Under an elastic supervisor, a guardian trip must be a recorded
+        *decision*, not just a dead process: append one line to the
+        supervisor's incidents.jsonl."""
+        path = os.environ.get("PADDLE_ELASTIC_INCIDENTS")
+        if not path:
+            return
+        try:
+            from ..parallel.elastic import IncidentLog
+
+            IncidentLog(path).log(
+                "guardian_trip", step=rec.step, policy=policy,
+                loss=rec.loss, grad_norm=rec.grad_norm, scale=rec.scale,
+                finite=rec.finite, spike=rec.spike, bundle=bundle,
+                rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        except Exception:
+            # incident reporting must never mask the trip itself
+            pass
+
+    # -- flight-recorder dump --
+    def dump_bundle(self, rec: HealthRecord, spec: GuardSpec,
+                    ctx: dict) -> str:
+        import numpy as np
+
+        root = self.config.bundle_dir
+        os.makedirs(root, exist_ok=True)
+        bdir = os.path.join(root, f"step_{rec.step}")
+        n = 1
+        while os.path.exists(bdir):
+            bdir = os.path.join(root, f"step_{rec.step}.{n}")
+            n += 1
+        os.makedirs(bdir)
+
+        program = ctx["program"]
+        with open(os.path.join(bdir, BUNDLE_PROGRAM), "wb") as f:
+            f.write(program.serialize_to_string())
+        np.savez(os.path.join(bdir, BUNDLE_FEEDS),
+                 **{k: np.asarray(v) for k, v in ctx["feeds"].items()})
+        np.savez(os.path.join(bdir, BUNDLE_STATE),
+                 **{k: np.asarray(v) for k, v in ctx["state"].items()})
+        loss32 = np.float32(rec.loss)
+        meta = {
+            "step": rec.step,
+            "loss": rec.loss,
+            "loss_bits": loss32.tobytes().hex(),
+            "grad_norm": rec.grad_norm,
+            "scale": rec.scale,
+            "finite": rec.finite,
+            "spike": rec.spike,
+            "fetch_names": list(ctx.get("fetch_names", [])),
+            "extra_fetch_names": spec.extra_fetch_names(),
+            "scale_vars": list(spec.scale_vars) if spec.scale_vars else None,
+            "growth_interval": spec.growth_interval,
+            "sentinel": {k: float(v) for k, v in ctx["sentinel"].items()},
+            "feed_lods": {k: [list(map(int, lv)) for lv in lod]
+                          for k, lod in (ctx.get("feed_lods") or {}).items()},
+            "program_cache_token": getattr(program, "_cache_token", None),
+        }
+        with open(os.path.join(bdir, BUNDLE_META), "w") as f:
+            json.dump(meta, f, indent=1)
+        with open(os.path.join(bdir, BUNDLE_RECORDS), "w") as f:
+            json.dump([r.to_dict() for r in self.recorder.records()], f)
+        return bdir
+
+    def metrics(self) -> dict:
+        """ServingMetrics-style counter snapshot."""
+        out = dict(self.counters)
+        out["loss_scale"] = self.last_scale
+        out["ring_depth"] = len(self.recorder.ring)
+        return out
+
+
+# -- module-level management --
+
+
+def install(config: Optional[GuardianConfig]) -> Optional[Guardian]:
+    """Arm (or with None, disarm) the guardian programmatically — this
+    overrides the PADDLE_TPU_GUARDIAN env contract."""
+    global _guardian
+    _guardian = Guardian(config) if config is not None else None
+    return _guardian
+
+
+def enable(policy: str = "skip", **kwargs) -> Guardian:
+    return install(GuardianConfig(policy=policy, **kwargs))
+
+
+def disable() -> None:
+    install(None)
+
+
+def current() -> Optional[Guardian]:
+    global _guardian
+    if _guardian is _UNSET:
+        cfg = GuardianConfig.from_env()
+        _guardian = Guardian(cfg) if cfg is not None else None
+    return _guardian
+
+
+def metrics() -> dict:
+    g = current()
+    return g.metrics() if g is not None else {}
+
+
+def flush() -> None:
+    g = current()
+    if g is not None:
+        g.flush()
+
+
+# ---------------------------------------------------------------------------
+# Replay: re-execute a dumped step on CPU and bisect the first bad var
+# ---------------------------------------------------------------------------
+
+
+def replay(bundle_dir: str, verbose: bool = False) -> dict:
+    """Re-execute a replay bundle's recorded step.
+
+    Two passes:
+
+    1. **jit pass** — rebuild the exact guarded step (same plan, same extra
+       fetches, same sentinel inputs) and execute it once; the recomputed
+       loss must reproduce the recorded value bit-for-bit (same XLA
+       program, same inputs, one machine).
+    2. **eager bisect** — walk the block op-by-op with concrete values and
+       report the FIRST variable that goes non-finite, i.e. the op that
+       manufactured the NaN/Inf.
+
+    Returns a report dict (also printed as JSON by the CLI)."""
+    import numpy as np
+
+    try:  # force CPU when the backend is not yet initialized
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except (ImportError, RuntimeError):
+        pass
+    import jax
+    import jax.numpy as jnp
+
+    from .executor import LOD_SUFFIX, BlockPlan, run_op, trace_block
+    from .framework import Program, RNG_STATE_VAR
+
+    with open(os.path.join(bundle_dir, BUNDLE_META)) as f:
+        meta = json.load(f)
+    with open(os.path.join(bundle_dir, BUNDLE_PROGRAM), "rb") as f:
+        program = Program.parse_from_string(f.read())
+    feeds = dict(np.load(os.path.join(bundle_dir, BUNDLE_FEEDS)))
+    state_np = dict(np.load(os.path.join(bundle_dir, BUNDLE_STATE)))
+
+    user_fetches = meta["fetch_names"]
+    extra = meta["extra_fetch_names"]
+    sentinel = {k: np.float32(v) for k, v in meta["sentinel"].items()}
+    spec = GuardSpec(extra[0], extra[1:],
+                     meta.get("scale_vars"), meta.get("growth_interval", 1000))
+
+    plan = BlockPlan(program, 0, list(feeds), user_fetches + extra)
+    static_env = {k + LOD_SUFFIX: tuple(tuple(lv) for lv in lod)
+                  for k, lod in (meta.get("feed_lods") or {}).items()}
+    # the bundle's state IS the step's exact input set (including the
+    # scaler vars the executor force-gathers outside plan.state_in)
+    state = {k: jnp.asarray(v) for k, v in state_np.items()}
+
+    n_user = len(user_fetches)
+
+    def step(feed_vals, state_vals, sent):
+        env_state = dict(state_vals)
+        feed_vals = dict(feed_vals)
+        feed_vals[LOSS_SEED_MUL] = seed_multiplier(spec, env_state, sent)
+        fetches, new_state = trace_block(program, 0, plan, feed_vals,
+                                         env_state, static_env=static_env)
+        mut = {k: v for k, v in new_state.items() if k in env_state}
+        _, health = fold_health(spec, fetches[n_user:], new_state, mut,
+                                env_state, sent)
+        return fetches, health
+
+    feeds_j = {k: jnp.asarray(v) for k, v in feeds.items()}
+    fetches, health = jax.jit(step)(feeds_j, state, sentinel)
+    replayed_loss = np.float32(np.asarray(health["loss"]))
+    recorded_bits = meta["loss_bits"]
+    replayed_bits = replayed_loss.tobytes().hex()
+    # NaNs never compare equal; the BIT pattern is the reproduction check
+    bitwise_match = replayed_bits == recorded_bits
+
+    # eager bisect: concrete op-by-op walk, first non-finite var wins
+    env: Dict[str, object] = {}
+    env.update(static_env)
+    env.update({k: jnp.asarray(v) for k, v in state_np.items()})
+    env.update(feeds_j)
+    env[LOSS_SEED_MUL] = seed_multiplier(spec, env, sentinel)
+    rng_box = [env[RNG_STATE_VAR]] if plan.needs_rng else None
+    first_bad = None
+    trail = []
+    for idx, op in enumerate(plan.ops):
+        run_op(op, env, rng_box)
+        if first_bad is not None:
+            continue
+        for name in op.output_arg_names:
+            val = env.get(name)
+            if val is None or not hasattr(val, "dtype"):
+                continue
+            if not jnp.issubdtype(val.dtype, jnp.floating):
+                continue
+            arr = np.asarray(val)
+            if not np.isfinite(arr).all():
+                kinds = []
+                if np.isnan(arr).any():
+                    kinds.append("nan")
+                if np.isinf(arr).any():
+                    kinds.append("inf")
+                first_bad = {"op_index": idx, "op_type": op.type,
+                             "var": name, "kind": "+".join(kinds),
+                             "bad_count": int((~np.isfinite(arr)).sum()),
+                             "size": int(arr.size)}
+                break
+        if verbose:
+            trail.append({"op_index": idx, "op_type": op.type})
+
+    report = {
+        "bundle": os.path.abspath(bundle_dir),
+        "step": meta["step"],
+        "recorded_loss": meta["loss"],
+        "replayed_loss": float(replayed_loss),
+        "recorded_loss_bits": recorded_bits,
+        "replayed_loss_bits": replayed_bits,
+        "bitwise_match": bitwise_match,
+        "first_nonfinite": first_bad,
+        "n_ops": len(plan.ops),
+    }
+    if verbose:
+        report["trail"] = trail
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.fluid.guardian",
+        description="Guardian flight-recorder tools.")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("replay",
+                        help="re-execute a replay bundle on CPU and bisect "
+                             "the first non-finite variable")
+    rp.add_argument("bundle", help="replay-bundle directory")
+    rp.add_argument("--verbose", action="store_true",
+                    help="include the full op trail in the report")
+    args = ap.parse_args(argv)
+    if args.cmd == "replay":
+        report = replay(args.bundle, verbose=args.verbose)
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        if report["first_nonfinite"] is None and not report["bitwise_match"]:
+            return 1  # neither reproduced the bad value nor found one
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -20,6 +20,7 @@ import time
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler", "record_event", "is_profiling",
+           "record_counter", "counters",
            "device_op_table", "lower_program_hlo"]
 
 _trace_dir = None
@@ -27,6 +28,7 @@ _on = False
 _agg = {}        # name -> [calls, total, min, max]
 _timeline = []   # {"name", "ts", "dur"} microseconds since start
 _t0 = 0.0
+_counters = {}   # name -> value (ServingMetrics-style counters/gauges)
 
 
 def is_profiling() -> bool:
@@ -50,6 +52,24 @@ def record_event(name: str, seconds: float, start: float = None) -> None:
     _timeline.append({"name": name, "ts": ts, "dur": seconds * 1e6})
 
 
+def record_counter(name: str, inc: int = 1, value=None) -> None:
+    """ServingMetrics-style counter/gauge, ALWAYS on (one dict write;
+    unlike record_event it does not require an active profiling session —
+    production counters must not depend on tracing being enabled).
+    Default increments by ``inc``; ``value=`` sets a gauge absolutely
+    (e.g. the guardian's current loss scale)."""
+    if value is not None:
+        _counters[name] = value
+    else:
+        _counters[name] = _counters.get(name, 0) + inc
+
+
+def counters() -> dict:
+    """Snapshot of all counters/gauges (guardian trips/skips/loss-scale,
+    plus anything subsystems recorded)."""
+    return dict(_counters)
+
+
 @contextlib.contextmanager
 def _event(name):
     t = time.perf_counter()
@@ -68,6 +88,7 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 def reset_profiler():
     _agg.clear()
     _timeline.clear()
+    _counters.clear()
 
 
 def start_profiler(state="All", trace_dir=None):

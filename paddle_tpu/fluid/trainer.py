@@ -392,6 +392,12 @@ class Trainer:
                 self._save_checkpoint(epoch_id, -1, end_of_epoch=True)
                 last_epoch_saved = epoch_id
             event_handler(EndEpochEvent(epoch_id))
+        # the guardian's sentinel observes each step one boundary late;
+        # flush here so a trip on the LAST step still raises/dumps instead
+        # of dying silently with the loop
+        from . import guardian as _guardian
+
+        _guardian.flush()
         if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
             # final state is always captured so resume never replays work
             # (skipped when the in-loop epoch save already wrote it)

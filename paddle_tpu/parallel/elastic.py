@@ -221,7 +221,11 @@ class ElasticSupervisor:
             except OSError:
                 pass
         env = {"PADDLE_ELASTIC_HB_DIR": self.hb_dir,
-               "PADDLE_ELASTIC_GENERATION": str(gen)}
+               "PADDLE_ELASTIC_GENERATION": str(gen),
+               # workers append their own decisions (guardian numerics
+               # trips — fluid.guardian) next to the supervisor's: one
+               # incident stream per pod, small O_APPEND json lines
+               "PADDLE_ELASTIC_INCIDENTS": self.incidents.path}
         env.update(self.extra_env)
         if gen == 0:
             env.update(self.fault_env)

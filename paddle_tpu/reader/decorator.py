@@ -4,11 +4,20 @@ from __future__ import annotations
 
 import itertools
 import random
-from queue import Queue
-from threading import Thread
+from queue import Empty, Full, Queue
+from threading import Event, Thread
 
 __all__ = ["PipeReader", "map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache"]
+
+
+class _WorkerError:
+    """Exception captured in a reader worker thread, queued so the CONSUMER
+    re-raises it.  Without this, a raising worker dies before posting the
+    end sentinel and the consumer deadlocks on q.get() forever."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 def map_readers(func, *readers):
@@ -20,18 +29,26 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
+def shuffle(reader, buf_size, seed=None):
+    """Buffered shuffle.  ``seed`` pins the permutation to a private
+    ``random.Random`` (NOT the global module state some other library may
+    have reseeded), so data order is reproducible — and therefore
+    recordable/replayable by the guardian's flight recorder.  Each fresh
+    iteration restarts from the same seed; pass a per-epoch seed for
+    epoch-varying order.  ``seed=None`` keeps independent randomness."""
+
     def data_reader():
+        rng = random.Random(seed)
         buf = []
         for e in reader():
             buf.append(e)
             if len(buf) >= buf_size:
-                random.shuffle(buf)
+                rng.shuffle(buf)
                 for b in buf:
                     yield b
                 buf = []
         if buf:
-            random.shuffle(buf)
+            rng.shuffle(buf)
             for b in buf:
                 yield b
 
@@ -85,9 +102,15 @@ def buffered(reader, size):
     end = EndSignal()
 
     def read_worker(r, q):
-        for d in r:
-            q.put(d)
-        q.put(end)
+        try:
+            for d in r:
+                q.put(d)
+        except BaseException as exc:
+            # surface the failure to the consumer instead of dying
+            # silently (which would hang the consumer's q.get() forever)
+            q.put(_WorkerError(exc))
+        else:
+            q.put(end)
 
     def data_reader():
         r = reader()
@@ -97,6 +120,8 @@ def buffered(reader, size):
         t.start()
         e = q.get()
         while e is not end:
+            if isinstance(e, _WorkerError):
+                raise e.exc
             yield e
             e = q.get()
 
@@ -114,26 +139,59 @@ def firstn(reader, n):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel-map a reader with worker threads (ref: decorator.py:243)."""
+    """Parallel-map a reader with worker threads (ref: decorator.py:243).
+
+    A raising ``mapper`` (or source reader) propagates to the consumer
+    instead of silently killing its thread — which would leave ``end``
+    unposted and the consumer blocked on ``out_q.get()`` forever.  On
+    error the consumer flips an abort event; feeder and workers use
+    timeout-puts so a full queue can never wedge the drain."""
     end = object()
 
     def data_reader():
         in_q = Queue(buffer_size)
         out_q = Queue(buffer_size)
+        abort = Event()
+
+        def _put(q, item) -> bool:
+            while not abort.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except Full:
+                    continue
+            return False
 
         def feed():
-            for sample in reader():
-                in_q.put(sample)
+            try:
+                for sample in reader():
+                    if not _put(in_q, sample):
+                        return
+            except BaseException as exc:
+                _put(out_q, _WorkerError(exc))
+                return
             for _ in range(process_num):
-                in_q.put(end)
+                if not _put(in_q, end):
+                    return
 
         def work():
             while True:
-                sample = in_q.get()
+                try:
+                    sample = in_q.get(timeout=0.05)
+                except Empty:
+                    if abort.is_set():
+                        return
+                    continue
                 if sample is end:
-                    out_q.put(end)
+                    _put(out_q, end)
                     return
-                out_q.put(mapper(sample))
+                try:
+                    result = mapper(sample)
+                except BaseException as exc:
+                    _put(out_q, _WorkerError(exc))
+                    return
+                if not _put(out_q, result):
+                    return
 
         feeder = Thread(target=feed)
         feeder.daemon = True
@@ -145,12 +203,20 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             w.start()
             workers.append(w)
         finished = 0
-        while finished < process_num:
-            sample = out_q.get()
-            if sample is end:
-                finished += 1
-            else:
-                yield sample
+        try:
+            while finished < process_num:
+                sample = out_q.get()
+                if isinstance(sample, _WorkerError):
+                    raise sample.exc
+                if sample is end:
+                    finished += 1
+                else:
+                    yield sample
+        finally:
+            # stops on error AND on an early-exiting consumer (firstn):
+            # the remaining threads drain via their timeout loops instead
+            # of blocking forever on a queue nobody reads
+            abort.set()
 
     return data_reader
 

@@ -1,0 +1,307 @@
+"""fluid.guardian: async numerics sentinel, dynamic fp16 loss scaling, and
+the flight recorder's record -> trip -> replay round-trip.
+
+Every guardian path is driven by a deterministic fluid.fault oracle:
+PADDLE_FAULT_GRAD_INF_STEP poisons the backward seed in-graph (so the Inf
+flows through real grad ops and the replay bundle reproduces it),
+PADDLE_FAULT_LOSS_SPIKE_STEP multiplies the observed loss."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import amp, fault, guardian
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    fault.clear()
+    guardian.disable()
+    amp.disable()
+    yield
+    fault.clear()
+    guardian.disable()
+    amp.disable()
+
+
+def _build_mlp(lr=0.05, seed=7):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=8, act="relu")
+    pred = fluid.layers.fc(input=h, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _feed(seed):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.normal(size=(8, 4)).astype(np.float32),
+            "y": rng.normal(size=(8, 1)).astype(np.float32)}
+
+
+def _param_names(scope):
+    return sorted(n for n in scope.keys() if ".w_" in n)
+
+
+def test_skip_policy_detects_within_one_step_and_reverts_bitwise():
+    """Grad-Inf injected at step 2: the sentinel observes it at the step-3
+    boundary (one-step lag), the device-side commit gate leaves every
+    parameter BIT-identical to the post-step-1 state, and training
+    continues."""
+    guardian.enable(policy="skip")
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+    exe, loss = _build_mlp()
+    scope = fluid.global_scope()
+    params = _param_names(scope)
+    assert params, "no parameters found"
+    snaps = {}
+    for i in range(5):
+        exe.run(fluid.default_main_program(), feed=_feed(i),
+                fetch_list=[loss])
+        snaps[i] = {p: np.array(scope.get(p)) for p in params}
+        if i < 2:
+            # detection lags one step: nothing tripped yet at steps 0-2
+            assert guardian.metrics()["trips"] == 0
+    guardian.flush()
+    m = guardian.metrics()
+    assert m["trips"] == 1 and m["skips"] == 1 and m["halts"] == 0
+    for p in params:
+        # step 2's poisoned update was dropped device-side
+        assert np.array_equal(snaps[2][p], snaps[1][p]), p
+        # and step 3 trained normally again
+        assert not np.array_equal(snaps[3][p], snaps[2][p]), p
+    # trip surfaced in the ServingMetrics-style profiler counters
+    assert fluid.profiler.counters().get("guardian_trips", 0) >= 1
+
+
+def test_halt_policy_raises_numerics_tripped():
+    guardian.enable(policy="halt")
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+    exe, loss = _build_mlp()
+    for i in range(3):  # steps 0..2; step 2 computes the Inf
+        exe.run(fluid.default_main_program(), feed=_feed(i),
+                fetch_list=[loss])
+    with pytest.raises(guardian.NumericsTripped) as ei:
+        # observed at the NEXT boundary, before step 3 dispatches
+        exe.run(fluid.default_main_program(), feed=_feed(3),
+                fetch_list=[loss])
+    assert ei.value.record.step == 2
+    assert not ei.value.record.finite
+
+
+def test_flush_surfaces_last_step_trip():
+    guardian.enable(policy="halt")
+    fault.install(fault.FaultPlan(grad_inf_step=1, mode="raise"))
+    exe, loss = _build_mlp()
+    exe.run(fluid.default_main_program(), feed=_feed(0), fetch_list=[loss])
+    exe.run(fluid.default_main_program(), feed=_feed(1), fetch_list=[loss])
+    with pytest.raises(guardian.NumericsTripped):
+        guardian.flush()
+
+
+def test_loss_spike_trips_policy():
+    """A corrupt-batch loss spike (finite!) trips the sentinel once enough
+    clean history exists to form the cap."""
+    guardian.enable(policy="halt", spike_factor=5.0, spike_window=8)
+    fault.install(fault.FaultPlan(loss_spike_step=8, loss_spike_factor=1e4,
+                                  mode="raise"))
+    exe, loss = _build_mlp()
+    with pytest.raises(guardian.NumericsTripped) as ei:
+        for i in range(11):
+            exe.run(fluid.default_main_program(), feed=_feed(i % 4),
+                    fetch_list=[loss])
+        guardian.flush()
+    assert ei.value.record.step == 8
+    assert ei.value.record.finite and ei.value.record.spike
+
+
+def test_dump_and_halt_bundle_replays_bitwise(tmp_path):
+    """dump_and_halt writes a replay bundle whose in-process replay
+    reproduces the recorded loss bit-for-bit and bisects the first
+    non-finite variable (the poisoned backward seed)."""
+    guardian.enable(policy="dump_and_halt", bundle_dir=str(tmp_path))
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+    exe, loss = _build_mlp()
+    bundle = None
+    try:
+        for i in range(5):
+            exe.run(fluid.default_main_program(), feed=_feed(i),
+                    fetch_list=[loss])
+        guardian.flush()
+    except guardian.NumericsTripped as exc:
+        bundle = exc.bundle
+    assert bundle and os.path.isdir(bundle)
+    # bundle carries the flight-recorder ring and the step meta
+    with open(os.path.join(bundle, guardian.BUNDLE_META)) as f:
+        meta = json.load(f)
+    assert meta["step"] == 2
+    with open(os.path.join(bundle, guardian.BUNDLE_RECORDS)) as f:
+        ring = json.load(f)
+    assert ring and ring[-1]["step"] == 2 and not ring[-1]["ok"]
+
+    report = guardian.replay(bundle)
+    assert report["bitwise_match"], report
+    bad = report["first_nonfinite"]
+    assert bad is not None
+    # the injection poisons the backward seed — the bisect must name a
+    # gradient variable, not a forward activation
+    assert "@GRAD" in bad["var"]
+
+
+def test_guardian_trip_writes_supervisor_incident(tmp_path, monkeypatch):
+    """Under the elastic supervisor a guardian trip is an incident-log
+    entry, not just a dead process."""
+    incidents = tmp_path / "incidents.jsonl"
+    monkeypatch.setenv("PADDLE_ELASTIC_INCIDENTS", str(incidents))
+    guardian.enable(policy="skip")
+    fault.install(fault.FaultPlan(grad_inf_step=1, mode="raise"))
+    exe, loss = _build_mlp()
+    for i in range(3):
+        exe.run(fluid.default_main_program(), feed=_feed(i),
+                fetch_list=[loss])
+    guardian.flush()
+    lines = [json.loads(l) for l in incidents.read_text().splitlines()]
+    trips = [e for e in lines if e["event"] == "guardian_trip"]
+    assert len(trips) == 1
+    assert trips[0]["step"] == 1 and trips[0]["policy"] == "skip"
+
+
+def test_unguarded_program_keeps_plain_path():
+    """Guardian off + no fp16 scaler -> the executor compiles the plain
+    2-tuple step (no health fetches, no sentinel inputs on the hot path)."""
+    exe, loss = _build_mlp()
+    assert guardian.for_program(fluid.default_main_program()) is None
+    out = exe.run(fluid.default_main_program(), feed=_feed(0),
+                  fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
+
+
+# ---------------------------------------------------------------------------
+# dynamic fp16 loss scaling
+# ---------------------------------------------------------------------------
+
+
+def test_fp16_scaler_shrinks_on_overflow_then_regrows():
+    amp.enable("float16", init_loss_scale=2.0 ** 8, growth_interval=3)
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+    exe, loss = _build_mlp()
+    scope = fluid.global_scope()
+    scales = []
+    for i in range(8):
+        exe.run(fluid.default_main_program(), feed=_feed(i),
+                fetch_list=[loss])
+        scales.append(float(np.asarray(scope.get(amp.LOSS_SCALE_VAR))[0]))
+    assert scales[1] == 256.0          # clean steps keep the scale
+    assert scales[2] == 128.0          # overflow at step 2: shrink /2 + skip
+    assert max(scales[3:]) >= 256.0    # 3 clean steps later: regrow x2
+
+
+def test_fp16_overflow_skips_update_keeps_optimizer_state():
+    """The scaler's skip-on-overflow is the same device-side commit gate:
+    params AND momentum accumulators stay bit-identical through the
+    overflowed step."""
+    amp.enable("float16", init_loss_scale=2.0 ** 8, growth_interval=100)
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    tracked = [n for n in scope.keys()
+               if ".w_" in n or n.startswith("velocity_")]
+    assert any(n.startswith("velocity_") for n in tracked)
+    snaps = {}
+    for i in range(4):
+        exe.run(fluid.default_main_program(), feed=_feed(i),
+                fetch_list=[loss])
+        snaps[i] = {n: np.array(scope.get(n)) for n in tracked}
+    for n in tracked:
+        assert np.array_equal(snaps[2][n], snaps[1][n]), n
+        assert not np.array_equal(snaps[3][n], snaps[2][n]), n
+
+
+def _train_synthetic_mlp(steps=35, seed=3):
+    """MNIST-shaped MLP on a learnable synthetic mapping (the pattern
+    test_mnist_mlp uses); returns the loss trajectory."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = seed
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=64, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(seed)
+        for _ in range(steps):
+            xb = rng.normal(0, 0.5, size=(32, 784)).astype(np.float32)
+            yb = rng.randint(0, 10, size=(32, 1)).astype(np.int64)
+            xb[np.arange(32), yb[:, 0]] += 3.0
+            lv = exe.run(prog, feed={"img": xb, "label": yb},
+                         fetch_list=[loss])
+            losses.append(float(lv[0][0]))
+    return losses
+
+
+def test_fp16_dynamic_scaling_trains_mnist_mlp_to_bf16_band():
+    """amp.enable('float16') is now usable for training: with the dynamic
+    scaler the MNIST MLP reaches the same loss band as bf16, with no
+    unrecovered overflow."""
+    amp.enable("bfloat16")
+    bf16 = _train_synthetic_mlp()
+    amp.disable()
+    amp.enable("float16", growth_interval=20)
+    fp16 = _train_synthetic_mlp()
+    amp.disable()
+    assert all(np.isfinite(fp16)), "fp16 run produced non-finite losses"
+    # both train
+    assert np.mean(fp16[-5:]) < 0.6 * np.mean(fp16[:5])
+    assert np.mean(bf16[-5:]) < 0.6 * np.mean(bf16[:5])
+    # and land in the same band
+    assert abs(np.mean(fp16[-5:]) - np.mean(bf16[-5:])) \
+        < 0.5 * max(np.mean(bf16[-5:]), 0.2)
+
+
+def test_run_steps_rejects_scaler_programs():
+    amp.enable("float16")
+    exe, loss = _build_mlp()
+    with pytest.raises(RuntimeError, match="loss scaling"):
+        exe.run_steps(fluid.default_main_program(), _feed(0), [loss],
+                      n_steps=4)
+
+
+# ---------------------------------------------------------------------------
+# CLI / tooling round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_replay_smoke_tool(tmp_path):
+    """tools/replay_smoke.py: record -> trip -> replay via the real CLI."""
+    import tools.replay_smoke as smoke
+
+    report = smoke.main(workdir=str(tmp_path))
+    assert report["ok"], report
+    assert report["replay"]["bitwise_match"]
+    assert report["replay"]["first_nonfinite"]["kind"] == "inf"

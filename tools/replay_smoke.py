@@ -1,0 +1,91 @@
+"""Guardian record -> trip -> replay round-trip smoke (CPU, < 10 s).
+
+The CI oracle for the flight recorder: train a tiny MLP with a grad-Inf
+fault armed, let the ``dump_and_halt`` guardian catch it and write a replay
+bundle, then invoke the real ``python -m paddle_tpu.fluid.guardian replay``
+CLI in a subprocess and verify the bundle (a) reproduces the recorded loss
+bit-for-bit and (b) bisects a first non-finite variable.
+
+Run directly (``python tools/replay_smoke.py``) or from tier-1 via
+``tests/test_guardian.py::test_replay_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(workdir=None) -> dict:
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import fault, guardian
+
+    workdir = workdir or tempfile.mkdtemp(prefix="replay_smoke_")
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 11
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+
+    scope = fluid.Scope()
+    guardian.install(guardian.GuardianConfig(
+        policy="dump_and_halt", bundle_dir=os.path.join(workdir, "dumps")))
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+    bundle = None
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(3)
+            for _ in range(6):
+                exe.run(prog, feed={
+                    "x": rng.normal(size=(8, 4)).astype(np.float32),
+                    "y": rng.normal(size=(8, 1)).astype(np.float32),
+                }, fetch_list=[loss])
+            guardian.flush()
+    except guardian.NumericsTripped as exc:
+        bundle = exc.bundle
+    finally:
+        guardian.disable()
+        fault.clear()
+    report = {"ok": False, "bundle": bundle, "workdir": workdir}
+    if not bundle:
+        report["error"] = "guardian did not dump a replay bundle"
+        print(json.dumps(report))
+        return report
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.fluid.guardian", "replay", bundle],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    report["cli_returncode"] = proc.returncode
+    try:
+        cli = json.loads(proc.stdout)
+    except ValueError:
+        report["error"] = f"replay CLI emitted no JSON: {proc.stderr[-500:]}"
+        print(json.dumps(report))
+        return report
+    report["replay"] = cli
+    report["ok"] = (proc.returncode == 0 and cli.get("bitwise_match")
+                    and cli.get("first_nonfinite") is not None)
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
