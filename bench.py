@@ -180,6 +180,26 @@ def probe_platform(timeout: float = 180.0) -> tuple:
         time.sleep(pause)
 
 
+def _cache_counters():
+    """(hit, miss) snapshot of the persistent compile cache's counters."""
+    from paddle_tpu.fluid import profiler as _prof
+
+    c = _prof.counters()
+    return (c.get("compile_cache.hit", 0), c.get("compile_cache.miss", 0))
+
+
+def _cold_info(t_compile, before, after):
+    """BENCH-line cold-start fields: the first dispatch's wall time
+    (trace + XLA compile + step) reported SEPARATELY from steady-state
+    throughput, plus whether it was served warm from the persistent
+    compile cache (PADDLE_COMPILE_CACHE_DIR) — so warm-vs-cold runs are
+    distinguishable in the trajectory."""
+    h0, m0 = before
+    h1, m1 = after
+    return {"compile_seconds": round(t_compile, 3),
+            "cache_hit": bool(h1 > h0 and m1 == m0)}
+
+
 def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     """Shared harness: startup program, warmup (compile), timed steps.
 
@@ -199,7 +219,10 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     ~7ms/dispatch floor applies per step; the bench's deferred-fetch loop
     does not.
 
-    Returns (seconds, steps_actually_timed, executor)."""
+    Returns (seconds, steps_actually_timed, executor, cold) — ``cold``
+    carries the first-dispatch ``compile_seconds`` (trace + XLA compile,
+    measured separately from the steady-state timing) and ``cache_hit``
+    (whether the persistent compile cache served it warm)."""
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
@@ -221,7 +244,10 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     if spd > 1:
         n_chunks = max(1, steps // spd)
         steps = n_chunks * spd
+        cc0 = _cache_counters()
+        t_c = time.perf_counter()
         exe.run_steps(prog, feed=feed, fetch_list=[loss], n_steps=spd)
+        cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters())
         t0 = time.perf_counter()
         out = None
         for _ in range(n_chunks):
@@ -230,8 +256,12 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
         last = float(np.asarray(out).reshape(-1)[0])
         dt = time.perf_counter() - t0
         assert np.isfinite(last), f"non-finite loss {last}"
-        return dt, steps, exe
-    for _ in range(warmup):
+        return dt, steps, exe, cold
+    cc0 = _cache_counters()
+    t_c = time.perf_counter()
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters())
+    for _ in range(max(0, warmup - 1)):
         exe.run(prog, feed=feed, fetch_list=[loss])
     # fetch device-resident losses per step (return_numpy=False defers the
     # D2H sync); materializing the LAST loss inside the timed region blocks
@@ -245,7 +275,7 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     last = float(np.asarray(out).reshape(-1)[0])
     dt = time.perf_counter() - t0
     assert np.isfinite(last), f"non-finite loss {last}"
-    return dt, steps, exe
+    return dt, steps, exe, cold
 
 
 def result_line(name, value, unit, baseline_key, **extra):
@@ -276,12 +306,12 @@ def bench_resnet(fluid, platform, on_accel):
     rng = np.random.RandomState(0)
     feed = {"img": rng.normal(size=(batch, 3, image_hw, image_hw)).astype(np.float32),
             "label": rng.randint(0, class_dim, size=(batch, 1)).astype(np.int64)}
-    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _, cold = timed_run(fluid, on_accel, loss, feed, steps)
 
     ips = batch * steps / dt
     # MFU input: ResNet-50 fwd ~3.86 GFLOP/img at 224px (scales ~(hw/224)^2);
     # train ~= 3x fwd.  Only meaningful on a real accelerator.
-    extra = {"amp": fluid.amp.compute_dtype() or "off"}
+    extra = {"amp": fluid.amp.compute_dtype() or "off", **cold}
     if on_accel:
         import jax
 
@@ -310,13 +340,13 @@ def bench_transformer(fluid, platform, on_accel):
     feed = {"src_word": rng.randint(1, cfg.src_vocab_size, size=(batch, seq_len)).astype(np.int64),
             "tgt_word": rng.randint(1, cfg.tgt_vocab_size, size=(batch, seq_len)).astype(np.int64),
             "lbl_word": rng.randint(1, cfg.tgt_vocab_size, size=(batch, seq_len, 1)).astype(np.int64)}
-    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _, cold = timed_run(fluid, on_accel, loss, feed, steps)
 
     tps = batch * seq_len * steps / dt  # target tokens/sec
     return result_line(
         f"transformer_{cfg.name}_len{seq_len}_bs{batch}_train_{platform}",
         tps, "tokens/sec/chip", "transformer",
-        amp=fluid.amp.compute_dtype() or "off")
+        amp=fluid.amp.compute_dtype() or "off", **cold)
 
 
 def bench_vgg(fluid, platform, on_accel):
@@ -336,11 +366,11 @@ def bench_vgg(fluid, platform, on_accel):
             .astype(np.float32),
             "label": rng.randint(0, class_dim,
                                  size=(batch, 1)).astype(np.int64)}
-    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _, cold = timed_run(fluid, on_accel, loss, feed, steps)
     ips = batch * steps / dt
     return result_line(f"vgg19_{image_hw}px_bs{batch}_train_{platform}",
                        ips, "images/sec/chip", "vgg",
-                       amp=fluid.amp.compute_dtype() or "off")
+                       amp=fluid.amp.compute_dtype() or "off", **cold)
 
 
 def bench_mnist(fluid, platform, on_accel):
@@ -354,10 +384,10 @@ def bench_mnist(fluid, platform, on_accel):
     rng = np.random.RandomState(0)
     feed = {"img": rng.normal(size=(batch, 784)).astype(np.float32),
             "label": rng.randint(0, 10, size=(batch, 1)).astype(np.int64)}
-    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _, cold = timed_run(fluid, on_accel, loss, feed, steps)
     ips = batch * steps / dt
     return result_line(f"mnist_mlp_bs{batch}_train_{platform}",
-                       ips, "images/sec/chip", "mnist")
+                       ips, "images/sec/chip", "mnist", **cold)
 
 
 def bench_resnet_infer(fluid, platform, on_accel):
@@ -405,8 +435,11 @@ def bench_resnet_infer(fluid, platform, on_accel):
         feed = {k: ((jax.device_put(v[0], dev), v[1])
                     if isinstance(v, tuple) else jax.device_put(v, dev))
                 for k, v in feed.items()}
-    for _ in range(2):
-        exe.run(infer_prog, feed=feed, fetch_list=[prediction])
+    cc0 = _cache_counters()
+    t_c = time.perf_counter()
+    exe.run(infer_prog, feed=feed, fetch_list=[prediction])
+    cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters())
+    exe.run(infer_prog, feed=feed, fetch_list=[prediction])
     t0 = time.perf_counter()
     out = None
     for _ in range(steps):
@@ -421,7 +454,7 @@ def bench_resnet_infer(fluid, platform, on_accel):
         f"resnet50_{image_hw}px_bs{batch}_infer{tag}_{platform}",
         ips, "images/sec/chip", "resnet_infer",
         amp=fluid.amp.compute_dtype() or "off",
-        weights=("int8" if int8 else "fp32"))
+        weights=("int8" if int8 else "fp32"), **cold)
 
 
 def bench_decode(fluid, platform, on_accel):
@@ -492,8 +525,11 @@ def bench_decode(fluid, platform, on_accel):
                 np.zeros((batch, 1), np.int64), lod2),
             "init_scores": fluid.create_lod_tensor(
                 np.zeros((batch, 1), np.float32), lod2)}
+    cc0 = _cache_counters()
+    t_c = time.perf_counter()
     (warm,) = exe.run(fluid.default_main_program(), feed=feed,
                       fetch_list=[out_ids], return_numpy=False)
+    cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters())
     t0 = time.perf_counter()
     n_tokens = 0
     for _ in range(rounds):
@@ -504,7 +540,7 @@ def bench_decode(fluid, platform, on_accel):
     return {"metric": f"beam_decode_b{batch}_beam{beam}_len{max_len}"
                       f"_{engine}{'_int8' if int8 else ''}_{platform}",
             "value": round(n_tokens / dt, 2), "unit": "tokens/sec/chip",
-            "vs_baseline": 0.0,
+            "vs_baseline": 0.0, **cold,
             "note": "no published reference decode throughput; absolute "
                     "generation rate ("
                     + ("one compiled while_loop program"
@@ -536,11 +572,11 @@ def _bench_v2_image(model, fluid, platform, on_accel, ref_hw):
     feed = {"data": rng.normal(size=(batch, 3 * hw * hw)).astype(np.float32),
             "label": rng.randint(0, class_dim,
                                  size=(batch, 1)).astype(np.int64)}
-    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _, cold = timed_run(fluid, on_accel, loss, feed, steps)
     ips = batch * steps / dt
     return result_line(f"{model}_{hw}px_bs{batch}_train_{platform}",
                        ips, "images/sec/chip", model,
-                       amp=fluid.amp.compute_dtype() or "off")
+                       amp=fluid.amp.compute_dtype() or "off", **cold)
 
 
 def bench_rnn(fluid, platform, on_accel):
@@ -569,11 +605,12 @@ def bench_rnn(fluid, platform, on_accel):
     rows = rng.randint(1, vocab, size=(batch * seqlen, 1)).astype(np.int64)
     feed = {"data": (rows, [[seqlen] * batch]),
             "label": rng.randint(0, 2, size=(batch, 1)).astype(np.int64)}
-    dt, steps, _ = timed_run(fluid, on_accel, loss, feed, steps)
+    dt, steps, _, cold = timed_run(fluid, on_accel, loss, feed, steps)
     sps = batch * steps / dt
     return result_line(f"rnn_lstm2_h{hidden}_len{seqlen}_bs{batch}"
                        f"_train_{platform}", sps, "sequences/sec/chip",
-                       "rnn", amp=fluid.amp.compute_dtype() or "off")
+                       "rnn", amp=fluid.amp.compute_dtype() or "off",
+                       **cold)
 
 
 def bench_alexnet(fluid, platform, on_accel):

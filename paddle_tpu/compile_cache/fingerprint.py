@@ -1,0 +1,161 @@
+"""Canonical program fingerprinting for the persistent compile cache.
+
+The in-process jit cache keys on ``Program._cache_token`` — a per-object
+identity that dies with the process.  Cross-process reuse needs a *content*
+identity: two processes that built the same model must produce the same
+key even though every ``unique_name`` counter, ``id()``, and variable name
+suffix differs between them ("fc_0.w_0" in one build is "fc_3.w_0" in the
+next when layers were built in a different order).
+
+The fingerprint therefore hashes a CANONICALIZED form of the ProgramDesc:
+
+ - variable names are replaced by dense indices in deterministic
+   first-use order (blocks in index order, ops in program order, slots
+   sorted, inputs before outputs) — pure rename noise cancels out;
+ - ops contribute (type, slot->canonical-name lists, canonicalized attrs);
+   attr STRINGS that exactly match a var name are canonicalized too
+   (``op_role_var`` carries param/grad names);
+ - every referenced var contributes its shape/dtype/persistable/lod_level/
+   is_data metadata, keyed by canonical name — an attr- or shape-level
+   change MUST change the hash;
+ - the jit configuration rides along: feed signature (shapes/dtypes),
+   fetch names (canonicalized), and an ``extra`` dict for everything else
+   the compiled artifact depends on (platform, amp mode, donation, scan
+   length, serving bucket, mesh spec, ...);
+ - jax/jaxlib versions are folded in, so a toolchain upgrade naturally
+   invalidates every entry instead of resurrecting stale executables.
+
+Anything un-canonicalizable (exotic attr object) degrades to ``repr`` —
+deterministic within a build, possibly process-unique, which turns a cache
+hit into a miss but never a wrong hit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["program_fingerprint", "program_signature"]
+
+
+def _canon_attr(v, rename: Dict[str, str]):
+    """Deterministic, rename-aware encoding of one attr value."""
+    if isinstance(v, (list, tuple)):
+        return [_canon_attr(x, rename) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _canon_attr(v[k], rename)
+                for k in sorted(v, key=str)}
+    if isinstance(v, str):
+        return rename.get(v, v)
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    try:
+        import numpy as np
+
+        if isinstance(v, np.ndarray):
+            return ["ndarray", list(v.shape), str(v.dtype),
+                    hashlib.sha256(v.tobytes()).hexdigest()[:16]]
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, (np.floating, np.bool_)):
+            return float(v)
+    except Exception:
+        pass
+    return repr(v)
+
+
+def program_signature(program) -> Tuple[list, Dict[str, str]]:
+    """Canonical structural signature of a Program.
+
+    Returns ``(signature, rename)`` where ``rename`` maps every var name
+    referenced by an op to its canonical dense name — callers reuse it to
+    canonicalize feed/fetch names so the jit config is rename-invariant
+    too.
+    """
+    rename: Dict[str, str] = {}
+
+    def cname(n: str) -> str:
+        if n not in rename:
+            rename[n] = f"v{len(rename)}"
+        return rename[n]
+
+    # pass 1: structure + name discovery (attrs wait for the full map)
+    skeleton = []
+    for b in program.blocks:
+        ops = []
+        for op in b.ops:
+            ins = [[slot, [cname(n) if n else "" for n in names]]
+                   for slot, names in sorted(op.inputs.items())]
+            outs = [[slot, [cname(n) if n else "" for n in names]]
+                    for slot, names in sorted(op.outputs.items())]
+            ops.append([op.type, ins, outs, op.attrs])
+        skeleton.append([b.idx, b.parent_idx, b.forward_block_idx, ops])
+
+    # pass 2: attrs (with the complete rename map) + var metadata
+    sig_blocks = []
+    for b_idx, parent, fwd, ops in skeleton:
+        sig_ops = [[t, i, o,
+                    {str(k): _canon_attr(a[k], rename)
+                     for k in sorted(a, key=str)}]
+                   for t, i, o, a in ops]
+        sig_blocks.append([b_idx, parent, fwd, sig_ops])
+    var_meta = []
+    gb = program.global_block()
+    for name in rename:
+        try:
+            v = gb._var_recursive(name)
+        except ValueError:
+            v = None
+            for b in program.blocks:
+                if b._has_var_recursive(name):
+                    v = b._var_recursive(name)
+                    break
+        if v is None:
+            var_meta.append([rename[name], None])
+            continue
+        var_meta.append([rename[name],
+                         [list(v.shape) if v.shape is not None else None,
+                          str(v.dtype), bool(v.persistable),
+                          int(v.lod_level), bool(v.is_data),
+                          str(getattr(v, "type", ""))]])
+    var_meta.sort()
+    return [sig_blocks, var_meta], rename
+
+
+def program_fingerprint(program,
+                        feeds: Optional[Iterable[tuple]] = None,
+                        fetches: Optional[Sequence[str]] = None,
+                        extra: Optional[dict] = None,
+                        include_versions: bool = True) -> str:
+    """Stable content hash of (program, jit configuration, toolchain).
+
+    ``feeds``   iterable of ``(name, shape, dtype)`` — the concrete feed
+                signature the executable is specialized on;
+    ``fetches`` fetch var names (canonicalized through the program's
+                rename map, so noise-renamed fetch temporaries still hit);
+    ``extra``   any further jsonable config the artifact depends on
+                (platform, amp, donation set, n_steps, bucket, mesh...).
+    """
+    sig, rename = program_signature(program)
+    feed_sig: List[list] = []
+    for name, shape, dtype in (feeds or []):
+        feed_sig.append([rename.get(str(name), str(name)),
+                         [int(d) for d in shape], str(dtype)])
+    feed_sig.sort()
+    payload = {
+        "program": sig,
+        "feeds": feed_sig,
+        "fetches": [rename.get(str(n), str(n)) for n in (fetches or [])],
+        "extra": _canon_attr(dict(extra or {}), rename),
+    }
+    if include_versions:
+        import jax
+        import jaxlib
+
+        payload["versions"] = [jax.__version__, jaxlib.__version__]
+    blob = json.dumps(payload, sort_keys=True, default=repr,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import warnings
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -453,13 +454,59 @@ def _guardian_mod():
 # ---------------------------------------------------------------------------
 
 
+class _JitCache:
+    """Bounded in-process jit cache (LRU by last use).
+
+    The old dict grew without bound across programs — a long-lived process
+    cycling many Programs (serving several models, notebooks, the test
+    suite) pinned every compiled executable plus its donated-buffer
+    metadata forever.  ``PADDLE_EXECUTOR_CACHE_CAP`` bounds it (default
+    64 entries, comfortably above any serving bucket set); size and
+    evictions surface as always-on profiler counters."""
+
+    def __init__(self, cap: Optional[int] = None):
+        if cap is None:
+            cap = int(os.environ.get("PADDLE_EXECUTOR_CACHE_CAP", "")
+                      or 64)
+        self.cap = max(1, int(cap))
+        self.evictions = 0
+        self._od: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+    def get(self, key):
+        entry = self._od.get(key)
+        if entry is not None:
+            self._od.move_to_end(key)
+        return entry
+
+    def __setitem__(self, key, entry):
+        from . import profiler as _prof
+
+        self._od[key] = entry
+        self._od.move_to_end(key)
+        while len(self._od) > self.cap:
+            self._od.popitem(last=False)
+            self.evictions += 1
+            _prof.record_counter("executor.jit_cache.evictions")
+        _prof.record_counter("executor.jit_cache.size",
+                             value=len(self._od))
+
+    def __len__(self):
+        return len(self._od)
+
+    def __contains__(self, key):
+        return key in self._od
+
+    def clear(self):
+        self._od.clear()
+
+
 class Executor:
     """ref: python/paddle/fluid/executor.py:256.  ``place`` selects the JAX
     device; everything else is handled by XLA."""
 
     def __init__(self, place=None):
         self.place = place if place is not None else core.CPUPlace()
-        self._cache = {}
+        self._cache = _JitCache()
         # feed-name -> (host snapshot, device buffer): unchanged feeds are
         # NOT re-shipped every step.  On a tunneled/remote TPU the H2D copy
         # dominates step time for repeated feeds, so this cache is the
@@ -523,9 +570,21 @@ class Executor:
                _amp.compute_dtype(),
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key)
+        probe = None
         if entry is None:
             from .log import VLOG
+            from .. import compile_cache as _cc
 
+            # persistent-cache consult BEFORE tracing: a hit means another
+            # process already compiled this exact (program, jit config) —
+            # the backend executable loads from the shared disk cache
+            probe = _cc.executor_probe(
+                program, feed_arrays, fetch_names,
+                extra={"kind": "run_steps", "n_steps": int(n_steps),
+                       "feed_per_step": bool(feed_per_step),
+                       "platform": self.place.device_type,
+                       "amp": _amp.compute_dtype(),
+                       "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
             VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan")
             plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
             if plan.needs_eager:
@@ -586,7 +645,15 @@ class Executor:
         device = core.get_jax_device(self.place)
         feed_dev = {k: self._put_feed(k, v, device)
                     for k, v in feed_arrays.items()}
-        fetches, new_state = fn(feed_dev, const_state, mut_state)
+        if probe is not None:
+            import time as _time
+
+            _t_compile = _time.perf_counter()
+            fetches, new_state = fn(feed_dev, const_state, mut_state)
+            probe.finish(_time.perf_counter() - _t_compile, program,
+                         meta={"kind": "run_steps", "n_steps": int(n_steps)})
+        else:
+            fetches, new_state = fn(feed_dev, const_state, mut_state)
         if _fault.active() is not None:
             new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():
@@ -653,9 +720,23 @@ class Executor:
                guard.cache_token() if guard is not None else None,
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key) if use_program_cache else None
+        probe = None
         if entry is None:
             from .log import VLOG
+            from .. import compile_cache as _cc
 
+            # persistent-cache consult BEFORE tracing (hit/miss counters +
+            # backend warm start through the shared jax disk cache)
+            probe = _cc.executor_probe(
+                program, feed_arrays, fetch_names,
+                extra={"kind": "run",
+                       "feed_lods": tuple(sorted(feed_lods.items())),
+                       "state_lods": tuple(sorted(state_lods.items())),
+                       "platform": self.place.device_type,
+                       "amp": _amp.compute_dtype(),
+                       "guard": (guard.cache_token()
+                                 if guard is not None else None),
+                       "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
             VLOG(1, f"Executor: compiling block "
                     f"({len(program.global_block().ops)} ops, "
                     f"fetches={fetch_names})")
@@ -752,6 +833,13 @@ class Executor:
             _prof.record_event(
                 f"executor_run[{len(plan.ops)}ops]",
                 _time.perf_counter() - t, start=t)
+        if probe is not None:
+            # first dispatch of a fresh entry = trace + compile; commit the
+            # artifact (miss) / freshen it (hit) now that it exists
+            probe.finish(_time.perf_counter() - t, program,
+                         meta={"kind": "run",
+                               "ops": len(plan.ops),
+                               "fetches": len(plan.fetch_names)})
         if _fault.active() is not None:
             new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():

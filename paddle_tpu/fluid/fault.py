@@ -48,6 +48,11 @@ worker processes:
                                   InjectedFault delivered on that request's
                                   future (the engine must isolate it: the
                                   rest of the batch still completes)
+    PADDLE_FAULT_CACHE_CORRUPT=1  treat every persistent compile-cache
+                                  entry load as corrupt (the deterministic
+                                  oracle for the cache's fallback path:
+                                  the run must recompile fresh and still
+                                  succeed — see paddle_tpu.compile_cache)
     PADDLE_FAULT_MODE=exit|raise  crash flavor: hard process exit (default)
                                   or an InjectedFault raise (in-process
                                   tests of the recovery path)
@@ -77,7 +82,7 @@ __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
     "barrier_stall", "serving_request", "sentinel_injection",
-    "current_step", "KILL_EXIT_CODE",
+    "cache_corrupt", "current_step", "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -104,6 +109,7 @@ class FaultPlan:
                  loss_spike_factor: float = 1e4,
                  barrier_stall_s: float = 0.0,
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
+                 cache_corrupt: bool = False,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -124,6 +130,7 @@ class FaultPlan:
         self.barrier_stall_s = float(barrier_stall_s)
         self.serve_delay_ms = float(serve_delay_ms)
         self.serve_fail_every = int(serve_fail_every)
+        self.cache_corrupt = bool(cache_corrupt)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
@@ -157,6 +164,8 @@ class FaultPlan:
             barrier_stall_s=getf("PADDLE_FAULT_BARRIER_STALL"),
             serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
             serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
+            cache_corrupt=env.get("PADDLE_FAULT_CACHE_CORRUPT", "").strip()
+            .lower() in ("1", "true", "yes"),
             rank=int(rank) if rank else None,
             mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
         )
@@ -316,6 +325,18 @@ def serving_request() -> None:
         if plan._serve_count % plan.serve_fail_every == 0:
             raise InjectedFault(
                 f"injected serving failure (request #{plan._serve_count})")
+
+
+def cache_corrupt() -> bool:
+    """Compile-cache read-corruption oracle: when armed, every persistent
+    cache entry load is treated as corrupt, forcing the fresh-compile
+    fallback (``CompileCacheStore.get`` quarantines the entry and reports
+    a miss; the run must still succeed).  Deterministic by construction —
+    the hook is consulted at every load, so a run under this flag
+    exercises the fallback path on every single lookup."""
+    plan = active()
+    return (plan is not None and plan.cache_corrupt
+            and plan._applies_to_this_rank())
 
 
 def barrier_stall(tag: str = "") -> None:

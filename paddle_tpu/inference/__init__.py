@@ -74,6 +74,10 @@ class AnalysisConfig(NativeConfig):
     serving_max_queue_depth: int = 256
     serving_warmup: bool = False
     serving_batch_invariant: bool = False
+    # bucket-manifest destination for warmup() (atomic write; lets a
+    # restarted predictor re-warm the same bucket set — empty means "under
+    # the persistent compile cache when enabled, else nowhere")
+    serving_manifest_path: str = ""
 
 
 class PaddlePredictor:
@@ -124,7 +128,8 @@ class PaddlePredictor:
                 max_batch_size=config.serving_max_batch_size,
                 max_wait_ms=config.serving_max_wait_ms,
                 max_queue_depth=config.serving_max_queue_depth,
-                batch_invariant=config.serving_batch_invariant))
+                batch_invariant=config.serving_batch_invariant,
+                manifest_path=config.serving_manifest_path or None))
             if config.serving_warmup:
                 self._engine.warmup()
 

@@ -28,6 +28,11 @@ Pieces:
  - fault handoff: ``PADDLE_FAULT_*`` flags (see ``fluid.fault``) are
    forwarded to generation 0 ONLY — a restarted generation must not
    replay the injected fault it just recovered from.
+ - compile-cache handoff: every generation gets the same
+   ``PADDLE_COMPILE_CACHE_DIR`` (``paddle_tpu.compile_cache``), so
+   generation N+1 skips XLA compilation of the exact programs generation
+   N was running when it died — restart latency drops from
+   checkpoint-load + full-recompile to checkpoint-load alone.
 
 CLI::
 
@@ -157,7 +162,8 @@ class ElasticSupervisor:
                  devices_per_host: Optional[int] = None,
                  extra_env: Optional[Dict[str, str]] = None,
                  fault_env: Optional[Dict[str, str]] = None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 compile_cache_dir: Optional[str] = None):
         if nproc < 1:
             raise ValueError("nproc must be >= 1")
         self.entry = entry
@@ -172,6 +178,12 @@ class ElasticSupervisor:
         self.fault_env = dict(fault_env or {})
         self.deadline = deadline
         self.hb_dir = os.path.join(self.workdir, "heartbeats")
+        # persistent compile cache shared by ALL generations: priority is
+        # explicit arg > inherited env > a per-run default under workdir
+        self.compile_cache_dir = os.path.abspath(
+            compile_cache_dir
+            or os.environ.get("PADDLE_COMPILE_CACHE_DIR", "").strip()
+            or os.path.join(self.workdir, "compile_cache"))
         self.incidents = IncidentLog(
             os.path.join(self.workdir, "incidents.jsonl"))
 
@@ -220,12 +232,15 @@ class ElasticSupervisor:
                 os.remove(heartbeat_path(self.hb_dir, rank))
             except OSError:
                 pass
+        os.makedirs(self.compile_cache_dir, exist_ok=True)
         env = {"PADDLE_ELASTIC_HB_DIR": self.hb_dir,
                "PADDLE_ELASTIC_GENERATION": str(gen),
                # workers append their own decisions (guardian numerics
                # trips — fluid.guardian) next to the supervisor's: one
                # incident stream per pod, small O_APPEND json lines
-               "PADDLE_ELASTIC_INCIDENTS": self.incidents.path}
+               "PADDLE_ELASTIC_INCIDENTS": self.incidents.path,
+               # generation N+1 reuses generation N's compiled programs
+               "PADDLE_COMPILE_CACHE_DIR": self.compile_cache_dir}
         env.update(self.extra_env)
         if gen == 0:
             env.update(self.fault_env)
@@ -248,6 +263,7 @@ class ElasticSupervisor:
             logs.append(lf)
         self.incidents.log("generation_start", generation=gen, port=port,
                            nproc=self.nproc,
+                           compile_cache_dir=self.compile_cache_dir,
                            fault_env=sorted(self.fault_env) if gen == 0
                            else [])
         return procs, logs
@@ -332,6 +348,9 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline", type=float, default=None,
                     help="overall wall-clock budget in seconds")
     ap.add_argument("--devices-per-host", type=int, default=None)
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="persistent compile cache shared by all "
+                         "generations (default: <workdir>/compile_cache)")
     ap.add_argument("--env", action="append", default=[], metavar="K=V")
     args = ap.parse_args(argv)
     extra = {}
@@ -344,7 +363,8 @@ def main(argv=None) -> int:
         args.entry, args.nproc, args.workdir, hb_timeout=args.hb_timeout,
         poll_interval=args.poll_interval, max_restarts=args.max_restarts,
         deadline=args.deadline, devices_per_host=args.devices_per_host,
-        extra_env=extra or None)
+        extra_env=extra or None,
+        compile_cache_dir=args.compile_cache_dir)
     result = sup.run()
     print(json.dumps(result))
     return 0 if result["status"] == "finished" else 1

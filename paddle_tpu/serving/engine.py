@@ -34,6 +34,8 @@ so the jit cache sees a single writer.  Results travel back on
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -86,6 +88,12 @@ class ServingConfig:
                         One canonical bucket makes results bit-identical
                         regardless of arrival pattern — deterministic
                         serving, at the cost of padded FLOPs at low load.
+    ``manifest_path``   where warmup() persists its bucket manifest
+                        (atomic tmp+rename).  Works with the compile
+                        cache disabled; when unset and the persistent
+                        compile cache IS enabled, the manifest lands
+                        under ``<cache>/serving/``.  A restarted engine
+                        re-warms the exact same bucket set from it.
     """
     max_batch_size: int = 32
     max_wait_ms: float = 5.0
@@ -94,6 +102,7 @@ class ServingConfig:
     default_timeout_ms: Optional[float] = None
     require_warmup: bool = False
     batch_invariant: bool = False
+    manifest_path: Optional[str] = None
 
     def buckets(self) -> List[int]:
         """Power-of-two batch buckets up to max_batch_size (inclusive —
@@ -384,29 +393,146 @@ class ServingEngine:
     # warmup
     # ------------------------------------------------------------------
 
-    def warmup(self, sample_inputs: Optional[Sequence] = None) -> List[int]:
+    def warmup(self, sample_inputs: Optional[Sequence] = None,
+               only_missing: Optional[bool] = None) -> List[int]:
         """AOT-precompile every batch bucket before admitting traffic.
 
         ``sample_inputs``: an optional single-row request used as the
         template (required when the model's feed shapes have unknown
-        non-batch dims).  Without it, zero-filled rows are synthesized
-        from the program's feed var shapes/dtypes.  Returns the bucket
-        list.  Safe to call again (cached executables make it cheap)."""
+        non-batch dims).  Without it, the previously persisted bucket
+        manifest supplies the row signature (a restarted predictor warms
+        the SAME bucket set deterministically); failing that, zero-filled
+        rows are synthesized from the program's feed var shapes/dtypes.
+
+        With the persistent compile cache enabled (``only_missing`` left
+        at its default), buckets whose program fingerprints are already
+        in the store are NOT dispatched — a prior process compiled them
+        into the shared backend cache, so this restart precompiles only
+        the missing buckets (counter ``warmup_cached`` vs
+        ``warmup_dispatches``).  ``only_missing=False`` forces full
+        dispatch.
+
+        The bucket manifest (bucket list, per-feed row shapes/dtypes,
+        per-bucket fingerprints) is written ATOMICALLY (tmp+rename) after
+        warmup — including when the cache subsystem is disabled, provided
+        ``ServingConfig.manifest_path`` names a destination.
+
+        Returns the bucket list.  Safe to call again."""
+        from .. import compile_cache as _cc
+
+        store = _cc.get_store()
+        if only_missing is None:
+            only_missing = store is not None
         if sample_inputs is not None:
             feed, rows, _sig = self._resolve(sample_inputs)
             if rows != 1:
                 feed = {k: v[:1] for k, v in feed.items()}
             row_feed = feed
         else:
-            row_feed = self._zero_rows()
+            row_feed = self._rows_from_manifest() or self._zero_rows()
+        fps = self._bucket_fingerprints(row_feed)
         for b in self.config.buckets():
+            fp = fps.get(b)
+            if only_missing and store is not None and fp is not None \
+                    and store.get(fp) is not None:
+                # compiled by a prior process into the shared store: the
+                # executable loads lazily from disk on first use
+                self.metrics.inc("warmup_cached")
+                continue
             feed_b = {k: np.concatenate([v] * b, axis=0)
                       for k, v in row_feed.items()}
             self._run_bucket(feed_b, b, b)
             self.metrics.inc("warmup_dispatches")
+            if store is not None and fp is not None:
+                try:
+                    store.put(fp, self._pred._program.serialize_to_string(),
+                              {"kind": "serving_bucket", "bucket": int(b)})
+                except Exception:
+                    pass  # cache bookkeeping never fails warmup
+        self._write_manifest(row_feed, fps)
         with self._cond:
             self._warm = True
         return self.config.buckets()
+
+    # -- bucket manifest + fingerprints --
+    def _manifest_path(self) -> Optional[str]:
+        if self.config.manifest_path:
+            return self.config.manifest_path
+        from .. import compile_cache as _cc
+
+        store = _cc.get_store()
+        if store is None:
+            return None
+        try:
+            model_fp = _cc.program_fingerprint(
+                self._pred._program, fetches=self._fetch_names,
+                extra={"kind": "serving_model"})
+        except Exception:
+            return None
+        return store.serving_manifest_path(model_fp)
+
+    def _bucket_fingerprints(self, row_feed) -> dict:
+        """bucket -> program fingerprint specialized on that bucket's feed
+        shapes (empty on fingerprint failure — warmup then just dispatches
+        everything)."""
+        from .. import compile_cache as _cc
+
+        fps = {}
+        try:
+            for b in self.config.buckets():
+                feeds = [(k, (b,) + tuple(v.shape[1:]), str(v.dtype))
+                         for k, v in sorted(row_feed.items())]
+                fps[b] = _cc.program_fingerprint(
+                    self._pred._program, feeds=feeds,
+                    fetches=self._fetch_names,
+                    extra={"kind": "serving_bucket", "bucket": int(b)})
+        except Exception:
+            return {}
+        return fps
+
+    def _write_manifest(self, row_feed, fps) -> None:
+        """Atomic (tmp + rename) manifest commit; never fails warmup."""
+        path = self._manifest_path()
+        if not path:
+            return
+        manifest = {
+            "version": 1,
+            "created": time.time(),
+            "buckets": self.config.buckets(),
+            "max_batch_size": self.config.max_batch_size,
+            "batch_invariant": self.config.batch_invariant,
+            "feeds": [[k, list(v.shape[1:]), str(v.dtype)]
+                      for k, v in sorted(row_feed.items())],
+            "fetches": list(self._fetch_names),
+            "fingerprints": {str(b): fp for b, fp in fps.items()},
+        }
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _rows_from_manifest(self) -> Optional[Dict[str, np.ndarray]]:
+        """Zero rows shaped from a previously persisted manifest, so a
+        restarted predictor can warm the same bucket set without sample
+        inputs even when the program's var shapes have unknown dims."""
+        path = self._manifest_path()
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            rows = {name: np.zeros((1,) + tuple(int(d) for d in shape),
+                                   dtype=dtype)
+                    for name, shape, dtype in manifest["feeds"]}
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        if set(rows) != set(self._feed_names):
+            return None  # stale manifest from another model
+        return rows
 
     def _zero_rows(self) -> Dict[str, np.ndarray]:
         """One all-zero row per feed, shaped from the program's var descs."""
@@ -486,6 +612,8 @@ def create_serving_engine(config, serving_config: Optional[ServingConfig]
             max_queue_depth=getattr(config, "serving_max_queue_depth", 256),
             batch_invariant=getattr(config, "serving_batch_invariant",
                                     False),
+            manifest_path=getattr(config, "serving_manifest_path", "")
+            or None,
         )
     eng = ServingEngine(pred, serving_config)
     if warmup or getattr(config, "serving_warmup", False):

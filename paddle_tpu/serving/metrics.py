@@ -29,7 +29,8 @@ class ServingMetrics:
 
     #: counters every snapshot reports even when still zero
     COUNTERS = ("submitted", "completed", "failed", "shed", "expired",
-                "dispatches", "bucket_compiles", "warmup_dispatches")
+                "dispatches", "bucket_compiles", "warmup_dispatches",
+                "warmup_cached")
 
     def __init__(self, latency_window: int = 4096):
         self._lock = threading.Lock()

@@ -38,3 +38,8 @@ def fresh_programs():
 
     _framework.fresh_session()
     yield
+    # a test that enabled the persistent compile cache must not leak it
+    # (or the jax disk-cache dir it points at) into later tests
+    from paddle_tpu import compile_cache as _compile_cache
+
+    _compile_cache.reset()

@@ -1,0 +1,423 @@
+"""Persistent compile cache: fingerprinting, artifact store, executor /
+serving integration, and the cross-process warm-start acceptance oracle.
+
+ISSUE 4: a subprocess re-running the MNIST MLP train step against a
+populated cache dir must record zero new backend compiles (cache-hit
+counter equals program count), and a deliberately corrupted entry must
+fall back to a fresh compile with the run still succeeding.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_mlp(act="relu", width=8, feat=16):
+    """Tiny train-step program; built WITHOUT unique_name.guard so every
+    call in one session gets noise-shifted variable names (fc_0 -> fc_2,
+    mean_0 -> mean_1, ...) — the fingerprint's rename-invariance oracle."""
+    import paddle_tpu.fluid as fluid
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name="img", shape=[feat], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=width, act=act)
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    return prog, startup, loss
+
+
+def _feed(feat=16):
+    rng = np.random.RandomState(0)
+    return {"img": rng.normal(size=(8, feat)).astype(np.float32),
+            "label": rng.randint(0, 4, size=(8, 1)).astype(np.int64)}
+
+
+def _run_once(prog, startup, loss, feat=16):
+    import paddle_tpu.fluid as fluid
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (out,) = exe.run(prog, feed=_feed(feat), fetch_list=[loss])
+    return exe, float(np.asarray(out).reshape(-1)[0])
+
+
+def _cc_counters():
+    from paddle_tpu.fluid import profiler
+
+    c = profiler.counters()
+    return {k.split(".", 1)[1]: v for k, v in c.items()
+            if k.startswith("compile_cache.")}
+
+
+def _delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(before) | set(after)}
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_rename_invariance():
+    from paddle_tpu.compile_cache import program_fingerprint
+
+    p1, _s1, l1 = _build_mlp()
+    p2, _s2, l2 = _build_mlp()  # same structure, noise-shifted names
+    assert l1.name != l2.name, "builds were expected to drift names"
+    feeds = [("img", (8, 16), "float32"), ("label", (8, 1), "int64")]
+    f1 = program_fingerprint(p1, feeds=feeds, fetches=[l1.name])
+    f2 = program_fingerprint(p2, feeds=feeds, fetches=[l2.name])
+    assert f1 == f2
+
+
+def test_fingerprint_attr_shape_and_config_sensitivity():
+    from paddle_tpu.compile_cache import program_fingerprint
+
+    feeds = [("img", (8, 16), "float32"), ("label", (8, 1), "int64")]
+    p1, _s, l1 = _build_mlp(act="relu")
+    base = program_fingerprint(p1, feeds=feeds, fetches=[l1.name])
+
+    p2, _s, l2 = _build_mlp(act="tanh")  # op-level change
+    assert program_fingerprint(p2, feeds=feeds, fetches=[l2.name]) != base
+    p3, _s, l3 = _build_mlp(width=9)     # var-shape change
+    assert program_fingerprint(p3, feeds=feeds, fetches=[l3.name]) != base
+    # feed-signature change (same program)
+    other = [("img", (16, 16), "float32"), ("label", (16, 1), "int64")]
+    assert program_fingerprint(p1, feeds=other, fetches=[l1.name]) != base
+    # jit-config change (same program + feeds)
+    assert program_fingerprint(p1, feeds=feeds, fetches=[l1.name],
+                               extra={"n_steps": 4}) != base
+
+
+# ---------------------------------------------------------------------------
+# store: hit/miss, eviction, corruption
+# ---------------------------------------------------------------------------
+
+
+def test_executor_hit_miss_counters(tmp_path):
+    from paddle_tpu import compile_cache
+
+    compile_cache.configure(str(tmp_path))
+    p, s, l = _build_mlp()
+    c0 = _cc_counters()
+    _run_once(p, s, l)
+    d1 = _delta(c0, _cc_counters())
+    assert d1.get("miss", 0) == 2  # startup + main program
+    assert d1.get("hit", 0) == 0 and d1.get("put", 0) == 2
+
+    # a FRESH executor (empty in-process cache) re-consults the store;
+    # noise-renamed rebuild of the same model must hit
+    p2, s2, l2 = _build_mlp()
+    c1 = _cc_counters()
+    _run_once(p2, s2, l2)
+    d2 = _delta(c1, _cc_counters())
+    assert d2.get("hit", 0) == 2 and d2.get("miss", 0) == 0
+
+
+def test_lru_eviction_at_budget(tmp_path):
+    from paddle_tpu.compile_cache import CompileCacheStore
+
+    store = CompileCacheStore(str(tmp_path), budget_mb=0.02)  # ~20 KiB
+    blob = os.urandom(8 << 10)  # 8 KiB per entry
+    for i in range(5):
+        assert store.put(f"fp{i:02d}", blob, {"i": i})
+    stats = store.stats()
+    assert stats["entry_bytes"] <= 0.02 * (1 << 20)
+    assert stats["entries"] < 5
+    # newest entry survives (put protects its own write), oldest evicted
+    assert store.complete("fp04")
+    assert not store.complete("fp00")
+    assert store.get("fp00", count=False) is None
+    assert store.get("fp04", count=False) is not None
+
+
+def test_corrupted_entry_falls_back_to_fresh_compile(tmp_path):
+    from paddle_tpu import compile_cache
+
+    store = compile_cache.configure(str(tmp_path))
+    p, s, l = _build_mlp()
+    _, loss0 = _run_once(p, s, l)
+
+    # garble every committed payload behind the _SUCCESS markers
+    for rec in store.entries():
+        with open(os.path.join(rec["dir"], "program.bin"), "wb") as f:
+            f.write(b"bit rot")
+    c0 = _cc_counters()
+    p2, s2, l2 = _build_mlp()
+    _, loss1 = _run_once(p2, s2, l2)  # fresh executor -> store consult
+    d = _delta(c0, _cc_counters())
+    assert d.get("corrupt_fallback", 0) == 2
+    assert d.get("hit", 0) == 0 and d.get("miss", 0) == 2
+    assert np.isfinite(loss1) and abs(loss1 - loss0) < 1e-5
+    # quarantined entries were rewritten by the fallback compiles
+    assert all(store.verify_entry(r["fingerprint"]) == "ok"
+               for r in store.entries())
+
+
+def test_fault_cache_corrupt_injection(tmp_path):
+    """PADDLE_FAULT_CACHE_CORRUPT is the deterministic oracle: every load
+    is treated as corrupt, the run still succeeds via fresh compiles."""
+    from paddle_tpu import compile_cache
+    from paddle_tpu.fluid import fault
+
+    compile_cache.configure(str(tmp_path))
+    p, s, l = _build_mlp()
+    _run_once(p, s, l)  # populate
+
+    fault.install(fault.FaultPlan(cache_corrupt=True))
+    try:
+        c0 = _cc_counters()
+        p2, s2, l2 = _build_mlp()
+        _, loss = _run_once(p2, s2, l2)
+        d = _delta(c0, _cc_counters())
+    finally:
+        fault.clear()
+    assert d.get("corrupt_fallback", 0) == 2 and d.get("hit", 0) == 0
+    assert np.isfinite(loss)
+
+
+# ---------------------------------------------------------------------------
+# satellite: bounded in-process executor jit cache
+# ---------------------------------------------------------------------------
+
+
+def test_executor_jit_cache_is_bounded(monkeypatch):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler
+
+    monkeypatch.setenv("PADDLE_EXECUTOR_CACHE_CAP", "2")
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert exe._cache.cap == 2
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    prog = fluid.default_main_program()
+    # three feed signatures = three jit entries; the cap holds at 2
+    for rows in (1, 2, 3):
+        exe.run(prog, feed={"x": np.ones((rows, 4), np.float32)},
+                fetch_list=[y])
+    assert len(exe._cache) == 2
+    assert exe._cache.evictions >= 1
+    c = profiler.counters()
+    assert c.get("executor.jit_cache.size") == 2
+    assert c.get("executor.jit_cache.evictions", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: serving bucket manifest
+# ---------------------------------------------------------------------------
+
+
+def _save_tiny_model(dirname):
+    import paddle_tpu.fluid as fluid
+
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    out = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(dirname, ["x"], [out], exe)
+
+
+def test_serving_manifest_written_atomically_without_cache(tmp_path):
+    """warmup() persists its bucket manifest even with the compile cache
+    DISABLED, and a restarted engine re-warms the same bucket set from it
+    (no sample inputs needed)."""
+    from paddle_tpu.inference import NativeConfig, PaddlePredictor
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    model_dir = str(tmp_path / "model")
+    _save_tiny_model(model_dir)
+    manifest = str(tmp_path / "serving" / "buckets.json")
+    cfg = ServingConfig(max_batch_size=4, manifest_path=manifest)
+
+    eng = ServingEngine(
+        PaddlePredictor(NativeConfig(model_dir=model_dir, use_tpu=False)),
+        cfg)
+    try:
+        buckets = eng.warmup()
+        assert buckets == [1, 2, 4]
+        assert os.path.exists(manifest)
+        # atomic commit leaves no staging litter
+        assert not [f for f in os.listdir(os.path.dirname(manifest))
+                    if ".tmp." in f]
+        with open(manifest) as f:
+            m = json.load(f)
+        assert m["buckets"] == [1, 2, 4]
+        assert m["feeds"] == [["x", [6], "float32"]]
+    finally:
+        eng.shutdown(timeout_s=5)
+
+    eng2 = ServingEngine(
+        PaddlePredictor(NativeConfig(model_dir=model_dir, use_tpu=False)),
+        cfg)
+    try:
+        assert eng2.warmup() == [1, 2, 4]
+        assert eng2.metrics.counter("warmup_dispatches") == 3
+        r = eng2.infer([np.ones((2, 6), np.float32)], timeout_ms=10000)
+        assert np.asarray(r[0].data).shape == (2, 3)
+    finally:
+        eng2.shutdown(timeout_s=5)
+
+
+def test_serving_warmup_skips_cached_buckets(tmp_path):
+    """With the store enabled, a restarted engine precompiles only the
+    buckets missing from the persistent cache (here: none)."""
+    from paddle_tpu import compile_cache
+    from paddle_tpu.inference import NativeConfig, PaddlePredictor
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    compile_cache.configure(str(tmp_path / "cache"))
+    model_dir = str(tmp_path / "model")
+    _save_tiny_model(model_dir)
+    cfg = ServingConfig(max_batch_size=4)
+
+    eng = ServingEngine(
+        PaddlePredictor(NativeConfig(model_dir=model_dir, use_tpu=False)),
+        cfg)
+    try:
+        eng.warmup()
+        assert eng.metrics.counter("warmup_dispatches") == 3
+        assert eng.metrics.counter("warmup_cached") == 0
+    finally:
+        eng.shutdown(timeout_s=5)
+
+    eng2 = ServingEngine(
+        PaddlePredictor(NativeConfig(model_dir=model_dir, use_tpu=False)),
+        cfg)
+    try:
+        assert eng2.warmup() == [1, 2, 4]
+        assert eng2.metrics.counter("warmup_dispatches") == 0
+        assert eng2.metrics.counter("warmup_cached") == 3
+        # traffic still flows (compiles lazily from the warm disk cache)
+        r = eng2.infer([np.ones((3, 6), np.float32)], timeout_ms=10000)
+        assert np.asarray(r[0].data).shape == (3, 3)
+    finally:
+        eng2.shutdown(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor handoff
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_supervisor_hands_cache_dir_to_workers(tmp_path,
+                                                       monkeypatch):
+    """Every generation shares one PADDLE_COMPILE_CACHE_DIR (arg > env >
+    <workdir>/compile_cache), so generation N+1 starts compile-warm."""
+    from paddle_tpu.parallel.elastic import ElasticSupervisor
+
+    wd = str(tmp_path / "run")
+    monkeypatch.delenv("PADDLE_COMPILE_CACHE_DIR", raising=False)
+    sup = ElasticSupervisor("true", 1, wd)
+    assert sup.compile_cache_dir == os.path.join(os.path.abspath(wd),
+                                                 "compile_cache")
+    monkeypatch.setenv("PADDLE_COMPILE_CACHE_DIR", str(tmp_path / "env"))
+    sup = ElasticSupervisor("true", 1, wd)
+    assert sup.compile_cache_dir == str(tmp_path / "env")
+    explicit = str(tmp_path / "explicit")
+    sup = ElasticSupervisor("true", 1, wd, compile_cache_dir=explicit)
+    assert sup.compile_cache_dir == explicit
+
+
+# ---------------------------------------------------------------------------
+# acceptance: cross-process warm start (subprocess round-trip)
+# ---------------------------------------------------------------------------
+
+_WARM_START_SCRIPT = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu.fluid as fluid
+from paddle_tpu import compile_cache
+from paddle_tpu.fluid import profiler
+from paddle_tpu.models import mnist
+
+compile_cache.configure(sys.argv[1])
+img, label, prediction, loss, acc = mnist.mlp()
+fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+feed = {"img": rng.normal(size=(16, 784)).astype(np.float32),
+        "label": rng.randint(0, 10, size=(16, 1)).astype(np.int64)}
+out = None
+for _ in range(3):
+    (out,) = exe.run(fluid.default_main_program(), feed=feed,
+                     fetch_list=[loss])
+c = profiler.counters()
+print(json.dumps({
+    "hit": c.get("compile_cache.hit", 0),
+    "miss": c.get("compile_cache.miss", 0),
+    "corrupt": c.get("compile_cache.corrupt_fallback", 0),
+    "programs": len(exe._cache),
+    "loss": float(np.asarray(out).reshape(-1)[0])}))
+"""
+
+
+def _warm_start_proc(cache_dir, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_FAULT_CACHE_CORRUPT", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-c", _WARM_START_SCRIPT, cache_dir, REPO],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_subprocess_warm_start_and_corrupt_fallback(tmp_path):
+    """The ISSUE's acceptance oracle, in-tree: process 2 re-running the
+    MNIST MLP train step against process 1's cache dir records zero new
+    compiles (hit counter == program count), and a corrupted cache still
+    trains successfully via the fallback path."""
+    cache = str(tmp_path / "cache")
+
+    cold = _warm_start_proc(cache)
+    assert cold["miss"] == cold["programs"] == 2, cold
+    assert cold["hit"] == 0 and np.isfinite(cold["loss"])
+
+    warm = _warm_start_proc(cache)
+    # zero new backend compiles: every program came out of the store
+    assert warm["miss"] == 0, warm
+    assert warm["hit"] == warm["programs"] == 2, warm
+    assert np.isfinite(warm["loss"])
+    assert abs(warm["loss"] - cold["loss"]) < 1e-5
+
+    # deliberately corrupted cache: fresh compile, run still succeeds
+    hurt = _warm_start_proc(cache,
+                            extra_env={"PADDLE_FAULT_CACHE_CORRUPT": "1"})
+    assert hurt["corrupt"] == 2 and hurt["hit"] == 0, hurt
+    assert np.isfinite(hurt["loss"])
+    assert abs(hurt["loss"] - cold["loss"]) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# satellite: cache_ctl CLI smoke (mirrors tools/replay_smoke.py in tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_ctl_smoke_tool():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cache_ctl.py"),
+         "--smoke"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-1000:]
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert report["warm"]["hit"] == report["cold"]["miss"]
+    assert report["elapsed_s"] < 10.0

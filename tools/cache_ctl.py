@@ -1,0 +1,198 @@
+"""Operator CLI for the persistent compile cache (paddle_tpu.compile_cache).
+
+Commands (default root: $PADDLE_COMPILE_CACHE_DIR, overridable via --dir)::
+
+    python tools/cache_ctl.py ls                  # one line per entry
+    python tools/cache_ctl.py stats               # sizes / counts JSON
+    python tools/cache_ctl.py verify              # checksum every entry
+    python tools/cache_ctl.py prune [--budget-mb N]
+                                                  # drop incomplete/corrupt
+                                                  # entries + LRU-evict
+    python tools/cache_ctl.py clear               # wipe the whole root
+    python tools/cache_ctl.py --smoke             # CI round-trip oracle
+
+``--smoke`` is the tier-1 oracle (mirrors ``tools/replay_smoke.py``): in a
+temp root it populates the cache by running a tiny MLP train step twice
+(cold then warm), then drives stats -> verify -> a deliberate corruption ->
+verify -> prune -> clear through the same code paths an operator would,
+printing one JSON report and exiting non-zero on any failed check.  Must
+finish in well under 10 s on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _store(args):
+    from paddle_tpu.compile_cache import CompileCacheStore
+
+    root = args.dir or os.environ.get("PADDLE_COMPILE_CACHE_DIR", "").strip()
+    if not root:
+        print(json.dumps({"error": "no cache dir: pass --dir or set "
+                                   "PADDLE_COMPILE_CACHE_DIR"}))
+        raise SystemExit(2)
+    return CompileCacheStore(root, args.budget_mb)
+
+
+def cmd_ls(args) -> int:
+    store = _store(args)
+    rows = []
+    for rec in store.entries():
+        m = rec["manifest"] or {}
+        rows.append({"fingerprint": rec["fingerprint"],
+                     "complete": rec["complete"],
+                     "bytes": rec["bytes"],
+                     "kind": m.get("kind"),
+                     "compile_seconds": m.get("compile_seconds"),
+                     "created": m.get("created")})
+    print(json.dumps(rows, indent=1))
+    return 0
+
+
+def cmd_stats(args) -> int:
+    print(json.dumps(_store(args).stats(), indent=1))
+    return 0
+
+
+def cmd_verify(args) -> int:
+    store = _store(args)
+    report = {rec["fingerprint"]: store.verify_entry(rec["fingerprint"])
+              for rec in store.entries()}
+    bad = {fp: st for fp, st in report.items() if st != "ok"}
+    print(json.dumps({"entries": len(report), "bad": bad}, indent=1))
+    return 0 if not bad else 1
+
+
+def cmd_prune(args) -> int:
+    store = _store(args)
+    budget = (None if args.budget_mb is None
+              else int(float(args.budget_mb) * (1 << 20)))
+    print(json.dumps(store.prune(budget), indent=1))
+    return 0
+
+
+def cmd_clear(args) -> int:
+    store = _store(args)
+    store.clear()
+    print(json.dumps({"cleared": store.root}))
+    return 0
+
+
+def _smoke_populate(root):
+    """Run a tiny MLP train step against ``root`` twice (fresh Executor the
+    second time) and return the cache counter deltas."""
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import compile_cache
+    from paddle_tpu.fluid import profiler
+
+    compile_cache.configure(root)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.normal(size=(4, 8)).astype(np.float32),
+            "y": rng.normal(size=(4, 1)).astype(np.float32)}
+
+    def one_pass():
+        before = profiler.counters()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(prog, feed=feed, fetch_list=[loss])
+        after = profiler.counters()
+        return {k: after.get(f"compile_cache.{k}", 0)
+                - before.get(f"compile_cache.{k}", 0)
+                for k in ("hit", "miss", "put", "corrupt_fallback")}
+
+    return one_pass(), one_pass()
+
+
+def cmd_smoke(_args) -> int:
+    import shutil
+    import tempfile
+
+    t_start = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="cache_ctl_smoke_")
+    ns = argparse.Namespace(dir=root, budget_mb=None)
+    report = {"ok": False, "root": root}
+    try:
+        cold, warm = _smoke_populate(root)
+        report["cold"], report["warm"] = cold, warm
+        store = _store(ns)
+        report["stats"] = store.stats()
+        verify0 = {r["fingerprint"]: store.verify_entry(r["fingerprint"])
+                   for r in store.entries()}
+        report["verify_clean"] = all(v == "ok" for v in verify0.values())
+        # corrupt one payload on disk; verify must flag it, prune must
+        # remove it, and the stale fingerprint must re-load as a miss
+        victim = store.entries()[0]["fingerprint"]
+        with open(os.path.join(store.entry_dir(victim), "program.bin"),
+                  "wb") as f:
+            f.write(b"garbage")
+        report["verify_flags_corruption"] = \
+            store.verify_entry(victim).startswith("corrupt")
+        pruned = store.prune()
+        report["prune_removed"] = [r["fingerprint"]
+                                   for r in pruned["removed"]]
+        store.clear()
+        report["cleared_empty"] = (store.stats()["entries"] == 0)
+        report["elapsed_s"] = round(time.perf_counter() - t_start, 2)
+        report["ok"] = (
+            cold["miss"] >= 2 and cold["hit"] == 0
+            and warm["hit"] == cold["miss"] and warm["miss"] == 0
+            and report["verify_clean"]
+            and report["verify_flags_corruption"]
+            and victim in report["prune_removed"]
+            and report["cleared_empty"])
+    except Exception as exc:  # a broken smoke must still print its JSON
+        import traceback
+
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["trace"] = traceback.format_exc(limit=5)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Inspect / maintain the persistent compile cache.")
+    ap.add_argument("command", nargs="?", default="stats",
+                    choices=["ls", "stats", "verify", "prune", "clear"])
+    ap.add_argument("--dir", default=None,
+                    help="cache root (default $PADDLE_COMPILE_CACHE_DIR)")
+    ap.add_argument("--budget-mb", type=float, default=None,
+                    help="size budget for prune / stats")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI round-trip: populate -> stats -> verify -> "
+                         "prune -> clear in a temp root")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return cmd_smoke(args)
+    return {"ls": cmd_ls, "stats": cmd_stats, "verify": cmd_verify,
+            "prune": cmd_prune, "clear": cmd_clear}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
