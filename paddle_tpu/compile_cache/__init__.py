@@ -116,9 +116,18 @@ class _Probe:
         self.done = True
         try:
             from ..fluid import profiler as _prof
+            from .. import observe
 
             _prof.record_counter("compile_cache.compile_seconds",
                                  inc=round(float(seconds), 6))
+            # warm starts and cold compiles belong in the run-event stream
+            # next to guardian trips and generation restarts — a restarted
+            # generation's cache hits are the proof its recovery was cheap
+            observe.emit("compile_cache.hit" if self.hit
+                         else "compile_cache.miss",
+                         fingerprint=self.fp[:12],
+                         first_dispatch_s=round(float(seconds), 6),
+                         kind=(meta or {}).get("kind"))
             if not self.hit and program is not None:
                 m = dict(meta or {})
                 m["compile_seconds"] = round(float(seconds), 6)
@@ -150,6 +159,10 @@ def executor_probe(program, feed_arrays=None, fetch_names=None,
                                  fetches=list(fetch_names or []),
                                  extra=extra)
         hit = store.get(fp) is not None
+        from .. import observe
+
+        # every event the run emits from here on correlates to this program
+        observe.note_program(fp[:12])
         return _Probe(store, fp, hit)
     except Exception:
         try:

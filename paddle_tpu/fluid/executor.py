@@ -889,6 +889,11 @@ class Executor:
                 _fault.advance(n_steps)
         else:
             _fault._step += n_steps  # keep the index flowing for the guardian
+        from .. import observe
+
+        # every subsystem's events from here to the next boundary correlate
+        # to this step (guardian trips, cache hits, checkpoint commits)
+        observe.note_step(fired)
         hb_dir = os.environ.get("PADDLE_ELASTIC_HB_DIR")
         if hb_dir:
             from ..parallel.elastic import write_heartbeat

@@ -404,9 +404,16 @@ class Guardian:
 
     def _incident(self, rec: HealthRecord, policy: str,
                   bundle: Optional[str]) -> None:
-        """Under an elastic supervisor, a guardian trip must be a recorded
-        *decision*, not just a dead process: append one line to the
-        supervisor's incidents.jsonl."""
+        """A guardian trip must be a recorded *decision*, not just a dead
+        process: one stamped record in the run-event stream (where it
+        correlates with the supervisor's generation restarts and the next
+        generation's cache hits by (host, gen, step)), plus — under an
+        elastic supervisor — one line in the legacy incidents.jsonl view."""
+        from .. import observe
+
+        observe.emit("guardian_trip", step=rec.step, policy=policy,
+                     loss=rec.loss, grad_norm=rec.grad_norm, scale=rec.scale,
+                     finite=rec.finite, spike=rec.spike, bundle=bundle)
         path = os.environ.get("PADDLE_ELASTIC_INCIDENTS")
         if not path:
             return

@@ -8,6 +8,15 @@ traces open in TensorBoard/perfetto/XProf).
 ``stop_profiler`` prints the reference-style aggregate table (calls, total,
 min, max, ave) and writes a JSON event log that ``tools/timeline.py``
 converts to a chrome://tracing file (ref: tools/timeline.py:36,115).
+
+Storage note (ISSUE 5): the counters and the [calls,total,min,max] event
+aggregates used to live in module-level plain dicts — an unlocked
+read-modify-write per update that DROPPED increments whenever serving
+workers, the guardian observer and the training loop emitted concurrently.
+Both now route through ``paddle_tpu.observe``'s process registry: counters
+via ``registry.inc``/``set_gauge``, event aggregates via
+``registry.record_timing``, and this module's timeline list is mutated
+under the registry's own lock, so one lock covers all profiler state.
 """
 
 from __future__ import annotations
@@ -25,10 +34,14 @@ __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
 
 _trace_dir = None
 _on = False
-_agg = {}        # name -> [calls, total, min, max]
 _timeline = []   # {"name", "ts", "dur"} microseconds since start
 _t0 = 0.0
-_counters = {}   # name -> value (ServingMetrics-style counters/gauges)
+
+
+def _registry():
+    from .. import observe
+
+    return observe.registry()
 
 
 def is_profiling() -> bool:
@@ -39,35 +52,32 @@ def record_event(name: str, seconds: float, start: float = None) -> None:
     """Aggregate one timed host event (executor hooks call this)."""
     if not _on:
         return
-    e = _agg.get(name)
-    if e is None:
-        _agg[name] = [1, seconds, seconds, seconds]
-    else:
-        e[0] += 1
-        e[1] += seconds
-        e[2] = min(e[2], seconds)
-        e[3] = max(e[3], seconds)
+    reg = _registry()
+    reg.record_timing(name, seconds)
     ts = ((start if start is not None else time.perf_counter() - seconds)
           - _t0) * 1e6
-    _timeline.append({"name": name, "ts": ts, "dur": seconds * 1e6})
+    with reg.lock:
+        _timeline.append({"name": name, "ts": ts, "dur": seconds * 1e6})
 
 
 def record_counter(name: str, inc: int = 1, value=None) -> None:
-    """ServingMetrics-style counter/gauge, ALWAYS on (one dict write;
-    unlike record_event it does not require an active profiling session —
-    production counters must not depend on tracing being enabled).
-    Default increments by ``inc``; ``value=`` sets a gauge absolutely
-    (e.g. the guardian's current loss scale)."""
+    """ServingMetrics-style counter/gauge, ALWAYS on (unlike record_event
+    it does not require an active profiling session — production counters
+    must not depend on tracing being enabled).  Default increments by
+    ``inc``; ``value=`` sets a gauge absolutely (e.g. the guardian's
+    current loss scale).  Thread-safe: backed by the observe registry's
+    lock, so concurrent emitters never lose increments."""
     if value is not None:
-        _counters[name] = value
+        _registry().set_gauge(name, value)
     else:
-        _counters[name] = _counters.get(name, 0) + inc
+        _registry().inc(name, inc)
 
 
 def counters() -> dict:
     """Snapshot of all counters/gauges (guardian trips/skips/loss-scale,
-    plus anything subsystems recorded)."""
-    return dict(_counters)
+    plus anything subsystems recorded) — the flat compatibility view of
+    ``paddle_tpu.observe.registry()``."""
+    return _registry().flat()
 
 
 @contextlib.contextmanager
@@ -86,9 +96,10 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    _agg.clear()
-    _timeline.clear()
-    _counters.clear()
+    reg = _registry()
+    with reg.lock:
+        reg.clear(timings_only=True)
+        _timeline.clear()
 
 
 def start_profiler(state="All", trace_dir=None):
@@ -97,6 +108,9 @@ def start_profiler(state="All", trace_dir=None):
 
     reset_profiler()
     _t0 = time.perf_counter()
+    # per-change counter samples for the chrome-trace "C" track (queue
+    # depth, cache hits... over time); recorded only while profiling
+    _registry().start_sampling(_t0)
     _on = True
     _trace_dir = trace_dir or os.path.join(tempfile.gettempdir(),
                                            "paddle_tpu_profile")
@@ -120,8 +134,10 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     except RuntimeError:
         pass
 
+    reg = _registry()
+    samples = reg.stop_sampling()
     rows = [(n, c, tot, mn, mx, tot / c)
-            for n, (c, tot, mn, mx) in _agg.items()]
+            for n, (c, tot, mn, mx) in reg.timings().items()]
     key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}
     rows.sort(key=lambda r: -r[key_idx.get(sorted_key, 2)])
     if rows:
@@ -131,8 +147,15 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
             print(f"{n[:40]:<40} {c:>8} {tot * 1e3:>12.3f} "
                   f"{mn * 1e3:>10.3f} {mx * 1e3:>10.3f} {ave * 1e3:>10.3f}")
     if profile_path:
+        from ..observe.events import host_name
+
+        with reg.lock:
+            events = list(_timeline)
         with open(profile_path, "w") as f:
-            json.dump({"events": _timeline, "trace_dir": _trace_dir}, f)
+            # "host" + "counters" feed tools/timeline.py's multi-host merge
+            # (distinct pids) and its "ph":"C" counter tracks
+            json.dump({"events": events, "trace_dir": _trace_dir,
+                       "host": host_name(), "counters": samples}, f)
     return _trace_dir
 
 

@@ -78,6 +78,9 @@ class AnalysisConfig(NativeConfig):
     # restarted predictor re-warm the same bucket set — empty means "under
     # the persistent compile cache when enabled, else nowhere")
     serving_manifest_path: str = ""
+    # localhost /metrics + /healthz port (paddle_tpu.observe; 0 picks an
+    # ephemeral port, negative means disabled)
+    serving_metrics_port: int = -1
 
 
 class PaddlePredictor:
@@ -129,7 +132,10 @@ class PaddlePredictor:
                 max_wait_ms=config.serving_max_wait_ms,
                 max_queue_depth=config.serving_max_queue_depth,
                 batch_invariant=config.serving_batch_invariant,
-                manifest_path=config.serving_manifest_path or None))
+                manifest_path=config.serving_manifest_path or None,
+                metrics_port=(config.serving_metrics_port
+                              if config.serving_metrics_port >= 0
+                              else None)))
             if config.serving_warmup:
                 self._engine.warmup()
 

@@ -1,0 +1,267 @@
+"""Operator CLI for the observability subsystem (docs/OBSERVABILITY.md).
+
+Commands (default dir: $PADDLE_OBSERVE_DIR, overridable via --dir)::
+
+    python -m paddle_tpu.observe tail [--n 20] [--event guardian_trip]
+                                     # newest merged events, one JSON/line
+    python -m paddle_tpu.observe summary
+                                     # aggregated fleet snapshot JSON
+    python -m paddle_tpu.observe export --out trace.json
+                                     # merged chrome://tracing file
+    python -m paddle_tpu.observe serve [--port 9102]
+                                     # /metrics + /healthz over the
+                                     # aggregated fleet view
+    python -m paddle_tpu.observe --smoke
+                                     # CI round-trip oracle (tier-1, <2s
+                                     # after interpreter start; pattern of
+                                     # tools/cache_ctl.py --smoke)
+
+``--smoke`` exercises the full surface in a temp dir with NO accelerator
+work: two simulated workers (distinct host/rank sinks) emit counters,
+histograms and events; then the race oracle (8 threads x 2000 increments
+must total exactly 16000), the Prometheus round-trip (render -> parse ->
+same values), the live HTTP endpoint, fleet aggregation (summed counters
+across workers), event merge ordering, and the chrome-trace export are all
+checked, printing one JSON report and exiting non-zero on any failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _dir_or_die(args) -> str:
+    d = args.dir or os.environ.get("PADDLE_OBSERVE_DIR", "").strip()
+    if not d:
+        print(json.dumps({"error": "no observe dir: pass --dir or set "
+                                   "PADDLE_OBSERVE_DIR"}))
+        raise SystemExit(2)
+    return d
+
+
+def cmd_tail(args) -> int:
+    from .fleet import fleet_events
+
+    recs = fleet_events(_dir_or_die(args))
+    if args.event:
+        recs = [r for r in recs if r.get("event") == args.event]
+    for rec in recs[-args.n:]:
+        print(json.dumps(rec))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from .fleet import fleet_events, fleet_snapshot
+
+    root = _dir_or_die(args)
+    snap = fleet_snapshot(root)
+    events = fleet_events(root)
+    kinds = {}
+    for r in events:
+        kinds[r.get("event", "?")] = kinds.get(r.get("event", "?"), 0) + 1
+    out = {"root": snap["root"], "workers": snap["workers"],
+           "counters_sum": snap["counters_sum"],
+           "gauges_by_worker": snap["gauges_by_worker"],
+           "events_total": len(events), "events_by_kind": kinds}
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_export(args) -> int:
+    from .export import chrome_trace
+    from .fleet import fleet_events
+
+    recs = fleet_events(_dir_or_die(args))
+    trace = chrome_trace(recs, device_trace_dir=args.device_trace_dir)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    print(json.dumps({"out": args.out, "events": len(recs),
+                      "pids": len({(r.get('host'), r.get('rank'))
+                                   for r in recs})}))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from .fleet import fleet_snapshot
+    from .http import MetricsServer
+
+    root = _dir_or_die(args)
+
+    def provider():
+        snap = fleet_snapshot(root)
+        return {"counters": snap["counters_sum"],
+                "gauges": {f'{n}{{worker="{w}"}}': v
+                           for n, by in snap["gauges_by_worker"].items()
+                           for w, v in by.items()},
+                "histograms": {}}
+
+    srv = MetricsServer(args.port, providers=[provider],
+                        health=lambda: {"ok": True, "root": root})
+    print(json.dumps({"serving": f"http://127.0.0.1:{srv.port}/metrics",
+                      "healthz": f"http://127.0.0.1:{srv.port}/healthz"}))
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        srv.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# smoke
+# ---------------------------------------------------------------------------
+
+
+def cmd_smoke(_args) -> int:
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from . import Sink, registry, reset
+    from .export import parse_prometheus_text, prometheus_text, chrome_trace
+    from .fleet import fleet_events, fleet_snapshot
+    from .registry import MetricsRegistry
+
+    t_start = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="observe_smoke_")
+    report = {"ok": False, "root": root}
+    sinks = []
+    try:
+        # -- 1. the race oracle: N threads x M increments == exactly N*M
+        reg = registry()
+        n_threads, m_incs = 8, 2000
+
+        def hammer():
+            for _ in range(m_incs):
+                reg.inc("smoke.race")
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        report["race_total"] = reg.flat().get("smoke.race")
+        report["race_exact"] = report["race_total"] == n_threads * m_incs
+
+        # -- 2. two simulated workers, each with its own sink + registry
+        for i, host in enumerate(("hostA", "hostB")):
+            wreg = MetricsRegistry()
+            wreg.inc("smoke.requests", 5 + i)
+            wreg.set_gauge("smoke.queue_depth", i)
+            wreg.observe("smoke.latency_s", 0.004 + i * 0.01)
+            sink = Sink(root, flush_s=60.0, host=host, rank=i, gen=0,
+                        reg=wreg)
+            sink.events.emit("smoke.worker_start", idx=i)
+            sink.events.emit("smoke.worker_done", idx=i)
+            sink.flush()
+            sinks.append(sink)
+
+        # -- 3. Prometheus round trip on worker 0's registry
+        snap0 = sinks[0].registry.snapshot()
+        parsed = parse_prometheus_text(prometheus_text(snap0))
+        report["prom_round_trip"] = (
+            parsed["counters"].get("smoke_requests") == 5
+            and parsed["gauges"].get("smoke_queue_depth") == 0
+            and parsed["histograms"].get("smoke_latency_s",
+                                         {}).get("count") == 1)
+
+        # -- 4. live endpoint over the process registry
+        from .http import MetricsServer
+
+        srv = MetricsServer(0, providers=[reg.snapshot],
+                            health=lambda: {"ok": True})
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5).read().decode()
+        health = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=5).read().decode())
+        srv.close()
+        scraped = parse_prometheus_text(text)
+        report["endpoint_counter_matches"] = (
+            scraped["counters"].get("smoke_race") == report["race_total"])
+        report["healthz_ok"] = bool(health.get("ok"))
+
+        # -- 5. fleet aggregation: summed counters + merged events
+        fsnap = fleet_snapshot(root)
+        report["fleet_workers"] = fsnap["workers"]
+        report["fleet_sum"] = fsnap["counters_sum"].get("smoke.requests")
+        report["fleet_sum_exact"] = report["fleet_sum"] == 5 + 6
+        events = fleet_events(root)
+        report["events_total"] = len(events)
+        report["events_sorted"] = all(
+            events[i]["ts"] <= events[i + 1]["ts"]
+            for i in range(len(events) - 1))
+        report["events_stamped"] = all(
+            {"host", "rank", "gen", "pid"} <= set(r) for r in events)
+
+        # -- 6. chrome-trace export: one pid per (host, rank)
+        trace = chrome_trace(events)
+        pids = {e["pid"] for e in trace["traceEvents"]
+                if e.get("ph") != "M"}
+        names = [e for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"]
+        report["trace_pids"] = sorted(pids)
+        report["trace_distinct_pids"] = len(pids) == 2 and len(names) == 2
+
+        report["elapsed_s"] = round(time.perf_counter() - t_start, 2)
+        report["ok"] = all(report[k] for k in (
+            "race_exact", "prom_round_trip", "endpoint_counter_matches",
+            "healthz_ok", "fleet_sum_exact", "events_sorted",
+            "events_stamped", "trace_distinct_pids"))
+    except Exception as exc:  # a broken smoke must still print its JSON
+        import traceback
+
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["trace"] = traceback.format_exc(limit=5)
+    finally:
+        for sink in sinks:
+            sink.close()
+        reset()
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observe",
+        description="Inspect / export / serve observability data.")
+    ap.add_argument("command", nargs="?", default="summary",
+                    choices=["tail", "summary", "export", "serve"])
+    ap.add_argument("--dir", default=None,
+                    help="observe dir (default $PADDLE_OBSERVE_DIR)")
+    ap.add_argument("--n", type=int, default=20, help="tail: line count")
+    ap.add_argument("--event", default=None,
+                    help="tail: only this event kind")
+    ap.add_argument("--out", default="timeline.json",
+                    help="export: chrome-trace output path")
+    ap.add_argument("--device-trace-dir", default=None,
+                    help="export: jax trace dir to reference")
+    ap.add_argument("--port", type=int, default=0,
+                    help="serve: port (0 = ephemeral)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI round-trip in a temp dir")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return cmd_smoke(args)
+    try:
+        return {"tail": cmd_tail, "summary": cmd_summary,
+                "export": cmd_export, "serve": cmd_serve}[args.command](args)
+    except BrokenPipeError:
+        # `... | head` closing stdout early is normal unix usage, not an
+        # error worth a traceback
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""Exporters: Prometheus text, JSON snapshot files, chrome-trace merge.
+
+Prometheus exposition is the lingua franca of fleet scrapers; the renderer
+here is intentionally parseable by its own :func:`parse_prometheus_text`
+so the round trip (registry -> text -> parse -> same values) is a CI
+oracle, not a hope.  Metric names sanitize ``.`` and other non-identifier
+characters to ``_`` (``compile_cache.hit`` -> ``compile_cache_hit``);
+labels pass through in ``name{k="v"}`` form.
+
+The chrome-trace exporter turns merged run-event logs into a
+``chrome://tracing`` / perfetto file: spans (records with ``dur_s``)
+become ``"ph": "X"`` duration events, other records become ``"ph": "i"``
+instants, counter samples become ``"ph": "C"`` counter tracks, and every
+(host, rank) pair gets its own pid with a ``process_name`` metadata row —
+one timeline for the whole fleet.  The jax device trace stays in its
+``trace_dir`` (xplane protobuf, opened by TensorBoard/perfetto natively);
+the exporter records the pointer in the trace metadata rather than
+pretending to transcode it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional
+
+from .registry import split_name
+
+__all__ = ["sanitize_metric_name", "prometheus_text",
+           "parse_prometheus_text", "write_snapshot", "chrome_trace"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _render_line(rendered_key: str, value, out: List[str],
+                 suffix: str = "", extra_label: str = "") -> None:
+    name, labels = split_name(rendered_key)
+    name = sanitize_metric_name(name) + suffix
+    items = [f'{k}="{v}"' for k, v in labels]
+    if extra_label:
+        items.append(extra_label)
+    label_s = "{" + ",".join(items) + "}" if items else ""
+    out.append(f"{name}{label_s} {value}")
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a ``MetricsRegistry.snapshot()``-shaped dict (or a flat
+    name->value dict, treated as gauges) to Prometheus exposition text."""
+    if "counters" not in snapshot and "gauges" not in snapshot:
+        snapshot = {"counters": {}, "gauges": dict(snapshot),
+                    "histograms": {}}
+    lines: List[str] = []
+    for key in sorted(snapshot.get("counters", {})):
+        name, _ = split_name(key)
+        lines.append(f"# TYPE {sanitize_metric_name(name)} counter")
+        _render_line(key, snapshot["counters"][key], lines)
+    for key in sorted(snapshot.get("gauges", {})):
+        v = snapshot["gauges"][key]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue  # only numeric gauges are exposable
+        name, _ = split_name(key)
+        lines.append(f"# TYPE {sanitize_metric_name(name)} gauge")
+        _render_line(key, v, lines)
+    for key in sorted(snapshot.get("histograms", {})):
+        h = snapshot["histograms"][key]
+        name, _ = split_name(key)
+        lines.append(f"# TYPE {sanitize_metric_name(name)} histogram")
+        cum = 0
+        for ub, c in zip(h["buckets"], h["counts"]):
+            cum += c
+            _render_line(key, cum, lines, suffix="_bucket",
+                         extra_label=f'le="{ub}"')
+        cum += h["counts"][-1] if len(h["counts"]) > len(h["buckets"]) \
+            else 0
+        _render_line(key, cum, lines, suffix="_bucket",
+                     extra_label='le="+Inf"')
+        _render_line(key, h["sum"], lines, suffix="_sum")
+        _render_line(key, h["count"], lines, suffix="_count")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse exposition text back into ``{"counters": {...}, "gauges":
+    {...}, "histograms": {name: {"sum":, "count":}}}`` keyed on the
+    SANITIZED rendered names (the round-trip oracle's comparison form)."""
+    types: Dict[str, str] = {}
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            mname, _, mtype = rest.partition(" ")
+            types[mname] = mtype.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z0-9_:]+)(\{[^}]*\})?\s+(\S+)$", line)
+        if not m:
+            continue
+        name, labels, val = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        if value == int(value):
+            value = int(value)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) \
+                    and types.get(name[:-len(suffix)]) == "histogram":
+                base = name[:-len(suffix)]
+                h = out["histograms"].setdefault(base, {})
+                if suffix == "_sum":
+                    h["sum"] = value
+                elif suffix == "_count":
+                    h["count"] = value
+                break
+        else:
+            key = name + labels
+            if types.get(name) == "counter":
+                out["counters"][key] = value
+            else:
+                out["gauges"][key] = value
+    return out
+
+
+def write_snapshot(dir_path: str, snapshot: dict, *, stem: str,
+                   meta: Optional[dict] = None) -> List[str]:
+    """Atomically (tmp + rename) write ``<stem>.json`` and ``<stem>.prom``
+    under ``dir_path``; returns the paths.  The JSON carries ``meta`` (the
+    writer's host/rank/gen stamp) so the fleet aggregator never has to
+    parse filenames."""
+    os.makedirs(dir_path, exist_ok=True)
+    payload = {"meta": meta or {}}
+    payload.update(snapshot)
+    paths = []
+    for ext, data in ((".json", json.dumps(payload)),
+                      (".prom", prometheus_text(snapshot))):
+        path = os.path.join(dir_path, stem + ext)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        paths.append(path)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# chrome trace
+# ---------------------------------------------------------------------------
+
+
+def _pid_table(records: Iterable[dict]) -> Dict[tuple, int]:
+    """(host, rank) -> stable pid, in first-seen order."""
+    pids: Dict[tuple, int] = {}
+    for r in records:
+        key = (r.get("host", "?"), r.get("rank", 0))
+        if key not in pids:
+            pids[key] = len(pids)
+    return pids
+
+
+def chrome_trace(records: List[dict],
+                 counter_samples: Optional[List[dict]] = None,
+                 device_trace_dir: Optional[str] = None) -> dict:
+    """Merged event records -> chrome://tracing JSON dict.
+
+    ``records`` come from :func:`events.merge_events`; ``counter_samples``
+    are the profiler session's (ts, name, value) samples (emitted as
+    ``"ph": "C"`` on pid 0)."""
+    trace_events: List[dict] = []
+    pids = _pid_table(records)
+    if not pids:
+        pids[("host", 0)] = 0
+    for (host, rank), pid in pids.items():
+        trace_events.append({"name": "process_name", "ph": "M", "pid": pid,
+                             "args": {"name": f"{host}:r{rank}"}})
+    t0 = min((r.get("ts", 0) for r in records), default=0)
+    for r in records:
+        pid = pids.get((r.get("host", "?"), r.get("rank", 0)), 0)
+        ts_us = (r.get("ts", t0) - t0) * 1e6
+        args = {k: v for k, v in r.items()
+                if k not in ("ts", "event") and v is not None}
+        if r.get("dur_s") is not None:
+            dur_us = float(r["dur_s"]) * 1e6
+            trace_events.append({"ph": "X", "cat": "event",
+                                 "ts": ts_us - dur_us, "dur": dur_us,
+                                 "pid": pid, "tid": r.get("gen", 0),
+                                 "name": r.get("event", "?"), "args": args})
+        else:
+            trace_events.append({"ph": "i", "cat": "event", "ts": ts_us,
+                                 "pid": pid, "tid": r.get("gen", 0),
+                                 "s": "p",
+                                 "name": r.get("event", "?"), "args": args})
+    for s in counter_samples or []:
+        trace_events.append({"ph": "C", "pid": 0, "ts": s["ts"],
+                             "name": s["name"],
+                             "args": {"value": s["value"]}})
+    out = {"traceEvents": trace_events}
+    if device_trace_dir:
+        out["otherData"] = {"device_trace_dir": device_trace_dir,
+                            "note": "open the xplane capture in "
+                                    "TensorBoard/perfetto alongside"}
+    return out

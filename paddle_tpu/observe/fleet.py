@@ -1,0 +1,102 @@
+"""Fleet aggregation: many (host, rank, generation) files -> one view.
+
+Each worker process writes its own metric snapshot
+(``metrics-<host>-r<rank>-g<gen>.json``) and event log
+(``events-<host>-r<rank>-g<gen>.jsonl``) under the shared observe dir —
+never a shared file, so there is no cross-process interleaving to referee.
+The aggregator's job is the join:
+
+ - **per-worker views** keyed ``<host>:r<rank>:g<gen>`` (exactly what each
+   process reported, stamp included);
+ - **fleet sums**: counters summed over the LATEST generation of each
+   (host, rank) — a restarted worker's counters restart from zero, so
+   summing every generation would double-count the survivor's history;
+   earlier generations remain visible in the per-worker views;
+ - **merged events**: every generation's stream, wall-clock ordered (the
+   supervisor's restarts, guardian trips, cache hits in one timeline).
+
+This is what ``python -m paddle_tpu.observe summary`` prints and what the
+elastic supervisor persists as ``fleet.json`` at the end of a run.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from .events import merge_events
+
+__all__ = ["scan_dir", "fleet_snapshot", "fleet_events", "write_fleet"]
+
+METRICS_GLOB = "metrics-*.json"
+EVENTS_GLOB = "events-*.jsonl"
+
+
+def scan_dir(root: str) -> Dict[str, List[str]]:
+    root = os.path.abspath(root)
+    return {"metrics": sorted(glob.glob(os.path.join(root, METRICS_GLOB))),
+            "events": sorted(glob.glob(os.path.join(root, EVENTS_GLOB)))}
+
+
+def _load_metrics(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # torn/corrupt snapshot: skip, never fail the fleet view
+
+
+def fleet_snapshot(root: str) -> dict:
+    """Aggregate every worker's newest metric snapshot under ``root``."""
+    workers: Dict[str, dict] = {}
+    latest: Dict[tuple, dict] = {}  # (host, rank) -> snapshot of max gen
+    for path in scan_dir(root)["metrics"]:
+        snap = _load_metrics(path)
+        if snap is None:
+            continue
+        meta = snap.get("meta", {})
+        host = meta.get("host", os.path.basename(path))
+        rank, gen = meta.get("rank", 0), meta.get("gen", 0)
+        workers[f"{host}:r{rank}:g{gen}"] = snap
+        key = (host, rank)
+        if key not in latest or latest[key]["meta"].get("gen", 0) <= gen:
+            latest[key] = snap
+    summed: Dict[str, float] = {}
+    for snap in latest.values():
+        for name, v in snap.get("counters", {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                summed[name] = summed.get(name, 0) + v
+    gauges: Dict[str, dict] = {}
+    for key, snap in latest.items():
+        label = f"{key[0]}:r{key[1]}"
+        for name, v in snap.get("gauges", {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                gauges.setdefault(name, {})[label] = v
+    return {"ts": time.time(), "root": os.path.abspath(root),
+            "workers": sorted(workers),
+            "counters_sum": summed,
+            "gauges_by_worker": gauges,
+            "per_worker": workers}
+
+
+def fleet_events(root: str) -> List[dict]:
+    """Every worker generation's events, one wall-clock-ordered stream."""
+    return merge_events(scan_dir(root)["events"])
+
+
+def write_fleet(root: str, path: Optional[str] = None) -> Optional[str]:
+    """Persist the aggregated snapshot as ``<root>/fleet.json`` (atomic).
+    Returns the path, or None when nothing could be written."""
+    snap = fleet_snapshot(root)
+    path = path or os.path.join(os.path.abspath(root), "fleet.json")
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None

@@ -1,0 +1,122 @@
+"""Localhost observability endpoint: ``/metrics`` + ``/healthz``.
+
+A deliberately tiny stdlib HTTP server (no framework, no extra deps —
+container constraint) bound to 127.0.0.1 only: this is a scrape target and
+liveness probe for a sidecar/operator on the same host, NOT a public
+service.  ``/metrics`` renders every registered provider's snapshot as one
+Prometheus exposition document; ``/metrics.json`` returns the raw merged
+JSON; ``/healthz`` returns 200 with the merged health dicts (503 when any
+provider reports ``ok: false`` — the shape load balancers expect).
+
+Providers are callables returning either a ``MetricsRegistry.snapshot()``
+dict or a flat name->value mapping; the serving engine registers its own
+``ServingMetrics`` view next to the process registry so the endpoint's
+counters match ``ServingMetrics.snapshot()`` exactly (acceptance oracle in
+tests/test_observe.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+from .export import prometheus_text
+
+__all__ = ["MetricsServer"]
+
+
+class MetricsServer:
+    """Threaded localhost HTTP endpoint over a set of metric providers."""
+
+    def __init__(self, port: int = 0,
+                 providers: Optional[List[Callable[[], dict]]] = None,
+                 health: Optional[Callable[[], dict]] = None):
+        self._providers: List[Callable[[], dict]] = list(providers or [])
+        self._health: List[Callable[[], dict]] = [health] if health else []
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr spam per scrape
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path.startswith("/metrics.json"):
+                        body = json.dumps(server.merged()).encode()
+                        ctype, code = "application/json", 200
+                    elif self.path.startswith("/metrics"):
+                        body = server.prometheus().encode()
+                        ctype = "text/plain; version=0.0.4"
+                        code = 200
+                    elif self.path.startswith("/healthz"):
+                        health = server.health()
+                        code = 200 if health.get("ok", True) else 503
+                        body = json.dumps(health).encode()
+                        ctype = "application/json"
+                    else:
+                        body, ctype, code = b"not found", "text/plain", 404
+                except Exception as exc:  # a broken provider != a dead port
+                    body = f"provider error: {exc!r}".encode()
+                    ctype, code = "text/plain", 500
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="observe-http", daemon=True)
+        self._thread.start()
+
+    # -- providers --
+    def add_provider(self, fn: Callable[[], dict]) -> None:
+        with self._lock:
+            self._providers.append(fn)
+
+    def add_health(self, fn: Callable[[], dict]) -> None:
+        with self._lock:
+            self._health.append(fn)
+
+    # -- views --
+    def merged(self) -> dict:
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            providers = list(self._providers)
+        for fn in providers:
+            snap = fn() or {}
+            if "counters" not in snap and "gauges" not in snap:
+                snap = {"gauges": {k: v for k, v in snap.items()
+                                   if isinstance(v, (int, float))
+                                   and not isinstance(v, bool)}}
+            for family in ("counters", "gauges", "histograms"):
+                out[family].update(snap.get(family, {}))
+        return out
+
+    def prometheus(self) -> str:
+        return prometheus_text(self.merged())
+
+    def health(self) -> dict:
+        out: Dict[str, object] = {"ok": True}
+        with self._lock:
+            health = list(self._health)
+        for fn in health:
+            h = fn() or {}
+            if not h.get("ok", True):
+                out["ok"] = False
+            for k, v in h.items():
+                if k != "ok":
+                    out[k] = v
+        return out
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass

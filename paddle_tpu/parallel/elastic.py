@@ -33,6 +33,11 @@ Pieces:
    generation N+1 skips XLA compilation of the exact programs generation
    N was running when it died — restart latency drops from
    checkpoint-load + full-recompile to checkpoint-load alone.
+ - observability handoff: every generation gets the same
+   ``PADDLE_OBSERVE_DIR`` (``paddle_tpu.observe``), the supervisor's own
+   decisions are mirrored into the same run-event stream, and at end of
+   run the fleet aggregator writes ``<observe_dir>/fleet.json`` — one
+   snapshot summing every worker's latest-generation counters.
 
 CLI::
 
@@ -103,10 +108,17 @@ def read_heartbeat(hb_dir: str, rank: int) -> Optional[dict]:
 class IncidentLog:
     """Append-only JSON-lines incident record (the etcd-event analogue of
     the reference master's state transitions): one line per supervisor
-    decision, machine-parseable for postmortems."""
+    decision, machine-parseable for postmortems.
 
-    def __init__(self, path: str):
+    Since ISSUE 5 this file is a *view* of the unified run-event stream:
+    when a ``mirror`` (an :class:`paddle_tpu.observe.events.EventLog`) is
+    attached, every incident also lands — fully stamped — in the observe
+    dir, where ``python -m paddle_tpu.observe tail`` correlates it with
+    guardian trips and compile-cache hits by (host, generation, step)."""
+
+    def __init__(self, path: str, mirror=None):
         self.path = path
+        self.mirror = mirror
         self.events: List[dict] = []
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
@@ -116,6 +128,11 @@ class IncidentLog:
         self.events.append(rec)
         with open(self.path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        if self.mirror is not None:
+            try:
+                self.mirror.emit(event, **fields)
+            except Exception:
+                pass  # the mirror must never block the primary record
         return rec
 
 
@@ -163,7 +180,8 @@ class ElasticSupervisor:
                  extra_env: Optional[Dict[str, str]] = None,
                  fault_env: Optional[Dict[str, str]] = None,
                  deadline: Optional[float] = None,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 observe_dir: Optional[str] = None):
         if nproc < 1:
             raise ValueError("nproc must be >= 1")
         self.entry = entry
@@ -184,8 +202,24 @@ class ElasticSupervisor:
             compile_cache_dir
             or os.environ.get("PADDLE_COMPILE_CACHE_DIR", "").strip()
             or os.path.join(self.workdir, "compile_cache"))
+        # unified observability dir shared by every generation: workers
+        # write per-(host, rank, gen) event logs + metric snapshots there,
+        # and the supervisor's own decisions join the same stream (the
+        # incidents.jsonl below stays as the legacy flat view)
+        self.observe_dir = os.path.abspath(
+            observe_dir
+            or os.environ.get("PADDLE_OBSERVE_DIR", "").strip()
+            or os.path.join(self.workdir, "observe"))
+        from ..observe.events import EventLog, host_name
+
+        os.makedirs(self.observe_dir, exist_ok=True)
+        self._observe_log = EventLog(
+            os.path.join(self.observe_dir,
+                         f"events-{host_name()}-supervisor.jsonl"),
+            source="supervisor")
         self.incidents = IncidentLog(
-            os.path.join(self.workdir, "incidents.jsonl"))
+            os.path.join(self.workdir, "incidents.jsonl"),
+            mirror=self._observe_log)
 
     # -- public --
     def run(self) -> dict:
@@ -240,7 +274,11 @@ class ElasticSupervisor:
                # incident stream per pod, small O_APPEND json lines
                "PADDLE_ELASTIC_INCIDENTS": self.incidents.path,
                # generation N+1 reuses generation N's compiled programs
-               "PADDLE_COMPILE_CACHE_DIR": self.compile_cache_dir}
+               "PADDLE_COMPILE_CACHE_DIR": self.compile_cache_dir,
+               # every generation's events + metric snapshots land in one
+               # shared observe dir (per-(host, rank, gen) files; the
+               # fleet aggregator joins them at end of run)
+               "PADDLE_OBSERVE_DIR": self.observe_dir}
         env.update(self.extra_env)
         if gen == 0:
             env.update(self.fault_env)
@@ -326,9 +364,19 @@ class ElasticSupervisor:
                                killed=len(alive))
 
     def _summary(self, status: str, generations: int) -> dict:
+        from ..observe import fleet as _fleet
+
+        # one aggregated view of every generation's metric snapshots
+        # (<observe_dir>/fleet.json); never fails the summary
+        try:
+            fleet_path = _fleet.write_fleet(self.observe_dir)
+        except Exception:
+            fleet_path = None
         return {"status": status, "generations": generations,
                 "incidents": list(self.incidents.events),
-                "incident_log": self.incidents.path}
+                "incident_log": self.incidents.path,
+                "observe_dir": self.observe_dir,
+                "fleet_snapshot": fleet_path}
 
 
 def main(argv=None) -> int:
@@ -351,6 +399,9 @@ def main(argv=None) -> int:
     ap.add_argument("--compile-cache-dir", default=None,
                     help="persistent compile cache shared by all "
                          "generations (default: <workdir>/compile_cache)")
+    ap.add_argument("--observe-dir", default=None,
+                    help="unified observability dir shared by all "
+                         "generations (default: <workdir>/observe)")
     ap.add_argument("--env", action="append", default=[], metavar="K=V")
     args = ap.parse_args(argv)
     extra = {}
@@ -364,7 +415,8 @@ def main(argv=None) -> int:
         poll_interval=args.poll_interval, max_restarts=args.max_restarts,
         deadline=args.deadline, devices_per_host=args.devices_per_host,
         extra_env=extra or None,
-        compile_cache_dir=args.compile_cache_dir)
+        compile_cache_dir=args.compile_cache_dir,
+        observe_dir=args.observe_dir)
     result = sup.run()
     print(json.dumps(result))
     return 0 if result["status"] == "finished" else 1

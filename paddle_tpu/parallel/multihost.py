@@ -402,6 +402,11 @@ def save_sharded_serial(state: dict, root: str, serial: int,
         with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
             f.write("")
         _fault.ckpt_crash_point("after")
+        from .. import observe
+
+        # the commit point: after _SUCCESS the serial is trusted, and the
+        # run-event stream shows which step's state survives a restart
+        observe.emit("checkpoint.commit", serial=int(serial), path=cur)
     barrier(f"ckpt_commit_{serial}")
     if process_index() == 0 and max_num is not None:
         complete = [(s, n) for s, n in _sharded_serial_dirs(root)

@@ -94,6 +94,14 @@ class ServingConfig:
                         compile cache IS enabled, the manifest lands
                         under ``<cache>/serving/``.  A restarted engine
                         re-warms the exact same bucket set from it.
+    ``metrics_port``    serve ``/metrics`` (Prometheus text, counters
+                        identical to ``ServingMetrics.snapshot()``) +
+                        ``/healthz`` on 127.0.0.1:<port> (0 = ephemeral;
+                        the bound port is ``engine.metrics_server.port``).
+                        None starts no server — but if the observe env
+                        endpoint (``PADDLE_OBSERVE_PORT``) is up, the
+                        engine attaches its metrics there instead, so one
+                        process-wide port exposes serving + registry.
     """
     max_batch_size: int = 32
     max_wait_ms: float = 5.0
@@ -103,6 +111,7 @@ class ServingConfig:
     require_warmup: bool = False
     batch_invariant: bool = False
     manifest_path: Optional[str] = None
+    metrics_port: Optional[int] = None
 
     def buckets(self) -> List[int]:
         """Power-of-two batch buckets up to max_batch_size (inclusive —
@@ -167,6 +176,29 @@ class ServingEngine:
             for i in range(max(1, self.config.num_workers))]
         for t in self._workers:
             t.start()
+        # observability endpoint: a dedicated /metrics server when
+        # configured, else piggyback on the process observe endpoint
+        self.metrics_server = None
+        from .. import observe
+
+        if self.config.metrics_port is not None:
+            from ..observe.http import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self.config.metrics_port,
+                providers=[self.metrics.export_snapshot],
+                health=self._health)
+        else:
+            srv = observe.http_server()
+            if srv is not None:
+                srv.add_provider(self.metrics.export_snapshot)
+                srv.add_health(self._health)
+
+    def _health(self) -> dict:
+        with self._cond:
+            return {"ok": not self._stopped and not self._draining,
+                    "warm": self._warm, "queue_depth": len(self._queue),
+                    "inflight": self._inflight}
 
     # ------------------------------------------------------------------
     # admission
@@ -193,6 +225,14 @@ class ServingEngine:
                     "(ServingConfig.require_warmup)")
             if len(self._queue) >= self.config.max_queue_depth:
                 self.metrics.inc("shed")
+                from .. import observe
+
+                # load-shed decisions belong in the run-event stream, next
+                # to guardian trips and generation restarts (one
+                # correlatable record per shed; no-op without an observe
+                # dir)
+                observe.emit("serving.shed",
+                             queue_depth=self.config.max_queue_depth)
                 raise EngineOverloaded(
                     f"queue full ({self.config.max_queue_depth} pending); "
                     f"request shed")
@@ -452,6 +492,12 @@ class ServingEngine:
         self._write_manifest(row_feed, fps)
         with self._cond:
             self._warm = True
+        from .. import observe
+
+        observe.emit(
+            "serving.warmup", buckets=self.config.buckets(),
+            dispatched=self.metrics.counter("warmup_dispatches"),
+            cached=self.metrics.counter("warmup_cached"))
         return self.config.buckets()
 
     # -- bucket manifest + fingerprints --
@@ -577,6 +623,9 @@ class ServingEngine:
             self._cond.notify_all()
         for t in self._workers:
             t.join(timeout=timeout_s)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         return ok
 
     def __enter__(self):
@@ -606,6 +655,7 @@ def create_serving_engine(config, serving_config: Optional[ServingConfig]
         cfg = dataclasses.replace(config, enable_serving=False)
     pred = _inf.PaddlePredictor(cfg)
     if serving_config is None:
+        mport = getattr(config, "serving_metrics_port", None)
         serving_config = ServingConfig(
             max_batch_size=getattr(config, "serving_max_batch_size", 32),
             max_wait_ms=getattr(config, "serving_max_wait_ms", 5.0),
@@ -614,6 +664,8 @@ def create_serving_engine(config, serving_config: Optional[ServingConfig]
                                     False),
             manifest_path=getattr(config, "serving_manifest_path", "")
             or None,
+            metrics_port=mport if mport is not None and mport >= 0
+            else None,
         )
     eng = ServingEngine(pred, serving_config)
     if warmup or getattr(config, "serving_warmup", False):

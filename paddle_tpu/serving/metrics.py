@@ -1,12 +1,22 @@
-"""Serving metrics: a lock-protected registry for the engine's counters,
-gauges and latency distribution.
+"""Serving metrics: the engine's counters, gauges and latency distribution,
+backed by the unified observability registry (``paddle_tpu.observe``).
 
-The reference stack exported serving health through each server's
-`/metrics`-style counters; here one in-process registry covers the single
-engine.  Everything is O(1) per observation: counters are plain ints,
-latencies go into a fixed-size ring buffer (percentiles are computed only
-at ``snapshot()`` time), and batch occupancy is tracked as two running
-sums (real rows / padded bucket rows).
+Each engine owns a private :class:`~paddle_tpu.observe.MetricsRegistry`
+(so two engines in one process never mix counts) and MIRRORS every counter
+and gauge into the process-wide registry under the ``serving.`` prefix —
+that is what the ``/metrics`` endpoint, the background flusher and the
+fleet aggregator read, so one engine's traffic is visible fleet-wide
+without any extra wiring.  Latencies additionally land in the global
+``serving.latency_s`` histogram (Prometheus-bucket form) while the private
+ring buffer keeps exact-ish percentiles for ``snapshot()``.
+
+Windowed rates (ISSUE 5 satellite): ``snapshot()``'s cumulative ``qps``
+decays toward the lifetime mean and is meaningless after hours of uptime.
+``window(prev, cur)`` computes interval rates from ANY two snapshots, and
+``interval()`` maintains the previous-snapshot state for you — each call
+returns the rates since the last call (exactly Prometheus ``rate()``
+semantics, computed client-side).  ``tools/bench_serving.py`` and the
+``/metrics`` endpoint report these, not the lifetime average.
 
 ``snapshot()`` returns a plain dict so callers can json.dump it (the bench
 tool's BENCH-line format) or diff two snapshots.  Per-event wiring into
@@ -17,9 +27,11 @@ context around serving traffic gets ``serving_request`` /
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
+
+from ..observe import MetricsRegistry
+from ..observe import registry as _global_registry
 
 __all__ = ["ServingMetrics"]
 
@@ -30,35 +42,38 @@ class ServingMetrics:
     #: counters every snapshot reports even when still zero
     COUNTERS = ("submitted", "completed", "failed", "shed", "expired",
                 "dispatches", "bucket_compiles", "warmup_dispatches",
-                "warmup_cached")
+                "warmup_cached", "rows_real", "rows_padded")
 
-    def __init__(self, latency_window: int = 4096):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
-        self._gauges: Dict[str, float] = {"queue_depth": 0}
+    def __init__(self, latency_window: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
+        self._reg = registry or MetricsRegistry()
+        self._lock = self._reg.lock  # one lock for registry + ring state
+        for k in self.COUNTERS:
+            self._reg.inc(k, 0)
+        self._reg.set_gauge("queue_depth", 0)
         # latency ring buffer, seconds; percentile accuracy degrades
         # gracefully under sustained load instead of growing unboundedly
         self._window = int(latency_window)
         self._lat = [0.0] * self._window
         self._lat_n = 0  # total observations ever (ring index = n % window)
-        self._rows_real = 0
-        self._rows_padded = 0
         self._t0 = time.perf_counter()
+        self._last_interval: Optional[dict] = None
 
     # -- recording --
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+        self._reg.inc(name, n)
+        _global_registry().inc(f"serving.{name}", n)
 
     def set_gauge(self, name: str, value) -> None:
-        with self._lock:
-            self._gauges[name] = value
+        self._reg.set_gauge(name, value)
+        _global_registry().set_gauge(f"serving.{name}", value)
 
     def observe_latency(self, seconds: float) -> None:
         """One completed request's queue+execute latency."""
         with self._lock:
             self._lat[self._lat_n % self._window] = float(seconds)
             self._lat_n += 1
+        _global_registry().observe("serving.latency_s", seconds)
         # profiler hook: no-op unless a profiler session is active
         from ..fluid import profiler as _prof
 
@@ -68,18 +83,17 @@ class ServingMetrics:
                       seconds: Optional[float] = None) -> None:
         """One executor dispatch: ``real_rows`` request rows padded into a
         ``bucket_rows`` executable."""
-        with self._lock:
-            self._rows_real += int(real_rows)
-            self._rows_padded += int(bucket_rows)
+        self.inc("rows_real", int(real_rows))
+        self.inc("rows_padded", int(bucket_rows))
         if seconds is not None:
             from ..fluid import profiler as _prof
 
-            _prof.record_event(f"serving_dispatch[bs={bucket_rows}]", seconds)
+            _prof.record_event(f"serving_dispatch[bs={bucket_rows}]",
+                               seconds)
 
     # -- reading --
     def counter(self, name: str) -> int:
-        with self._lock:
-            return self._counters.get(name, 0)
+        return self._reg.flat().get(name, 0)
 
     def _percentiles(self, lat, qs):
         if not lat:
@@ -94,19 +108,71 @@ class ServingMetrics:
     def snapshot(self) -> dict:
         """Point-in-time dict of every metric (safe to json.dump)."""
         with self._lock:
-            counters = dict(self._counters)
-            gauges = dict(self._gauges)
+            flat = self._reg.flat()
             n = min(self._lat_n, self._window)
             lat = list(self._lat[:n])
-            rows_real, rows_padded = self._rows_real, self._rows_padded
             elapsed = time.perf_counter() - self._t0
-        snap = dict(counters)
-        snap.update(gauges)
+        snap = dict(flat)
         snap["elapsed_s"] = round(elapsed, 3)
-        snap["qps"] = round(counters.get("completed", 0) / elapsed, 3) \
+        snap["qps"] = round(flat.get("completed", 0) / elapsed, 3) \
             if elapsed > 0 else 0.0
         snap.update(self._percentiles(lat, (0.50, 0.95, 0.99)))
         snap["latency_samples"] = n
+        rows_real = flat.get("rows_real", 0)
+        rows_padded = flat.get("rows_padded", 0)
         snap["mean_batch_occupancy"] = (
             round(rows_real / rows_padded, 4) if rows_padded else None)
         return snap
+
+    def export_snapshot(self) -> dict:
+        """This engine's metrics in ``MetricsRegistry.snapshot()`` shape
+        with the ``serving.`` prefix — the ``/metrics`` endpoint's provider
+        view.  Counters/gauges are the SAME values ``snapshot()`` reports
+        (one consistent source, the private registry), plus per-scrape
+        interval rates as gauges (``serving.interval_qps`` ...) so the
+        endpoint shows current throughput, not the decayed lifetime mean."""
+        snap = self._reg.snapshot()
+        out = {fam: {f"serving.{k}": v for k, v in snap.get(fam, {}).items()}
+               for fam in ("counters", "gauges", "histograms")}
+        rates = self.interval()
+        for src, dst in (("qps", "serving.interval_qps"),
+                         ("dispatch_rate", "serving.interval_dispatch_rate"),
+                         ("interval_s", "serving.interval_s"),
+                         ("mean_batch_occupancy",
+                          "serving.interval_batch_occupancy")):
+            v = rates.get(src)
+            if isinstance(v, (int, float)):
+                out["gauges"][dst] = v
+        return out
+
+    # -- windowed rates --
+    @staticmethod
+    def window(prev: dict, cur: dict) -> dict:
+        """Interval rates between two ``snapshot()`` dicts (cur - prev):
+        current throughput/shed-rate/occupancy, immune to uptime decay."""
+        dt = cur.get("elapsed_s", 0) - prev.get("elapsed_s", 0)
+        delta: Dict[str, float] = {
+            k: cur.get(k, 0) - prev.get(k, 0)
+            for k in ("completed", "submitted", "failed", "shed", "expired",
+                      "dispatches", "rows_real", "rows_padded")}
+        out = {"interval_s": round(dt, 3)}
+        out.update({k: v for k, v in delta.items()})
+        out["qps"] = round(delta["completed"] / dt, 3) if dt > 0 else 0.0
+        out["dispatch_rate"] = (round(delta["dispatches"] / dt, 3)
+                                if dt > 0 else 0.0)
+        out["mean_batch_occupancy"] = (
+            round(delta["rows_real"] / delta["rows_padded"], 4)
+            if delta["rows_padded"] else None)
+        return out
+
+    def interval(self) -> dict:
+        """Rates since the previous ``interval()`` call (or construction).
+        Each caller tick defines the window — a /metrics scrape loop gets
+        per-scrape rates for free."""
+        cur = self.snapshot()
+        with self._lock:
+            prev = self._last_interval
+            self._last_interval = cur
+        if prev is None:
+            prev = {"elapsed_s": 0.0}
+        return self.window(prev, cur)

@@ -43,3 +43,8 @@ def fresh_programs():
     from paddle_tpu import compile_cache as _compile_cache
 
     _compile_cache.reset()
+    # same for observability: close any sink/endpoint, clear the process
+    # registry and the (step, program) stamp, re-arm env late-binding
+    from paddle_tpu import observe as _observe
+
+    _observe.reset()

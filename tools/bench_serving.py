@@ -114,23 +114,29 @@ def run_bench(args) -> dict:
     snap = eng.metrics.snapshot()
     eng.shutdown()
 
-    served_window = snap["elapsed_s"] - warm["elapsed_s"]
+    # windowed interval rates (warm-snapshot -> final-snapshot diff): the
+    # cumulative snapshot qps includes warmup dead time and decays toward
+    # the lifetime mean; the window is the actual serving interval
+    from paddle_tpu.serving import ServingMetrics
+
+    win = ServingMetrics.window(warm, snap)
     out = {
         "metric": f"serving_mlp784_openloop_{args.device.lower()}",
-        "value": round(results["ok"] / served_window, 2)
-        if served_window > 0 else 0.0,
+        "value": win["qps"],
         "unit": "req/s",
         "offered_qps": args.qps,
         "duration_s": args.duration,
+        "window_s": win["interval_s"],
         "sent": sent,
         "completed": results["ok"],
-        "shed": results["shed"] + snap["shed"] - warm["shed"],
+        "shed": results["shed"] + win["shed"],
         "errors": results["err"],
         "p50_ms": snap["p50_ms"],
         "p95_ms": snap["p95_ms"],
         "p99_ms": snap["p99_ms"],
-        "mean_batch_occupancy": snap["mean_batch_occupancy"],
-        "dispatches": snap["dispatches"] - warm["dispatches"],
+        "mean_batch_occupancy": win["mean_batch_occupancy"],
+        "dispatches": win["dispatches"],
+        "dispatch_rate": win["dispatch_rate"],
         "bucket_compiles": snap["bucket_compiles"],
         "compiles_after_warmup":
             snap["bucket_compiles"] - warm["bucket_compiles"],

@@ -1,13 +1,20 @@
-"""Convert a paddle_tpu profile event log to a chrome://tracing JSON file.
+"""Convert paddle_tpu profile event logs to ONE chrome://tracing JSON file.
 
 ref: tools/timeline.py (_ChromeTraceFormatter :36, Timeline :115) — the
 reference converts its profiler proto into the Chrome trace-event format;
-this converts the JSON event log written by
+this converts the JSON event logs written by
 ``fluid.profiler.stop_profiler(profile_path=...)``.  The device-side trace
-(XLA ops) lives in the jax trace_dir referenced by the log and opens in
+(XLA ops) lives in the jax trace_dir referenced by each log and opens in
 TensorBoard/perfetto directly.
 
-Usage: python tools/timeline.py --profile_path /tmp/profile \
+Multi-host (ISSUE 5): pass several logs and each gets its own pid with a
+``process_name`` metadata row (named from the ``host`` field the profiler
+stamps, falling back to the file name), so a pod's host timelines line up
+in one view instead of all collapsing onto pid 0.  Counter samples recorded
+during the profiling session (queue depth, cache hits ... over time) become
+``"ph": "C"`` counter tracks on their host's pid.
+
+Usage: python tools/timeline.py --profile_path /tmp/p0 [/tmp/p1 ...] \
                                 --timeline_path /tmp/timeline.json
 """
 
@@ -15,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 
 
 class ChromeTraceFormatter:
@@ -32,6 +40,10 @@ class ChromeTraceFormatter:
                              "dur": duration, "pid": pid, "tid": tid,
                              "name": name, "args": args or {}})
 
+    def emit_counter(self, timestamp, pid, name, value):
+        self._events.append({"ph": "C", "ts": timestamp, "pid": pid,
+                             "name": name, "args": {"value": value}})
+
     def format_to_string(self, pretty=False):
         trace = {"traceEvents": self._metadata + self._events}
         return json.dumps(trace, indent=4 if pretty else None,
@@ -39,33 +51,47 @@ class ChromeTraceFormatter:
 
 
 class Timeline:
-    def __init__(self, events):
-        self._events = events
+    """``logs`` is a list of (label, log-dict) pairs — one per host profile
+    file; each pair becomes one pid in the merged trace."""
+
+    def __init__(self, logs):
+        if isinstance(logs, dict):  # single pre-parsed log (legacy callers)
+            logs = [("paddle_tpu:host", logs)]
+        self._logs = list(logs)
         self._chrome = ChromeTraceFormatter()
 
     def generate_chrome_trace(self) -> str:
-        self._chrome.emit_pid("paddle_tpu:host", 0)
-        for ev in self._events:
-            self._chrome.emit_region(ev["ts"], ev["dur"], 0, 0, "Op",
-                                     ev["name"])
+        for pid, (label, log) in enumerate(self._logs):
+            host = log.get("host") or label
+            self._chrome.emit_pid(f"paddle_tpu:{host}", pid)
+            for ev in log.get("events", []):
+                self._chrome.emit_region(ev["ts"], ev["dur"], pid, 0, "Op",
+                                         ev["name"])
+            for s in log.get("counters", []):
+                self._chrome.emit_counter(s["ts"], pid, s["name"],
+                                          s["value"])
         return self._chrome.format_to_string()
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--profile_path", required=True,
-                   help="JSON written by fluid.profiler.stop_profiler")
+    p.add_argument("--profile_path", required=True, nargs="+",
+                   help="JSON log(s) written by fluid.profiler."
+                        "stop_profiler — one per host for a merged view")
     p.add_argument("--timeline_path", required=True,
                    help="chrome://tracing output file")
     args = p.parse_args()
-    with open(args.profile_path) as f:
-        log = json.load(f)
-    tl = Timeline(log.get("events", []))
+    logs = []
+    for path in args.profile_path:
+        with open(path) as f:
+            logs.append((os.path.basename(path), json.load(f)))
+    tl = Timeline(logs)
     with open(args.timeline_path, "w") as f:
         f.write(tl.generate_chrome_trace())
-    if log.get("trace_dir"):
-        print(f"device trace (open in TensorBoard/perfetto): "
-              f"{log['trace_dir']}")
+    for _, log in logs:
+        if log.get("trace_dir"):
+            print(f"device trace (open in TensorBoard/perfetto): "
+                  f"{log['trace_dir']}")
 
 
 if __name__ == "__main__":
