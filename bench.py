@@ -188,16 +188,23 @@ def _cache_counters():
     return (c.get("compile_cache.hit", 0), c.get("compile_cache.miss", 0))
 
 
-def _cold_info(t_compile, before, after):
+def _cold_info(t_compile, before, after, window_steps=1, prefetch=0):
     """BENCH-line cold-start fields: the first dispatch's wall time
     (trace + XLA compile + step) reported SEPARATELY from steady-state
     throughput, plus whether it was served warm from the persistent
     compile cache (PADDLE_COMPILE_CACHE_DIR) — so warm-vs-cold runs are
-    distinguishable in the trajectory."""
+    distinguishable in the trajectory.  Every line also records the
+    dispatch shape of the measured loop: ``window_steps`` (steps fused
+    per run_steps dispatch; 1 = per-step), the resulting
+    ``dispatches_per_step`` amortization, and the ``prefetch`` depth the
+    loop staged input with (0 = synchronous / fixed resident feed)."""
     h0, m0 = before
     h1, m1 = after
     return {"compile_seconds": round(t_compile, 3),
-            "cache_hit": bool(h1 > h0 and m1 == m0)}
+            "cache_hit": bool(h1 > h0 and m1 == m0),
+            "window_steps": int(window_steps),
+            "dispatches_per_step": round(1.0 / max(1, int(window_steps)), 4),
+            "prefetch": int(prefetch)}
 
 
 def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
@@ -209,8 +216,11 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     rather than re-fed from numpy every step (ref:
     benchmark/fluid/fluid_benchmark.py:149).
 
-    BENCH_SPD=K>1 opts into Executor.run_steps (lax.scan, K steps per
-    dispatch).  Measured 2026-07-30 over the tunneled TPU: NOT the default
+    BENCH_SPD=K>1 (or the library-wide PADDLE_TPU_SPD, honored when the
+    bench knob is unset) opts into Executor.run_steps (lax.scan, K steps
+    per dispatch) — guardian-gated and dynamic-fp16-loss-scaled programs
+    included, since ISSUE 6 folded the sentinel + scaler into the scan
+    carry.  Measured 2026-07-30 over the tunneled TPU: NOT the default
     because the executor's per-step async dispatches already pipeline on
     device (~0.14 s/step ResNet-50 bs256), while the scanned loop runs
     ~2-3x slower per step (scan carry overhead dominates once dispatch
@@ -219,16 +229,27 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     ~7ms/dispatch floor applies per step; the bench's deferred-fetch loop
     does not.
 
+    BENCH_PREFETCH=1 (with SPD>1) additionally drives the
+    production-shaped input path: per-step batches staged window-by-window
+    through a DevicePrefetcher (feed_per_step windows, H2D overlapping
+    compute) instead of one fixed device-resident feed.
+
     Returns (seconds, steps_actually_timed, executor, cold) — ``cold``
     carries the first-dispatch ``compile_seconds`` (trace + XLA compile,
-    measured separately from the steady-state timing) and ``cache_hit``
-    (whether the persistent compile cache served it warm)."""
+    measured separately from the steady-state timing), ``cache_hit``
+    (whether the persistent compile cache served it warm) and the
+    window/prefetch shape fields (_cold_info)."""
     place = fluid.TPUPlace() if on_accel else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
     prog = fluid.default_main_program()
-    spd = int(os.environ.get("BENCH_SPD", "0"))
-    if on_accel:
+    spd = int(os.environ.get("BENCH_SPD",
+                             os.environ.get("PADDLE_TPU_SPD", "0") or "0")
+              or 0)
+    spd = max(1, min(spd, steps)) if spd > 0 else 1
+    use_pf = spd > 1 and not any(isinstance(v, tuple) for v in feed.values()) \
+        and os.environ.get("BENCH_PREFETCH", "").strip().lower() in ("1", "true")
+    if on_accel and not use_pf:
         import jax
 
         from paddle_tpu.fluid import core as _core
@@ -240,14 +261,40 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
         feed = {k: ((jax.device_put(v[0], dev), v[1])
                     if isinstance(v, tuple) else jax.device_put(v, dev))
                 for k, v in feed.items()}
-    spd = max(1, min(spd, steps)) if spd > 0 else 1
     if spd > 1:
         n_chunks = max(1, steps // spd)
         steps = n_chunks * spd
+        if use_pf:
+            from paddle_tpu.fluid.prefetch import (DevicePrefetcher,
+                                                   default_depth)
+
+            depth = default_depth()
+            batches = (dict(feed) for _ in range((n_chunks + 1) * spd))
+            cc0 = _cache_counters()
+            t_c = time.perf_counter()
+            with DevicePrefetcher(batches, n_steps=spd, place=place,
+                                  depth=depth) as pf:
+                it = iter(pf)
+                fd, cnt = next(it)
+                exe.run_steps(prog, feed=fd, fetch_list=[loss],
+                              n_steps=cnt, feed_per_step=True)
+                cold = _cold_info(time.perf_counter() - t_c, cc0,
+                                  _cache_counters(), spd, depth)
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(n_chunks):
+                    fd, cnt = next(it)
+                    (out,) = exe.run_steps(prog, feed=fd, fetch_list=[loss],
+                                           n_steps=cnt, feed_per_step=True)
+            last = float(np.asarray(out).reshape(-1)[0])
+            dt = time.perf_counter() - t0
+            assert np.isfinite(last), f"non-finite loss {last}"
+            return dt, steps, exe, cold
         cc0 = _cache_counters()
         t_c = time.perf_counter()
         exe.run_steps(prog, feed=feed, fetch_list=[loss], n_steps=spd)
-        cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters())
+        cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters(),
+                          spd, 0)
         t0 = time.perf_counter()
         out = None
         for _ in range(n_chunks):

@@ -50,6 +50,8 @@ from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
 from . import fault
 from . import guardian
 from .guardian import NumericsTripped
+from . import prefetch
+from .prefetch import DevicePrefetcher
 from . import evaluator
 from . import debugger
 from . import ir
@@ -59,7 +61,7 @@ Tensor = framework.Variable
 
 __all__ = [
     "io", "initializer", "layers", "nets", "optimizer", "backward", "amp",
-    "fault", "guardian", "NumericsTripped",
+    "fault", "guardian", "NumericsTripped", "prefetch", "DevicePrefetcher",
     "regularizer", "metrics", "clip", "profiler", "unique_name",
     "Program", "Operator", "Parameter", "Variable",
     "default_main_program", "default_startup_program", "program_guard",

@@ -535,6 +535,14 @@ class Executor:
         ``feed_per_step=True``: each feed array carries a leading
         ``n_steps`` dim and step i consumes slice i.
 
+        Guardian-gated and dynamic-fp16-loss-scaled programs scan too: the
+        per-step sentinel (health reduction + ``where(ok)`` commit gate)
+        and the loss-scale update ride the carry, and the host observes ONE
+        aggregated health record per window (first-trip step index + worst
+        values) with the usual one-boundary lag — policy applies at window
+        granularity, and a dump bundle captures the PRE-WINDOW state so
+        replay reproduces the trip (guardian.replay walks the window).
+
         Returns the fetches of the LAST step (host numpy).  Programs with
         data-dependent eager islands cannot be scanned and raise.
         """
@@ -543,11 +551,7 @@ class Executor:
 
         program = program or default_main_program()
         scope = scope or global_scope()
-        if getattr(program, "_loss_scale_vars", None) is not None:
-            raise RuntimeError(
-                "run_steps: this program was built with dynamic fp16 loss "
-                "scaling, whose per-step scale update and skip-on-overflow "
-                "gate live at the step boundary; use Executor.run per step")
+        n_steps = int(n_steps)
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list or []]
         feed_arrays = {}
@@ -559,15 +563,22 @@ class Executor:
                     "scanned loop; use Executor.run per step")
             feed_arrays[k] = arr
         from . import amp as _amp
+        from . import guardian as _guardian
+
+        # guarded window: sentinel + dynamic loss scale fold into the scan
+        # body exactly like Executor.run's single guarded step
+        guard = _guardian.for_program(program)
+        n_user = len(fetch_names)
 
         key = ("run_steps", program._cache_token, program._version,
-               tuple(fetch_names), int(n_steps), bool(feed_per_step),
+               tuple(fetch_names), n_steps, bool(feed_per_step),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_arrays.items())),
                self.place.device_type,
                # execution-mode toggles invalidate compiled fns (same
                # contract as Executor.run's cache key)
                _amp.compute_dtype(),
+               guard.cache_token() if guard is not None else None,
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key)
         probe = None
@@ -580,28 +591,63 @@ class Executor:
             # the backend executable loads from the shared disk cache
             probe = _cc.executor_probe(
                 program, feed_arrays, fetch_names,
-                extra={"kind": "run_steps", "n_steps": int(n_steps),
+                extra={"kind": "run_steps", "n_steps": n_steps,
                        "feed_per_step": bool(feed_per_step),
                        "platform": self.place.device_type,
                        "amp": _amp.compute_dtype(),
+                       "guard": (guard.cache_token()
+                                 if guard is not None else None),
                        "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
-            VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan")
-            plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+            VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan"
+                    f"{' (guarded)' if guard is not None else ''}")
+            plan_fetches = list(fetch_names)
+            if guard is not None:
+                plan_fetches += guard.extra_fetch_names()
+            plan = BlockPlan(program, 0, list(feed_arrays), plan_fetches)
             if plan.needs_eager:
+                if guard is not None and guard.scale_vars is not None:
+                    raise RuntimeError(
+                        "dynamic fp16 loss scaling is not supported for "
+                        "programs with data-dependent eager ops")
                 raise RuntimeError(
                     "run_steps: program contains data-dependent eager "
                     "ops; use Executor.run per step")
+            if guard is not None and guard.scale_vars:
+                # the scale/good-steps vars are read/written only by the
+                # guarded wrapper (no IR op touches the counter), so
+                # liveness never saw them — gather with the rest of state
+                for n in guard.scale_vars:
+                    if n not in plan.state_in:
+                        plan.state_in.append(n)
 
-            def kfn(feed_vals, const_state, mut_state):
+            def kfn(feed_vals, const_state, mut_state, sentinel):
                 def body(carry, xs):
-                    mut, _prev_fetch = carry
-                    step_feed = xs if feed_per_step else feed_vals
+                    if guard is not None:
+                        mut, _prev_fetch, agg = carry
+                    else:
+                        mut, _prev_fetch = carry
+                    step_feed = dict(xs["feed"] if feed_per_step
+                                     else feed_vals)
                     state = dict(const_state)
                     state.update(mut)
+                    if guard is not None:
+                        step_sent = {"loss_cap": sentinel["loss_cap"],
+                                     "seed_mul": xs["seed_mul"],
+                                     "loss_mul": xs["loss_mul"]}
+                        step_feed[_guardian.LOSS_SEED_MUL] = \
+                            _guardian.seed_multiplier(guard, state, step_sent)
                     fetches, new_state = trace_block(
                         program, 0, plan, step_feed, state)
                     # fetches ride the carry: only the LAST step's values
                     # survive, with no (n_steps, ...) stacking buffer
+                    if guard is not None:
+                        committed, health = _guardian.fold_health(
+                            guard, fetches[n_user:], new_state, mut, state,
+                            step_sent)
+                        agg = _guardian.window_health_update(
+                            agg, health, xs["i"], n_steps)
+                        return ({**mut, **committed}, fetches[:n_user],
+                                agg), None
                     return ({**mut, **new_state}, fetches), None
 
                 first_feed = (
@@ -611,7 +657,8 @@ class Executor:
                     lambda st: trace_block(program, 0, plan, first_feed,
                                            {**const_state, **st}),
                     mut_state)
-                fetch0 = [_jnp.zeros(t.shape, t.dtype) for t in fetch0]
+                fetch0 = [_jnp.zeros(t.shape, t.dtype)
+                          for t in fetch0[:n_user]]
                 # write-only persistables (written before first read, e.g.
                 # a decayed lr var) appear in new_state but not in
                 # _gather_state's mut_state — seed them so the carry
@@ -620,46 +667,112 @@ class Executor:
                 for k, t in state0.items():
                     if k not in mut_state:
                         mut_state[k] = _jnp.zeros(t.shape, t.dtype)
-                xs = feed_vals if feed_per_step else None
+                xs = {"i": _jnp.arange(n_steps, dtype=_jnp.int32)}
+                if feed_per_step:
+                    xs["feed"] = feed_vals
+                if guard is not None:
+                    xs["seed_mul"] = sentinel["seed_mul"]
+                    xs["loss_mul"] = sentinel["loss_mul"]
+                    carry0 = (mut_state, fetch0,
+                              _guardian.window_health_init(n_steps))
+                    (mut_final, last, agg), _ = _lax.scan(
+                        body, carry0, xs, length=n_steps)
+                    return last, mut_final, agg
                 (mut_final, last), _ = _lax.scan(
                     body, (mut_state, fetch0), xs, length=n_steps)
                 return last, mut_final
 
             device = core.get_jax_device(self.place)
-            donate = (2,) if device.platform == "tpu" else ()
-            entry = (plan, jax.jit(kfn, donate_argnums=donate))
+            donate = self._donate_argnums(device, program)
+            entry = (plan, jax.jit(kfn, donate_argnums=donate), guard)
             self._cache[key] = entry
-        plan, fn = entry
+        plan, fn, guard = entry
 
         from . import fault as _fault
+        from . import profiler as _prof
 
+        window_start = 0
         if program._params_grads is not None:
-            self._step_boundary(_fault, n_steps)
+            window_start = self._step_boundary(_fault, n_steps)
+        g = _guardian.current() if guard is not None else None
+        if g is not None:
+            # one-window-lag sentinel: observe the PREVIOUS dispatch's
+            # aggregated health and apply policy BEFORE this window runs
+            g.on_boundary()
         state_vals = self._gather_state(program, plan, scope)
         mut_names = set(plan.state_out)
         if plan.needs_rng:
             mut_names.add(RNG_STATE_VAR)
+        if guard is not None and guard.scale_vars:
+            mut_names.update(guard.scale_vars)
         mut_state = {k: v for k, v in state_vals.items() if k in mut_names}
         const_state = {k: v for k, v in state_vals.items()
                        if k not in mut_names}
         device = core.get_jax_device(self.place)
         feed_dev = {k: self._put_feed(k, v, device)
                     for k, v in feed_arrays.items()}
-        if probe is not None:
-            import time as _time
+        sentinel = None
+        dump_state = None
+        if guard is not None:
+            seed_mul, loss_mul = _fault.sentinel_injection_window(
+                window_start, n_steps)
+            sentinel = {
+                "loss_cap": np.float32(g.loss_cap() if g is not None
+                                       else float("inf")),
+                "seed_mul": seed_mul,
+                "loss_mul": loss_mul,
+            }
+            dump_state = state_vals
+            if g is not None and g.config.policy == "dump_and_halt" \
+                    and self._donate_argnums(device, program):
+                # donation invalidates mutated input buffers after the
+                # dispatch; dump mode keeps pre-window device copies alive
+                dump_state = {k: (jnp.array(v, copy=True) if k in mut_names
+                                  else v)
+                              for k, v in state_vals.items()}
+        import time as _time
 
-            _t_compile = _time.perf_counter()
-            fetches, new_state = fn(feed_dev, const_state, mut_state)
-            probe.finish(_time.perf_counter() - _t_compile, program,
-                         meta={"kind": "run_steps", "n_steps": int(n_steps)})
+        agg = None
+        t = _time.perf_counter()
+        if guard is not None:
+            fetches, new_state, agg = fn(feed_dev, const_state, mut_state,
+                                         sentinel)
         else:
-            fetches, new_state = fn(feed_dev, const_state, mut_state)
+            fetches, new_state = fn(feed_dev, const_state, mut_state, None)
+            if _prof.is_profiling():
+                jax.block_until_ready(fetches)
+        if _prof.is_profiling():
+            _prof.record_event(
+                f"executor_run[{len(plan.ops)}ops x{n_steps}steps]",
+                _time.perf_counter() - t, start=t)
+        # window visibility in the always-on counters (the smoke oracle
+        # counts dispatches; window_steps tracks amortization)
+        _prof.record_counter("executor.dispatches")
+        _prof.record_counter("executor.windows")
+        _prof.record_counter("executor.window_steps", inc=n_steps)
+        if probe is not None:
+            probe.finish(_time.perf_counter() - t, program,
+                         meta={"kind": "run_steps", "n_steps": n_steps})
         if _fault.active() is not None:
             new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():
             scope.set(name, val)
         self._check_nan_inf(list(new_state.items())
                             + list(zip(plan.fetch_names, fetches)))
+        if g is not None and agg is not None:
+            g.defer(guard, window_start, agg, {
+                "program": program, "feeds": feed_arrays,
+                "feed_lods": {}, "fetch_names": fetch_names,
+                "state": dump_state, "sentinel": sentinel,
+                "duration_s": _time.perf_counter() - t,
+                "window": {"start": window_start, "n_steps": n_steps,
+                           "feed_per_step": bool(feed_per_step)}})
+        if program._params_grads is not None:
+            from .. import observe
+
+            # events emitted after the window (checkpoint commits, cache
+            # probes) correlate to its LAST executed step, not its first
+            observe.note_step(window_start + n_steps - 1)
         return [np.asarray(v) for v in fetches]
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
@@ -809,7 +922,7 @@ class Executor:
             }
             dump_state = state_vals
             if g is not None and g.config.policy == "dump_and_halt" \
-                    and device.platform != "cpu":
+                    and self._donate_argnums(device, program):
                 # donation invalidates mutated input buffers after the
                 # dispatch; dump mode keeps pre-step device copies alive
                 dump_state = {k: (jnp.array(v, copy=True) if k in mut_names
@@ -833,6 +946,7 @@ class Executor:
             _prof.record_event(
                 f"executor_run[{len(plan.ops)}ops]",
                 _time.perf_counter() - t, start=t)
+        _prof.record_counter("executor.dispatches")
         if probe is not None:
             # first dispatch of a fresh entry = trace + compile; commit the
             # artifact (miss) / freshen it (hit) now that it exists
@@ -874,6 +988,28 @@ class Executor:
         return out
 
     # -- helpers --
+    @staticmethod
+    def _donate_argnums(device, program):
+        """Donation argnums for the jitted step: the mutable-state arg
+        (index 2) is donated so XLA aliases its buffers into the updated
+        state — a true in-place parameter update.  Modern jax implements
+        donation on every backend (cpu/gpu/tpu), and the executor already
+        protects the one read-after-donate hazard (fetches aliasing
+        mutated state are copied on return, executor.run's donated-fetch
+        path), so it is on for every TRAINING program (built via
+        optimizer.minimize, whose step loop is single-threaded by
+        contract).  Inference/eval programs never donate: predictor
+        clones run concurrently against one shared scope, and a donated
+        buffer deleted under a sibling thread's in-flight dispatch is the
+        one hazard copy-on-return cannot fix.  ``PADDLE_TPU_DONATE=0``
+        opts out entirely (debugging buffer lifetimes)."""
+        if program is not None and program._params_grads is None:
+            return ()
+        if os.environ.get("PADDLE_TPU_DONATE", "").strip().lower() \
+                in ("0", "false", "off"):
+            return ()
+        return (2,)
+
     @staticmethod
     def _step_boundary(_fault, n_steps=1):
         """Training-step boundary: fires armed step faults (kill-at-step-N)
@@ -937,15 +1073,23 @@ class Executor:
         if ent is not None:
             snap, dev_arr, misses = ent
             if misses is None:
-                return jax.device_put(arr, device)  # cache retired
-            if snap.shape == arr.shape and snap.dtype == arr.dtype \
+                # retired entry: snap records the (shape, dtype) that
+                # retired it.  Same geometry keeps transferring (fresh
+                # batches every step), but a geometry CHANGE — e.g. the
+                # name switching from train batches to a fixed eval feed —
+                # re-arms the cache instead of transferring forever
+                if snap == (arr.shape, str(arr.dtype)):
+                    return jax.device_put(arr, device)
+                ent = None
+            elif snap.shape == arr.shape and snap.dtype == arr.dtype \
                     and np.array_equal(snap, arr):
                 ent[2] = 0
                 return dev_arr
-            if misses + 1 >= 3:
+            elif misses + 1 >= 3:
                 # fresh batch every step (the normal training loop): stop
                 # paying the compare+snapshot tax and just transfer
-                self._feed_cache[name] = [None, None, None]
+                self._feed_cache[name] = [(arr.shape, str(arr.dtype)),
+                                          None, None]
                 return jax.device_put(arr, device)
         dev_arr = jax.device_put(arr, device)
         prev_misses = ent[2] if ent is not None else 0
@@ -956,7 +1100,7 @@ class Executor:
     def _build(self, program, plan, feed_lods=None, lod_box=None,
                guard=None, n_user=None):
         device = core.get_jax_device(self.place)
-        donate = (2,) if device.platform == "tpu" else ()
+        donate = self._donate_argnums(device, program)
         static_env = {k + LOD_SUFFIX: lod
                       for k, lod in (feed_lods or {}).items()}
 

@@ -82,7 +82,8 @@ __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
     "barrier_stall", "serving_request", "sentinel_injection",
-    "cache_corrupt", "current_step", "KILL_EXIT_CODE",
+    "sentinel_injection_window", "cache_corrupt", "current_step",
+    "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -289,6 +290,28 @@ def sentinel_injection(step: int):
     loss_mul = plan.loss_spike_factor \
         if plan.loss_spike_step == step else 1.0
     return seed_mul, loss_mul
+
+
+def sentinel_injection_window(start: int, n_steps: int):
+    """Vectorized :func:`sentinel_injection` for a fused ``run_steps``
+    window: ``(seed_mul, loss_mul)`` float32 arrays of shape ``(n_steps,)``
+    covering absolute steps ``[start, start + n_steps)``.  The guarded scan
+    consumes slice ``i`` at window step ``i``, so a grad-Inf armed at an
+    absolute step inside the window fires at exactly that step of the
+    scanned loop — same determinism contract as the per-step path."""
+    import numpy as np
+
+    seed = np.ones(n_steps, np.float32)
+    loss = np.ones(n_steps, np.float32)
+    plan = active()
+    if plan is not None and plan._applies_to_this_rank():
+        if plan.grad_inf_step is not None \
+                and start <= plan.grad_inf_step < start + n_steps:
+            seed[plan.grad_inf_step - start] = plan.grad_inf_value
+        if plan.loss_spike_step is not None \
+                and start <= plan.loss_spike_step < start + n_steps:
+            loss[plan.loss_spike_step - start] = plan.loss_spike_factor
+    return seed, loss
 
 
 def ckpt_crash_point(where: str) -> None:

@@ -310,6 +310,56 @@ def fold_health(spec: GuardSpec, extra_fetches, new_state: Dict,
     return committed, health
 
 
+def window_health_init(n_steps: int):
+    """Initial aggregated-health carry for a fused ``run_steps`` window.
+
+    The scan cannot ship one health record per step back to the host
+    without stacking ``n_steps`` buffers; instead the carry reduces the
+    window to the record the host actually acts on: the FIRST tripped
+    step (index + its health values — the trip the policy attributes),
+    the worst values seen anywhere in the window, and the trip count.
+    ``trip_idx == n_steps`` is the no-trip sentinel."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    return {
+        "trip_idx": jnp.full((), n_steps, jnp.int32),
+        "trip_loss": jnp.zeros((), f32),
+        "trip_grad_norm": jnp.zeros((), f32),
+        "trip_finite": jnp.asarray(True),
+        "bad_steps": jnp.zeros((), jnp.int32),
+        "worst_loss": jnp.full((), -jnp.inf, f32),
+        "worst_grad_norm": jnp.zeros((), f32),
+        "all_finite": jnp.asarray(True),
+        "scale": jnp.ones((), f32),
+    }
+
+
+def window_health_update(agg, health, step_i, n_steps: int):
+    """Fold one scanned step's health into the window aggregate (pure JAX,
+    runs inside the scan body)."""
+    import jax.numpy as jnp
+
+    first = (agg["trip_idx"] == n_steps) & ~health["ok"]
+    return {
+        "trip_idx": jnp.where(first, step_i.astype(jnp.int32),
+                              agg["trip_idx"]),
+        "trip_loss": jnp.where(first, health["loss"], agg["trip_loss"]),
+        "trip_grad_norm": jnp.where(first, health["grad_norm"],
+                                    agg["trip_grad_norm"]),
+        "trip_finite": jnp.where(first, health["finite"],
+                                 agg["trip_finite"]),
+        "bad_steps": agg["bad_steps"] + (~health["ok"]).astype(jnp.int32),
+        # maximum propagates NaN, so a NaN loss also poisons worst_loss —
+        # exactly what "worst" should report
+        "worst_loss": jnp.maximum(agg["worst_loss"], health["loss"]),
+        "worst_grad_norm": jnp.maximum(agg["worst_grad_norm"],
+                                       health["grad_norm"]),
+        "all_finite": agg["all_finite"] & health["finite"],
+        "scale": health["scale"],
+    }
+
+
 # ---------------------------------------------------------------------------
 # Host-side guardian (module singleton, env-armed like fluid.fault)
 # ---------------------------------------------------------------------------
@@ -339,8 +389,12 @@ class Guardian:
         self._check_pending()
 
     def defer(self, spec, step, health, ctx) -> None:
+        """Queue a dispatch's health for observation at the next boundary.
+        ``step`` is the dispatch's first absolute step; a fused window
+        (``ctx["window"]``) carries the AGGREGATED health of all its steps
+        (see :func:`window_health_init`)."""
         self._pending = (spec, step, health, ctx)
-        self.counters["steps"] += 1
+        self.counters["steps"] += (ctx.get("window") or {}).get("n_steps", 1)
 
     def flush(self) -> None:
         """Force-check the deferred health record (call after the last
@@ -355,16 +409,42 @@ class Guardian:
 
         spec, step, health, ctx = self._pending
         self._pending = None
-        rec = HealthRecord(
-            step=step,
-            loss=float(np.asarray(health["loss"])),
-            grad_norm=float(np.asarray(health["grad_norm"])),
-            scale=float(np.asarray(health["scale"])),
-            finite=bool(np.asarray(health["finite"])),
-            ok=bool(np.asarray(health["ok"])),
-            spike=False,
-            duration_s=ctx.get("duration_s", 0.0),
-        )
+        win = ctx.get("window")
+        if win is not None:
+            # fused window: materialize the aggregate (the dispatch has
+            # retired; these are a handful of scalars) and attribute the
+            # record to the FIRST tripped step's absolute index — or, on a
+            # clean window, to its last step with the worst values seen
+            n = int(win["n_steps"])
+            trip_idx = int(np.asarray(health["trip_idx"]))
+            tripped = trip_idx < n
+            win["trip_offset"] = trip_idx if tripped else None
+            win["bad_steps"] = int(np.asarray(health["bad_steps"]))
+            rec = HealthRecord(
+                step=step + (trip_idx if tripped else n - 1),
+                loss=float(np.asarray(
+                    health["trip_loss" if tripped else "worst_loss"])),
+                grad_norm=float(np.asarray(
+                    health["trip_grad_norm" if tripped
+                           else "worst_grad_norm"])),
+                scale=float(np.asarray(health["scale"])),
+                finite=bool(np.asarray(
+                    health["trip_finite" if tripped else "all_finite"])),
+                ok=not tripped,
+                spike=False,
+                duration_s=ctx.get("duration_s", 0.0),
+            )
+        else:
+            rec = HealthRecord(
+                step=step,
+                loss=float(np.asarray(health["loss"])),
+                grad_norm=float(np.asarray(health["grad_norm"])),
+                scale=float(np.asarray(health["scale"])),
+                finite=bool(np.asarray(health["finite"])),
+                ok=bool(np.asarray(health["ok"])),
+                spike=False,
+                duration_s=ctx.get("duration_s", 0.0),
+            )
         rec.spike = rec.finite and not rec.ok
         self.recorder.append(rec)
         self.last_scale = rec.scale
@@ -390,7 +470,7 @@ class Guardian:
                 bundle = self.dump_bundle(rec, spec, ctx)
             except Exception as exc:
                 LOG(f"guardian: replay-bundle dump failed: {exc!r}")
-        self._incident(rec, policy, bundle)
+        self._incident(rec, policy, bundle, window=ctx.get("window"))
         if policy == "skip":
             self.counters["skips"] += 1
             _prof.record_counter("guardian_skips")
@@ -403,17 +483,25 @@ class Guardian:
         raise NumericsTripped(rec, bundle)
 
     def _incident(self, rec: HealthRecord, policy: str,
-                  bundle: Optional[str]) -> None:
+                  bundle: Optional[str], window: Optional[dict] = None) -> None:
         """A guardian trip must be a recorded *decision*, not just a dead
         process: one stamped record in the run-event stream (where it
         correlates with the supervisor's generation restarts and the next
         generation's cache hits by (host, gen, step)), plus — under an
-        elastic supervisor — one line in the legacy incidents.jsonl view."""
+        elastic supervisor — one line in the legacy incidents.jsonl view.
+        A trip inside a fused window additionally records the window's
+        extent and trip count — the granularity the policy acted at."""
         from .. import observe
 
+        extra = {}
+        if window is not None:
+            extra = {"window_start": window["start"],
+                     "window_steps": window["n_steps"],
+                     "window_bad_steps": window.get("bad_steps")}
         observe.emit("guardian_trip", step=rec.step, policy=policy,
                      loss=rec.loss, grad_norm=rec.grad_norm, scale=rec.scale,
-                     finite=rec.finite, spike=rec.spike, bundle=bundle)
+                     finite=rec.finite, spike=rec.spike, bundle=bundle,
+                     **extra)
         path = os.environ.get("PADDLE_ELASTIC_INCIDENTS")
         if not path:
             return
@@ -451,6 +539,13 @@ class Guardian:
         np.savez(os.path.join(bdir, BUNDLE_STATE),
                  **{k: np.asarray(v) for k, v in ctx["state"].items()})
         loss32 = np.float32(rec.loss)
+
+        def _sent_json(v):
+            # per-step injection multipliers are (n_steps,) arrays in a
+            # fused-window bundle, scalars in a per-step one
+            a = np.asarray(v, np.float32)
+            return a.tolist() if a.ndim else float(a)
+
         meta = {
             "step": rec.step,
             "loss": rec.loss,
@@ -463,11 +558,22 @@ class Guardian:
             "extra_fetch_names": spec.extra_fetch_names(),
             "scale_vars": list(spec.scale_vars) if spec.scale_vars else None,
             "growth_interval": spec.growth_interval,
-            "sentinel": {k: float(v) for k, v in ctx["sentinel"].items()},
+            "sentinel": {k: _sent_json(v)
+                         for k, v in ctx["sentinel"].items()},
             "feed_lods": {k: [list(map(int, lv)) for lv in lod]
                           for k, lod in (ctx.get("feed_lods") or {}).items()},
             "program_cache_token": getattr(program, "_cache_token", None),
         }
+        win = ctx.get("window")
+        if win is not None:
+            # the bundle's state/feeds are PRE-WINDOW; replay advances
+            # trip_offset steps to reproduce the trip bit-for-bit
+            meta["window"] = {
+                "start": int(win["start"]),
+                "n_steps": int(win["n_steps"]),
+                "feed_per_step": bool(win.get("feed_per_step", False)),
+                "trip_offset": int(rec.step - win["start"]),
+            }
         with open(os.path.join(bdir, BUNDLE_META), "w") as f:
             json.dump(meta, f, indent=1)
         with open(os.path.join(bdir, BUNDLE_RECORDS), "w") as f:
@@ -562,14 +668,36 @@ def replay(bundle_dir: str, verbose: bool = False) -> dict:
 
     user_fetches = meta["fetch_names"]
     extra = meta["extra_fetch_names"]
-    sentinel = {k: np.float32(v) for k, v in meta["sentinel"].items()}
     spec = GuardSpec(extra[0], extra[1:],
                      meta.get("scale_vars"), meta.get("growth_interval", 1000))
 
-    plan = BlockPlan(program, 0, list(feeds), user_fetches + extra)
+    # window bundles store PRE-WINDOW state + the whole window's feeds and
+    # per-step injection arrays; a per-step bundle is the degenerate
+    # 1-step window with trip_offset 0, so one loop replays both
+    win = meta.get("window") or {"n_steps": 1, "trip_offset": 0,
+                                 "feed_per_step": False}
+    trip_offset = int(win["trip_offset"])
+    feed_per_step = bool(win["feed_per_step"])
+    sent_meta = meta["sentinel"]
+    loss_cap = np.float32(sent_meta.get("loss_cap", np.inf))
+    seed_muls = np.asarray(sent_meta.get("seed_mul", 1.0),
+                           np.float32).reshape(-1)
+    loss_muls = np.asarray(sent_meta.get("loss_mul", 1.0),
+                           np.float32).reshape(-1)
+
+    def _step_feed(arrs, i):
+        return {k: v[i] for k, v in arrs.items()} if feed_per_step else arrs
+
+    def _step_sent(i):
+        return {"loss_cap": loss_cap,
+                "seed_mul": seed_muls[min(i, len(seed_muls) - 1)],
+                "loss_mul": loss_muls[min(i, len(loss_muls) - 1)]}
+
+    feed_keys = list(_step_feed(feeds, 0))
+    plan = BlockPlan(program, 0, feed_keys, user_fetches + extra)
     static_env = {k + LOD_SUFFIX: tuple(tuple(lv) for lv in lod)
                   for k, lod in (meta.get("feed_lods") or {}).items()}
-    # the bundle's state IS the step's exact input set (including the
+    # the bundle's state IS the window's exact input set (including the
     # scaler vars the executor force-gathers outside plan.state_in)
     state = {k: jnp.asarray(v) for k, v in state_np.items()}
 
@@ -582,24 +710,34 @@ def replay(bundle_dir: str, verbose: bool = False) -> dict:
         fetches, new_state = trace_block(program, 0, plan, feed_vals,
                                          env_state, static_env=static_env)
         mut = {k: v for k, v in new_state.items() if k in env_state}
-        _, health = fold_health(spec, fetches[n_user:], new_state, mut,
-                                env_state, sent)
-        return fetches, health
+        committed, health = fold_health(spec, fetches[n_user:], new_state,
+                                        mut, env_state, sent)
+        return fetches, health, committed
 
     feeds_j = {k: jnp.asarray(v) for k, v in feeds.items()}
-    fetches, health = jax.jit(step)(feeds_j, state, sentinel)
+    jstep = jax.jit(step)
+    # committed-state walk up to the trip step (clean prefix steps commit
+    # exactly like the scanned window did)
+    for i in range(trip_offset):
+        _, _, committed = jstep(_step_feed(feeds_j, i), state, _step_sent(i))
+        state = {**state, **committed}
+    pre_trip_state = dict(state)
+    trip_feed = _step_feed(feeds_j, trip_offset)
+    trip_sent = _step_sent(trip_offset)
+    fetches, health, _ = jstep(trip_feed, state, trip_sent)
     replayed_loss = np.float32(np.asarray(health["loss"]))
     recorded_bits = meta["loss_bits"]
     replayed_bits = replayed_loss.tobytes().hex()
     # NaNs never compare equal; the BIT pattern is the reproduction check
     bitwise_match = replayed_bits == recorded_bits
 
-    # eager bisect: concrete op-by-op walk, first non-finite var wins
+    # eager bisect of the TRIP step: concrete op-by-op walk from the
+    # committed pre-trip state, first non-finite var wins
     env: Dict[str, object] = {}
     env.update(static_env)
-    env.update({k: jnp.asarray(v) for k, v in state_np.items()})
-    env.update(feeds_j)
-    env[LOSS_SEED_MUL] = seed_multiplier(spec, env, sentinel)
+    env.update(pre_trip_state)
+    env.update(trip_feed)
+    env[LOSS_SEED_MUL] = seed_multiplier(spec, env, trip_sent)
     rng_box = [env[RNG_STATE_VAR]] if plan.needs_rng else None
     first_bad = None
     trail = []
@@ -638,6 +776,7 @@ def replay(bundle_dir: str, verbose: bool = False) -> dict:
         "bitwise_match": bitwise_match,
         "first_nonfinite": first_bad,
         "n_ops": len(plan.ops),
+        "window": meta.get("window"),
     }
     if verbose:
         report["trail"] = trail

@@ -1,0 +1,214 @@
+"""Double-buffered host→device input prefetch for windowed training.
+
+The fused window (``Executor.run_steps``) removes per-step dispatch
+latency, which leaves input staging as the serial tail: a ``feed_per_step``
+training loop reads window k's batches, stacks them to ``(n_steps, ...)``
+arrays and ships them host→device *between* dispatches, so the device
+idles while the host does IO.  :class:`DevicePrefetcher` moves that work
+onto a background thread with a bounded queue of device-resident windows —
+while the device runs window k, the host is already reading and
+``device_put``-ing window k+1 (the host-side analogue of the reference's
+in-graph reader loop, ref benchmark/fluid/fluid_benchmark.py:149, where
+the data pipeline runs concurrently with compute by construction).
+
+Contract (matches the reader decorators' PR-3 hardening):
+
+ - bounded depth: at most ``depth`` staged windows are ever alive
+   (``PADDLE_TPU_PREFETCH_DEPTH``, default 2 — double buffering); device
+   memory use is bounded at ``depth x window_bytes``;
+ - worker exceptions propagate to the consumer instead of silently
+   killing the thread (which would deadlock the consumer's queue get);
+ - clean shutdown: an early-exiting consumer (``stop()``/break) flips an
+   abort event and the worker drains via timeout-puts, never wedging on a
+   queue nobody reads;
+ - ``depth=0`` stages synchronously in the caller's thread — the
+   baseline the overlap oracle (tests/test_prefetch.py) compares against.
+
+``fluid.fault.io_delay()`` is consulted once per staged window, so
+``PADDLE_FAULT_IO_DELAY_MS`` deterministically models slow input IO: the
+synchronous path pays it inline, the prefetched path overlaps it with the
+device's current window.
+"""
+
+from __future__ import annotations
+
+import os
+from queue import Empty, Full, Queue
+from threading import Event, Thread
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["DevicePrefetcher", "default_depth", "iter_device_samples"]
+
+_END = object()
+
+
+class _WorkerError:
+    """Exception captured on the staging thread, queued so the CONSUMER
+    re-raises it (same contract as reader.decorator's buffered/xmap)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def default_depth() -> int:
+    """The env-configured prefetch depth (``PADDLE_TPU_PREFETCH_DEPTH``,
+    default 2: double buffering — one window on device, one staging)."""
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_PREFETCH_DEPTH", "")
+                          or 2))
+    except ValueError:
+        return 2
+
+
+def _resolve_device(place):
+    import jax
+
+    if place is not None:
+        from . import core
+
+        return core.get_jax_device(place)
+    return jax.devices()[0]
+
+
+def _background_iter(src_iter, stage_fn, depth: int, abort: Event):
+    """Yield ``stage_fn(item)`` for every item of ``src_iter``, with the
+    staging running on a background thread ``depth`` items ahead."""
+    q: Queue = Queue(maxsize=max(1, depth))
+
+    def _put(item) -> bool:
+        while not abort.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except Full:
+                continue
+        return False
+
+    def work():
+        try:
+            for item in src_iter:
+                if abort.is_set():
+                    return
+                if not _put(stage_fn(item)):
+                    return
+        except BaseException as exc:
+            _put(_WorkerError(exc))
+            return
+        _put(_END)
+
+    t = Thread(target=work, name="device-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            try:
+                item = q.get(timeout=0.05)
+            except Empty:
+                if not t.is_alive() and q.empty():
+                    # worker died without posting (only possible if abort
+                    # raced its final put) — nothing more is coming
+                    return
+                continue
+            if item is _END:
+                return
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            yield item
+    finally:
+        abort.set()
+
+
+def _windows(source, n_steps: int):
+    batches = []
+    for sample in source:
+        batches.append(sample)
+        if len(batches) == n_steps:
+            yield batches
+            batches = []
+    if batches:
+        yield batches  # tail window (count < n_steps)
+
+
+class DevicePrefetcher:
+    """Iterate ``(feed_dev, count)`` windows staged on the device.
+
+    ``source`` is an iterable of per-step feed dicts (``{name: array}``,
+    what ``DataFeeder.feed`` returns); every ``n_steps`` consecutive dicts
+    are stacked to a leading window dim and ``device_put`` — ready to pass
+    straight to ``Executor.run_steps(feed=feed_dev, n_steps=count,
+    feed_per_step=True)``.  The final window may be short (``count <
+    n_steps``); the caller dispatches it with its actual count.
+    """
+
+    def __init__(self, source: Iterable[Dict[str, object]], n_steps: int = 1,
+                 place=None, depth: Optional[int] = None):
+        self.n_steps = max(1, int(n_steps))
+        self.depth = default_depth() if depth is None else max(0, int(depth))
+        self._source = source
+        self._place = place
+        self._device = None
+        self._abort = Event()
+
+    # -- staging --
+    def _stage(self, batches) -> Tuple[Dict[str, object], int]:
+        from . import fault as _fault
+
+        _fault.io_delay()  # deterministic slow-input oracle (module doc)
+        import jax
+
+        if self._device is None:
+            self._device = _resolve_device(self._place)
+        window = {}
+        for name in batches[0]:
+            window[name] = jax.device_put(
+                np.stack([np.asarray(b[name]) for b in batches]),
+                self._device)
+        return window, len(batches)
+
+    def __iter__(self):
+        wins = _windows(self._source, self.n_steps)
+        if self.depth == 0:
+            # synchronous mode: stage in the caller's thread, on demand
+            for batches in wins:
+                if self._abort.is_set():
+                    return
+                yield self._stage(batches)
+            return
+        yield from _background_iter(wins, self._stage, self.depth,
+                                    self._abort)
+
+    def close(self) -> None:
+        """Stop the staging thread; safe to call repeatedly."""
+        self._abort.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def iter_device_samples(reader, depth: Optional[int] = None, place=None):
+    """Sample-level device staging for the reader-decorator surface
+    (:func:`paddle_tpu.reader.decorator.device_buffered`): yield the
+    reader's samples with every array element already ``device_put``, the
+    transfers issued ``depth`` samples ahead on a background thread."""
+    import jax
+
+    device = _resolve_device(place)
+    depth = default_depth() if depth is None else max(1, int(depth))
+
+    def stage(sample):
+        def put(x):
+            return (jax.device_put(x, device)
+                    if isinstance(x, np.ndarray) else x)
+
+        if isinstance(sample, dict):
+            return {k: put(v) for k, v in sample.items()}
+        if isinstance(sample, (tuple, list)):
+            return type(sample)(put(x) for x in sample)
+        return put(sample)
+
+    yield from _background_iter(iter(reader()), stage, depth, Event())

@@ -339,13 +339,29 @@ class Trainer:
     def train(self, num_epochs, event_handler, reader=None, feed_order=None):
         """Epoch/step loop with events; resumes from a restored epoch/step
         (skipping already-consumed steps of the restored epoch, ref
-        trainer.py:1060 trainer args)."""
+        trainer.py:1060 trainer args).
+
+        ``PADDLE_TPU_SPD=K`` (steps per dispatch, K>1) switches to the
+        windowed production loop: K steps fuse into one ``run_steps``
+        dispatch (guardian sentinel and dynamic fp16 loss scale included —
+        they ride the scan carry) while a
+        :class:`~paddle_tpu.fluid.prefetch.DevicePrefetcher` stages the
+        NEXT window's batches onto the device concurrently
+        (``PADDLE_TPU_PREFETCH_DEPTH``).  Step events then fire once per
+        window and checkpoint step cadence is preserved at window
+        granularity; LoD (variable-length) feeds need the per-step loop.
+        """
         start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
         feeder = DataFeeder(feed_list=feed_order, place=self.place,
                             program=self.train_program)
+        spd = int(os.environ.get("PADDLE_TPU_SPD", "0") or 0)
         try:
-            self._train_loop(start_epoch, num_epochs, event_handler, reader,
-                             feeder)
+            if spd > 1:
+                self._train_loop_windowed(start_epoch, num_epochs,
+                                          event_handler, reader, feeder, spd)
+            else:
+                self._train_loop(start_epoch, num_epochs, event_handler,
+                                 reader, feeder)
         except BaseException:
             if self.checkpoint_cfg and self.checkpoint_cfg.async_save:
                 # drain writes so the newest checkpoint lands, but never
@@ -401,6 +417,59 @@ class Trainer:
         if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
             # final state is always captured so resume never replays work
             # (skipped when the in-loop epoch save already wrote it)
+            self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True)
+
+    def _train_loop_windowed(self, start_epoch, num_epochs, event_handler,
+                             reader, feeder, n_steps):
+        """The fused-window loop: the prefetcher stages window k+1 while
+        the device runs window k, and each window is one ``run_steps``
+        dispatch.  A checkpoint fires whenever the window crossed a
+        ``step_interval`` boundary, stamped with the window's last step —
+        so resume lands on the same steps the per-step loop would have
+        saved."""
+        import itertools
+
+        from .prefetch import DevicePrefetcher
+
+        last_epoch_saved = None
+        iv = self.checkpoint_cfg.step_interval if self.checkpoint_cfg else 0
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            skip_until = (self.checkpoint_cfg.step_id
+                          if self.checkpoint_cfg and
+                          epoch_id == self.checkpoint_cfg.epoch_id else 0)
+            feeds = itertools.islice(
+                (feeder.feed(data) for data in reader()), skip_until, None)
+            step_id = skip_until
+            with DevicePrefetcher(feeds, n_steps=n_steps,
+                                  place=self.place) as pf:
+                for feed_dev, count in pf:
+                    if self.stop_flag:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = (self.train_func_outputs
+                             if begin.fetch_metrics else [])
+                    metrics = self.exe.run_steps(
+                        self.train_program, feed=feed_dev, fetch_list=fetch,
+                        n_steps=count, feed_per_step=True)
+                    last_step = step_id + count - 1
+                    event_handler(EndStepEvent(epoch_id, last_step, metrics))
+                    if self.checkpoint_cfg and \
+                            (last_step + 1) // iv > step_id // iv:
+                        self._save_checkpoint(epoch_id, last_step)
+                    step_id += count
+            if self.checkpoint_cfg and \
+                    (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0:
+                self._save_checkpoint(epoch_id, -1, end_of_epoch=True)
+                last_epoch_saved = epoch_id
+            event_handler(EndEpochEvent(epoch_id))
+        # same teardown as the per-step loop: surface a last-window trip,
+        # capture final state
+        from . import guardian as _guardian
+
+        _guardian.flush()
+        if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
             self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True)
 
     def test(self, reader, feed_order):

@@ -8,7 +8,7 @@ from queue import Empty, Full, Queue
 from threading import Event, Thread
 
 __all__ = ["PipeReader", "map_readers", "buffered", "compose", "chain", "shuffle",
-           "firstn", "xmap_readers", "cache"]
+           "firstn", "xmap_readers", "cache", "device_buffered"]
 
 
 class _WorkerError:
@@ -217,6 +217,29 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             # the remaining threads drain via their timeout loops instead
             # of blocking forever on a queue nobody reads
             abort.set()
+
+    return data_reader
+
+
+def device_buffered(reader, size=None, place=None):
+    """Like :func:`buffered`, but the worker thread also issues the
+    host→device transfer for every array in the sample, so samples arrive
+    at the consumer already device-resident — the H2D copy overlaps the
+    consumer's compute instead of serializing with it (the Executor passes
+    pre-placed jax arrays straight through, ``Executor._coerce_feed``).
+
+    ``size`` bounds the number of in-flight staged samples (default
+    ``PADDLE_TPU_PREFETCH_DEPTH``); worker exceptions propagate to the
+    consumer and an early-exiting consumer never wedges the worker — the
+    same contract as :func:`buffered`/:func:`xmap_readers`.  For staging
+    whole ``run_steps`` windows, use
+    :class:`paddle_tpu.fluid.prefetch.DevicePrefetcher`, which this
+    delegates to."""
+
+    def data_reader():
+        from ..fluid.prefetch import iter_device_samples
+
+        yield from iter_device_samples(reader, depth=size, place=place)
 
     return data_reader
 

@@ -284,12 +284,33 @@ def test_fp16_dynamic_scaling_trains_mnist_mlp_to_bf16_band():
         < 0.5 * max(np.mean(bf16[-5:]), 0.2)
 
 
-def test_run_steps_rejects_scaler_programs():
-    amp.enable("float16")
+def test_run_steps_accepts_scaler_programs_and_shrinks_on_overflow():
+    """ISSUE 6: the fused window no longer rejects dynamic-fp16-scaled
+    programs — the scale update (grow x2/interval, shrink /2 + skip on
+    overflow) rides the scan carry.  An overflow injected INSIDE the
+    window shrinks the scale and the window still completes."""
+    amp.enable("float16", init_loss_scale=2.0 ** 8, growth_interval=100)
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
     exe, loss = _build_mlp()
-    with pytest.raises(RuntimeError, match="loss scaling"):
-        exe.run_steps(fluid.default_main_program(), _feed(0), [loss],
-                      n_steps=4)
+    scope = fluid.global_scope()
+    (l,) = exe.run_steps(fluid.default_main_program(), _feed(0), [loss],
+                         n_steps=4)
+    assert np.isfinite(float(np.asarray(l).reshape(-1)[0]))
+    # one overflow inside the window: 256 -> 128, no regrow yet
+    assert float(np.asarray(scope.get(amp.LOSS_SCALE_VAR))[0]) == 128.0
+
+
+def test_run_steps_guarded_window_skip_counts():
+    """A guarded window reports aggregated health: one trip, n_steps
+    accounted, training state advances for the clean steps."""
+    guardian.enable(policy="skip")
+    fault.install(fault.FaultPlan(grad_inf_step=1, mode="raise"))
+    exe, loss = _build_mlp()
+    exe.run_steps(fluid.default_main_program(), _feed(0), [loss], n_steps=5)
+    guardian.flush()
+    m = guardian.metrics()
+    assert m["steps"] == 5 and m["trips"] == 1 and m["skips"] == 1
+    assert fluid.profiler.counters().get("executor.window_steps", 0) >= 5
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,236 @@
+"""DevicePrefetcher: double-buffered host→device input staging for the
+fused training window (ISSUE 6).
+
+Covers the reader-contract hardening (worker exceptions propagate, early
+exit never wedges), window stacking/tail semantics, the decorator-surface
+``device_buffered``, the CI window smoke, and the overlap oracle: under an
+injected input-IO delay (``PADDLE_FAULT_IO_DELAY_MS``), the prefetched
+``feed_per_step`` training loop's wall-clock is measurably below the
+synchronous (depth=0) baseline, because staging window k+1 overlaps
+window k's dispatch."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import fault
+from paddle_tpu.fluid.prefetch import DevicePrefetcher, default_depth
+from paddle_tpu.reader import decorator
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _feeds(n, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        yield {"x": rng.normal(size=(8, dim)).astype(np.float32),
+               "y": rng.normal(size=(8, 1)).astype(np.float32)}
+
+
+def test_windows_stack_and_tail():
+    """10 per-step feeds at n_steps=4 -> windows of 4, 4 and a 2-step
+    tail, each stacked on the leading dim and already device-resident."""
+    got = list(DevicePrefetcher(_feeds(10), n_steps=4,
+                                place=fluid.CPUPlace(), depth=2))
+    assert [count for _, count in got] == [4, 4, 2]
+    for feed_dev, count in got:
+        assert set(feed_dev) == {"x", "y"}
+        assert feed_dev["x"].shape == (count, 8, 4)
+        assert isinstance(feed_dev["x"], jax.Array)
+    # values survive the stack+transfer round trip in order
+    ref = list(_feeds(10))
+    np.testing.assert_array_equal(np.asarray(got[0][0]["x"])[1], ref[1]["x"])
+    np.testing.assert_array_equal(np.asarray(got[2][0]["y"])[1], ref[9]["y"])
+
+
+def test_worker_exception_propagates_to_consumer():
+    class Boom(RuntimeError):
+        pass
+
+    def bad_feeds():
+        yield from _feeds(3)
+        raise Boom("reader died")
+
+    pf = DevicePrefetcher(bad_feeds(), n_steps=2, place=fluid.CPUPlace(),
+                          depth=2)
+    with pytest.raises(Boom, match="reader died"):
+        for _ in pf:
+            pass
+
+
+def test_early_exit_does_not_wedge():
+    """A consumer that stops after one window (stop_flag / break) must not
+    leave the staging thread blocked on a full queue."""
+    pf = DevicePrefetcher(_feeds(64), n_steps=2, place=fluid.CPUPlace(),
+                          depth=2)
+    for _ in pf:
+        break
+    pf.close()
+    t0 = time.time()
+    # a second iteration after close yields nothing rather than hanging
+    assert list(pf) == []
+    assert time.time() - t0 < 5.0
+
+
+def test_depth_zero_is_synchronous():
+    got = list(DevicePrefetcher(_feeds(4), n_steps=2,
+                                place=fluid.CPUPlace(), depth=0))
+    assert [count for _, count in got] == [2, 2]
+
+
+def test_default_depth_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PREFETCH_DEPTH", "5")
+    assert default_depth() == 5
+    monkeypatch.setenv("PADDLE_TPU_PREFETCH_DEPTH", "")
+    assert default_depth() == 2
+
+
+def test_device_buffered_decorator():
+    """reader.decorator.device_buffered: samples arrive device-resident,
+    order preserved, errors propagate (the buffered/xmap contract)."""
+
+    def reader():
+        for i in range(6):
+            yield (np.full((3,), i, np.float32), i)
+
+    out = list(decorator.device_buffered(reader, size=2,
+                                         place=fluid.CPUPlace())())
+    assert len(out) == 6
+    for i, (arr, tag) in enumerate(out):
+        assert isinstance(arr, jax.Array)
+        assert tag == i
+        np.testing.assert_array_equal(np.asarray(arr), np.full((3,), i))
+
+    def bad_reader():
+        yield (np.zeros((3,), np.float32), 0)
+        raise ValueError("decode failed")
+
+    with pytest.raises(ValueError, match="decode failed"):
+        list(decorator.device_buffered(bad_reader, size=2,
+                                       place=fluid.CPUPlace())())
+
+
+def _build_train(seed=5):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=8, act="relu")
+    pred = fluid.layers.fc(input=h, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def test_prefetch_overlaps_injected_io_delay():
+    """The overlap oracle: with PADDLE_FAULT_IO_DELAY_MS armed (the
+    prefetcher consults fault.io_delay once per staged window), the
+    prefetched feed_per_step loop beats the synchronous depth=0 baseline
+    by roughly the staging time it hid.  The per-window sleep stands in
+    for device occupancy (on this CPU backend the dispatch returns almost
+    immediately, where a real accelerator window would keep the device
+    busy while the host stages)."""
+    exe, loss = _build_train()
+    n_windows, spd, delay_ms, busy_s = 6, 4, 40, 0.04
+
+    def run_loop(depth):
+        fault.install(fault.FaultPlan(io_delay_ms=delay_ms, mode="raise"))
+        t0 = time.perf_counter()
+        with DevicePrefetcher(_feeds(n_windows * spd), n_steps=spd,
+                              place=fluid.CPUPlace(), depth=depth) as pf:
+            for feed_dev, count in pf:
+                exe.run_steps(fluid.default_main_program(), feed=feed_dev,
+                              fetch_list=[loss], n_steps=count,
+                              feed_per_step=True)
+                time.sleep(busy_s)
+        fault.clear()
+        return time.perf_counter() - t0
+
+    run_loop(2)  # compile outside the timed comparison
+    t_sync = run_loop(0)
+    t_pre = run_loop(2)
+    # sync pays delay + busy serially every window (~0.48 s); prefetch
+    # hides all but the first window's delay (~0.28 s).  Margin is half
+    # the hideable staging time — comfortably inside CI jitter.
+    hideable = (n_windows - 1) * delay_ms / 1000.0
+    assert t_pre < t_sync - 0.5 * hideable, (t_sync, t_pre)
+
+
+def test_trainer_windowed_loop(tmp_path, monkeypatch):
+    """PADDLE_TPU_SPD=K drives Trainer.train through prefetched run_steps
+    windows: events fire once per window with the window's step ids, all
+    samples are consumed, checkpoint cadence lands on interval crossings,
+    and the final params match training (spot check: loss decreases)."""
+    monkeypatch.setenv("PADDLE_TPU_SPD", "3")
+    rng = np.random.RandomState(2)
+
+    def train_func():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    def reader():
+        r = np.random.RandomState(4)
+        for _ in range(8):  # windows of 3, 3, 2
+            x = r.normal(size=(16, 8)).astype(np.float32)
+            yield from [(x[i], x[i, :1] * 2.0) for i in range(16)]
+
+    events = []
+
+    def handler(ev):
+        events.append(ev)
+
+    ckpt = fluid.CheckpointConfig(checkpoint_dir=str(tmp_path),
+                                  step_interval=4)
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+        place=fluid.CPUPlace(), checkpoint_config=ckpt)
+
+    def batched():
+        batch = []
+        for s in reader():
+            batch.append(s)
+            if len(batch) == 16:
+                yield batch
+                batch = []
+
+    trainer.train(num_epochs=1, event_handler=handler, reader=batched,
+                  feed_order=["x", "y"])
+    steps = [(e.step, getattr(e, "metrics", None)) for e in events
+             if isinstance(e, fluid.EndStepEvent)]
+    # 8 batches at spd=3 -> windows ending at steps 2, 5, 7
+    assert [s for s, _ in steps] == [2, 5, 7]
+    losses = [float(np.asarray(m[0]).reshape(-1)[0]) for _, m in steps]
+    assert losses[-1] < losses[0]
+    # interval-4 crossings inside windows [3,5] and [6,7] -> two mid-epoch
+    # saves (same count as the per-step loop's steps 3 and 7), plus the
+    # end-of-epoch save
+    import paddle_tpu.fluid.trainer as _trainer
+
+    serials = [s for s, _ in _trainer._serial_dirs(str(tmp_path))]
+    assert len(serials) == 3
+
+
+def test_window_smoke_tool():
+    """tools/window_smoke.py: 16-step guarded window + prefetch completes
+    in <=2 dispatches (the tier-1 CI oracle, <5 s)."""
+    import tools.window_smoke as smoke
+
+    report = smoke.main()
+    assert report["ok"], report
+    assert report["dispatches"] <= 2
+    assert report["window_steps"] == 16

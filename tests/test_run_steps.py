@@ -1,11 +1,30 @@
 """Executor.run_steps: K training steps in one dispatch (lax.scan over the
 traced step, donated state carry) must reproduce K sequential Executor.run
-calls exactly — the TPU host-loop amortization behind the bench."""
+calls exactly — the TPU host-loop amortization behind the bench.
+
+Since ISSUE 6 the scan also carries the guardian's numerics sentinel
+(commit gate + aggregated window health) and the dynamic fp16 loss scale:
+a guarded + scaled window must be BITWISE equal to the per-step path,
+including a step with an injected overflow (skip + scale-shrink inside the
+window)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 import paddle_tpu.fluid.executor as _executor
+from paddle_tpu.fluid import amp, fault, guardian
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    fault.clear()
+    guardian.disable()
+    amp.disable()
+    yield
+    fault.clear()
+    guardian.disable()
+    amp.disable()
 
 
 def _build(seed=13):
@@ -102,3 +121,223 @@ def test_run_steps_with_lr_decay_write_only_state():
                          feed={"img": x, "label": y}, fetch_list=[loss],
                          n_steps=5)
     assert np.isfinite(float(np.asarray(l).reshape(-1)[0]))
+
+
+# ---------------------------------------------------------------------------
+# guarded + fp16-scaled windows (ISSUE 6 tentpole)
+# ---------------------------------------------------------------------------
+
+
+N_EQ_STEPS = 6
+
+
+def _build_guarded_mlp(seed=7):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=8, act="relu")
+    pred = fluid.layers.fc(input=h, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _window_feeds(n=N_EQ_STEPS):
+    rng = np.random.RandomState(0)
+    return {"x": rng.normal(size=(n, 8, 4)).astype(np.float32),
+            "y": rng.normal(size=(n, 8, 1)).astype(np.float32)}
+
+
+def _run_guarded(mode, fs, overflow_step=2, n=N_EQ_STEPS):
+    """One fresh build + N guarded fp16-scaled steps with an injected
+    grad-Inf at ``overflow_step``; returns (final scope state, metrics)."""
+    amp.enable("float16", init_loss_scale=2.0 ** 8, growth_interval=3)
+    guardian.enable(policy="skip")
+    fault.install(fault.FaultPlan(grad_inf_step=overflow_step, mode="raise"))
+    from paddle_tpu.fluid import framework as fw
+
+    with fw.program_guard(fw.Program(), fw.Program()), \
+            fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        exe, loss = _build_guarded_mlp()
+        scope = fluid.global_scope()
+        if mode == "per_step":
+            for i in range(n):
+                (out,) = exe.run(fluid.default_main_program(),
+                                 feed={"x": fs["x"][i], "y": fs["y"][i]},
+                                 fetch_list=[loss])
+        else:
+            (out,) = exe.run_steps(fluid.default_main_program(), feed=fs,
+                                   fetch_list=[loss], n_steps=n,
+                                   feed_per_step=True)
+        guardian.flush()
+        state = {k: np.asarray(scope.get(k)) for k in scope.keys()
+                 if scope.get(k) is not None}
+    metrics = dict(guardian.metrics())
+    amp.disable()
+    guardian.disable()
+    fault.clear()
+    return state, np.asarray(out), metrics
+
+
+def test_guarded_fp16_window_bitwise_equals_per_step():
+    """The acceptance oracle: N guarded + dynamic-fp16-scaled steps via one
+    run_steps window == N Executor.run calls BIT-FOR-BIT — params,
+    momentum accumulators, loss scale, good-step counter and RNG key —
+    including the injected overflow step (skip + scale /2 inside the
+    window)."""
+    fs = _window_feeds()
+    ref, ref_out, m_ref = _run_guarded("per_step", fs)
+    win, win_out, m_win = _run_guarded("window", fs)
+    assert m_ref["trips"] == 1 and m_ref["skips"] == 1
+    assert m_win["trips"] == 1 and m_win["skips"] == 1
+    assert m_win["steps"] == N_EQ_STEPS
+    # scale shrank at the overflow and the survivors match exactly
+    assert m_win["loss_scale"] == m_ref["loss_scale"]
+    assert sorted(ref) == sorted(win)
+    for k in sorted(ref):
+        assert np.array_equal(ref[k], win[k], equal_nan=True), k
+    np.testing.assert_array_equal(ref_out, win_out)
+
+
+def test_window_trip_has_absolute_step_and_halts():
+    """halt policy at window granularity: the aggregated health record
+    carries the FIRST tripped step's ABSOLUTE index."""
+    guardian.enable(policy="halt")
+    fault.install(fault.FaultPlan(grad_inf_step=9, mode="raise"))
+    exe, loss = _build_guarded_mlp()
+    fs = _window_feeds(4)
+    # window [0,4) is clean; window [4,8) is clean; trip in [8,12)
+    exe.run_steps(fluid.default_main_program(), feed=fs, fetch_list=[loss],
+                  n_steps=4, feed_per_step=True)
+    exe.run_steps(fluid.default_main_program(), feed=fs, fetch_list=[loss],
+                  n_steps=4, feed_per_step=True)
+    with pytest.raises(guardian.NumericsTripped) as ei:
+        exe.run_steps(fluid.default_main_program(), feed=fs,
+                      fetch_list=[loss], n_steps=4, feed_per_step=True)
+        guardian.flush()
+    assert ei.value.record.step == 9
+    assert not ei.value.record.finite
+
+
+def test_window_trip_lands_in_observe_stream(tmp_path, monkeypatch):
+    """Acceptance: a window-level guardian trip is one stamped record in
+    the observe event stream with the correct absolute step index and the
+    window extent."""
+    import json
+
+    monkeypatch.setenv("PADDLE_OBSERVE_DIR", str(tmp_path))
+    from paddle_tpu import observe
+
+    observe.reset()
+    guardian.enable(policy="skip")
+    fault.install(fault.FaultPlan(grad_inf_step=5, mode="raise"))
+    exe, loss = _build_guarded_mlp()
+    fs = _window_feeds(4)
+    for _ in range(2):  # steps [0,4) then [4,8); trip at absolute step 5
+        exe.run_steps(fluid.default_main_program(), feed=fs,
+                      fetch_list=[loss], n_steps=4, feed_per_step=True)
+    guardian.flush()
+    observe.reset()  # flush file handles
+    events = []
+    for p in tmp_path.glob("events-*.jsonl"):
+        events += [json.loads(l) for l in p.read_text().splitlines()]
+    trips = [e for e in events if e.get("event") == "guardian_trip"]
+    assert len(trips) == 1, events
+    assert trips[0]["step"] == 5
+    assert trips[0]["window_start"] == 4
+    assert trips[0]["window_steps"] == 4
+    assert trips[0]["window_bad_steps"] == 1
+
+
+def test_window_dump_bundle_replays_trip_bitwise(tmp_path):
+    """dump_and_halt inside a window: the bundle captures the PRE-WINDOW
+    state and guardian.replay walks the window's clean prefix, reproduces
+    the trip step's loss bit-for-bit and bisects the poisoned gradient."""
+    amp.enable("float16", init_loss_scale=2.0 ** 8, growth_interval=3)
+    guardian.enable(policy="dump_and_halt", bundle_dir=str(tmp_path))
+    fault.install(fault.FaultPlan(grad_inf_step=3, mode="raise"))
+    exe, loss = _build_guarded_mlp()
+    fs = _window_feeds()
+    bundle = None
+    try:
+        exe.run_steps(fluid.default_main_program(), feed=fs,
+                      fetch_list=[loss], n_steps=N_EQ_STEPS,
+                      feed_per_step=True)
+        guardian.flush()
+    except guardian.NumericsTripped as exc:
+        bundle = exc.bundle
+    assert bundle, "window trip did not dump a bundle"
+    report = guardian.replay(bundle)
+    assert report["window"] == {"start": 0, "n_steps": N_EQ_STEPS,
+                                "feed_per_step": True, "trip_offset": 3}
+    assert report["step"] == 3
+    assert report["bitwise_match"], report
+    assert report["first_nonfinite"] is not None
+    assert "@GRAD" in report["first_nonfinite"]["var"]
+
+
+# ---------------------------------------------------------------------------
+# donation + feed-cache satellites
+# ---------------------------------------------------------------------------
+
+
+def test_donated_then_read_fetch_survives():
+    """Donation is now on for non-TPU backends too: a fetch handle that
+    aliases mutated state (return_numpy=False) must survive the NEXT run's
+    donation of that buffer — the executor's copy-on-return path."""
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(size=(8, 16)).astype(np.float32),
+            "label": rng.randint(0, 10, size=(8, 1)).astype(np.int64)}
+    # fetch a PARAMETER (mutated state) as a device-resident handle
+    param = next(n for n in _executor._global_scope.keys() if ".w_" in n)
+    (handle,) = exe.run(fluid.default_main_program(), feed=feed,
+                        fetch_list=[param], return_numpy=False)
+    snap = np.array(handle)
+    exe.run(fluid.default_main_program(), feed=feed, fetch_list=[])
+    exe.run_steps(fluid.default_main_program(), feed=feed, fetch_list=[],
+                  n_steps=3)
+    # the handle still reads its original value after two donating runs
+    np.testing.assert_array_equal(np.array(handle), snap)
+
+
+def test_put_feed_retired_cache_rearms_on_geometry_change(monkeypatch):
+    """Satellite regression: a feed name retired from the H2D cache (fresh
+    batches every step) must RE-ARM when the shape/dtype changes — e.g.
+    switching from train batches to a fixed eval feed — instead of
+    re-transferring the identical eval feed forever."""
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    class RemoteDev:  # non-cpu platform so the cache path engages
+        platform = "tpu"
+
+    transfers = []
+
+    def fake_put(arr, device):
+        transfers.append(np.asarray(arr))
+        return transfers[-1]
+
+    monkeypatch.setattr(_executor.jax, "device_put", fake_put)
+    rng = np.random.RandomState(0)
+    dev = RemoteDev()
+    # 4 distinct train batches retire the entry (3 misses)
+    for _ in range(4):
+        exe._put_feed("img", rng.normal(size=(4, 8)).astype(np.float32), dev)
+    assert exe._feed_cache["img"][2] is None  # retired
+    # same geometry keeps transferring (still retired, no re-arm)
+    exe._put_feed("img", rng.normal(size=(4, 8)).astype(np.float32), dev)
+    assert exe._feed_cache["img"][2] is None
+    # geometry change (eval feed): re-arms, then a repeated send HITS
+    ev = rng.normal(size=(2, 8)).astype(np.float32)
+    d1 = exe._put_feed("img", ev, dev)
+    assert exe._feed_cache["img"][2] is not None  # armed again
+    n_before = len(transfers)
+    d2 = exe._put_feed("img", ev.copy(), dev)
+    assert d2 is d1  # cache hit
+    assert len(transfers) == n_before  # no re-transfer

@@ -1,0 +1,101 @@
+"""Windowed-training smoke (CPU, < 5 s).
+
+The CI oracle for the device-resident training window (ISSUE 6): a
+GUARDED 16-step training window — numerics sentinel armed, batches staged
+through a DevicePrefetcher — must complete in at most 2 executor
+dispatches (startup + one fused window; the whole point of the window is
+that 16 steps are NOT 16 dispatches), train all 16 steps, and leave the
+window visible in the always-on counters (``executor.windows`` /
+``executor.window_steps``).
+
+Run directly (``python tools/window_smoke.py``) or from tier-1 via
+``tests/test_prefetch.py::test_window_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_STEPS = 16
+
+
+def main() -> dict:
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import guardian
+    from paddle_tpu.fluid.prefetch import DevicePrefetcher
+
+    t0 = time.perf_counter()
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 11
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+
+    rng = np.random.RandomState(3)
+
+    def batches():
+        for _ in range(N_STEPS):
+            yield {"x": rng.normal(size=(8, 8)).astype(np.float32),
+                   "y": rng.normal(size=(8, 1)).astype(np.float32)}
+
+    scope = fluid.Scope()
+    guardian.install(guardian.GuardianConfig(policy="skip"))
+    counters0 = dict(fluid.profiler.counters())
+    losses = []
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            with DevicePrefetcher(batches(), n_steps=N_STEPS,
+                                  place=fluid.CPUPlace(), depth=2) as pf:
+                for feed_dev, count in pf:
+                    (lv,) = exe.run_steps(prog, feed=feed_dev,
+                                          fetch_list=[loss], n_steps=count,
+                                          feed_per_step=True)
+                    losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            guardian.flush()
+            gm = guardian.metrics()
+    finally:
+        guardian.disable()
+
+    c = fluid.profiler.counters()
+
+    def delta(name):
+        return c.get(name, 0) - counters0.get(name, 0)
+
+    dispatches = delta("executor.dispatches")
+    report = {
+        "ok": bool(
+            dispatches <= 2
+            and delta("executor.windows") == 1
+            and delta("executor.window_steps") == N_STEPS
+            and gm.get("steps") == N_STEPS
+            and gm.get("trips", 0) == 0
+            and losses and np.isfinite(losses[-1])),
+        "dispatches": int(dispatches),
+        "windows": int(delta("executor.windows")),
+        "window_steps": int(delta("executor.window_steps")),
+        "guardian_steps": gm.get("steps"),
+        "last_loss": losses[-1] if losses else None,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
