@@ -207,6 +207,39 @@ def _cold_info(t_compile, before, after, window_steps=1, prefetch=0):
             "prefetch": int(prefetch)}
 
 
+def _timed_run_mesh(fluid, loss, feed, steps, spd, mesh_spec):
+    """BENCH_MESH=dp4,tp2 (or PADDLE_TPU_MESH): the whole-program SPMD
+    path — one ParallelExecutor over the named mesh, ``spd`` steps fused
+    per dispatch (BENCH_SPD, default 4), so every BENCH line on this path
+    records ``dispatches_per_step < 1`` plus the mesh label.  The batch
+    must divide the mesh's dp extent (the runner raises the named
+    ValueError otherwise — size your BENCH_*_BS accordingly)."""
+    from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+
+    spd = spd if spd > 1 else min(4, max(1, steps))
+    n_chunks = max(1, steps // spd)
+    steps = n_chunks * spd
+    prog = fluid.default_main_program()
+    pe = ParallelExecutor(loss_name=loss.name, main_program=prog,
+                          mesh=mesh_spec)
+    feed_w = {k: np.stack([np.asarray(v)] * spd) for k, v in feed.items()}
+    cc0 = _cache_counters()
+    t_c = time.perf_counter()
+    pe.run_steps([loss], feed=feed_w, n_steps=spd, feed_per_step=True)
+    cold = _cold_info(time.perf_counter() - t_c, cc0, _cache_counters(),
+                      spd, 0)
+    cold["mesh"] = pe.mesh_label
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_chunks):
+        (out,) = pe.run_steps([loss], feed=feed_w, n_steps=spd,
+                              feed_per_step=True)
+    last = float(np.asarray(out).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(last), f"non-finite loss {last}"
+    return dt, steps, pe, cold
+
+
 def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     """Shared harness: startup program, warmup (compile), timed steps.
 
@@ -247,6 +280,12 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
                              os.environ.get("PADDLE_TPU_SPD", "0") or "0")
               or 0)
     spd = max(1, min(spd, steps)) if spd > 0 else 1
+    mesh_spec = os.environ.get(
+        "BENCH_MESH", os.environ.get("PADDLE_TPU_MESH", "")).strip()
+    if mesh_spec and not any(isinstance(v, tuple) for v in feed.values()):
+        # sharded windowed path (LoD feeds need the per-step executor);
+        # startup already ran above, so the scope state is live
+        return _timed_run_mesh(fluid, loss, feed, steps, spd, mesh_spec)
     use_pf = spd > 1 and not any(isinstance(v, tuple) for v in feed.values()) \
         and os.environ.get("BENCH_PREFETCH", "").strip().lower() in ("1", "true")
     if on_accel and not use_pf:

@@ -142,13 +142,15 @@ class _Probe:
 
 
 def executor_probe(program, feed_arrays=None, fetch_names=None,
-                   extra=None) -> Optional[_Probe]:
+                   extra=None, spec_table=None) -> Optional[_Probe]:
     """Consult the store for an executor-shaped program specialization.
 
-    Called by ``Executor.run``/``run_steps`` right before building a fresh
-    jit entry (i.e. on every in-process cache miss).  Returns None when
-    the cache is disabled or fingerprinting fails; otherwise a
-    :class:`_Probe` whose hit/miss was already counted."""
+    Called by ``Executor.run``/``run_steps`` (and the SPMD step/window
+    runners, which also pass their mesh-derived ``spec_table``) right
+    before building a fresh jit entry (i.e. on every in-process cache
+    miss).  Returns None when the cache is disabled or fingerprinting
+    fails; otherwise a :class:`_Probe` whose hit/miss was already
+    counted."""
     store = get_store()
     if store is None:
         return None
@@ -157,7 +159,7 @@ def executor_probe(program, feed_arrays=None, fetch_names=None,
                  for k, v in sorted((feed_arrays or {}).items())]
         fp = program_fingerprint(program, feeds=feeds,
                                  fetches=list(fetch_names or []),
-                                 extra=extra)
+                                 extra=extra, spec_table=spec_table)
         hit = store.get(fp) is not None
         from .. import observe
 

@@ -129,15 +129,22 @@ def program_fingerprint(program,
                         feeds: Optional[Iterable[tuple]] = None,
                         fetches: Optional[Sequence[str]] = None,
                         extra: Optional[dict] = None,
+                        spec_table: Optional[Iterable[list]] = None,
                         include_versions: bool = True) -> str:
     """Stable content hash of (program, jit configuration, toolchain).
 
-    ``feeds``   iterable of ``(name, shape, dtype)`` — the concrete feed
-                signature the executable is specialized on;
-    ``fetches`` fetch var names (canonicalized through the program's
-                rename map, so noise-renamed fetch temporaries still hit);
-    ``extra``   any further jsonable config the artifact depends on
-                (platform, amp, donation set, n_steps, bucket, mesh...).
+    ``feeds``      iterable of ``(name, shape, dtype)`` — the concrete feed
+                   signature the executable is specialized on;
+    ``fetches``    fetch var names (canonicalized through the program's
+                   rename map, so noise-renamed fetch temporaries still hit);
+    ``extra``      any further jsonable config the artifact depends on
+                   (platform, amp, donation set, n_steps, bucket, mesh...);
+    ``spec_table`` iterable of ``[var_name, spec]`` sharding-table entries
+                   (``parallel.spmd.table_signature``) — var names are
+                   canonicalized through the rename map and the table is
+                   sorted AFTER renaming, so the fingerprint is
+                   rename-invariant yet changes whenever the mesh layout
+                   assigns any var a different PartitionSpec.
     """
     sig, rename = program_signature(program)
     feed_sig: List[list] = []
@@ -151,6 +158,10 @@ def program_fingerprint(program,
         "fetches": [rename.get(str(n), str(n)) for n in (fetches or [])],
         "extra": _canon_attr(dict(extra or {}), rename),
     }
+    if spec_table is not None:
+        payload["spec_table"] = sorted(
+            [rename.get(str(name), str(name)), _canon_attr(spec, rename)]
+            for name, spec in spec_table)
     if include_versions:
         import jax
         import jaxlib

@@ -293,6 +293,99 @@ def _resolve_opdef(op_type):
 _SKIP_OPS = frozenset(["feed", "fetch", "read", "create_py_reader"])
 
 
+def build_window_fn(program: Program, plan: "BlockPlan", guard, n_user: int,
+                    n_steps: int, feed_per_step: bool,
+                    trace=None, finalize=None):
+    """Build the fused-window step function ``kfn(feed_vals, const_state,
+    mut_state, sentinel)`` — a ``lax.scan`` over the traced step with the
+    mutable state (plus, when guarded, the aggregated health record) riding
+    the carry.  Shared by ``Executor.run_steps`` (single device) and the
+    SPMD window runner (``parallel.spmd.ShardedWindowRunner``), so the
+    sharded path scans the EXACT same body the single-device oracle tests
+    pin down.
+
+    ``trace(feed, state)`` overrides the default ``trace_block`` call
+    (the sharded runner wraps it in a ``mesh_scope``); ``finalize(last,
+    mut_final, agg)`` post-processes the outputs inside the trace (the
+    sharded runner pins shardings there; ``agg`` is None unguarded).
+    """
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+
+    from . import guardian as _guardian
+
+    if trace is None:
+        def trace(feed_vals, state_vals):
+            return trace_block(program, 0, plan, feed_vals, state_vals)
+    if finalize is None:
+        def finalize(last, mut_final, agg):
+            return last, mut_final, agg
+
+    def kfn(feed_vals, const_state, mut_state, sentinel):
+        def body(carry, xs):
+            if guard is not None:
+                mut, _prev_fetch, agg = carry
+            else:
+                mut, _prev_fetch = carry
+            step_feed = dict(xs["feed"] if feed_per_step
+                             else feed_vals)
+            state = dict(const_state)
+            state.update(mut)
+            if guard is not None:
+                step_sent = {"loss_cap": sentinel["loss_cap"],
+                             "seed_mul": xs["seed_mul"],
+                             "loss_mul": xs["loss_mul"]}
+                step_feed[_guardian.LOSS_SEED_MUL] = \
+                    _guardian.seed_multiplier(guard, state, step_sent)
+            fetches, new_state = trace(step_feed, state)
+            # fetches ride the carry: only the LAST step's values
+            # survive, with no (n_steps, ...) stacking buffer
+            if guard is not None:
+                committed, health = _guardian.fold_health(
+                    guard, fetches[n_user:], new_state, mut, state,
+                    step_sent)
+                agg = _guardian.window_health_update(
+                    agg, health, xs["i"], n_steps)
+                return ({**mut, **committed}, fetches[:n_user],
+                        agg), None
+            return ({**mut, **new_state}, fetches), None
+
+        first_feed = (
+            {k: v[0] for k, v in feed_vals.items()}
+            if feed_per_step else feed_vals)
+        fetch0, state0 = jax.eval_shape(
+            lambda st: trace(first_feed, {**const_state, **st}),
+            mut_state)
+        fetch0 = [_jnp.zeros(t.shape, t.dtype)
+                  for t in fetch0[:n_user]]
+        # write-only persistables (written before first read, e.g.
+        # a decayed lr var) appear in new_state but not in
+        # _gather_state's mut_state — seed them so the carry
+        # structure is stable across scan iterations
+        mut_state = dict(mut_state)
+        for k, t in state0.items():
+            if k not in mut_state:
+                mut_state[k] = _jnp.zeros(t.shape, t.dtype)
+        xs = {"i": _jnp.arange(n_steps, dtype=_jnp.int32)}
+        if feed_per_step:
+            xs["feed"] = feed_vals
+        if guard is not None:
+            xs["seed_mul"] = sentinel["seed_mul"]
+            xs["loss_mul"] = sentinel["loss_mul"]
+            carry0 = (mut_state, fetch0,
+                      _guardian.window_health_init(n_steps))
+            (mut_final, last, agg), _ = _lax.scan(
+                body, carry0, xs, length=n_steps)
+            last, mut_final, agg = finalize(last, mut_final, agg)
+            return last, mut_final, agg
+        (mut_final, last), _ = _lax.scan(
+            body, (mut_state, fetch0), xs, length=n_steps)
+        last, mut_final, _ = finalize(last, mut_final, None)
+        return last, mut_final
+
+    return kfn
+
+
 LOD_SUFFIX = "@LOD"
 
 
@@ -546,9 +639,6 @@ class Executor:
         Returns the fetches of the LAST step (host numpy).  Programs with
         data-dependent eager islands cannot be scanned and raise.
         """
-        import jax.numpy as _jnp
-        from jax import lax as _lax
-
         program = program or default_main_program()
         scope = scope or global_scope()
         n_steps = int(n_steps)
@@ -620,68 +710,8 @@ class Executor:
                     if n not in plan.state_in:
                         plan.state_in.append(n)
 
-            def kfn(feed_vals, const_state, mut_state, sentinel):
-                def body(carry, xs):
-                    if guard is not None:
-                        mut, _prev_fetch, agg = carry
-                    else:
-                        mut, _prev_fetch = carry
-                    step_feed = dict(xs["feed"] if feed_per_step
-                                     else feed_vals)
-                    state = dict(const_state)
-                    state.update(mut)
-                    if guard is not None:
-                        step_sent = {"loss_cap": sentinel["loss_cap"],
-                                     "seed_mul": xs["seed_mul"],
-                                     "loss_mul": xs["loss_mul"]}
-                        step_feed[_guardian.LOSS_SEED_MUL] = \
-                            _guardian.seed_multiplier(guard, state, step_sent)
-                    fetches, new_state = trace_block(
-                        program, 0, plan, step_feed, state)
-                    # fetches ride the carry: only the LAST step's values
-                    # survive, with no (n_steps, ...) stacking buffer
-                    if guard is not None:
-                        committed, health = _guardian.fold_health(
-                            guard, fetches[n_user:], new_state, mut, state,
-                            step_sent)
-                        agg = _guardian.window_health_update(
-                            agg, health, xs["i"], n_steps)
-                        return ({**mut, **committed}, fetches[:n_user],
-                                agg), None
-                    return ({**mut, **new_state}, fetches), None
-
-                first_feed = (
-                    {k: v[0] for k, v in feed_vals.items()}
-                    if feed_per_step else feed_vals)
-                fetch0, state0 = jax.eval_shape(
-                    lambda st: trace_block(program, 0, plan, first_feed,
-                                           {**const_state, **st}),
-                    mut_state)
-                fetch0 = [_jnp.zeros(t.shape, t.dtype)
-                          for t in fetch0[:n_user]]
-                # write-only persistables (written before first read, e.g.
-                # a decayed lr var) appear in new_state but not in
-                # _gather_state's mut_state — seed them so the carry
-                # structure is stable across scan iterations
-                mut_state = dict(mut_state)
-                for k, t in state0.items():
-                    if k not in mut_state:
-                        mut_state[k] = _jnp.zeros(t.shape, t.dtype)
-                xs = {"i": _jnp.arange(n_steps, dtype=_jnp.int32)}
-                if feed_per_step:
-                    xs["feed"] = feed_vals
-                if guard is not None:
-                    xs["seed_mul"] = sentinel["seed_mul"]
-                    xs["loss_mul"] = sentinel["loss_mul"]
-                    carry0 = (mut_state, fetch0,
-                              _guardian.window_health_init(n_steps))
-                    (mut_final, last, agg), _ = _lax.scan(
-                        body, carry0, xs, length=n_steps)
-                    return last, mut_final, agg
-                (mut_final, last), _ = _lax.scan(
-                    body, (mut_state, fetch0), xs, length=n_steps)
-                return last, mut_final
-
+            kfn = build_window_fn(program, plan, guard, n_user, n_steps,
+                                  feed_per_step)
             device = core.get_jax_device(self.place)
             donate = self._donate_argnums(device, program)
             entry = (plan, jax.jit(kfn, donate_argnums=donate), guard)

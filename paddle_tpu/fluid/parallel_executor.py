@@ -28,7 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import core
 from .executor import _MISSING, global_scope
 from .framework import Variable, default_main_program
-from ..parallel.spmd import ShardedTrainStep
+from ..parallel.mesh import env_mesh_spec, mesh_from_spec, mesh_label
+from ..parallel.spmd import ShardedTrainStep, ShardedWindowRunner
 
 
 class ExecutionStrategy:
@@ -81,7 +82,7 @@ class ParallelExecutor:
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
                  num_trainers=1, trainer_id=0, scope=None, use_tpu=None,
-                 devices=None, **kwargs):
+                 devices=None, mesh=None, **kwargs):
         from ..parallel import multihost as _mh
 
         self._program = main_program or default_main_program()
@@ -96,17 +97,39 @@ class ParallelExecutor:
         _mh.ensure_init(dist_info)
         self._multihost = _mh.process_count() > 1
 
-        if devices is not None:
+        # mesh selection: explicit Mesh > explicit devices (1-D dp) >
+        # spec string from _dist_info / PADDLE_TPU_MESH ("dp4,tp2") >
+        # the degenerate all-devices dp mesh.  The spec path is how
+        # DistributeTranspiler-annotated programs pick their topology.
+        mesh_spec = mesh if isinstance(mesh, str) else None
+        if mesh_spec is None and not isinstance(mesh, Mesh):
+            mesh_spec = dist_info.get("mesh") or env_mesh_spec()
+        if isinstance(mesh, Mesh):
+            self._mesh = mesh
+        elif devices is not None:
             self._devices = list(devices)
-            self._mesh = Mesh(np.array(self._devices), ("dp",))
+            self._mesh = (mesh_from_spec(mesh_spec, devices=self._devices)
+                          if mesh_spec
+                          else Mesh(np.array(self._devices), ("dp",)))
+        elif mesh_spec:
+            self._mesh = mesh_from_spec(mesh_spec)  # global device order
         else:
             self._mesh = _mh.global_mesh(("dp",))  # global when multihost
-            self._devices = list(self._mesh.devices.reshape(-1))
+        self._devices = list(self._mesh.devices.reshape(-1))
         self._cache = {}
+        self._window_cache = {}
 
     @property
     def device_count(self):
         return len(self._devices)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def mesh_label(self):
+        return mesh_label(self._mesh)
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict
@@ -143,6 +166,13 @@ class ParallelExecutor:
                os.environ.get("PADDLE_TPU_FLASH", ""))
         step = self._cache.get(key)
         if step is None:
+            if getattr(self._program, "_loss_scale_vars", None) is not None:
+                # the per-step sharded path has no guarded wrapper: the
+                # backward seed would go unscaled while append_unscale_ops
+                # still divides grads by the scale — silently wrong math
+                raise RuntimeError(
+                    "dynamic fp16 loss scaling requires the windowed "
+                    "sharded path: use ParallelExecutor.run_steps")
             zero1 = (self._build_strategy.reduce_strategy ==
                      BuildStrategy.ReduceStrategy.Reduce)
             step = ShardedTrainStep(
@@ -150,14 +180,7 @@ class ParallelExecutor:
                 zero1=zero1, multihost=self._multihost)
             self._cache[key] = step
 
-        gb = self._program.global_block()
-        for name in step.plan.state_in:
-            if self._scope.get(name, _MISSING) is _MISSING:
-                if gb._has_var_recursive(name) and \
-                        gb._var_recursive(name).is_data:
-                    raise RuntimeError(f"Data variable '{name}' was not fed")
-                raise RuntimeError(f"Variable '{name}' is not initialized; "
-                                   f"run the startup program first")
+        self._check_initialized(step.plan)
         feed_dev = step.place_feed(feed_arrays)
         state_vals = step.place_state(self._scope)
 
@@ -167,6 +190,90 @@ class ParallelExecutor:
         if return_numpy:
             return [step.fetch_to_host(v) for v in fetches]
         return list(fetches)
+
+    def run_steps(self, fetch_list, feed=None, n_steps=1,
+                  feed_per_step=False, return_numpy=True):
+        """N training steps in ONE dispatch over the mesh — the sharded
+        twin of ``Executor.run_steps`` (same scan body via
+        ``executor.build_window_fn``, guardian sentinel + dynamic fp16
+        loss scale riding the carry), with the spec-table shardings pinned
+        on the carried state and the mutable state donated.
+
+        ``feed_per_step=True``: each feed array carries a leading
+        ``n_steps`` dim and scanned step i consumes slice i; the batch
+        (dim 1) shards over the mesh's dp axes and must divide them —
+        indivisible batches raise a clear ValueError rather than an
+        opaque XLA sharding error."""
+        from . import amp as _amp
+        from . import guardian as _guardian
+
+        n_steps = int(n_steps)
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list or []]
+        gb = self._program.global_block()
+        feed_arrays = {}
+        for k, v in dict(feed or {}).items():
+            if isinstance(v, jax.Array):
+                feed_arrays[k] = v
+                continue
+            arr = np.asarray(v)
+            if gb._has_var_recursive(k):
+                want = core.np_dtype(gb._var_recursive(k).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feed_arrays[k] = arr
+
+        guard = _guardian.for_program(self._program)
+        key = (id(self._program), self._program._version,
+               tuple(fetch_names), n_steps, bool(feed_per_step),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               _amp.compute_dtype(),
+               guard.cache_token() if guard is not None else None,
+               os.environ.get("PADDLE_TPU_FLASH", ""),
+               self.mesh_label)
+        runner = self._window_cache.get(key)
+        if runner is None:
+            zero1 = (self._build_strategy.reduce_strategy ==
+                     BuildStrategy.ReduceStrategy.Reduce)
+            runner = ShardedWindowRunner(
+                self._program, list(feed_arrays), fetch_names, self._mesh,
+                n_steps=n_steps, feed_per_step=feed_per_step, zero1=zero1,
+                multihost=self._multihost)
+            self._window_cache[key] = runner
+        self._check_initialized(runner.plan)
+        return runner.run(feed_arrays, scope=self._scope,
+                          return_numpy=return_numpy)
+
+    def stage_window(self, window):
+        """Place one stacked ``(n_steps, batch, ...)`` feed window with the
+        mesh's window sharding (batch dim 1 over the dp axes) — the
+        ``DevicePrefetcher`` ``stage_fn`` for sharded training, so window
+        k+1 lands shard-placed while the device runs window k."""
+        from ..parallel.spmd import batch_spec
+
+        arrays = {k: np.asarray(v) for k, v in window.items()}
+        bspec = batch_spec(self._mesh)
+        axes = [ax for ax in bspec if ax is not None]
+        div = 1
+        for ax in axes:
+            div *= self._mesh.shape[ax]
+        out = {}
+        for k, arr in arrays.items():
+            divisible = arr.ndim > 1 and arr.shape[1] % div == 0
+            spec = P(*([None] + list(bspec))) if divisible else P()
+            out[k] = jax.device_put(arr, NamedSharding(self._mesh, spec))
+        return out
+
+    def _check_initialized(self, plan):
+        gb = self._program.global_block()
+        for name in plan.state_in:
+            if self._scope.get(name, _MISSING) is _MISSING:
+                if gb._has_var_recursive(name) and \
+                        gb._var_recursive(name).is_data:
+                    raise RuntimeError(f"Data variable '{name}' was not fed")
+                raise RuntimeError(f"Variable '{name}' is not initialized; "
+                                   f"run the startup program first")
 
     def bcast_params(self):
         """ref: parallel_executor.cc:234 BCastParamsToDevices — replication is

@@ -142,13 +142,18 @@ class DevicePrefetcher:
     """
 
     def __init__(self, source: Iterable[Dict[str, object]], n_steps: int = 1,
-                 place=None, depth: Optional[int] = None):
+                 place=None, depth: Optional[int] = None, stage_fn=None):
         self.n_steps = max(1, int(n_steps))
         self.depth = default_depth() if depth is None else max(0, int(depth))
         self._source = source
         self._place = place
         self._device = None
         self._abort = Event()
+        # stage_fn({name: stacked (count, batch, ...) array}) -> placed
+        # dict: overrides the single-device device_put — the sharded
+        # training path passes ParallelExecutor.stage_window so windows
+        # land on the mesh with the batch axis already dp-sharded
+        self._stage_fn = stage_fn
 
     # -- staging --
     def _stage(self, batches) -> Tuple[Dict[str, object], int]:
@@ -157,14 +162,14 @@ class DevicePrefetcher:
         _fault.io_delay()  # deterministic slow-input oracle (module doc)
         import jax
 
+        window = {name: np.stack([np.asarray(b[name]) for b in batches])
+                  for name in batches[0]}
+        if self._stage_fn is not None:
+            return self._stage_fn(window), len(batches)
         if self._device is None:
             self._device = _resolve_device(self._place)
-        window = {}
-        for name in batches[0]:
-            window[name] = jax.device_put(
-                np.stack([np.asarray(b[name]) for b in batches]),
-                self._device)
-        return window, len(batches)
+        return ({name: jax.device_put(arr, self._device)
+                 for name, arr in window.items()}, len(batches))
 
     def __iter__(self):
         wins = _windows(self._source, self.n_steps)

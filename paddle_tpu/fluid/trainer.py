@@ -293,7 +293,13 @@ def clean_checkpoint(checkpoint_dir, delete_dir=False):
 
 class Trainer:
     """``train_func() -> loss`` (or [loss, ...]) builds the model;
-    ``optimizer_func() -> Optimizer`` attaches the backward + update."""
+    ``optimizer_func() -> Optimizer`` attaches the backward + update.
+
+    ``parallel=True`` dispatches training through the SPMD path: a named
+    mesh from ``PADDLE_TPU_MESH`` (e.g. ``dp4,tp2``, docs/SPMD.md) or the
+    all-devices dp mesh, per step via ``ParallelExecutor.run`` or — under
+    ``PADDLE_TPU_SPD=K`` — as K-step fused windows whose input the
+    prefetcher stages already dp-sharded."""
 
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None):
@@ -321,6 +327,18 @@ class Trainer:
 
         self.exe = Executor(self.place)
         self.exe.run(self.startup_program)
+
+        # parallel=True: train dispatches go through the SPMD path — a
+        # named mesh from PADDLE_TPU_MESH (e.g. "dp4,tp2") or the
+        # degenerate all-devices dp mesh, windows via the sharded
+        # run_steps when PADDLE_TPU_SPD>1.  Built AFTER startup so the
+        # scope state it places is initialized.
+        self.parallel_exe = None
+        if parallel:
+            from .parallel_executor import ParallelExecutor
+
+            self.parallel_exe = ParallelExecutor(
+                loss_name=self.loss.name, main_program=self.train_program)
 
         if self.checkpoint_cfg:
             args = load_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir,
@@ -396,9 +414,13 @@ class Trainer:
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)
                 fetch = self.train_func_outputs if begin.fetch_metrics else []
-                metrics = self.exe.run(self.train_program,
-                                       feed=feeder.feed(data),
-                                       fetch_list=fetch)
+                if self.parallel_exe is not None:
+                    metrics = self.parallel_exe.run(
+                        fetch, feed=feeder.feed(data))
+                else:
+                    metrics = self.exe.run(self.train_program,
+                                           feed=feeder.feed(data),
+                                           fetch_list=fetch)
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 if self.checkpoint_cfg and \
                         (step_id + 1) % self.checkpoint_cfg.step_interval == 0:
@@ -441,8 +463,13 @@ class Trainer:
             feeds = itertools.islice(
                 (feeder.feed(data) for data in reader()), skip_until, None)
             step_id = skip_until
+            # sharded runs stage windows with the batch axis ALREADY
+            # dp-sharded (stage_window), so the prefetch thread's H2D
+            # overlap covers the mesh placement too
+            stage_fn = (self.parallel_exe.stage_window
+                        if self.parallel_exe is not None else None)
             with DevicePrefetcher(feeds, n_steps=n_steps,
-                                  place=self.place) as pf:
+                                  place=self.place, stage_fn=stage_fn) as pf:
                 for feed_dev, count in pf:
                     if self.stop_flag:
                         return
@@ -450,9 +477,15 @@ class Trainer:
                     event_handler(begin)
                     fetch = (self.train_func_outputs
                              if begin.fetch_metrics else [])
-                    metrics = self.exe.run_steps(
-                        self.train_program, feed=feed_dev, fetch_list=fetch,
-                        n_steps=count, feed_per_step=True)
+                    if self.parallel_exe is not None:
+                        metrics = self.parallel_exe.run_steps(
+                            fetch, feed=feed_dev, n_steps=count,
+                            feed_per_step=True)
+                    else:
+                        metrics = self.exe.run_steps(
+                            self.train_program, feed=feed_dev,
+                            fetch_list=fetch, n_steps=count,
+                            feed_per_step=True)
                     last_step = step_id + count - 1
                     event_handler(EndStepEvent(epoch_id, last_step, metrics))
                     if self.checkpoint_cfg and \

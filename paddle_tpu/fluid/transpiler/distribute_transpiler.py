@@ -24,6 +24,8 @@ staleness is bounded by the sync period rather than unbounded.
 
 from __future__ import annotations
 
+import os
+
 from ..framework import Program, default_main_program
 
 
@@ -41,18 +43,31 @@ class DistributeTranspiler:
         self._transpiled = False
 
     def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
-                  trainers=1, sync_mode=True, startup_program=None):
+                  trainers=1, sync_mode=True, startup_program=None,
+                  mesh=None):
         """Record the trainer topology on the program.  ParallelExecutor
         reads this annotation and joins the coordination service
         (parallel.multihost.init) with the first pserver endpoint as the
         coordinator address — the TPU mapping of the reference's
-        gen_nccl_id-over-gRPC bootstrap (gen_nccl_id_op.cc:31)."""
+        gen_nccl_id-over-gRPC bootstrap (gen_nccl_id_op.cc:31).
+
+        ``mesh`` (or the ``PADDLE_TPU_MESH`` env, e.g. ``dp4,tp2``)
+        selects the named axis layout the SPMD lowering partitions over;
+        unset means the pure data-parallel mesh over all devices."""
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.sync_mode = sync_mode
         self.origin_program = program or default_main_program()
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
         self._transpiled = True
+        mesh_spec = mesh or os.environ.get("PADDLE_TPU_MESH", "").strip() \
+            or None
+        if mesh_spec is not None:
+            # fail at transpile time on a malformed spec, not inside jit
+            from ...parallel.mesh import parse_mesh_spec
+
+            parse_mesh_spec(mesh_spec)
+        self.mesh_spec = mesh_spec
         self.origin_program._dist_info = {
             "trainer_id": trainer_id,
             "trainers": trainers,
@@ -62,6 +77,9 @@ class DistributeTranspiler:
             # with periodic averaging (parallel.local_sgd) instead of the
             # per-step GSPMD collective program
             "mode": "spmd_ici" if sync_mode else "async_local_sgd",
+            # named mesh axes the SPMD lowering shards over ("dp4,tp2");
+            # None = the degenerate all-devices dp mesh
+            "mesh": mesh_spec,
         }
         # Join the pod NOW: jax.distributed.initialize must run before any
         # JAX computation touches the backend, and in the reference flow

@@ -46,8 +46,8 @@ from .registry import MetricsRegistry
 __all__ = [
     "MetricsRegistry", "EventLog", "registry", "get_sink", "configure",
     "disable", "reset", "emit", "span", "note_step", "note_program",
-    "current_step", "current_program", "http_server",
-    "ENV_DIR", "ENV_FLUSH", "ENV_PORT",
+    "note_mesh", "current_step", "current_program", "current_mesh",
+    "http_server", "ENV_DIR", "ENV_FLUSH", "ENV_PORT",
 ]
 
 ENV_DIR = "PADDLE_OBSERVE_DIR"
@@ -67,6 +67,7 @@ _registry = MetricsRegistry()
 # correctness.
 _step: Optional[int] = None
 _program: Optional[str] = None
+_mesh: Optional[str] = None
 
 
 def registry() -> MetricsRegistry:
@@ -87,12 +88,24 @@ def note_program(fingerprint: Optional[str]) -> None:
     _program = fingerprint
 
 
+def note_mesh(label: Optional[str]) -> None:
+    """Record the executing mesh topology (``dp4xtp2``-style label from
+    ``parallel.mesh.mesh_label``) for event stamping — so fleet views can
+    distinguish what topology a trip/cache-hit/checkpoint happened on."""
+    global _mesh
+    _mesh = label
+
+
 def current_step() -> Optional[int]:
     return _step
 
 
 def current_program() -> Optional[str]:
     return _program
+
+
+def current_mesh() -> Optional[str]:
+    return _mesh
 
 
 # ---------------------------------------------------------------------------
@@ -229,7 +242,7 @@ def disable() -> None:
 def reset() -> None:
     """Close the sink, clear the registry and context, and re-arm env
     late-binding.  Test-harness hook (tests/conftest.py)."""
-    global _sink, _step, _program
+    global _sink, _step, _program, _mesh
     with _sink_lock:
         if _sink not in (None, _UNSET):
             _sink.close()
@@ -238,6 +251,7 @@ def reset() -> None:
     _registry.stop_sampling()
     _step = None
     _program = None
+    _mesh = None
 
 
 def http_server():

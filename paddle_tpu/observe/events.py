@@ -74,11 +74,16 @@ class EventLog:
     def emit(self, event: str, **fields) -> dict:
         """Append one stamped record; returns it.  Never raises — losing a
         telemetry line must not fail the run it describes."""
-        from . import current_program, current_step
+        from . import current_mesh, current_program, current_step
 
         rec = {"ts": time.time(), "event": event, "host": self.host,
                "pid": os.getpid(), "rank": self.rank, "gen": self.gen,
                "step": current_step(), "program": current_program()}
+        mesh = current_mesh()
+        if mesh is not None:
+            # topology stamp (dp4xtp2) — only present on sharded runs, so
+            # single-device streams keep their exact record shape
+            rec["mesh"] = mesh
         if self.source:
             rec["source"] = self.source
         rec.update(fields)

@@ -1,11 +1,31 @@
 """Mesh construction (ref analogue: platform/nccl_helper.h NCCLContextMap —
-rank math over trainers × local GPUs becomes an N-D device mesh)."""
+rank math over trainers × local GPUs becomes an N-D device mesh).
+
+Named multi-axis meshes (ISSUE 7): ``PADDLE_TPU_MESH`` carries the
+topology as a compact spec string — ``dp4,tp2`` is a 4×2 mesh whose first
+axis shards the batch and whose second shards model weights; axis order =
+spec order, later axes map to faster-varying (more ICI-adjacent) device
+indices.  Recognized axis names: ``dp`` (data), ``tp`` (tensor/Megatron),
+``fsdp`` (parameter sharding), plus the legacy ``mp``/``sp``/``ep``/``pp``
+names the dryruns use.  ``mesh_from_spec()`` is the one constructor every
+consumer (DistributeTranspiler → ParallelExecutor → ShardedWindowRunner)
+goes through, and ``mesh_label()`` (``dp4xtp2``) is the observe/metrics
+label for the topology.
+"""
 
 from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+MESH_ENV = "PADDLE_TPU_MESH"
+
+_AXIS_RE = re.compile(r"([a-zA-Z_]+?)(\d+)$")
 
 
 def local_device_count(platform=None) -> int:
@@ -30,6 +50,67 @@ def make_mesh(n_devices=None, tp=1, axis_names=("dp", "mp")) -> Mesh:
         raise ValueError(f"n_devices={n} not divisible by tp={tp}")
     arr = np.array(devs[:n]).reshape(n // tp, tp)
     return Mesh(arr, axis_names)
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """``"dp4,tp2"`` -> ``{"dp": 4, "tp": 2}`` (insertion-ordered).
+
+    Raises ``ValueError`` on malformed tokens or duplicate axes so a typo
+    in ``PADDLE_TPU_MESH`` fails loudly at mesh construction, not as an
+    opaque reshape error deep in jit."""
+    axes: Dict[str, int] = {}
+    for tok in str(spec).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        m = _AXIS_RE.fullmatch(tok)
+        if m is None:
+            raise ValueError(
+                f"bad mesh axis {tok!r} in spec {spec!r} — expected "
+                f"<name><extent> tokens like 'dp4,tp2'")
+        name, size = m.group(1), int(m.group(2))
+        if name in axes:
+            raise ValueError(f"duplicate mesh axis {name!r} in {spec!r}")
+        if size < 1:
+            raise ValueError(f"mesh axis {tok!r} must have extent >= 1")
+        axes[name] = size
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return axes
+
+
+def env_mesh_spec() -> Optional[str]:
+    """The ``PADDLE_TPU_MESH`` spec string, or None when unset/empty."""
+    return os.environ.get(MESH_ENV, "").strip() or None
+
+
+def mesh_from_spec(spec: Optional[str] = None, devices=None) -> Mesh:
+    """Build a named mesh from a ``dp4,tp2``-style spec.
+
+    ``spec=None`` reads ``PADDLE_TPU_MESH``; with neither, the result is a
+    1-axis ``("dp",)`` mesh over all (given) devices — the degenerate
+    data-parallel mesh the old ParallelExecutor always built.  Later spec
+    axes map onto faster-varying device indices (the ICI-adjacent
+    dimension), so put the most communication-hungry axis last."""
+    if spec is None:
+        spec = env_mesh_spec()
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if not spec:
+        return Mesh(np.array(devs), ("dp",))
+    axes = parse_mesh_spec(spec)
+    sizes = tuple(axes.values())
+    n = int(np.prod(sizes))
+    if n > len(devs):
+        raise ValueError(
+            f"mesh spec {spec!r} needs {n} devices, only {len(devs)} "
+            f"visible")
+    arr = np.array(devs[:n]).reshape(sizes)
+    return Mesh(arr, tuple(axes))
+
+
+def mesh_label(mesh: Mesh) -> str:
+    """Canonical topology label for metrics/events: ``dp4xtp2``."""
+    return "x".join(f"{a}{mesh.shape[a]}" for a in mesh.axis_names)
 
 
 def make_mesh_nd(**axes) -> Mesh:

@@ -1,0 +1,555 @@
+"""Whole-program SPMD oracles (ISSUE 7).
+
+ROADMAP item 1's acceptance: a transformer (and MLP) trains under a dp×tp
+named mesh on the 8 forced CPU devices with loss numerically stable vs the
+single-device run at equal global batch; the windowed sharded path runs
+N-step ``run_steps`` windows with guardian + dynamic fp16 loss scaling
+active; the compile-cache fingerprint folds mesh shape + spec table (and a
+second process warm-starts a sharded program); indivisible batches raise
+the named error instead of an opaque XLA sharding failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.executor as _executor
+from paddle_tpu import observe
+from paddle_tpu.fluid import amp, fault, guardian
+from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+from paddle_tpu.parallel import (ShardedWindowRunner, collective_stats,
+                                 mesh_from_spec, mesh_label,
+                                 parse_mesh_spec, table_signature)
+from paddle_tpu.parallel.spmd import infer_param_specs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    fault.clear()
+    guardian.disable()
+    amp.disable()
+    yield
+    fault.clear()
+    guardian.disable()
+    amp.disable()
+
+
+def _build_mlp(seed=13):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=32, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _snapshot(scope):
+    return {k: np.asarray(scope.get(k)) for k in scope.keys()
+            if scope.get(k) is not None}
+
+
+def _restore(scope, snap):
+    for k, v in snap.items():
+        scope.set(k, v)
+
+
+# ---------------------------------------------------------------------------
+# mesh spec parsing / labels
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_spec_parsing_and_label():
+    assert parse_mesh_spec("dp4,tp2") == {"dp": 4, "tp": 2}
+    assert parse_mesh_spec(" dp2 , fsdp2,tp2 ") == \
+        {"dp": 2, "fsdp": 2, "tp": 2}
+    for bad in ("dp", "4dp", "dp4,dp2", "", "dp0"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+    mesh = mesh_from_spec("dp4,tp2")
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    assert mesh_label(mesh) == "dp4xtp2"
+    # unset spec -> all-devices dp mesh (the legacy PE default)
+    assert mesh_label(mesh_from_spec("")) == "dp8"
+    with pytest.raises(ValueError):
+        mesh_from_spec("dp16")  # more devices than visible
+
+
+# ---------------------------------------------------------------------------
+# ROADMAP item 1 oracle: dp×tp training matches single device
+# ---------------------------------------------------------------------------
+
+
+def test_mlp_dp_tp_window_matches_single_device():
+    """MLP under dp4×tp2, 4-step fused window, vs 4 sequential
+    single-device steps at the SAME global batch: losses and final
+    parameters agree (fp reassociation tolerance — GSPMD reduces in a
+    different order; bitwise is not guaranteed on the CPU backend)."""
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = _snapshot(scope)
+
+    rng = np.random.RandomState(0)
+    xs = rng.normal(size=(4, 16, 16)).astype(np.float32)
+    ys = rng.randint(0, 10, size=(4, 16, 1)).astype(np.int64)
+
+    seq = []
+    for i in range(4):
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={"img": xs[i], "label": ys[i]},
+                       fetch_list=[loss])
+        seq.append(float(np.asarray(l).reshape(-1)[0]))
+    seq_params = _snapshot(scope)
+
+    _restore(scope, init)
+    mesh = mesh_from_spec("dp4,tp2")
+    runner = ShardedWindowRunner(
+        fluid.default_main_program(), ["img", "label"], [loss.name], mesh,
+        n_steps=4, feed_per_step=True)
+    # the canonical table actually sharded something over tp
+    tp_sharded = [n for n, s in runner.specs.items()
+                  if s is not None and "tp" in tuple(s)]
+    assert tp_sharded, runner.specs
+    (l,) = runner.run({"img": xs, "label": ys})
+    np.testing.assert_allclose(float(np.asarray(l).reshape(-1)[0]), seq[-1],
+                               rtol=2e-4, atol=2e-4)
+    for k, v in seq_params.items():
+        np.testing.assert_allclose(np.asarray(scope.get(k)), v,
+                                   rtol=2e-4, atol=2e-4, err_msg=k)
+    # GSPMD really partitioned: the executable contains collectives
+    assert runner.collectives is not None
+    assert runner.collectives["count"] > 0
+    assert runner.collectives["bytes"] > 0
+
+
+def test_transformer_dp_tp_window_matches_single_device():
+    """The flagship attention model: tiny Transformer under dp4×tp2
+    windows vs the single-device per-step run at equal global batch."""
+    from paddle_tpu.models import transformer
+
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    cfg = transformer.tiny_config()
+    cfg.dropout = 0.0
+    src, tgt, lbl, loss = transformer.build(cfg, src_len=8, tgt_len=8,
+                                            lr=1e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = _snapshot(scope)
+
+    rng = np.random.RandomState(1)
+    bs, n = 8, 2
+    feeds = {
+        "src_word": rng.randint(1, cfg.src_vocab_size,
+                                size=(n, bs, 8)).astype(np.int64),
+        "tgt_word": rng.randint(1, cfg.tgt_vocab_size,
+                                size=(n, bs, 8)).astype(np.int64),
+        "lbl_word": rng.randint(1, cfg.tgt_vocab_size,
+                                size=(n, bs, 8, 1)).astype(np.int64)}
+
+    seq = []
+    for i in range(n):
+        (l,) = exe.run(fluid.default_main_program(),
+                       feed={k: v[i] for k, v in feeds.items()},
+                       fetch_list=[loss])
+        seq.append(float(np.asarray(l).reshape(-1)[0]))
+
+    _restore(scope, init)
+    mesh = mesh_from_spec("dp4,tp2")
+    runner = ShardedWindowRunner(
+        fluid.default_main_program(),
+        ["src_word", "tgt_word", "lbl_word"], [loss.name], mesh,
+        n_steps=n, feed_per_step=True)
+    (l,) = runner.run(feeds)
+    par = float(np.asarray(l).reshape(-1)[0])
+    assert np.isfinite(par)
+    np.testing.assert_allclose(par, seq[-1], rtol=5e-4, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: guarded + fp16-loss-scaled windows on the mesh
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_fp16_scaled_window_matches_single_device_window():
+    """A guardian-gated AND dynamically-fp16-loss-scaled program runs as a
+    fused window on dp4×tp2; losses, parameters AND the loss-scale
+    counters match the single-device fused window (the scale trajectory is
+    powers of two — it must match exactly)."""
+    amp.enable("float16", init_loss_scale=2.0 ** 8, growth_interval=3)
+    guardian.install(guardian.GuardianConfig(policy="skip"))
+    loss = _build_mlp(seed=5)
+    prog = fluid.default_main_program()
+    assert prog._loss_scale_vars is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+    init = _snapshot(scope)
+
+    rng = np.random.RandomState(2)
+    xs = rng.normal(size=(8, 16, 16)).astype(np.float32)
+    ys = rng.randint(0, 10, size=(8, 16, 1)).astype(np.int64)
+
+    (l,) = exe.run_steps(prog, feed={"img": xs, "label": ys},
+                         fetch_list=[loss], n_steps=8, feed_per_step=True)
+    single = float(np.asarray(l).reshape(-1)[0])
+    single_params = _snapshot(scope)
+    guardian.flush()
+
+    guardian.install(guardian.GuardianConfig(policy="skip"))
+    _restore(scope, init)
+    mesh = mesh_from_spec("dp4,tp2")
+    runner = ShardedWindowRunner(prog, ["img", "label"], [loss.name], mesh,
+                                 n_steps=8, feed_per_step=True)
+    assert runner.guard is not None and runner.guard.scale_vars
+    assert runner.donate  # sharded param/optimizer state updates in place
+    (l,) = runner.run({"img": xs, "label": ys})
+    guardian.flush()
+    gm = guardian.metrics()
+    np.testing.assert_allclose(float(np.asarray(l).reshape(-1)[0]), single,
+                               rtol=2e-4, atol=2e-4)
+    scale_name, good_name = prog._loss_scale_vars
+    for name in (scale_name, good_name):
+        np.testing.assert_array_equal(np.asarray(scope.get(name)),
+                                      single_params[name], err_msg=name)
+    for k, v in single_params.items():
+        # fp16 backward + loss-scale divide amplify fp reassociation noise
+        # slightly vs the fp32 oracle tests
+        np.testing.assert_allclose(np.asarray(scope.get(k)), v,
+                                   rtol=1e-3, atol=5e-4, err_msg=k)
+    assert gm.get("steps") == 8 and gm.get("trips", 0) == 0
+
+
+def test_guarded_window_injected_overflow_skips_in_graph():
+    """A grad-Inf injected at an absolute step INSIDE the sharded window
+    trips the in-graph commit gate: the bad step's update is dropped on
+    device, training continues, and the guardian observes the trip at the
+    right absolute step."""
+    guardian.install(guardian.GuardianConfig(policy="skip"))
+    loss = _build_mlp(seed=9)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = _executor._global_scope
+
+    rng = np.random.RandomState(3)
+    xs = rng.normal(size=(4, 8, 16)).astype(np.float32)
+    ys = rng.randint(0, 10, size=(4, 8, 1)).astype(np.int64)
+    fault.install(fault.FaultPlan(grad_inf_step=2, mode="raise"))
+
+    mesh = mesh_from_spec("dp4,tp2")
+    runner = ShardedWindowRunner(prog, ["img", "label"], [loss.name], mesh,
+                                 n_steps=4, feed_per_step=True)
+    (l,) = runner.run({"img": xs, "label": ys})
+    assert np.isfinite(float(np.asarray(l).reshape(-1)[0]))
+    guardian.flush()
+    gm = guardian.metrics()
+    assert gm.get("trips") == 1 and gm.get("skips") == 1
+    rec = guardian.current().recorder.records()[-1]
+    assert rec.step == 2 and not rec.finite
+
+
+# ---------------------------------------------------------------------------
+# satellite: indivisible batch -> named error, not opaque XLA failure
+# ---------------------------------------------------------------------------
+
+
+def test_indivisible_batch_raises_named_error():
+    loss = _build_mlp(seed=11)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = mesh_from_spec("dp4,tp2")
+    runner = ShardedWindowRunner(
+        fluid.default_main_program(), ["img", "label"], [loss.name], mesh,
+        n_steps=2, feed_per_step=True)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(size=(2, 6, 16)).astype(np.float32),
+            "label": rng.randint(0, 10, size=(2, 6, 1)).astype(np.int64)}
+    with pytest.raises(ValueError) as ei:
+        runner.run(feed)
+    msg = str(ei.value)
+    # names the batch size, the mesh axis, and the divisor
+    assert "6" in msg and "dp" in msg and "4" in msg
+    assert "img" in msg and "dp4xtp2" in msg
+
+    # the strict per-step surface raises the same named error
+    step = runner.step
+    with pytest.raises(ValueError, match="divis"):
+        step.place_feed({"img": rng.normal(size=(6, 16)).astype(np.float32)},
+                        strict=True)
+
+
+# ---------------------------------------------------------------------------
+# satellite: fingerprint folds mesh + spec table
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_mesh_sensitivity_and_rename_invariance():
+    from paddle_tpu.compile_cache import program_fingerprint
+    from paddle_tpu.fluid.executor import BlockPlan
+    from paddle_tpu.fluid.framework import Program, program_guard
+    from paddle_tpu.parallel.spmd import SpecLayout, resolve_tp_axis
+
+    def build(noise_layers=0):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            # advance the unique-name counters WITHOUT polluting the
+            # program: noise builds go to a throwaway program first
+            img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+            h = fluid.layers.fc(input=img, size=32, act="relu")
+            pred = fluid.layers.fc(input=h, size=10, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        return prog, loss
+
+    def fp(prog, loss, spec):
+        mesh = mesh_from_spec(spec)
+        plan = BlockPlan(prog, 0, ["img", "label"], [loss.name])
+        tp = resolve_tp_axis(mesh)
+        layout = SpecLayout(tp_axis=tp) if "tp" in mesh.axis_names else None
+        specs = infer_param_specs(prog, plan, mesh, tp, layout=layout)
+        extra = {"kind": "sharded_window", "n_steps": 4,
+                 "mesh": [[a, int(mesh.shape[a])] for a in mesh.axis_names]}
+        feeds = [("img", (8, 16), "float32"), ("label", (8, 1), "int64")]
+        return program_fingerprint(prog, feeds=feeds, fetches=[loss.name],
+                                   extra=extra,
+                                   spec_table=table_signature(specs))
+
+    prog_a, loss_a = build()
+    # second build: the global name counters have advanced, so every var
+    # name differs (fc_2.w_0 vs fc_0.w_0) — pure rename noise
+    prog_b, loss_b = build()
+    assert [v for v in prog_a.global_block().vars] != \
+        [v for v in prog_b.global_block().vars]
+
+    # same mesh twice -> identical fingerprint (the warm-start hit)
+    assert fp(prog_a, loss_a, "dp8") == fp(prog_a, loss_a, "dp8")
+    # rename invariance WITH the spec table folded in
+    assert fp(prog_a, loss_a, "dp8") == fp(prog_b, loss_b, "dp8")
+    assert fp(prog_a, loss_a, "dp4,tp2") == fp(prog_b, loss_b, "dp4,tp2")
+    # mesh sensitivity: dp8 vs dp4,tp2 are distinct executables
+    assert fp(prog_a, loss_a, "dp8") != fp(prog_a, loss_a, "dp4,tp2")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: second process warm-starts the sharded window program
+# ---------------------------------------------------------------------------
+
+_SHARDED_WARM_SCRIPT = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu.fluid as fluid
+from paddle_tpu import compile_cache
+from paddle_tpu.fluid import profiler
+from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+
+compile_cache.configure(sys.argv[1])
+fluid.default_main_program().random_seed = 5
+fluid.default_startup_program().random_seed = 5
+img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+h = fluid.layers.fc(input=img, size=32, act="relu")
+pred = fluid.layers.fc(input=h, size=10, act="softmax")
+loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+pe = ParallelExecutor(loss_name=loss.name, mesh="dp4,tp2")
+rng = np.random.RandomState(0)
+feed = {"img": rng.normal(size=(4, 8, 16)).astype(np.float32),
+        "label": rng.randint(0, 10, size=(4, 8, 1)).astype(np.int64)}
+out = None
+for _ in range(2):
+    (out,) = pe.run_steps([loss], feed=feed, n_steps=4, feed_per_step=True)
+c = profiler.counters()
+print(json.dumps({
+    "hit": c.get("compile_cache.hit", 0),
+    "miss": c.get("compile_cache.miss", 0),
+    "mesh": pe.mesh_label,
+    "loss": float(np.asarray(out).reshape(-1)[0])}))
+"""
+
+
+def test_subprocess_warm_start_sharded_window(tmp_path):
+    """A second process re-running the SAME dp4×tp2 windowed program
+    against the first's cache dir records hit>0, miss==0 — elastic
+    restarts of a sharded job warm-start (ISSUE 7 acceptance)."""
+    cache = str(tmp_path / "cache")
+
+    def run():
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", _SHARDED_WARM_SCRIPT, cache, REPO],
+            capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    assert cold["miss"] >= 1 and cold["hit"] == 0, cold
+    assert np.isfinite(cold["loss"]) and cold["mesh"] == "dp4xtp2"
+    warm = run()
+    assert warm["hit"] >= 1 and warm["miss"] == 0, warm
+    assert abs(warm["loss"] - cold["loss"]) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# satellite: mesh-labeled observability + collective gauge
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_labeled_counters_and_collective_gauge():
+    loss = _build_mlp(seed=21)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = mesh_from_spec("dp4,tp2")
+    runner = ShardedWindowRunner(
+        fluid.default_main_program(), ["img", "label"], [loss.name], mesh,
+        n_steps=2, feed_per_step=True)
+    rng = np.random.RandomState(0)
+    runner.run({"img": rng.normal(size=(2, 8, 16)).astype(np.float32),
+                "label": rng.randint(0, 10, size=(2, 8, 1)).astype(np.int64)})
+    flat = observe.registry().flat()
+    assert flat.get('executor.dispatches{mesh="dp4xtp2"}') == 1
+    assert flat.get('executor.window_steps{mesh="dp4xtp2"}') == 2
+    assert flat.get('spmd.collective_bytes{mesh="dp4xtp2"}', 0) > 0
+    assert flat.get('spmd.collective_count{mesh="dp4xtp2"}', 0) > 0
+    # event stamping context carries the topology
+    assert observe.current_mesh() == "dp4xtp2"
+
+
+def test_collective_stats_parser():
+    hlo = "\n".join([
+        "HloModule jit_kfn",
+        "  %p = f32[8,16]{1,0} parameter(0)",
+        "  %ar = f32[8,16]{1,0} all-reduce(f32[8,16]{1,0} %p), "
+        "replica_groups={{0,1}}",
+        "  %ag.s = (f32[32]{0}, f32[32]{0}) all-gather-start(%p)",
+        "  %ag.d = f32[32]{0} all-gather-done(%ag.s)",
+        "  %cp = bf16[4]{0} collective-permute(%p)",
+        "  ROOT %r = f32[8,16]{1,0} add(%ar, %ar)",
+    ])
+    stats = collective_stats(hlo)
+    assert stats["by_kind"] == {"all-reduce": 1, "all-gather": 1,
+                               "collective-permute": 1}
+    # 8*16*4 + 2*32*4 + 4*2 bytes; the -done line must not double count
+    assert stats["bytes"] == 8 * 16 * 4 + 2 * 32 * 4 + 4 * 2
+    assert stats["count"] == 3
+
+
+def test_mesh_stamp_in_run_events(tmp_path):
+    observe.configure(str(tmp_path / "obs"))
+    loss = _build_mlp(seed=23)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = mesh_from_spec("dp2,tp2")
+    runner = ShardedWindowRunner(
+        fluid.default_main_program(), ["img", "label"], [loss.name], mesh,
+        n_steps=2, feed_per_step=True)
+    rng = np.random.RandomState(0)
+    runner.run({"img": rng.normal(size=(2, 4, 16)).astype(np.float32),
+                "label": rng.randint(0, 10, size=(2, 4, 1)).astype(np.int64)})
+    sink = observe.get_sink()
+    from paddle_tpu.observe.events import read_events
+
+    recs = read_events(sink.events.path)
+    lowered = [r for r in recs if r["event"] == "spmd.lowered"]
+    assert lowered and lowered[0]["mesh"] == "dp2xtp2"
+    assert lowered[0]["collective_count"] > 0
+
+
+# ---------------------------------------------------------------------------
+# trainer + prefetcher on the sharded path
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_parallel_windowed_loop(tmp_path, monkeypatch):
+    """Trainer(parallel=True) under PADDLE_TPU_MESH + PADDLE_TPU_SPD runs
+    the windowed sharded loop: prefetcher stages dp-sharded windows,
+    run_steps dispatches < 1 per step, loss finite and falling."""
+    from paddle_tpu.fluid.trainer import Trainer
+
+    monkeypatch.setenv("PADDLE_TPU_MESH", "dp4,tp2")
+    monkeypatch.setenv("PADDLE_TPU_SPD", "4")
+
+    def train_func():
+        img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        return fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+
+    def optimizer_func():
+        return fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+
+    rng = np.random.RandomState(0)
+    # reader yields BATCHES as lists of per-sample tuples (the DataFeeder
+    # convention); batch 8 divides the dp4 extent
+    data = [[(rng.normal(size=(16,)).astype(np.float32),
+              rng.randint(0, 10, size=(1,)).astype(np.int64))
+             for _ in range(8)]
+            for _ in range(8)]
+
+    losses = []
+
+    def handler(event):
+        from paddle_tpu.fluid.trainer import EndStepEvent
+
+        if isinstance(event, EndStepEvent) and event.metrics:
+            losses.append(float(np.asarray(event.metrics[0]).reshape(-1)[0]))
+
+    c0 = dict(fluid.profiler.counters())
+    trainer = Trainer(train_func=train_func, optimizer_func=optimizer_func,
+                      place=fluid.CPUPlace(), parallel=True)
+    assert trainer.parallel_exe is not None
+    assert trainer.parallel_exe.mesh_label == "dp4xtp2"
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=lambda: iter(data), feed_order=["img", "label"])
+    c = fluid.profiler.counters()
+    assert losses and all(np.isfinite(l) for l in losses)
+    # 8 batches / SPD 4 = 2 fused windows
+    windows = c.get("executor.windows", 0) - c0.get("executor.windows", 0)
+    assert windows == 2
+    assert c.get('executor.windows{mesh="dp4xtp2"}', 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# smoke tool (wired into tier-1 like tools/window_smoke.py)
+# ---------------------------------------------------------------------------
+
+
+def test_spmd_smoke_tool():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import spmd_smoke
+    finally:
+        sys.path.pop(0)
+    report = spmd_smoke.main()
+    assert report["ok"], report
+    assert report["dispatches"] <= 2
+    assert report["window_steps"] == 16
+    assert report["collective_bytes"] > 0

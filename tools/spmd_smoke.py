@@ -1,0 +1,117 @@
+"""Whole-program SPMD smoke (CPU, 8 forced host devices, < 20 s).
+
+The CI oracle for the sharded windowed path (ISSUE 7): a GUARDED 16-step
+training window on a dp4×tp2 named mesh — numerics sentinel armed, the
+spec table sharding fc weights Megatron-style, mutable state donated —
+must complete in at most 2 executor dispatches (startup + one fused
+window), train all 16 steps with a finite falling loss, and leave the
+topology visible in the mesh-labeled counters plus a non-trivial
+``spmd.collective_*`` gauge (GSPMD actually inserted collectives).
+
+Run directly (``python tools/spmd_smoke.py`` — forces the 8-device
+virtual CPU mesh itself) or from tier-1 via
+``tests/test_spmd_window.py::test_spmd_smoke_tool`` (conftest already
+forces it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in _flags:
+    _flags += " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+os.environ["XLA_FLAGS"] = _flags
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_STEPS = 16
+MESH = "dp4,tp2"
+
+
+def main() -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observe
+    from paddle_tpu.fluid import guardian
+    from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+
+    t0 = time.perf_counter()
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 11
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            loss, startup_program=startup)
+
+    rng = np.random.RandomState(3)
+    feed = {  # one (N_STEPS, batch, ...) window; batch 8 divides dp4
+        "x": rng.normal(size=(N_STEPS, 8, 16)).astype(np.float32),
+        "y": rng.randint(0, 10, size=(N_STEPS, 8, 1)).astype(np.int64)}
+
+    scope = fluid.Scope()
+    guardian.install(guardian.GuardianConfig(policy="skip"))
+    counters0 = dict(fluid.profiler.counters())
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pe = ParallelExecutor(loss_name=loss.name, main_program=prog,
+                                  mesh=MESH)
+            (first,) = pe.run_steps([loss], feed=feed, n_steps=N_STEPS,
+                                    feed_per_step=True)
+            guardian.flush()
+            gm = guardian.metrics()
+    finally:
+        guardian.disable()
+
+    c = fluid.profiler.counters()
+
+    def delta(name):
+        return c.get(name, 0) - counters0.get(name, 0)
+
+    dispatches = delta("executor.dispatches")
+    label = pe.mesh_label
+    coll = c.get('spmd.collective_bytes{mesh="%s"}' % label, 0)
+    last = float(np.asarray(first).reshape(-1)[0])
+    report = {
+        "ok": bool(
+            dispatches <= 2
+            and delta("executor.windows") == 1
+            and delta("executor.window_steps") == N_STEPS
+            and delta('executor.dispatches{mesh="%s"}' % label) == 1
+            and gm.get("steps") == N_STEPS
+            and gm.get("trips", 0) == 0
+            and coll > 0
+            and np.isfinite(last)),
+        "mesh": label,
+        "dispatches": int(dispatches),
+        "windows": int(delta("executor.windows")),
+        "window_steps": int(delta("executor.window_steps")),
+        "dispatches_per_step": round(1.0 / N_STEPS, 4),
+        "guardian_steps": gm.get("steps"),
+        "collective_bytes": int(coll),
+        "last_loss": last,
+        "mesh_observed": observe.current_mesh(),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
