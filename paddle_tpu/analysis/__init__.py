@@ -1,0 +1,338 @@
+"""paddle_tpu.analysis — the pre-compile program verifier (ISSUE 8).
+
+The reference ships a whole ``fluid/inference/analysis`` subsystem because
+program-as-IR frameworks need static checks before an expensive backend
+touches the graph.  Here every misuse used to surface as an opaque XLA
+trace error seconds into compile, or as a scattered runtime reject — this
+package fails in *milliseconds* with named diagnostics instead.
+
+Four pass families over a Program (plus optional mesh + jit-config
+context), run by :func:`verify_program`:
+
+ - **structure** (AN103-AN109): dangling refs, def-before-use, unknown
+   ops, dead ops, unused feeds, unproducible fetches;
+ - **shape/dtype inference** (AN101/AN102): per-op infer rules registered
+   next to the op dispatch table + generic abstract evaluation via
+   ``jax.eval_shape`` over the op impls;
+ - **SPMD layout** (AN201-AN204): mesh-divisibility of feed batches and
+   annotated param dims, column/row chain conflicts, pre-compile
+   collective-bytes estimate;
+ - **contracts** (AN301/AN302, AN401/AN402): donation hazards and the
+   fp16-loss-scale / eager-window runtime rejects, pre-compile.
+
+Execution wiring: ``Executor.run``/``run_steps`` and ``ParallelExecutor``
+call :func:`check_before_compile` on every jit-cache miss, gated by
+``PADDLE_TPU_VERIFY=warn|strict|off`` (default ``warn``: error-severity
+findings become Python warnings; ``strict`` raises :class:`VerifyError`
+before any trace).  Diagnostics flow into ``observe`` events and
+``analysis.*`` counters.  CLI: ``python -m paddle_tpu.analysis lint``.
+Catalog: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Diagnostic", "Report", "VerifyError", "verify_program",
+           "check_before_compile", "verify_mode", "SEVERITIES", "CODES"]
+
+SEVERITIES = ("error", "warn", "info")
+
+#: the diagnostic catalog (code -> one-line meaning); docs/ANALYSIS.md
+#: carries the long-form table
+CODES = {
+    "AN000": "verifier internal error (diagnostic-free pass skipped)",
+    "AN101": "static shape mismatch",
+    "AN102": "static dtype mismatch (integer-index input fed floats)",
+    "AN103": "def-before-use read",
+    "AN104": "dangling reference (undeclared, never-produced input)",
+    "AN105": "maybe-uninitialized read (declared, never written)",
+    "AN106": "dead op for the requested fetches",
+    "AN107": "unused feed",
+    "AN108": "fetch nothing produces",
+    "AN109": "unknown op type",
+    "AN201": "feed batch not divisible by mesh data axis",
+    "AN202": "annotated param dim not divisible by its mesh axis",
+    "AN203": "conflicting column/row layout positions for one weight",
+    "AN204": "pre-compile collective-bytes estimate",
+    "AN301": "optimizer ops mutate shared state in an inference program",
+    "AN302": "fetch aliases donated training state",
+    "AN401": "fp16 loss-scale program on the per-step PE path",
+    "AN402": "data-dependent eager ops inside a fused window",
+}
+
+
+@dataclass
+class Diagnostic:
+    code: str
+    severity: str                     # error | warn | info
+    message: str
+    op_idx: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    hint: Optional[str] = None
+    block_idx: int = 0
+
+    def format(self) -> str:
+        site = ""
+        if self.op_idx is not None:
+            site = f" @op#{self.op_idx}" + (f"({self.op_type})"
+                                            if self.op_type else "")
+        elif self.var:
+            site = f" @var '{self.var}'"
+        s = f"[{self.code}:{self.severity}]{site} {self.message}"
+        if self.hint:
+            s += f"  (hint: {self.hint})"
+        return s
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+@dataclass
+class Report:
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+    duration_ms: float = 0.0
+    kind: str = "run"
+    mesh: Optional[str] = None
+    collective_bytes_est: Optional[int] = None
+
+    def by_severity(self, severity: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.by_severity("error")
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return self.by_severity("warn")
+
+    @property
+    def clean(self) -> bool:
+        """No errors and no warnings (info notes allowed)."""
+        return not self.errors and not self.warnings
+
+    def format(self, min_severity: str = "info") -> str:
+        keep = SEVERITIES[: SEVERITIES.index(min_severity) + 1]
+        lines = [d.format() for d in self.diagnostics if d.severity in keep]
+        lines.append(
+            f"-- verify[{self.kind}]: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s), "
+            f"{len(self.by_severity('info'))} note(s) in "
+            f"{self.duration_ms:.1f}ms --")
+        return "\n".join(lines)
+
+
+class VerifyError(RuntimeError):
+    """Strict-mode verification failure, raised BEFORE any trace/compile."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        errs = report.errors
+        head = f"program verification failed: {len(errs)} error(s)"
+        super().__init__(
+            head + "\n" + "\n".join(d.format() for d in errs))
+
+
+def verify_mode() -> str:
+    from ..fluid import envcontract
+
+    return envcontract.get("PADDLE_TPU_VERIFY")
+
+
+def _feed_infos(program, feed, batch_hint):
+    """Normalize the feed argument into name -> (shape, dtype) facts.
+
+    Accepts a dict of arrays (executor path: concrete) or a list of feed
+    NAMES / None (static path: declared shapes with the batch placeholder
+    bound to ``batch_hint``).  Returns (infos, concrete_flag)."""
+    import numpy as np
+
+    gb = program.global_block()
+    infos: Dict[str, object] = {}
+    if isinstance(feed, dict):
+        for k, v in feed.items():
+            try:
+                arr = v if hasattr(v, "shape") and hasattr(v, "dtype") \
+                    else np.asarray(v)
+                infos[k] = (tuple(int(d) for d in arr.shape),
+                            str(np.dtype(arr.dtype)))
+            except Exception:
+                infos[k] = None
+        return infos, True
+    names = list(feed) if feed is not None else [
+        v.name for v in gb.vars.values() if getattr(v, "is_data", False)]
+    for k in names:
+        if gb._has_var_recursive(k):
+            v = gb._var_recursive(k)
+            if v.shape is not None:
+                try:
+                    infos[k] = (
+                        tuple(batch_hint if d in (-1, None) else int(d)
+                              for d in v.shape),
+                        str(np.dtype(v.dtype)))
+                    continue
+                except TypeError:
+                    pass
+        infos[k] = None
+    return infos, False
+
+
+def verify_program(program=None, feed=None, fetch_list=None, mesh=None,
+                   kind: str = "run", batch_hint: int = 8,
+                   block_idx: int = 0) -> Report:
+    """Run all static passes over ``program``; never compiles anything.
+
+    ``feed``: dict of (arrays|shapes) for concrete checking, or a list of
+    feed names / None for declared-shape mode (``-1`` batch dims bind to
+    ``batch_hint``).  ``mesh``: a Mesh, a ``"dp4,tp2"`` spec string, or an
+    {axis: extent} dict — enables the SPMD pass.  ``kind`` names the
+    execution surface the program is headed for (``run``, ``run_steps``,
+    ``pe_run``, ``pe_run_steps``, ``lint``) and selects the contract
+    checks.
+    """
+    from ..fluid.framework import Variable, default_main_program
+    from .contracts import run_contract_pass
+    from .infer import run_infer_pass
+    from .spmd_check import mesh_axes_of, run_spmd_pass, _axes_label
+    from .structure import run_structure_pass
+
+    program = program or default_main_program()
+    fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                   for f in (fetch_list or [])]
+    t0 = time.perf_counter()
+    diags: List[Diagnostic] = []
+    axes = mesh_axes_of(mesh)
+    if axes.get("dp", 0) > 1:
+        # keep the declared-shape placeholder dividable so static lint
+        # doesn't invent indivisible batches
+        batch_hint = max(batch_hint, axes["dp"] * 2)
+        if batch_hint % axes["dp"]:
+            batch_hint = axes["dp"] * 2
+    feed_infos, concrete = _feed_infos(program, feed, batch_hint)
+
+    def guarded(pass_fn, *args):
+        try:
+            return pass_fn(*args)
+        except Exception as e:  # a verifier bug must not fail the run
+            diags.append(Diagnostic(
+                "AN000", "info",
+                f"verifier pass {pass_fn.__name__} crashed: "
+                f"{type(e).__name__}: {e}"))
+            return None
+
+    live = guarded(run_structure_pass, program, block_idx,
+                   list(feed_infos), fetch_names, diags)
+    guarded(run_infer_pass, program, block_idx, feed_infos, diags,
+            batch_hint, live)
+    est = guarded(run_spmd_pass, program, axes, feed_infos, fetch_names,
+                  diags, concrete)
+    guarded(run_contract_pass, program, fetch_names, kind, diags)
+
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    diags.sort(key=lambda d: (order.get(d.severity, 9),
+                              d.op_idx if d.op_idx is not None else 1 << 30))
+    return Report(diagnostics=diags,
+                  duration_ms=(time.perf_counter() - t0) * 1e3,
+                  kind=kind, mesh=_axes_label(axes) if axes else None,
+                  collective_bytes_est=est)
+
+
+# -- executor integration ---------------------------------------------------
+
+# one verification per (program identity, jit config); re-verifying the
+# same compiled entry would only re-pay the walk.  Lock-protected: the
+# serving engine compiles from worker threads (tools/repo_lint.py's
+# racy-dict contract).
+_verified: Dict[tuple, bool] = {}
+_warned: set = set()
+_memo_lock = threading.Lock()
+
+
+def reset() -> None:
+    """Clear the once-per-program memoization (test-harness hook)."""
+    with _memo_lock:
+        _verified.clear()
+        _warned.clear()
+
+
+def check_before_compile(program, feed=None, fetch_list=None, mesh=None,
+                         kind: str = "run") -> Optional[Report]:
+    """The Executor/ParallelExecutor hook: verify on jit-cache miss.
+
+    ``PADDLE_TPU_VERIFY=off`` skips entirely; ``warn`` (default) turns
+    error findings into Python warnings; ``strict`` raises
+    :class:`VerifyError` before any trace.  Every outcome lands on the
+    ``analysis.*`` counters and (when configured) the observe event log.
+    """
+    mode = verify_mode()
+    if mode == "off":
+        return None
+    try:
+        from ..parallel.mesh import mesh_label
+
+        label = mesh_label(mesh) if mesh is not None \
+            and not isinstance(mesh, (str, dict)) else str(mesh or "")
+        fetch_sig = tuple(str(getattr(f, "name", f))
+                          for f in (fetch_list or []))
+        feed_sig = tuple(sorted(feed)) if isinstance(feed, dict) \
+            else tuple(feed or ())
+        key = (program._cache_token, program._version, kind, label,
+               fetch_sig, feed_sig, mode)
+        with _memo_lock:
+            if _verified.get(key):
+                return None
+        report = verify_program(program, feed=feed, fetch_list=fetch_list,
+                                mesh=mesh, kind=kind)
+        with _memo_lock:
+            _verified[key] = True
+            if len(_verified) > 4096:
+                _verified.clear()
+        _note(report)
+    except VerifyError:
+        raise
+    except Exception:
+        return None  # the verifier must never take the run down
+    if report.errors:
+        if mode == "strict":
+            raise VerifyError(report)
+        wkey = (program._cache_token,
+                tuple(sorted({d.code for d in report.errors})))
+        with _memo_lock:
+            fresh = wkey not in _warned
+            _warned.add(wkey)
+        if fresh:
+            warnings.warn(
+                "program verification found "
+                f"{len(report.errors)} error(s) "
+                f"(PADDLE_TPU_VERIFY=strict to fail fast):\n"
+                + "\n".join(d.format() for d in report.errors),
+                stacklevel=3)
+    return report
+
+
+def _note(report: Report) -> None:
+    """analysis.* counters + one observe event per verification."""
+    try:
+        from .. import observe
+
+        reg = observe.registry()
+        reg.inc("analysis.programs")
+        reg.record_timing("analysis.verify_ms", report.duration_ms / 1e3)
+        for d in report.diagnostics:
+            reg.inc("analysis.diagnostics",
+                    labels={"code": d.code, "severity": d.severity})
+        if report.diagnostics:
+            observe.emit(
+                "analysis.verify", kind=report.kind, mesh=report.mesh,
+                errors=len(report.errors), warns=len(report.warnings),
+                notes=len(report.by_severity("info")),
+                ms=round(report.duration_ms, 3),
+                codes=sorted({d.code for d in report.diagnostics}))
+    except Exception:
+        pass

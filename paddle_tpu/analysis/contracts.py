@@ -1,0 +1,119 @@
+"""Pass 3: donation & AMP runtime-contract analysis.
+
+The execution layer enforces these contracts at runtime (PE rejects
+per-step fp16-scale programs, run_steps rejects eager ops, donation is
+training-only) — this pass turns each reject into a pre-compile
+diagnostic with a named code and a fix hint, and statically flags the
+donation hazards the runtime can only paper over.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Set
+
+from ..fluid.framework import OpRole, Parameter, Program
+
+
+def _has_eager(program: Program, block_idx: int = 0) -> bool:
+    from ..ops.array_ops import EAGER_OPS
+
+    def op_eager(op):
+        base = op.type[:-5] if op.type.endswith("_grad") else op.type
+        if base in EAGER_OPS:
+            return True
+        sub = op.attr("sub_block")
+        if isinstance(sub, int):
+            return any(op_eager(b) for b in program.block(sub).ops)
+        return False
+
+    return any(op_eager(op) for op in program.block(block_idx).ops)
+
+
+def mutated_persistables(program: Program) -> Set[str]:
+    gb = program.global_block()
+    out: Set[str] = set()
+    for op in gb.ops:
+        for n in op.output_arg_names:
+            if n and gb._has_var_recursive(n) \
+                    and gb._var_recursive(n).persistable:
+                out.add(n)
+    return out
+
+
+def run_contract_pass(program: Program, fetch_names: Sequence[str],
+                      kind: str, diags: list) -> None:
+    from . import Diagnostic
+    from ..fluid import envcontract
+
+    scale_vars = getattr(program, "_loss_scale_vars", None)
+
+    # fp16 dynamic loss scale on the per-step ParallelExecutor path: the
+    # backward seed goes unscaled while append_unscale_ops still divides
+    # grads — silently wrong math, rejected at runtime today
+    if scale_vars is not None and kind == "pe_run":
+        diags.append(Diagnostic(
+            "AN401", "error",
+            "dynamic fp16 loss-scale program headed for the per-step "
+            "ParallelExecutor path (unscaled backward seed + unscale ops "
+            "= silently wrong gradients)",
+            hint="use ParallelExecutor.run_steps (the windowed sharded "
+                 "path folds the scale update into the scan carry), or "
+                 "train in bfloat16 which needs no scaling"))
+
+    # fused windows cannot scan data-dependent eager islands
+    if kind in ("run_steps", "pe_run_steps") and _has_eager(program):
+        diags.append(Diagnostic(
+            "AN402", "error",
+            "program contains data-dependent eager ops; a fused "
+            "run_steps window cannot scan them",
+            hint="use Executor.run per step (eager-island segmentation), "
+                 "or move the data-dependent tail out of the training "
+                 "program"))
+
+    # an inference program (clone(for_test=True) — predictor clones may
+    # share its scope concurrently) that still carries optimizer-role ops
+    # mutates shared Parameters under its readers.  Keyed on _is_test,
+    # NOT on a missing param/grad list: hand-built training programs
+    # (append_backward + manual sgd appends, the reference-book style)
+    # legitimately never record one.
+    if getattr(program, "_is_test", False):
+        gb = program.global_block()
+        for idx, op in enumerate(gb.ops):
+            role = int(op.attr(OpRole.KEY, OpRole.Forward))
+            if role != OpRole.Optimize:
+                continue
+            wrote = [n for n in op.output_arg_names
+                     if n and gb._has_var_recursive(n)
+                     and isinstance(gb._var_recursive(n), Parameter)]
+            if wrote:
+                diags.append(Diagnostic(
+                    "AN301", "error",
+                    f"op #{idx} ({op.type}) is an optimizer-role op "
+                    f"writing shared parameter(s) {wrote} in a program "
+                    f"with no recorded param/grad list — predictor "
+                    f"clones sharing this scope would race on (and, if "
+                    f"donated, free) live state",
+                    op_idx=idx, op_type=op.type,
+                    hint="build inference programs with "
+                         "clone(for_test=True) (drops optimizer ops), or "
+                         "keep _params_grads on the training program"))
+                break
+
+    # donated-buffer read-after-commit: a fetch that aliases mutated
+    # persistable state on a donating program.  Executor.run copies the
+    # returned handle, but any scope handle taken BEFORE the dispatch is
+    # dead after it — worth a note at verify time.
+    if program._params_grads is not None \
+            and envcontract.get("PADDLE_TPU_DONATE") \
+            and kind in ("run", "run_steps", "pe_run_steps"):
+        mutated = mutated_persistables(program)
+        aliased = sorted(set(fetch_names) & mutated)
+        if aliased:
+            diags.append(Diagnostic(
+                "AN302", "info",
+                f"fetch(es) {aliased} alias donated training state: the "
+                f"dispatch invalidates the input buffer and the executor "
+                f"returns a device copy",
+                hint="don't hold pre-dispatch scope handles to these "
+                     "vars across the run; PADDLE_TPU_DONATE=0 disables "
+                     "donation for debugging"))

@@ -1,0 +1,327 @@
+"""Pass 1: static shape/dtype inference over a Program block.
+
+Walks the ops of a block in order, propagating ``(shape, dtype)`` facts
+from feeds / parameters / declared data vars through every op, using
+
+ 1. the explicit infer rule registered next to the op's dispatch entry
+    (``ops.registry.INFER_REGISTRY`` — precise named diagnostics), else
+ 2. the generic abstract evaluator: ``jax.eval_shape`` over the op's
+    registered forward impl with ``ShapeDtypeStruct`` operands — the same
+    code the real trace runs, so anything traceable is inferable, else
+ 3. ``unknown`` (eager/data-dependent ops, control flow, LoD-dependent
+    sequence kernels) — unknown facts propagate as unknown and never
+    produce diagnostics, which is what keeps false positives at zero.
+
+A mismatch surfaces as AN101 (shape) / AN102 (dtype) with the op index,
+op type and operand var names — milliseconds instead of an XLA trace
+error seconds into compile.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops import registry as _reg
+
+# VarInfo: (shape tuple, dtype str) or None for statically-unknown.
+VarInfo = Optional[Tuple[Tuple[int, ...], str]]
+
+_SKIP_OPS = frozenset(["feed", "fetch", "read", "create_py_reader"])
+_SIDE_EFFECT_OPS = frozenset(["print", "save", "save_combine"])
+
+#: op families whose generic abstract evaluation can fail for reasons
+#: other than a shape bug (host/LoD-dependent semantics) — their failures
+#: demote to an info note instead of an AN101 error.
+_UNRELIABLE_PREFIXES = ("sequence_", "lod_", "crf_", "beam_", "ctc_",
+                        "warpctc", "linear_chain_crf", "chunk_eval",
+                        "edit_distance", "im2sequence", "row_conv",
+                        "dynamic_", "shrink_", "array_", "reorder_",
+                        "multiclass_", "generate_", "rpn_", "box_",
+                        "anchor_", "detection_", "polygon_", "roi_",
+                        "prior_box", "density_prior_box", "target_assign",
+                        "mine_hard_examples", "bipartite_match")
+
+
+def _is_unreliable(op_type: str) -> bool:
+    base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    return base.startswith(_UNRELIABLE_PREFIXES)
+
+
+def _is_eager(op_type: str) -> bool:
+    from ..ops.array_ops import EAGER_OPS
+
+    base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    return base in EAGER_OPS
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((str(k), _freeze(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return (v.shape, str(v.dtype), v.tobytes())
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+class _EvalCache:
+    """Process-wide LRU over generic abstract evaluations, keyed on
+    (op type, attrs, input signature) — repeated geometry (ResNet stages,
+    transformer layers) infers once."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = cap
+        self._od: "OrderedDict[tuple, object]" = OrderedDict()
+
+    def get(self, key, miss):
+        if key in self._od:
+            self._od.move_to_end(key)
+            return self._od[key]
+        val = miss()
+        self._od[key] = val
+        if len(self._od) > self.cap:
+            self._od.popitem(last=False)
+        return val
+
+
+_eval_cache = _EvalCache()
+
+
+def _generic_eval(op, ins: Dict[str, List[VarInfo]], needs_rng: bool):
+    """Abstractly evaluate one op via jax.eval_shape over its impl.
+
+    Returns ({slot: [VarInfo]}, error_message_or_None, skipped_bool).
+    ``skipped`` means the evaluation could not run for a reason that is
+    NOT evidence of a user bug (host-dependent math, LoD semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    opdef = _reg.get_op_def(op.type[:-5] if (not _reg.is_registered(op.type)
+                                             and op.type.endswith("_grad"))
+                            else op.type)
+    if any(v is None for vals in ins.values() for v in vals):
+        return {}, None, True
+
+    structs = {slot: [jax.ShapeDtypeStruct(tuple(v[0]), np.dtype(v[1]))
+                      for v in vals]
+               for slot, vals in ins.items()}
+    outputs_spec = {s: list(n) for s, n in op.outputs.items() if n}
+    attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
+
+    def run():
+        def absfn(vals, key):
+            inputs = {slot: list(v) for slot, v in vals.items()}
+            ctx = _reg.ExecContext(op.type, inputs, outputs_spec, op.attrs,
+                                   [key] if needs_rng else None)
+            raw = opdef.fn(ctx)
+            out = {}
+            for k, v in (raw or {}).items():
+                if k.endswith("@LOD"):
+                    continue
+                out[k] = [x for x in (v if isinstance(v, (list, tuple))
+                                      else [v])]
+            return out
+
+        key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        try:
+            shaped = jax.eval_shape(absfn, structs, key_struct)
+        except Exception as e:  # classified below
+            return ("error", e)
+        out = {}
+        for slot, vals in shaped.items():
+            out[slot] = [
+                (tuple(int(d) for d in v.shape), str(np.dtype(v.dtype)))
+                if hasattr(v, "shape") else None
+                for v in vals]
+        return ("ok", out)
+
+    key = (op.type, _freeze(attrs),
+           tuple(sorted((s, tuple((tuple(v[0]), v[1]) for v in vals))
+                        for s, vals in ins.items())))
+    kind, payload = _eval_cache.get(key, run)
+    if kind == "ok":
+        return payload, None, False
+    exc = payload
+    trace_errs = tuple(
+        t for t in (getattr(jax.errors, n, None)
+                    for n in ("ConcretizationTypeError",
+                              "TracerArrayConversionError",
+                              "TracerBoolConversionError",
+                              "TracerIntegerConversionError"))
+        if t is not None)
+    if isinstance(exc, trace_errs) or isinstance(
+            exc, (NotImplementedError, KeyError, AttributeError,
+                  RuntimeError, IndexError)):
+        return {}, None, True
+    if _is_unreliable(op.type):
+        return {}, None, True
+    return {}, f"{type(exc).__name__}: {exc}", False
+
+
+def _grad_mirror(op, env: Dict[str, VarInfo]) -> Dict[str, List[VarInfo]]:
+    """Generic-vjp grad op: each output slot ``S@GRAD`` mirrors the
+    forward input slot ``S`` (same shapes/dtypes — backward.py declares
+    the grad vars that way too)."""
+    out = {}
+    for slot, names in op.outputs.items():
+        if not slot.endswith("@GRAD"):
+            out[slot] = [None] * len(names)
+            continue
+        fwd = op.inputs.get(slot[:-5], [])
+        vals = []
+        for i in range(len(names)):
+            vals.append(env.get(fwd[i]) if i < len(fwd) and fwd[i] else None)
+        out[slot] = vals
+    return out
+
+
+def run_infer_pass(program, block_idx, feed_infos: Dict[str, VarInfo],
+                   diags: list, batch_hint: int = 8,
+                   live=None) -> Dict[str, VarInfo]:
+    """Infer shapes/dtypes through one block; appends Diagnostic records
+    to ``diags``.  Returns the final name -> VarInfo environment.
+
+    ``live``: op-index set from the structure pass — dead ops are skipped
+    (the executor prunes them before tracing, so a dead op's shape bug is
+    not a runtime error; the structure pass already notes it as AN106)."""
+    from . import Diagnostic
+    from ..fluid import control_flow_exec
+
+    block = program.block(block_idx)
+
+    def declared_info(name) -> VarInfo:
+        if not block._has_var_recursive(name):
+            return None
+        v = block._var_recursive(name)
+        if v.shape is None or v.dtype is None:
+            return None
+        shape = tuple(batch_hint if d in (-1, None) else int(d)
+                      for d in v.shape)
+        try:
+            dt = str(np.dtype(v.dtype))
+        except TypeError:
+            return None
+        return (shape, dt)
+
+    env: Dict[str, VarInfo] = {}
+    for name, info in feed_infos.items():
+        env[name] = info
+        # a fed array must agree with the declared var on every static dim
+        if info is None or not block._has_var_recursive(name):
+            continue
+        v = block._var_recursive(name)
+        if v.shape is None:
+            continue
+        want = tuple(v.shape)
+        got = info[0]
+        # rank mismatch is legal (the mul family flattens, and feeders
+        # reshape); LoD feeds bind the ragged leading dim to the packed
+        # row count — only same-rank static-dim disagreements are bugs
+        ok = len(got) != len(want) or all(
+            w in (-1, None) or int(w) == g for w, g in zip(want, got))
+        if ok is not True and getattr(v, "lod_level", 0) > 0:
+            ok = True
+        if not ok:
+            # warn, not error: this framework binds shapes at trace time
+            # from the fed arrays (framework.py module contract), and the
+            # v2 facade feeds index labels into class-dim-declared data
+            # vars on purpose — a disagreement is a smell, not a fault
+            diags.append(Diagnostic(
+                "AN101", "warn",
+                f"feed '{name}' shape {list(got)} does not match declared "
+                f"var shape {list(want)}",
+                var=name, hint="fix the fed array or the data layer shape"))
+
+    def resolve(name) -> VarInfo:
+        if name in env:
+            return env[name]
+        # first read of a non-fed name: persistables and data vars carry
+        # trustworthy declared shapes; everything else is unknown
+        if block._has_var_recursive(name):
+            v = block._var_recursive(name)
+            if v.persistable or getattr(v, "is_data", False):
+                info = declared_info(name)
+                env[name] = info
+                return info
+        env[name] = None
+        return None
+
+    for idx, op in enumerate(block.ops):
+        if live is not None and idx not in live:
+            for names in op.outputs.values():
+                for n in names:
+                    if n:
+                        env[n] = None
+            continue
+        if op.type in _SKIP_OPS or op.type in _SIDE_EFFECT_OPS:
+            for names in op.outputs.values():
+                for n in names:
+                    if n:
+                        env[n] = declared_info(n)
+            continue
+        if (op.type in control_flow_exec.HANDLERS or _is_eager(op.type)
+                or op.attr("sub_block") is not None):
+            # data-dependent / control-flow: outputs unknown, no claims
+            for names in op.outputs.values():
+                for n in names:
+                    if n:
+                        env[n] = None
+            continue
+
+        ins = {slot: [resolve(n) if n else None for n in names]
+               for slot, names in op.inputs.items()}
+
+        is_grad = (not _reg.is_registered(op.type)) \
+            and op.type.endswith("_grad") \
+            and _reg.is_registered(op.type[:-5])
+        rule = _reg.get_infer_rule(op.type)
+        outs: Dict[str, List[VarInfo]] = {}
+        if rule is not None:
+            try:
+                outs = rule(op, ins) or {}
+            except _reg.InferMismatch as m:
+                diags.append(Diagnostic(
+                    m.code, "error", str(m), op_idx=idx, op_type=op.type,
+                    hint="operand shapes/dtypes are inconsistent at build "
+                         "time; this would fail (or silently truncate) in "
+                         "compile"))
+                outs = {}
+        elif is_grad and _reg.get_op_def(op.type[:-5]).grad_fn is None:
+            outs = _grad_mirror(op, env)
+        elif _reg.is_registered(op.type) or is_grad:
+            opdef = _reg.get_op_def(op.type[:-5] if is_grad else op.type)
+            if is_grad:
+                outs = _grad_mirror(op, env)
+            else:
+                outs, err, skipped = _generic_eval(op, ins,
+                                                   opdef.stateful)
+                if err is not None:
+                    opnd = ", ".join(
+                        f"{n}={list(v[0]) if v else '?'}"
+                        for ns in op.inputs.values() for n, v in
+                        ((n, env.get(n)) for n in ns) if n)
+                    diags.append(Diagnostic(
+                        "AN101", "error",
+                        f"{op.type}: abstract evaluation failed — {err} "
+                        f"(operands: {opnd})",
+                        op_idx=idx, op_type=op.type,
+                        hint="operand shapes are inconsistent; the XLA "
+                             "trace would fail the same way after seconds "
+                             "of compile"))
+        # unknown op types: the structure pass owns that diagnostic
+
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            for i, n in enumerate(names):
+                if not n:
+                    continue
+                env[n] = vals[i] if vals is not None and i < len(vals) \
+                    else None
+    return env

@@ -1,0 +1,208 @@
+"""Pass 2: static SPMD layout checking against a named mesh.
+
+Works from the mesh's {axis: extent} map alone (no devices needed — a
+laptop can lint a dp256 pod program), mirroring the canonical
+``parallel.spmd`` layout rules: feed batches must divide the data axes
+(the runtime ``place_feed`` check, now pre-compile), parameter dims
+annotated onto a mesh axis (``dist_spec``/``dist_hint`` or the
+SpecLayout column/row alternation) are checked for divisibility, shared
+weights with conflicting column/row chain positions are flagged, and a
+pre-compile collective-bytes estimate lands on the
+``analysis.collective_bytes_est`` gauge next to the post-compile
+``spmd.collective_bytes`` truth gauge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..fluid.framework import Parameter, Program
+
+
+def mesh_axes_of(mesh) -> Dict[str, int]:
+    """{axis: extent} from a Mesh, a 'dp4,tp2' spec string, or a dict."""
+    if mesh is None:
+        return {}
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    if isinstance(mesh, str):
+        from ..parallel.mesh import parse_mesh_spec
+
+        return parse_mesh_spec(mesh)
+    return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def _axes_label(axes: Dict[str, int]) -> str:
+    return "x".join(f"{a}{n}" for a, n in axes.items()) or "none"
+
+
+def _dtype_bytes(dtype) -> int:
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def _chain(program: Program):
+    """Every mul/matmul/lookup_table weight consumption in block order:
+    [(op_idx, op_type, weight_name, chain_order)] — the Megatron
+    column/row alternation index the spec table derives from."""
+    out = []
+    order = 0
+    for idx, op in enumerate(program.global_block().ops):
+        if op.type == "lookup_table":
+            for n in op.inputs.get("W", []):
+                if n:
+                    out.append((idx, op.type, n, None))
+        elif op.type in ("mul", "matmul"):
+            for n in op.inputs.get("Y", []):
+                if n:
+                    out.append((idx, op.type, n, order))
+                    order += 1
+    return out
+
+
+def run_spmd_pass(program: Program, axes: Dict[str, int],
+                  feed_infos: Dict[str, object], fetch_names, diags: list,
+                  batch_concrete: bool) -> Optional[int]:
+    """Append mesh diagnostics; returns the collective-bytes estimate
+    (None when the mesh has no sharding axes)."""
+    from . import Diagnostic
+
+    if not axes or all(n <= 1 for n in axes.values()):
+        return None
+    label = _axes_label(axes)
+    gb = program.global_block()
+    dp = axes.get("dp", 1)
+    tp = axes.get("tp", axes.get("mp", 1))
+    fsdp = axes.get("fsdp", 1)
+
+    # 1. feed batches must divide the data axis (place_feed, pre-compile)
+    if dp > 1 and batch_concrete:
+        for name, info in sorted(feed_infos.items()):
+            if info is None or not info[0]:
+                continue
+            b = int(info[0][0])
+            if b % dp != 0:
+                diags.append(Diagnostic(
+                    "AN201", "error",
+                    f"feed '{name}' batch {b} is not divisible by the "
+                    f"mesh data axis (dp={dp}, mesh {label})",
+                    var=name,
+                    hint=f"pad or drop the short batch, or pick a global "
+                         f"batch that is a multiple of {dp} — the sharded "
+                         f"window would reject this at dispatch"))
+
+    # 2. annotated parameter dims must divide their mesh axis
+    chain = _chain(program)
+    roles: Dict[str, list] = {}
+    for idx, op_type, name, order in chain:
+        roles.setdefault(name, []).append((idx, op_type, order))
+
+    def check_dims(name, shape, spec, source):
+        for d, ax in enumerate(spec):
+            if ax is None or d >= len(shape):
+                continue
+            ext = axes.get(ax, 0)
+            if ext <= 1:
+                continue  # axis absent/trivial: degrades by design
+            if shape[d] is None or int(shape[d]) % ext != 0:
+                diags.append(Diagnostic(
+                    "AN202", "warn",
+                    f"param '{name}' dim {d} ({shape[d]}) does not divide "
+                    f"mesh axis {ax}={ext} ({source}); it will run "
+                    f"REPLICATED on that axis",
+                    var=name,
+                    hint="resize the dim to a multiple of the axis or "
+                         "drop the annotation — silent degradation costs "
+                         "the sharding you asked for"))
+
+    for v in gb.vars.values():
+        if not isinstance(v, Parameter) or v.shape is None:
+            continue
+        shape = tuple(v.shape)
+        ds = getattr(v, "dist_spec", None)
+        if ds is not None:
+            check_dims(v.name, shape, tuple(ds[: len(shape)]),
+                       "explicit dist_spec")
+            continue
+        dh = getattr(v, "dist_hint", None)
+        if dh is not None:
+            check_dims(v.name, shape, (dh,) + (None,) * (len(shape) - 1),
+                       "explicit dist_hint")
+            continue
+        uses = roles.get(v.name)
+        if uses is None or len(shape) != 2 or (tp <= 1 and fsdp <= 1):
+            continue
+        # canonical SpecLayout: embedding/even orders column P(fsdp, tp),
+        # odd orders row P(tp, fsdp)
+        order = uses[0][2]
+        if order is None or order % 2 == 0:
+            spec = ("fsdp" if fsdp > 1 else None, "tp" if tp > 1 else None)
+        else:
+            spec = ("tp" if tp > 1 else None, "fsdp" if fsdp > 1 else None)
+        check_dims(v.name, shape, spec, "canonical SpecLayout table")
+
+    # 3. column/row conflicts: one weight at both chain parities (or as
+    # embedding AND linear operand) gets ONE layout — the other use pays
+    # a resharding collective every step
+    if tp > 1 or fsdp > 1:
+        for name, uses in sorted(roles.items()):
+            kinds = {("embedding" if o is None else ("col" if o % 2 == 0
+                                                     else "row"))
+                     for _, _, o in uses}
+            if len(kinds) > 1:
+                sites = ", ".join(f"op #{i} ({t})" for i, t, _ in uses)
+                diags.append(Diagnostic(
+                    "AN203", "warn",
+                    f"weight '{name}' is consumed at conflicting layout "
+                    f"positions ({'+'.join(sorted(kinds))}: {sites}) on "
+                    f"mesh {label}",
+                    var=name,
+                    hint="the spec table assigns the FIRST use's layout; "
+                         "every other use inserts a resharding collective "
+                         "— split the weight or align the uses"))
+
+    # 4. pre-compile collective estimate (cross-check against the
+    # post-compile spmd.collective_bytes gauge)
+    est = 0
+    if dp > 1:
+        # gradient all-reduce: one full param-sized reduction per step
+        # falls out of the partitioned backward when training
+        if program._params_grads is not None:
+            for v in gb.vars.values():
+                if isinstance(v, Parameter) and v.shape:
+                    est += int(np.prod(v.shape, dtype=np.int64)) \
+                        * _dtype_bytes(v.dtype)
+    if tp > 1:
+        # row-parallel (odd-order) matmuls all-reduce their activation
+        # output [batch, d_out] once per consumption
+        for idx, op_type, name, order in chain:
+            if order is None or order % 2 == 0:
+                continue
+            v = gb.vars.get(name)
+            if v is None or not v.shape or len(v.shape) != 2:
+                continue
+            batch = 1
+            for info in feed_infos.values():
+                if info is not None and info[0]:
+                    batch = max(batch, int(info[0][0]))
+            est += batch * int(v.shape[1]) * _dtype_bytes(v.dtype)
+    if est:
+        diags.append(Diagnostic(
+            "AN204", "info",
+            f"estimated per-step collective traffic on mesh {label}: "
+            f"{est} bytes (grad all-reduce + row-parallel activation "
+            f"all-reduce)", hint="compare with the spmd.collective_bytes "
+            "gauge after compile"))
+        try:
+            from .. import observe
+
+            observe.registry().set_gauge(
+                "analysis.collective_bytes_est", float(est),
+                labels={"mesh": label})
+        except Exception:
+            pass
+    return est

@@ -1,0 +1,168 @@
+"""Pass 0: structural/dataflow checks over one block (no shape math).
+
+Catches the misuse classes that today surface as opaque runtime errors
+deep inside trace/compile: unknown op types (NotImplementedError mid-
+trace), dangling references (KeyError / 'not initialized'), def-before-
+use reads, fetches nothing produces, unused feeds, and dead ops.  All
+reported with op index + var names, in milliseconds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..ops import registry as _reg
+from .infer import _SIDE_EFFECT_OPS, _SKIP_OPS
+
+
+def _is_known_op(op_type: str) -> bool:
+    from ..fluid import control_flow_exec
+
+    if op_type in _SKIP_OPS or op_type in _SIDE_EFFECT_OPS:
+        return True
+    if op_type in control_flow_exec.HANDLERS:
+        return True
+    if _reg.is_registered(op_type):
+        return True
+    return op_type.endswith("_grad") and _reg.is_registered(op_type[:-5])
+
+
+def live_op_indices(block, feed_names: Sequence[str],
+                    fetch_names: Sequence[str]) -> Set[int]:
+    """The executor's live-op slice (BlockPlan rule): ops needed for the
+    fetches, persistable writes, or side effects."""
+
+    def _persistable(name):
+        return block._has_var_recursive(name) and \
+            block._var_recursive(name).persistable
+
+    needed = set(fetch_names)
+    live: Set[int] = set()
+    for idx in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[idx]
+        if op.type in _SKIP_OPS:
+            continue
+        outs = [n for n in op.output_arg_names if n]
+        if (op.type in _SIDE_EFFECT_OPS
+                or any(n in needed for n in outs)
+                or any(_persistable(n) for n in outs)):
+            live.add(idx)
+            needed.update(n for n in op.input_arg_names if n)
+    return live
+
+
+def run_structure_pass(program, block_idx, feed_names: Sequence[str],
+                       fetch_names: Sequence[str], diags: list) -> Set[int]:
+    """Append structural diagnostics; returns the live-op index set."""
+    from . import Diagnostic
+
+    block = program.block(block_idx)
+    feed_set = set(feed_names)
+    fetch_set = set(fetch_names)
+    live = live_op_indices(block, feed_names, fetch_names)
+
+    def _var(name):
+        return block._var_recursive(name) \
+            if block._has_var_recursive(name) else None
+
+    # one forward walk: where is every name first written?
+    first_write: Dict[str, int] = {}
+    consumed: Set[str] = set()
+    for idx, op in enumerate(block.ops):
+        for n in op.output_arg_names:
+            if n and n not in first_write:
+                first_write[n] = idx
+
+    for idx, op in enumerate(block.ops):
+        if op.type in _SKIP_OPS:
+            continue
+        if not _is_known_op(op.type):
+            diags.append(Diagnostic(
+                "AN109", "error" if idx in live else "info",
+                f"unknown op type '{op.type}' (op #{idx}): no registered "
+                f"TPU implementation",
+                op_idx=idx, op_type=op.type,
+                hint="register the op in paddle_tpu/ops or remove it; a "
+                     "live unknown op raises NotImplementedError mid-"
+                     "trace" if idx in live else
+                     "dead — the executor prunes it, but it is likely a "
+                     "build mistake"))
+        for name in op.input_arg_names:
+            if not name:
+                continue
+            consumed.add(name)
+            if name in feed_set:
+                continue
+            wr = first_write.get(name)
+            v = _var(name)
+            persistable = v is not None and v.persistable
+            is_data = v is not None and getattr(v, "is_data", False)
+            if wr is None or wr >= idx:
+                # read before any in-block write
+                if persistable or is_data:
+                    continue  # scope state / fed-at-run data: fine
+                if v is None and wr is None:
+                    diags.append(Diagnostic(
+                        "AN104", "error" if idx in live else "info",
+                        f"op #{idx} ({op.type}) reads '{name}' which no "
+                        f"op produces and no block declares",
+                        op_idx=idx, op_type=op.type, var=name,
+                        hint="dangling reference — typo'd var name in the "
+                             "op's inputs?"))
+                elif wr is not None and wr > idx:
+                    diags.append(Diagnostic(
+                        "AN103", "warn",
+                        f"op #{idx} ({op.type}) reads '{name}' before op "
+                        f"#{wr} writes it (def-before-use)",
+                        op_idx=idx, op_type=op.type, var=name,
+                        hint="the first run will fault with 'not "
+                             "initialized' unless the scope was seeded "
+                             "externally"))
+                else:
+                    diags.append(Diagnostic(
+                        "AN105", "warn" if idx in live else "info",
+                        f"op #{idx} ({op.type}) reads '{name}' which is "
+                        f"declared (non-persistable) but never written "
+                        f"in-block",
+                        op_idx=idx, op_type=op.type, var=name,
+                        hint="runs only if the scope is pre-seeded; mark "
+                             "the var persistable or feed it"))
+
+    # dead ops (relative to THESE fetches): info — normal for mixed
+    # train/eval programs, but the first place to look when a fetch is
+    # mysteriously constant
+    for idx, op in enumerate(block.ops):
+        if op.type in _SKIP_OPS or idx in live:
+            continue
+        diags.append(Diagnostic(
+            "AN106", "info",
+            f"op #{idx} ({op.type}) is dead for fetches "
+            f"{sorted(fetch_set) if fetch_set else '[]'} (outputs "
+            f"unconsumed, non-persistable, unfetched)",
+            op_idx=idx, op_type=op.type))
+
+    # unused feeds
+    for name in sorted(feed_set):
+        if name not in consumed and name not in fetch_set:
+            diags.append(Diagnostic(
+                "AN107", "warn",
+                f"feed '{name}' is consumed by no op in block "
+                f"{block_idx}",
+                var=name,
+                hint="misspelled feed key, or feeding an eval-only input "
+                     "to a train program?"))
+
+    # fetches nothing can produce
+    for name in sorted(fetch_set):
+        v = _var(name)
+        ok = (name in first_write or name in feed_set
+              or (v is not None and v.persistable))
+        if not ok:
+            diags.append(Diagnostic(
+                "AN108", "error",
+                f"fetch '{name}' is produced by no op, not fed, and not "
+                f"persistable",
+                var=name,
+                hint="misspelled fetch target? the trace would fail with "
+                     "a bare KeyError"))
+    return live

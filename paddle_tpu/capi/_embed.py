@@ -13,11 +13,13 @@ inference model, no Python required IN THE CALLER) is the same.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
 _predictors: Dict[int, object] = {}
+_handle_lock = threading.Lock()
 _next_handle = 1
 
 
@@ -28,9 +30,10 @@ def create(model_dir: str, use_tpu: int, enable_int8: int = 0) -> int:
     cfg = AnalysisConfig(model_dir=model_dir, use_tpu=bool(use_tpu),
                          enable_int8=bool(enable_int8))
     pred = create_paddle_predictor(cfg)
-    h = _next_handle
-    _next_handle += 1
-    _predictors[h] = pred
+    with _handle_lock:
+        h = _next_handle
+        _next_handle += 1
+        _predictors[h] = pred
     return h
 
 

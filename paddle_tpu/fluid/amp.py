@@ -76,8 +76,9 @@ def enable(dtype: str = "bfloat16", keep_activations=None,
         raise ValueError(f"amp dtype must be one of {_SUPPORTED}, got {dtype!r}")
     _state["dtype"] = dtype
     if keep_activations is None:
-        keep_activations = os.environ.get(
-            "PADDLE_TPU_AMP_KEEP", "").strip().lower() in ("1", "true")
+        from . import envcontract
+
+        keep_activations = bool(envcontract.get("PADDLE_TPU_AMP_KEEP"))
     _state["keep"] = bool(keep_activations)
     # dynamic loss scaling: None = auto (on for float16, pointless for
     # bfloat16 whose exponent range matches fp32); True/False force it.

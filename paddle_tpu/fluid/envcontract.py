@@ -1,0 +1,278 @@
+"""Env-knob contract: one declared registry for every ``PADDLE_*`` knob.
+
+The subsystems grown in PRs 1-7 each invented env knobs ad hoc (fault
+injection, elastic supervisor, compile cache, observe, AMP, SPMD meshes,
+windowed training).  This module is the single source of truth: every knob
+is declared here with its type, default and owning subsystem, values are
+read through :func:`get` (live — a subprocess that sets the env before
+first use is honored, same late-binding contract as ``compile_cache``),
+and two pieces of tooling hang off the registry:
+
+ - ``tools/repo_lint.py`` ASTs the tree and fails CI on any
+   ``os.environ`` read of a ``PADDLE_*`` key that is not declared here —
+   so a typo'd or undocumented knob cannot ship;
+ - ``python -m paddle_tpu.fluid.envcontract`` regenerates ``docs/ENV.md``
+   (the committed file is diffed against the generator in tier-1, so the
+   doc cannot drift from the code).
+
+Declaring is cheap on purpose: ``declare("PADDLE_X", "int", 4, "executor",
+"what it does")``.  Families with dynamic suffixes (the PADDLE_FAULT_*
+contract) declare each member; :func:`declared` also accepts names covered
+by a declared ``prefix`` entry.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["EnvKnob", "declare", "get", "get_raw", "declared", "knobs",
+           "generate_markdown", "REGISTRY"]
+
+_TYPES = ("str", "int", "float", "bool", "enum", "path", "prefix")
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    name: str
+    type: str                      # one of _TYPES
+    default: object                # the value `get` returns when unset
+    subsystem: str                 # owning module family (docs grouping)
+    help: str
+    choices: Tuple[str, ...] = ()  # for type == "enum"
+
+    def parse(self, raw: Optional[str]):
+        """Typed value for a raw env string (None/empty -> default)."""
+        if raw is None:
+            return self.default
+        raw = raw.strip()
+        if raw == "":
+            return self.default
+        if self.type == "int":
+            return int(raw)
+        if self.type == "float":
+            return float(raw)
+        if self.type == "bool":
+            low = raw.lower()
+            if low in _TRUE:
+                return True
+            if low in _FALSE:
+                return False
+            return self.default
+        if self.type == "enum":
+            low = raw.lower()
+            return low if low in self.choices else self.default
+        return raw  # str / path / prefix
+
+
+REGISTRY: Dict[str, EnvKnob] = {}
+
+
+def declare(name: str, type: str, default, subsystem: str, help: str,
+            choices: Tuple[str, ...] = ()) -> EnvKnob:
+    if type not in _TYPES:
+        raise ValueError(f"knob type must be one of {_TYPES}, got {type!r}")
+    if name in REGISTRY:
+        raise ValueError(f"env knob {name} declared twice")
+    knob = EnvKnob(name, type, default, subsystem, help, tuple(choices))
+    REGISTRY[name] = knob
+    return knob
+
+
+def get(name: str):
+    """Typed live read of a declared knob — unset/empty returns the
+    declared default (raises KeyError on undeclared names: reading
+    through the contract IS the contract)."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"env knob {name!r} is not declared in fluid.envcontract — "
+            f"declare it (name, type, default, subsystem) before reading")
+    return knob.parse(os.environ.get(name))
+
+
+def get_raw(name: str) -> str:
+    """The raw (stripped) env string of a declared knob; "" when unset."""
+    if name not in REGISTRY and not declared(name):
+        raise KeyError(f"env knob {name!r} is not declared")
+    return os.environ.get(name, "").strip()
+
+
+def declared(name: str) -> bool:
+    """True if `name` is a declared knob or covered by a prefix family."""
+    if name in REGISTRY:
+        return True
+    return any(k.type == "prefix" and name.startswith(k.name)
+               for k in REGISTRY.values())
+
+
+def knobs() -> List[EnvKnob]:
+    return sorted(REGISTRY.values(), key=lambda k: (k.subsystem, k.name))
+
+
+# ---------------------------------------------------------------------------
+# The contract.  Grouped by subsystem; keep help to one line.
+# ---------------------------------------------------------------------------
+
+# -- executor / runtime --
+declare("PADDLE_EXECUTOR_CACHE_CAP", "int", 64, "executor",
+        "Bound on the in-process jit cache (LRU entries)")
+declare("PADDLE_TPU_DONATE", "bool", True, "executor",
+        "Donate mutable training state to XLA (0 disables, for buffer "
+        "lifetime debugging)")
+declare("PADDLE_TPU_VERIFY", "enum", "warn", "analysis",
+        "Pre-compile program verifier mode", choices=("warn", "strict",
+                                                      "off"))
+declare("PADDLE_TPU_FLASH", "bool", False, "ops",
+        "Use the Pallas flash-attention kernel for the attention ops")
+declare("PADDLE_TPU_SPD", "int", 0, "trainer",
+        "Steps per dispatch: K>1 runs the trainer loop as K-step fused "
+        "windows (Executor.run_steps)")
+declare("PADDLE_TPU_PREFETCH_DEPTH", "int", 2, "trainer",
+        "Device prefetch depth for windowed training (0 = synchronous)")
+
+# -- AMP --
+declare("PADDLE_TPU_AMP", "enum", None, "amp",
+        "Enable mixed precision at import", choices=("bfloat16", "float16"))
+declare("PADDLE_TPU_AMP_KEEP", "bool", False, "amp",
+        "Keep activations in the low compute dtype (pure-low regime)")
+declare("PADDLE_TPU_AMP_INIT_SCALE", "float", 2.0 ** 15, "amp",
+        "Initial dynamic fp16 loss scale")
+declare("PADDLE_TPU_AMP_SCALE_INTERVAL", "int", 1000, "amp",
+        "Overflow-free steps between loss-scale growth events")
+
+# -- guardian --
+declare("PADDLE_TPU_GUARDIAN", "str", None, "guardian",
+        "Arm the numerics guardian (skip|halt|dump_and_halt, or 1=skip)")
+declare("PADDLE_TPU_GUARDIAN_SPIKE", "float", 0.0, "guardian",
+        "Loss-spike rejection factor over the window median (0 = off)")
+declare("PADDLE_TPU_GUARDIAN_WINDOW", "int", 32, "guardian",
+        "Spike-median window length (steps)")
+declare("PADDLE_TPU_GUARDIAN_RING", "int", 128, "guardian",
+        "Flight-recorder ring size (steps)")
+declare("PADDLE_TPU_GUARDIAN_DIR", "path", None, "guardian",
+        "Flight-recorder replay-bundle directory")
+
+# -- SPMD / distributed --
+declare("PADDLE_TPU_MESH", "str", None, "parallel",
+        "Named mesh spec, e.g. dp4,tp2 (axis order = spec order)")
+declare("PADDLE_TRAINERS", "int", 1, "parallel",
+        "Process count for the multihost coordination service")
+declare("PADDLE_TRAINER_ID", "int", 0, "parallel",
+        "This process's rank")
+declare("PADDLE_COORDINATOR_ADDR", "str", None, "parallel",
+        "host:port of the jax coordination service (process 0)")
+declare("PADDLE_PSERVER_EPS", "str", None, "parallel",
+        "Legacy pserver endpoint list (transpiler compatibility)")
+declare("PADDLE_LOCAL_DEVICE_IDS", "str", None, "parallel",
+        "Comma-separated local device ids visible to this process")
+
+# -- elastic supervisor --
+declare("PADDLE_ELASTIC_HB_DIR", "path", None, "elastic",
+        "Heartbeat directory the supervisor watches (set per generation)")
+declare("PADDLE_ELASTIC_INCIDENTS", "path", None, "elastic",
+        "incidents.jsonl path guardian trips are appended to")
+declare("PADDLE_ELASTIC_GENERATION", "int", 0, "elastic",
+        "Elastic generation index of this worker process")
+
+# -- compile cache --
+declare("PADDLE_COMPILE_CACHE_DIR", "path", None, "compile_cache",
+        "Enable the persistent compile cache, rooted here")
+declare("PADDLE_COMPILE_CACHE_BUDGET_MB", "int", None, "compile_cache",
+        "LRU size budget over cache entries + the jax xla cache (MB)")
+
+# -- observability --
+declare("PADDLE_OBSERVE_DIR", "path", None, "observe",
+        "Enable file output (events JSONL + metric snapshots), rooted here")
+declare("PADDLE_OBSERVE_FLUSH_S", "float", 5.0, "observe",
+        "Metric snapshot flush interval (seconds)")
+declare("PADDLE_OBSERVE_PORT", "int", None, "observe",
+        "Serve /metrics + /healthz on 127.0.0.1:<port> (0 = ephemeral)")
+
+# -- fault injection (PADDLE_FAULT_* family; deterministic test faults) --
+declare("PADDLE_FAULT_", "prefix", None, "fault",
+        "Family prefix: any PADDLE_FAULT_* key is part of the injection "
+        "contract parsed by fluid.fault.FaultPlan.from_env")
+declare("PADDLE_FAULT_KILL_STEP", "int", None, "fault",
+        "Kill this process at training step N")
+declare("PADDLE_FAULT_MODE", "str", "exit", "fault",
+        "How kill faults fire (exit|segv|hang)")
+declare("PADDLE_FAULT_RANK", "int", None, "fault",
+        "Restrict armed faults to one trainer rank")
+declare("PADDLE_FAULT_CKPT_CRASH", "str", None, "fault",
+        "Crash inside checkpoint save (before|after the _SUCCESS commit)")
+declare("PADDLE_FAULT_IO_DELAY_MS", "float", 0.0, "fault",
+        "Inject IO delay into reader/prefetch paths (ms)")
+declare("PADDLE_FAULT_NAN_VAR", "str", None, "fault",
+        "Corrupt this state var with NaNs after a step")
+declare("PADDLE_FAULT_NAN_STEP", "int", 0, "fault",
+        "Step at which the NaN corruption fires")
+declare("PADDLE_FAULT_GRAD_INF_STEP", "int", None, "fault",
+        "Poison the backward seed with Inf at step N (in-graph)")
+declare("PADDLE_FAULT_GRAD_INF_VALUE", "float", float("inf"), "fault",
+        "Poison value for the grad-Inf injection")
+declare("PADDLE_FAULT_LOSS_SPIKE_STEP", "int", None, "fault",
+        "Multiply the observed loss at step N (spike injection)")
+declare("PADDLE_FAULT_LOSS_SPIKE_FACTOR", "float", 1e4, "fault",
+        "Spike multiplication factor")
+declare("PADDLE_FAULT_BARRIER_STALL", "float", 0.0, "fault",
+        "Stall this rank's barrier entry (seconds)")
+declare("PADDLE_FAULT_SERVE_DELAY_MS", "float", 0.0, "fault",
+        "Per-request serving delay injection (ms)")
+declare("PADDLE_FAULT_SERVE_FAIL_EVERY", "int", 0, "fault",
+        "Fail every Nth serving request with InjectedFault")
+declare("PADDLE_FAULT_CACHE_CORRUPT", "bool", False, "fault",
+        "Deterministically corrupt the next compile-cache read")
+
+
+# ---------------------------------------------------------------------------
+# docs/ENV.md generation
+# ---------------------------------------------------------------------------
+
+
+def _fmt_default(knob: EnvKnob) -> str:
+    d = knob.default
+    if d is None:
+        return "unset"
+    if isinstance(d, bool):
+        return "1" if d else "0"
+    if isinstance(d, float) and d == float("inf"):
+        return "inf"
+    return str(d)
+
+
+def generate_markdown() -> str:
+    lines = [
+        "# Environment contract",
+        "",
+        "Every `PADDLE_*` knob the runtime reads, by subsystem.  GENERATED",
+        "by `python -m paddle_tpu.fluid.envcontract > docs/ENV.md` from the",
+        "declarations in `paddle_tpu/fluid/envcontract.py` — edit those,",
+        "not this file (tier-1 `tools/repo_lint.py` diffs the two, and also",
+        "fails on any `os.environ` read of an undeclared `PADDLE_*` key).",
+        "",
+    ]
+    by_sub: Dict[str, List[EnvKnob]] = {}
+    for k in knobs():
+        by_sub.setdefault(k.subsystem, []).append(k)
+    for sub in sorted(by_sub):
+        lines.append(f"## {sub}")
+        lines.append("")
+        lines.append("| knob | type | default | description |")
+        lines.append("|---|---|---|---|")
+        for k in by_sub[sub]:
+            typ = k.type if k.type != "enum" \
+                else "enum(" + "|".join(k.choices) + ")"
+            name = k.name + "*" if k.type == "prefix" else k.name
+            lines.append(f"| `{name}` | {typ} | {_fmt_default(k)} "
+                         f"| {k.help} |")
+        lines.append("")
+    return "\n".join(lines) + ""
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via repo_lint
+    print(generate_markdown())

@@ -559,8 +559,9 @@ class _JitCache:
 
     def __init__(self, cap: Optional[int] = None):
         if cap is None:
-            cap = int(os.environ.get("PADDLE_EXECUTOR_CACHE_CAP", "")
-                      or 64)
+            from . import envcontract
+
+            cap = envcontract.get("PADDLE_EXECUTOR_CACHE_CAP")
         self.cap = max(1, int(cap))
         self.evictions = 0
         self._od: "OrderedDict[tuple, tuple]" = OrderedDict()
@@ -674,8 +675,19 @@ class Executor:
         probe = None
         if entry is None:
             from .log import VLOG
+            from .. import analysis as _analysis
             from .. import compile_cache as _cc
 
+            # pre-compile verifier (PADDLE_TPU_VERIFY): milliseconds of
+            # static checks before seconds of trace/compile; strict mode
+            # raises VerifyError here, before any backend work.  Stacked
+            # per-step feeds verify as ONE step's slice.
+            _analysis.check_before_compile(
+                program,
+                feed=({k: v[0] if getattr(v, "ndim", 0) > 0 else v
+                       for k, v in feed_arrays.items()}
+                      if feed_per_step else feed_arrays),
+                fetch_list=fetch_names, kind="run_steps")
             # persistent-cache consult BEFORE tracing: a hit means another
             # process already compiled this exact (program, jit config) —
             # the backend executable loads from the shared disk cache
@@ -866,8 +878,15 @@ class Executor:
         probe = None
         if entry is None:
             from .log import VLOG
+            from .. import analysis as _analysis
             from .. import compile_cache as _cc
 
+            # pre-compile verifier (PADDLE_TPU_VERIFY=warn|strict|off):
+            # named diagnostics in milliseconds instead of an XLA trace
+            # error seconds into compile
+            _analysis.check_before_compile(
+                program, feed=feed_arrays, fetch_list=fetch_names,
+                kind="run")
             # persistent-cache consult BEFORE tracing (hit/miss counters +
             # backend warm start through the shared jax disk cache)
             probe = _cc.executor_probe(
@@ -1035,8 +1054,9 @@ class Executor:
         opts out entirely (debugging buffer lifetimes)."""
         if program is not None and program._params_grads is None:
             return ()
-        if os.environ.get("PADDLE_TPU_DONATE", "").strip().lower() \
-                in ("0", "false", "off"):
+        from . import envcontract
+
+        if not envcontract.get("PADDLE_TPU_DONATE"):
             return ()
         return (2,)
 

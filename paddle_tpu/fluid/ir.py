@@ -183,12 +183,34 @@ class DeadOpElimination(Pass):
                 "dead_op_elimination requires explicit targets (the vars "
                 "you intend to fetch); ref prune.cc takes targets too")
 
+    def _subblock_live(self, program, op) -> bool:
+        """True when a control-flow op's sub-block (recursively) contains
+        a side-effecting op or writes persistable/checkpoint-visible
+        state — invisible to outer-block def-use liveness, so such ops
+        must never be eliminated on output-deadness alone."""
+        sub = op.attr("sub_block") if hasattr(op, "attr") else None
+        if not isinstance(sub, int) or sub >= len(program.blocks):
+            return False
+        block = program.block(sub)
+        for bop in block.ops:
+            if bop.type in self.SIDE_EFFECTS:
+                return True
+            for n in bop.output_arg_names:
+                if n and block._has_var_recursive(n) \
+                        and block._var_recursive(n).persistable:
+                    return True
+            if self._subblock_live(program, bop):
+                return True
+        return False
+
     def apply(self, graph: Graph) -> Graph:
         changed = True
         while changed:
             changed = False
             for node in list(graph.op_nodes):
                 if node.op.type in self.SIDE_EFFECTS:
+                    continue
+                if self._subblock_live(graph.program, node.op):
                     continue
                 live = False
                 for vn in node.outputs:

@@ -166,6 +166,13 @@ class ParallelExecutor:
                os.environ.get("PADDLE_TPU_FLASH", ""))
         step = self._cache.get(key)
         if step is None:
+            from .. import analysis as _analysis
+
+            # pre-compile verifier: turns the runtime rejects below (and
+            # the opaque GSPMD sharding errors) into named diagnostics
+            _analysis.check_before_compile(
+                self._program, feed=feed_arrays, fetch_list=fetch_names,
+                mesh=self._mesh, kind="pe_run")
             if getattr(self._program, "_loss_scale_vars", None) is not None:
                 # the per-step sharded path has no guarded wrapper: the
                 # backward seed would go unscaled while append_unscale_ops
@@ -234,6 +241,16 @@ class ParallelExecutor:
                self.mesh_label)
         runner = self._window_cache.get(key)
         if runner is None:
+            from .. import analysis as _analysis
+
+            # stacked (n_steps, batch, ...) windows verify as one step
+            _analysis.check_before_compile(
+                self._program,
+                feed=({k: v[0] if getattr(v, "ndim", 0) > 0 else v
+                       for k, v in feed_arrays.items()}
+                      if feed_per_step else feed_arrays),
+                fetch_list=fetch_names, mesh=self._mesh,
+                kind="pe_run_steps")
             zero1 = (self._build_strategy.reduce_strategy ==
                      BuildStrategy.ReduceStrategy.Reduce)
             runner = ShardedWindowRunner(

@@ -55,9 +55,10 @@ class _WorkerError:
 def default_depth() -> int:
     """The env-configured prefetch depth (``PADDLE_TPU_PREFETCH_DEPTH``,
     default 2: double buffering — one window on device, one staging)."""
+    from . import envcontract
+
     try:
-        return max(0, int(os.environ.get("PADDLE_TPU_PREFETCH_DEPTH", "")
-                          or 2))
+        return max(0, int(envcontract.get("PADDLE_TPU_PREFETCH_DEPTH")))
     except ValueError:
         return 2
 

@@ -372,7 +372,9 @@ class Trainer:
         start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
         feeder = DataFeeder(feed_list=feed_order, place=self.place,
                             program=self.train_program)
-        spd = int(os.environ.get("PADDLE_TPU_SPD", "0") or 0)
+        from . import envcontract
+
+        spd = int(envcontract.get("PADDLE_TPU_SPD") or 0)
         try:
             if spd > 1:
                 self._train_loop_windowed(start_epoch, num_epochs,

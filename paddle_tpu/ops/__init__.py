@@ -26,3 +26,4 @@ from . import (  # noqa: F401
     pipeline_ops,
     transformer_ops,
 )
+from . import infer_rules  # noqa: F401,E402  (static infer rules, after impls)

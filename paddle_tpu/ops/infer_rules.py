@@ -1,0 +1,311 @@
+"""Explicit static shape/dtype infer rules (paddle_tpu.analysis pass 1).
+
+Ops without a rule here are abstractly evaluated through ``jax.eval_shape``
+over their registered forward impl (analysis/infer.py), which covers the
+long tail for free.  A rule earns its place by one of:
+
+ - a *named* diagnostic beating a generic trace error — the matmul-family
+   contraction check reports "K mismatch: x[64,32] @ y[16,10]" with the
+   operand VAR names instead of a dot_general stack trace;
+ - catching what abstract evaluation cannot: the integer-id ops coerce
+   their index inputs with ``.astype(int32)``, so a float label/id tensor
+   traces fine and silently truncates at runtime — only a static dtype
+   rule sees it;
+ - skipping a jax trace for the hottest op families (elementwise chains,
+   optimizer updates) so whole-program verification stays in the
+   sub-50ms budget.
+
+Rule contract (ops/registry.py:register_infer): ``rule(op, ins)`` with
+``ins[slot] = [(shape, dtype) | None, ...]``; return ``{slot: [(shape,
+dtype) | None]}`` (None = unknown), or raise ``InferMismatch``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import InferMismatch, register_infer
+
+_INT_DTYPES = ("int8", "int16", "int32", "int64", "uint8", "bool")
+
+
+def _in(ins, slot, i=0):
+    vals = ins.get(slot) or []
+    return vals[i] if i < len(vals) and vals[i] is not None else None
+
+
+def _names(op, slot):
+    return ", ".join(repr(n) for n in op.inputs.get(slot, []) if n) or slot
+
+
+def _require_int(op, ins, slot):
+    v = _in(ins, slot)
+    if v is not None and v[1] is not None and v[1] not in _INT_DTYPES:
+        raise InferMismatch(
+            f"{op.type}: input {_names(op, slot)} must be an integer "
+            f"index/label tensor, got dtype {v[1]} (the kernel would "
+            f"silently truncate it with astype(int32))", code="AN102")
+    return v
+
+
+def _flat2(shape, ncol):
+    lead = int(np.prod(shape[:ncol], dtype=np.int64)) if ncol else 1
+    rest = int(np.prod(shape[ncol:], dtype=np.int64)) if ncol < len(shape) \
+        else 1
+    return lead, rest
+
+
+@register_infer("mul")
+def infer_mul(op, ins):
+    x, y = _in(ins, "X"), _in(ins, "Y")
+    if x is None or y is None:
+        return None
+    xnc = op.attr("x_num_col_dims", 1)
+    ync = op.attr("y_num_col_dims", 1)
+    _, k1 = _flat2(x[0], xnc)
+    k2, _ = _flat2(y[0], ync)
+    if k1 != k2:
+        raise InferMismatch(
+            f"mul: contraction mismatch — {_names(op, 'X')} {list(x[0])} "
+            f"flattened at {xnc} gives K={k1}, but {_names(op, 'Y')} "
+            f"{list(y[0])} flattened at {ync} gives K={k2}")
+    out = tuple(x[0][:xnc]) + tuple(y[0][ync:])
+    return {"Out": [(out, x[1])]}
+
+
+@register_infer("matmul")
+def infer_matmul(op, ins):
+    x, y = _in(ins, "X"), _in(ins, "Y")
+    if x is None or y is None:
+        return None
+    xs, ys = list(x[0]), list(y[0])
+    if len(xs) == 1:
+        xs = [1] + xs
+    if len(ys) == 1:
+        ys = ys + [1]
+    if op.attr("transpose_X", False):
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if op.attr("transpose_Y", False):
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if xs[-1] != ys[-2]:
+        raise InferMismatch(
+            f"matmul: contraction mismatch — {_names(op, 'X')} "
+            f"{list(x[0])} x {_names(op, 'Y')} {list(y[0])} contracts "
+            f"{xs[-1]} against {ys[-2]}")
+    try:
+        batch = tuple(np.broadcast_shapes(tuple(xs[:-2]), tuple(ys[:-2])))
+    except ValueError:
+        raise InferMismatch(
+            f"matmul: batch dims of {_names(op, 'X')} {list(x[0])} and "
+            f"{_names(op, 'Y')} {list(y[0])} do not broadcast")
+    return {"Out": [(batch + (xs[-2], ys[-1]), x[1])]}
+
+
+def _infer_elementwise(op, ins):
+    x, y = _in(ins, "X"), _in(ins, "Y")
+    if x is None:
+        return None
+    if y is None:
+        return {"Out": [x]}
+    xs, ys = x[0], y[0]
+    axis = op.attr("axis", -1)
+    if len(ys) > len(xs):
+        # a higher-rank Y still works when plain numpy broadcasting does
+        # (scalar-ish operands: [] + [1] -> [1])
+        try:
+            return {"Out": [(tuple(np.broadcast_shapes(xs, ys)), x[1])]}
+        except ValueError:
+            raise InferMismatch(
+                f"{op.type}: operand {_names(op, 'Y')} {list(ys)} does "
+                f"not broadcast against {_names(op, 'X')} {list(xs)}")
+    if axis is None or axis == -1:
+        axis = len(xs) - len(ys)
+    for d, yd in enumerate(ys):
+        xd = xs[axis + d] if 0 <= axis + d < len(xs) else None
+        if yd != 1 and xd is not None and yd != xd:
+            raise InferMismatch(
+                f"{op.type}: operand {_names(op, 'Y')} {list(ys)} does "
+                f"not broadcast against {_names(op, 'X')} {list(xs)} "
+                f"at axis {axis} (dim {yd} vs {xd})")
+    return {"Out": [x]}
+
+
+for _t in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "elementwise_max", "elementwise_min",
+           "elementwise_pow"):
+    register_infer(_t)(_infer_elementwise)
+
+
+@register_infer("lookup_table")
+def infer_lookup_table(op, ins):
+    ids = _require_int(op, ins, "Ids")
+    w = _in(ins, "W")
+    if ids is None or w is None or len(w[0]) != 2:
+        return None
+    idshape = tuple(ids[0])
+    if len(idshape) >= 2 and idshape[-1] == 1:
+        idshape = idshape[:-1]
+    return {"Out": [(idshape + (w[0][1],), w[1])]}
+
+
+@register_infer("cross_entropy")
+def infer_cross_entropy(op, ins):
+    x = _in(ins, "X")
+    if not op.attr("soft_label", False):
+        _require_int(op, ins, "Label")
+    if x is None:
+        return None
+    return {"Y": [(tuple(x[0][:-1]) + (1,), "float32"
+                   if x[1] in ("float16", "bfloat16") else x[1])]}
+
+
+@register_infer("softmax_with_cross_entropy")
+def infer_softmax_xent(op, ins):
+    logits = _in(ins, "Logits")
+    if not op.attr("soft_label", False):
+        _require_int(op, ins, "Label")
+    if logits is None:
+        return None
+    loss = tuple(logits[0][:-1]) + (1,)
+    return {"Softmax": [logits], "Loss": [(loss, logits[1])]}
+
+
+@register_infer("mean")
+def infer_mean(op, ins):
+    x = _in(ins, "X")
+    return {"Out": [((1,), x[1]) if x is not None else None]}
+
+
+@register_infer("sum")
+def infer_sum(op, ins):
+    vals = [v for v in ins.get("X", []) if v is not None]
+    if not vals:
+        return None
+    shapes = {tuple(v[0]) for v in vals}
+    if len(shapes) > 1:
+        raise InferMismatch(
+            f"sum: operands {_names(op, 'X')} disagree on shape: "
+            f"{sorted(map(list, shapes))}")
+    return {"Out": [vals[0]]}
+
+
+@register_infer("cast")
+def infer_cast(op, ins):
+    from ..fluid import core as _core
+
+    x = _in(ins, "X")
+    if x is None:
+        return None
+    dt = str(np.dtype(_core.np_dtype(
+        op.attr("out_dtype", op.attr("dtype", "float32")))))
+    return {"Out": [(x[0], dt)]}
+
+
+def _infer_same(op, ins):
+    """Out mirrors X — the unary activation/identity family."""
+    x = _in(ins, "X")
+    out = {}
+    for slot in op.outputs:
+        out[slot] = [x] * len(op.outputs[slot])
+    return out
+
+
+for _t in ("relu", "sigmoid", "tanh", "softmax", "exp", "log", "sqrt",
+           "square", "abs", "relu6", "leaky_relu", "elu", "softplus",
+           "softsign", "gelu", "scale", "clip", "sign", "dropout",
+           "fill_any_like", "assign", "floor", "ceil", "round",
+           "softshrink", "hard_sigmoid", "swish", "pow", "brelu",
+           "layer_norm_noop"):
+    register_infer(_t)(_infer_same)
+
+
+@register_infer("reshape", "reshape2")
+def infer_reshape(op, ins):
+    x = _in(ins, "X")
+    if x is None:
+        return None
+    want = list(op.attr("shape") or ())
+    if not want:
+        return None
+    n = int(np.prod(x[0], dtype=np.int64))
+    fixed = int(np.prod([d for d in want if d > 0], dtype=np.int64))
+    if 0 in want:
+        want = [x[0][i] if d == 0 and i < len(x[0]) else d
+                for i, d in enumerate(want)]
+        fixed = int(np.prod([d for d in want if d > 0], dtype=np.int64))
+    if -1 in want:
+        if fixed == 0 or n % fixed:
+            raise InferMismatch(
+                f"reshape: {_names(op, 'X')} {list(x[0])} ({n} elements) "
+                f"does not fit target shape {want}")
+        want = [n // fixed if d == -1 else d for d in want]
+    elif fixed != n:
+        raise InferMismatch(
+            f"reshape: {_names(op, 'X')} {list(x[0])} has {n} elements, "
+            f"target shape {want} has {fixed}")
+    out = {"Out": [(tuple(int(d) for d in want), x[1])]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [((0,) + tuple(x[0]), x[1])]
+    return out
+
+
+@register_infer("concat")
+def infer_concat(op, ins):
+    vals = [v for v in ins.get("X", []) if v is not None]
+    if len(vals) != len(ins.get("X", [])) or not vals:
+        return None
+    axis = op.attr("axis", 0)
+    base = list(vals[0][0])
+    axis = axis if axis >= 0 else axis + len(base)
+    total = 0
+    for v in vals:
+        s = list(v[0])
+        if len(s) != len(base) or any(
+                i != axis and s[i] != base[i] for i in range(len(base))):
+            raise InferMismatch(
+                f"concat: operands {_names(op, 'X')} disagree off axis "
+                f"{axis}: {[list(v[0]) for v in vals]}")
+        total += s[axis]
+    base[axis] = total
+    return {"Out": [(tuple(base), vals[0][1])]}
+
+
+@register_infer("fill_constant")
+def infer_fill_constant(op, ins):
+    from ..fluid import core as _core
+
+    shape = tuple(int(d) for d in (op.attr("shape") or ()))
+    dt = str(np.dtype(_core.np_dtype(op.attr("dtype", "float32"))))
+    return {"Out": [(shape, dt)]}
+
+
+def _infer_random(op, ins):
+    """Shape-attr random initializers — the bulk of every startup
+    program, so a rule here keeps startup verification trivially cheap."""
+    from ..fluid import core as _core
+
+    shape = tuple(int(d) for d in (op.attr("shape") or ()))
+    if not shape or any(d < 0 for d in shape):
+        return None
+    dt = str(np.dtype(_core.np_dtype(op.attr("dtype", "float32"))))
+    return {"Out": [(shape, dt)]}
+
+
+for _t in ("uniform_random", "gaussian_random",
+           "truncated_gaussian_random"):
+    register_infer(_t)(_infer_random)
+
+
+def _infer_param_update(op, ins):
+    """Optimizer-family updates: each '<X>Out' output mirrors input slot
+    '<X>' (ParamOut <- Param, MomentOut <- Moment, ...)."""
+    out = {}
+    for slot, names in op.outputs.items():
+        src = slot[:-3] if slot.endswith("Out") else slot
+        out[slot] = [_in(ins, src, i) for i in range(len(names))]
+    return out
+
+
+for _t in ("sgd", "momentum", "adam", "adamax", "adagrad", "rmsprop",
+           "decayed_adagrad", "ftrl", "lars_momentum"):
+    register_infer(_t)(_infer_param_update)

@@ -131,6 +131,51 @@ def register_grad(op_type: str):
     return deco
 
 
+# ---------------------------------------------------------------------------
+# Static shape/dtype infer rules (paddle_tpu.analysis pass 1)
+#
+# The analogue of the reference's InferShape/InferVarType registered per op
+# (ref: operator.h InferShapeContext) — here a rule is optional: ops without
+# one are abstractly evaluated via jax.eval_shape over the forward impl, so
+# explicit rules exist only where (a) a precise named diagnostic beats a
+# generic trace error (matmul contraction mismatch, integer-id inputs) or
+# (b) abstract evaluation cannot see the semantics.  Registered next to the
+# dispatch table on purpose: adding an op and adding its infer rule are the
+# same review.
+# ---------------------------------------------------------------------------
+
+INFER_REGISTRY: Dict[str, Callable] = {}
+
+
+class InferMismatch(Exception):
+    """Raised by an infer rule on a static contract violation.  ``code``
+    selects the diagnostic family (AN101 shape / AN102 dtype)."""
+
+    def __init__(self, message: str, code: str = "AN101"):
+        super().__init__(message)
+        self.code = code
+
+
+def register_infer(*op_types: str):
+    """Decorator: ``rule(op, ins) -> {slot: [(shape, dtype) | None]}``.
+
+    ``ins`` maps input slot -> list of ``(shape, dtype)`` tuples (entries
+    are None for vars whose shape is statically unknown).  Rules raise
+    :class:`InferMismatch` to report a violation; returning None marks all
+    outputs unknown."""
+
+    def deco(fn):
+        for t in op_types:
+            INFER_REGISTRY[t] = fn
+        return fn
+
+    return deco
+
+
+def get_infer_rule(op_type: str) -> Optional[Callable]:
+    return INFER_REGISTRY.get(op_type)
+
+
 def get_op_def(op_type: str) -> OpDef:
     try:
         return REGISTRY[op_type]

@@ -81,7 +81,9 @@ def parse_mesh_spec(spec: str) -> Dict[str, int]:
 
 def env_mesh_spec() -> Optional[str]:
     """The ``PADDLE_TPU_MESH`` spec string, or None when unset/empty."""
-    return os.environ.get(MESH_ENV, "").strip() or None
+    from ..fluid import envcontract
+
+    return envcontract.get(MESH_ENV) or None
 
 
 def mesh_from_spec(spec: Optional[str] = None, devices=None) -> Mesh:

@@ -12,6 +12,8 @@ Two modes, like the reference:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .. import fluid
@@ -98,6 +100,7 @@ class Inference:
 
 
 _INFER_CACHE = {}
+_INFER_LOCK = threading.Lock()
 
 
 def infer(output_layer, parameters=None, input=None, feeding=None,
@@ -111,13 +114,14 @@ def infer(output_layer, parameters=None, input=None, feeding=None,
     outs = output_layer if isinstance(output_layer, (list, tuple)) \
         else [output_layer]
     key = tuple(id(o) for o in outs)
-    inf = _INFER_CACHE.get(key)
-    if inf is None:
-        if len(_INFER_CACHE) > 8:
-            _INFER_CACHE.clear()
-        inf = _INFER_CACHE[key] = Inference(output_layer, parameters)
-        inf._last_params = parameters
-    elif parameters is not inf._last_params:
+    with _INFER_LOCK:
+        inf = _INFER_CACHE.get(key)
+        if inf is None:
+            if len(_INFER_CACHE) > 8:
+                _INFER_CACHE.clear()
+            inf = _INFER_CACHE[key] = Inference(output_layer, parameters)
+            inf._last_params = parameters
+    if parameters is not inf._last_params:
         # a DIFFERENT parameters object: install it.  (A live Parameters
         # is a view over the scope — re-installing the same object is a
         # no-op; only a detached from_tar mapping carries new values.)

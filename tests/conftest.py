@@ -48,3 +48,8 @@ def fresh_programs():
     from paddle_tpu import observe as _observe
 
     _observe.reset()
+    # verifier memoization is keyed per program token; clear it so warn
+    # dedup in one test can't hide an expected warning in the next
+    from paddle_tpu import analysis as _analysis
+
+    _analysis.reset()

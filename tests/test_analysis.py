@@ -1,0 +1,528 @@
+"""Pre-compile program verifier (ISSUE 8).
+
+Two-sided oracle: every in-tree program family lints CLEAN in strict
+mode (zero error/warn diagnostics — no false positives), and every
+seeded defect class produces its exact named diagnostic code.  Plus the
+executor/PE wiring (warn vs strict vs off), the observe plumbing, the
+collective-estimate cross-check, and the CLI/smoke-tool round trips.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.fluid import amp, framework, guardian
+from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_modes():
+    amp.disable()
+    guardian.disable()
+    yield
+    amp.disable()
+    guardian.disable()
+
+
+def _build_mlp(sizes=(32, 10)):
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=sizes[0], act="relu")
+    pred = fluid.layers.fc(input=h, size=sizes[1], act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _mlp_feed(batch=8):
+    return {"img": np.zeros((batch, 16), np.float32),
+            "label": np.zeros((batch, 1), np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# zero false positives: every in-tree program family strict-clean
+# ---------------------------------------------------------------------------
+
+
+def _case_book_fit_a_line():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=y_pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return (fluid.default_main_program(),
+            {"x": np.zeros((32, 13), np.float32),
+             "y": np.zeros((32, 1), np.float32)}, [loss], "run", None)
+
+
+def _case_book_recognize_digits_conv():
+    from paddle_tpu.models import mnist
+
+    img, label, pred, loss, acc = mnist.cnn()
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return (fluid.default_main_program(),
+            {"img": np.zeros((8, 1, 28, 28), np.float32),
+             "label": np.zeros((8, 1), np.int64)}, [loss, acc], "run", None)
+
+
+def _case_benchmark_resnet():
+    from paddle_tpu.models import resnet
+
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = resnet.resnet_cifar10(img, class_dim=10, depth=20)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    return (fluid.default_main_program(),
+            {"img": np.zeros((4, 3, 32, 32), np.float32),
+             "label": np.zeros((4, 1), np.int64)}, [loss], "run", None)
+
+
+def _case_benchmark_transformer_dp_tp():
+    from paddle_tpu.models import transformer
+
+    src, tgt, lbl, cost = transformer.build(transformer.tiny_config(),
+                                            src_len=8, tgt_len=8)
+    return (fluid.default_main_program(),
+            {src.name: np.zeros((8, 8), np.int64),
+             tgt.name: np.zeros((8, 8), np.int64),
+             lbl.name: np.zeros((8, 8, 1), np.int64)},
+            [cost], "pe_run_steps", "dp4,tp2")
+
+
+def _case_beam_search_decode():
+    import paddle_tpu.fluid.layers as layers
+
+    pre_ids = layers.data("pre_ids", shape=[4, 1], dtype="int64",
+                          append_batch_size=False)
+    ids = layers.data("ids", shape=[4, 3], dtype="int64",
+                      append_batch_size=False, lod_level=1)
+    scores = layers.data("scores", shape=[4, 3], dtype="float32",
+                         append_batch_size=False, lod_level=1)
+    sel_ids, sel_scores = layers.beam_search(
+        pre_ids, None, ids, scores, beam_size=2, end_id=0)
+    return (fluid.default_main_program(),
+            ["pre_ids", "ids", "scores"], [sel_ids, sel_scores],
+            "run", None)
+
+
+def _case_guarded_amp_training():
+    amp.enable("float16")
+    guardian.enable("skip")
+    loss = _build_mlp()
+    return (fluid.default_main_program(), _mlp_feed(), [loss],
+            "run_steps", None)
+
+
+def _case_inference_clone():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    pred = next(op.outputs["Out"][0] for op in gb.ops
+                if op.type == "softmax")
+    infer = prog.clone(for_test=True)
+    return (infer, {"img": np.zeros((4, 16), np.float32)}, [pred],
+            "run", None)
+
+
+_CASES = {
+    "book_fit_a_line": _case_book_fit_a_line,
+    "book_recognize_digits_conv": _case_book_recognize_digits_conv,
+    "benchmark_resnet": _case_benchmark_resnet,
+    "benchmark_transformer_dp_tp": _case_benchmark_transformer_dp_tp,
+    "beam_search_decode": _case_beam_search_decode,
+    "guarded_amp_training": _case_guarded_amp_training,
+    "inference_clone": _case_inference_clone,
+}
+
+
+@pytest.mark.parametrize("name", sorted(_CASES))
+def test_in_tree_programs_strict_clean(name):
+    prog, feed, fetches, kind, mesh = _CASES[name]()
+    report = analysis.verify_program(prog, feed=feed, fetch_list=fetches,
+                                     kind=kind, mesh=mesh)
+    assert report.clean, f"{name} not clean:\n" + report.format("warn")
+    # strict mode raises on nothing here
+    assert not report.errors
+
+
+# ---------------------------------------------------------------------------
+# seeded defect classes -> exact codes
+# ---------------------------------------------------------------------------
+
+
+def _codes(report, severity=None):
+    return sorted({d.code for d in report.diagnostics
+                   if severity is None or d.severity == severity})
+
+
+def test_seeded_shape_mismatch_an101():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    w = next(v for v in prog.global_block().vars.values()
+             if v.shape == (16, 32))
+    w.shape = (16, 31)
+    r = analysis.verify_program(prog, feed=_mlp_feed(), fetch_list=[loss])
+    assert "AN101" in _codes(r, "error"), r.format()
+
+
+def test_seeded_mul_contraction_an101_names_operands():
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    gb = fluid.default_main_program().global_block()
+    w = gb.create_parameter(name="w_bad", shape=(8, 4), dtype="float32")
+    out = gb.create_var(name="mm_out", shape=(-1, 4), dtype="float32")
+    gb.append_op(type="mul", inputs={"X": [img.name], "Y": ["w_bad"]},
+                 outputs={"Out": ["mm_out"]})
+    r = analysis.verify_program(
+        fluid.default_main_program(),
+        feed={"img": np.zeros((2, 16), np.float32)}, fetch_list=[out])
+    errs = [d for d in r.errors if d.code == "AN101"]
+    assert errs, r.format()
+    assert "w_bad" in errs[0].message and "16" in errs[0].message
+
+
+def test_seeded_dtype_mismatch_an102():
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=img, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    r = analysis.verify_program(
+        fluid.default_main_program(),
+        feed={"img": np.zeros((8, 16), np.float32),
+              "label": np.zeros((8, 1), np.float32)}, fetch_list=[loss])
+    assert "AN102" in _codes(r, "error"), r.format()
+
+
+def test_seeded_dangling_ref_an104():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    prog.global_block().append_op(
+        type="elementwise_add", inputs={"X": ["__typo__"], "Y": [loss.name]},
+        outputs={"Out": [loss.name]})
+    r = analysis.verify_program(prog, feed=_mlp_feed(), fetch_list=[loss])
+    d = next(x for x in r.errors if x.code == "AN104")
+    assert "__typo__" in d.message
+
+
+def test_seeded_def_before_use_an103():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="late", shape=(1,), dtype="float32")
+    gb._insert_op(0, type="scale", inputs={"X": ["late"]},
+                  outputs={"Out": [loss.name]}, attrs={"scale": 1.0})
+    gb.append_op(type="scale", inputs={"X": [loss.name]},
+                 outputs={"Out": ["late"]}, attrs={"scale": 1.0})
+    r = analysis.verify_program(prog, feed=_mlp_feed(), fetch_list=[loss])
+    assert "AN103" in _codes(r), r.format()
+
+
+def test_seeded_unknown_op_an109_and_ghost_fetch_an108():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    prog.global_block().append_op(
+        type="frobnicate", inputs={"X": [loss.name]},
+        outputs={"Out": [loss.name]})
+    r = analysis.verify_program(prog, feed=_mlp_feed(),
+                                fetch_list=[loss, "ghost"])
+    assert "AN109" in _codes(r, "error")
+    assert "AN108" in _codes(r, "error")
+
+
+def test_seeded_mesh_indivisible_an201():
+    loss = _build_mlp()
+    r = analysis.verify_program(
+        fluid.default_main_program(), feed=_mlp_feed(batch=6),
+        fetch_list=[loss], mesh="dp4,tp2", kind="pe_run_steps")
+    d = next(x for x in r.errors if x.code == "AN201")
+    assert "6" in d.message and "dp=4" in d.message
+    # the same batch on a tp-only mesh is fine
+    r2 = analysis.verify_program(
+        fluid.default_main_program(), feed=_mlp_feed(batch=6),
+        fetch_list=[loss], mesh="tp2", kind="pe_run_steps")
+    assert "AN201" not in _codes(r2)
+
+
+def test_seeded_layout_conflict_an203():
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    gb = fluid.default_main_program().global_block()
+    w = gb.create_parameter(name="w_shared", shape=(16, 16),
+                            dtype="float32")
+    a = gb.create_var(name="a", shape=(-1, 16), dtype="float32")
+    b = gb.create_var(name="b", shape=(-1, 16), dtype="float32")
+    # same weight at chain positions 0 (column) and 1 (row)
+    gb.append_op(type="mul", inputs={"X": [img.name], "Y": ["w_shared"]},
+                 outputs={"Out": ["a"]})
+    gb.append_op(type="mul", inputs={"X": ["a"], "Y": ["w_shared"]},
+                 outputs={"Out": ["b"]})
+    r = analysis.verify_program(
+        fluid.default_main_program(),
+        feed={"img": np.zeros((8, 16), np.float32)}, fetch_list=[b],
+        mesh="dp2,tp2", kind="pe_run_steps")
+    d = next(x for x in r.diagnostics if x.code == "AN203")
+    assert "w_shared" in d.message
+
+
+def test_seeded_inference_optimizer_an301():
+    from paddle_tpu.fluid.framework import OpRole
+
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    infer = prog.clone(for_test=True)  # drops the optimizer ops
+    # seed the defect: a hand-appended update op in the test clone (the
+    # bad-transpiler / manual-edit class)
+    p = infer.global_block().all_parameters()[0]
+    lr = infer.global_block().create_var(name="lr0", shape=(1,),
+                                         dtype="float32", persistable=True)
+    infer.global_block().append_op(
+        type="sgd",
+        inputs={"Param": [p.name], "Grad": [p.name],
+                "LearningRate": ["lr0"]},
+        outputs={"ParamOut": [p.name]},
+        attrs={OpRole.KEY: OpRole.Optimize})
+    r = analysis.verify_program(infer, feed=_mlp_feed(),
+                                fetch_list=[loss])
+    assert "AN301" in _codes(r, "error"), r.format()
+    # a hand-built TRAINING program (no recorded param/grad list, not a
+    # test clone) is NOT flagged
+    prog._params_grads = None
+    r2 = analysis.verify_program(prog, feed=_mlp_feed(), fetch_list=[loss])
+    assert "AN301" not in _codes(r2)
+
+
+def test_seeded_donation_hazard_an302():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    pname = prog.global_block().all_parameters()[0].name
+    r = analysis.verify_program(prog, feed=_mlp_feed(),
+                                fetch_list=[loss, pname], kind="run_steps")
+    d = next(x for x in r.diagnostics if x.code == "AN302")
+    assert pname in d.message
+
+
+def test_seeded_fp16_per_step_pe_an401():
+    amp.enable("float16")
+    guardian.enable("skip")
+    loss = _build_mlp()
+    r = analysis.verify_program(fluid.default_main_program(),
+                                feed=_mlp_feed(), fetch_list=[loss],
+                                kind="pe_run")
+    assert "AN401" in _codes(r, "error")
+    # the windowed path takes it
+    r2 = analysis.verify_program(fluid.default_main_program(),
+                                 feed=_mlp_feed(), fetch_list=[loss],
+                                 kind="pe_run_steps")
+    assert "AN401" not in _codes(r2)
+
+
+def test_seeded_eager_window_an402():
+    prog, feed_names, fetches, _, _ = _case_beam_search_decode()
+    r = analysis.verify_program(prog, feed=feed_names,
+                                fetch_list=fetches, kind="run_steps")
+    assert "AN402" in _codes(r, "error")
+
+
+# ---------------------------------------------------------------------------
+# executor / ParallelExecutor wiring
+# ---------------------------------------------------------------------------
+
+
+def _broken_program():
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    prog.global_block().append_op(
+        type="elementwise_add", inputs={"X": ["__typo__"], "Y": [loss.name]},
+        outputs={"Out": [loss.name]})
+    return prog, loss
+
+
+def test_executor_warn_mode_warns_once(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_VERIFY", raising=False)
+    prog, loss = _broken_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.warns(UserWarning, match="AN104"):
+        with pytest.raises(Exception):
+            exe.run(prog, feed=_mlp_feed(), fetch_list=[loss])
+
+
+def test_executor_strict_mode_fails_before_compile(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+    prog, loss = _broken_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())  # startup itself is clean
+    with pytest.raises(analysis.VerifyError, match="AN104"):
+        exe.run(prog, feed=_mlp_feed(), fetch_list=[loss])
+
+
+def test_executor_off_mode_skips(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "off")
+    prog, loss = _broken_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        with pytest.raises(Exception) as ei:
+            exe.run(prog, feed=_mlp_feed(), fetch_list=[loss])
+    assert not isinstance(ei.value, analysis.VerifyError)
+
+
+def test_clean_training_run_emits_no_warnings(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "warn")
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        exe.run(fluid.default_main_program(), feed=_mlp_feed(),
+                fetch_list=[loss])
+    reg_snapshot = __import__("paddle_tpu").observe.registry().snapshot()
+    counters = reg_snapshot.get("counters", {})
+    assert any(k.startswith("analysis.programs")
+               for k in counters), sorted(counters)[:10]
+
+
+def test_pe_strict_fp16_named_error(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+    amp.enable("float16")
+    guardian.enable("skip")
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pe = ParallelExecutor(main_program=fluid.default_main_program(),
+                          loss_name=loss.name)
+    with pytest.raises(analysis.VerifyError, match="AN401"):
+        pe.run([loss], feed=_mlp_feed())
+
+
+def test_strict_windowed_guarded_amp_run_passes(monkeypatch):
+    """The PR 6/7 production path (guarded + fp16-scaled window) verifies
+    clean in strict mode AND still runs."""
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+    fluid.default_main_program().random_seed = 5
+    fluid.default_startup_program().random_seed = 5
+    amp.enable("float16")
+    guardian.enable("skip")
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.randn(8, 16).astype(np.float32),
+            "label": rng.randint(0, 10, size=(8, 1)).astype(np.int64)}
+    out = exe.run_steps(fluid.default_main_program(), feed, [loss],
+                        n_steps=4)
+    assert np.isfinite(np.asarray(out[0])).all()
+    guardian.current().flush()
+
+
+# ---------------------------------------------------------------------------
+# SPMD collective estimate cross-check + observe plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_collective_estimate_cross_checks_gauges(monkeypatch):
+    """The pre-compile estimate and the post-compile truth gauge agree on
+    'collectives happen here': both nonzero for a dp2,tp2 window."""
+    from paddle_tpu import observe
+
+    monkeypatch.setenv("PADDLE_TPU_MESH", "dp2,tp2")
+    fluid.default_main_program().random_seed = 3
+    fluid.default_startup_program().random_seed = 3
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pe = ParallelExecutor(main_program=fluid.default_main_program(),
+                          loss_name=loss.name)
+    rng = np.random.RandomState(1)
+    feed = {"img": rng.randn(8, 16).astype(np.float32),
+            "label": rng.randint(0, 10, size=(8, 1)).astype(np.int64)}
+    pe.run_steps([loss], feed=feed, n_steps=2)
+    snap = observe.registry().snapshot()
+    gauges = snap.get("gauges", {})
+    est = [v for k, v in gauges.items()
+           if k.startswith("analysis.collective_bytes_est")]
+    truth = [v for k, v in gauges.items()
+             if k.startswith("spmd.collective_bytes")]
+    assert est and est[0] > 0, sorted(gauges)
+    assert truth and truth[0] > 0, sorted(gauges)
+
+
+def test_diagnostics_reach_observe_events(tmp_path, monkeypatch):
+    from paddle_tpu import observe
+
+    monkeypatch.setenv("PADDLE_OBSERVE_DIR", str(tmp_path))
+    prog, loss = _broken_program()
+    with pytest.warns(UserWarning):
+        analysis.check_before_compile(prog, feed=_mlp_feed(),
+                                      fetch_list=[loss], kind="run")
+    sink = observe.get_sink()
+    assert sink is not None
+    recs = [json.loads(l) for l in
+            open(sink.events.path).read().splitlines()]
+    ev = [r for r in recs if r.get("event") == "analysis.verify"]
+    assert ev and ev[0]["errors"] >= 1 and "AN104" in ev[0]["codes"]
+    counters = observe.registry().snapshot()["counters"]
+    diag = [v for k, v in counters.items()
+            if k.startswith("analysis.diagnostics") and "AN104" in k]
+    assert diag and diag[0] >= 1
+
+
+# ---------------------------------------------------------------------------
+# CLI + smoke tool round trips (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_lint_model_roundtrip():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "lint",
+         "--model", "mlp", "--json"],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["errors"] == 0
+
+
+def test_cli_lint_saved_inference_model(tmp_path):
+    loss = _build_mlp()
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    pred = next(op.outputs["Out"][0] for op in gb.ops
+                if op.type == "softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(str(tmp_path), ["img"],
+                                  [gb.var(pred)], exe)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "lint",
+         "--dir", str(tmp_path), "--json"],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["errors"] == 0
+
+
+def test_verify_smoke_tool():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "verify_smoke.py")],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["ok"] is True
+    assert payload["verify_p50_ms"] < 50.0
+    assert payload["seeded_codes"] == ["AN101"]

@@ -141,3 +141,67 @@ def test_conv_bn_fuse_skips_shared_filter():
     t.transpile(infer, fluid.CPUPlace())
     types = [op.type for op in infer.global_block().ops]
     assert types.count("batch_norm") == 2, types  # untouched
+
+
+def test_dead_op_elimination_keeps_subblock_side_effects():
+    """ISSUE 8 regression: an op whose outer outputs are dead but whose
+    sub-block saves state / writes persistables must survive — sub-block
+    effects are invisible to outer def-use liveness."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        gb = prog.global_block()
+        # persistable (checkpoint-visible) counter written by an op with
+        # no consumers: must be kept
+        gb.create_var(name="gstep", shape=(1,), dtype="int64",
+                      persistable=True)
+        gb.append_op(type="increment", inputs={"X": ["gstep"]},
+                     outputs={"Out": ["gstep"]})
+        # genuinely dead op: must go
+        gb.create_var(name="deadv", shape=(4,), dtype="float32")
+        gb.append_op(type="scale", inputs={"X": [h.name]},
+                     outputs={"Out": ["deadv"]}, attrs={"scale": 2.0})
+        # dead-looking control-flow op whose sub-block saves: must be kept
+        sub = prog._create_block()
+        sub.append_op(type="save", inputs={"X": [h.name]}, outputs={},
+                      attrs={"file_path": "/tmp/ckpt"})
+        prog._rollback()
+        gb.create_var(name="while_out", shape=(1,), dtype="float32")
+        gb.append_op(type="while", inputs={"X": [h.name]},
+                     outputs={"Out": ["while_out"]},
+                     attrs={"sub_block": sub.idx})
+    out = ir.apply_pass(prog, "dead_op_elimination", targets=[h])
+    types = [op.type for op in out.global_block().ops]
+    assert "increment" in types, types
+    assert "while" in types, types
+    assert "scale" not in types, types
+
+
+def test_dead_op_elimination_keeps_guarded_amp_training_slice():
+    """A guarded fp16-loss-scaled training program keeps its loss-seed op
+    (__loss_seed__) and every optimizer update through the pass."""
+    from paddle_tpu.fluid import amp, guardian
+
+    amp.enable("float16")
+    guardian.enable("skip")
+    try:
+        img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(loss)
+        prog = fluid.default_main_program()
+        n_opt = sum(1 for op in prog.global_block().ops
+                    if op.type == "momentum")
+        out = ir.apply_pass(prog, "dead_op_elimination", targets=[loss])
+        kept = out.global_block().ops
+        assert sum(1 for op in kept if op.type == "momentum") == n_opt
+        assert any(op.attr("__loss_seed__") for op in kept), \
+            "loss-seed op (dynamic fp16 scale injection) was eliminated"
+    finally:
+        amp.disable()
+        guardian.disable()

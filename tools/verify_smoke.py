@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Tier-1 smoke for the pre-compile program verifier (<2s after import).
+
+ 1. builds an MLP training program and a tiny dp2,tp2-meshed transformer;
+ 2. strict-verifies both — must be CLEAN (no errors, no warnings);
+ 3. seeds a shape bug (fc weight resized) — must be caught as AN101;
+ 4. round-trips the ``python -m paddle_tpu.analysis lint`` CLI surface;
+ 5. measures verify latency — p50 must be under 50ms per program.
+
+Prints one BENCH-style JSON line; exit 0 = all gates pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_mlp():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+
+    framework.fresh_session()
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=32, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    return fluid.default_main_program(), ["img", "label"], [loss]
+
+
+def build_transformer():
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.models import transformer
+
+    framework.fresh_session()
+    src, tgt, lbl, cost = transformer.build(transformer.tiny_config(),
+                                            src_len=8, tgt_len=8)
+    import paddle_tpu.fluid as fluid
+
+    return fluid.default_main_program(), [src.name, tgt.name, lbl.name], \
+        [cost]
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    import numpy as np
+
+    from paddle_tpu import analysis
+
+    results = {"tool": "verify_smoke"}
+    failures = []
+
+    # 1+2: both reference programs strict-clean
+    mlp_prog, mlp_feed, mlp_fetch = build_mlp()
+    mlp_feed_arrays = {"img": np.zeros((8, 16), np.float32),
+                      "label": np.zeros((8, 1), np.int64)}
+    tr_prog, tr_feed, tr_fetch = build_transformer()
+
+    durations = []
+    for _ in range(5):
+        r_mlp = analysis.verify_program(mlp_prog, feed=mlp_feed_arrays,
+                                        fetch_list=mlp_fetch,
+                                        kind="run_steps")
+        durations.append(r_mlp.duration_ms)
+        r_tr = analysis.verify_program(tr_prog, feed=tr_feed,
+                                       fetch_list=tr_fetch,
+                                       mesh="dp2,tp2", kind="pe_run_steps")
+        durations.append(r_tr.duration_ms)
+    if not r_mlp.clean:
+        failures.append("mlp not clean: " + r_mlp.format("warn"))
+    if not r_tr.clean:
+        failures.append("transformer not clean: " + r_tr.format("warn"))
+    results["mesh"] = r_tr.mesh
+    results["collective_bytes_est"] = r_tr.collective_bytes_est
+    if not (r_tr.collective_bytes_est or 0) > 0:
+        failures.append("dp2,tp2 transformer produced no collective "
+                        "estimate")
+
+    # 3: seeded shape bug caught with a named code
+    gb = mlp_prog.global_block()
+    weight = next(v for v in gb.vars.values()
+                  if v.shape == (16, 32))
+    weight.shape = (16, 31)
+    mlp_prog._bump_version()
+    r_bug = analysis.verify_program(mlp_prog, feed=mlp_feed_arrays,
+                                    fetch_list=mlp_fetch)
+    codes = sorted({d.code for d in r_bug.errors})
+    results["seeded_codes"] = codes
+    if "AN101" not in codes:
+        failures.append(f"seeded shape bug not caught (codes={codes})")
+
+    # 4: CLI round-trip (in-process: same argument surface as
+    # `python -m paddle_tpu.analysis lint`)
+    from paddle_tpu.analysis.__main__ import main as cli_main
+
+    rc = cli_main(["lint", "--model", "mlp", "--json"])
+    if rc != 0:
+        failures.append(f"CLI lint --model mlp exited {rc}")
+    rc = cli_main(["--smoke"])
+    if rc != 0:
+        failures.append(f"CLI --smoke exited {rc}")
+
+    # 5: latency gate
+    durations.sort()
+    p50 = durations[len(durations) // 2]
+    results["verify_p50_ms"] = round(p50, 3)
+    results["verify_max_ms"] = round(durations[-1], 3)
+    if p50 >= 50.0:
+        failures.append(f"verify p50 {p50:.1f}ms >= 50ms budget")
+
+    results["wall_s"] = round(time.perf_counter() - t_start, 2)
+    results["ok"] = not failures
+    print(json.dumps(results))
+    for f in failures:
+        print("FAIL:", f, file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
