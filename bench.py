@@ -99,6 +99,14 @@ PROBE_SRC = (
 )
 
 
+def _probe_diag(rec: dict) -> None:
+    """Probe retry/wedge diagnostics go to STDERR: stdout is the metric
+    channel and every line of it must parse as a clean BENCH JSON line
+    (the round-5 BENCH tail was polluted by these — ISSUE 9 satellite;
+    regression: tests/test_bench_output.py parses every stdout line)."""
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
 def _probe_once(timeout: float) -> str:
     """Run a tiny jitted matmul in a subprocess; one attempt.
 
@@ -158,25 +166,25 @@ def probe_platform(timeout: float = 180.0) -> tuple:
             # quick retry (transient flake), then concede
             crashes += 1
             if crashes >= 2:
-                print(json.dumps({
+                _probe_diag({
                     "event": "tpu_probe_crashed", "attempts": attempt,
                     "elapsed_sec": round(elapsed, 1),
                     "note": "backend init fails fast (not a hang); "
-                            "falling back to CPU"}), flush=True)
+                            "falling back to CPU"})
                 return "cpu", "probe_crashed"
         remaining = budget - (time.monotonic() - t_start)
         if remaining <= pause:
-            print(json.dumps({
+            _probe_diag({
                 "event": "tpu_probe_gave_up", "attempts": attempt,
                 "elapsed_sec": round(elapsed, 1),
                 "note": "accelerator wedged for the whole probe budget; "
-                        "falling back to CPU"}), flush=True)
+                        "falling back to CPU"})
             return "cpu", "wedged_budget_exhausted"
-        print(json.dumps({
+        _probe_diag({
             "event": "tpu_probe_wedged_retrying", "attempt": attempt,
             "elapsed_sec": round(elapsed, 1),
             "retry_in_sec": pause,
-            "budget_remaining_sec": round(remaining, 1)}), flush=True)
+            "budget_remaining_sec": round(remaining, 1)})
         time.sleep(pause)
 
 

@@ -192,6 +192,27 @@ declare("PADDLE_OBSERVE_FLUSH_S", "float", 5.0, "observe",
         "Metric snapshot flush interval (seconds)")
 declare("PADDLE_OBSERVE_PORT", "int", None, "observe",
         "Serve /metrics + /healthz on 127.0.0.1:<port> (0 = ephemeral)")
+declare("PADDLE_TRACE", "bool", True, "observe",
+        "Span tracing master switch (0 disables all span emission; spans "
+        "only materialize when an observe dir is configured)")
+declare("PADDLE_TRACE_SAMPLE", "float", 1.0, "observe",
+        "Fraction of root spans recorded (deterministic every-Nth "
+        "sampling; children follow their root's decision)")
+declare("PADDLE_TRACEPARENT", "str", None, "observe",
+        "Inherited trace context, W3C-style '00-<trace>-<span>-01' (the "
+        "elastic supervisor sets it so worker spans join the run trace)")
+declare("PADDLE_SLO", "bool", False, "observe",
+        "Arm the SLO watchdog (rolling median+MAD baselines; emits "
+        "slo.breach run events on regression)")
+declare("PADDLE_SLO_FACTOR", "float", 3.0, "observe",
+        "Breach when a value exceeds factor x rolling median (and clears "
+        "the MAD noise guard)")
+declare("PADDLE_SLO_WINDOW", "int", 64, "observe",
+        "Rolling baseline window per watched metric (samples)")
+declare("PADDLE_SLO_MIN_SAMPLES", "int", 8, "observe",
+        "Baseline samples required before the watchdog may fire")
+declare("PADDLE_SLO_COOLDOWN_S", "float", 1.0, "observe",
+        "Minimum seconds between breach events for one metric")
 
 # -- fault injection (PADDLE_FAULT_* family; deterministic test faults) --
 declare("PADDLE_FAULT_", "prefix", None, "fault",

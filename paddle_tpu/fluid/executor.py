@@ -661,6 +661,8 @@ class Executor:
         guard = _guardian.for_program(program)
         n_user = len(fetch_names)
 
+        from ..observe import trace as _trace
+
         key = ("run_steps", program._cache_token, program._version,
                tuple(fetch_names), n_steps, bool(feed_per_step),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
@@ -673,149 +675,212 @@ class Executor:
                os.environ.get("PADDLE_TPU_FLASH", ""))
         entry = self._cache.get(key)
         probe = None
+        fresh_entry = entry is None
         if entry is None:
             from .log import VLOG
             from .. import analysis as _analysis
             from .. import compile_cache as _cc
 
-            # pre-compile verifier (PADDLE_TPU_VERIFY): milliseconds of
-            # static checks before seconds of trace/compile; strict mode
-            # raises VerifyError here, before any backend work.  Stacked
-            # per-step feeds verify as ONE step's slice.
-            _analysis.check_before_compile(
-                program,
-                feed=({k: v[0] if getattr(v, "ndim", 0) > 0 else v
-                       for k, v in feed_arrays.items()}
-                      if feed_per_step else feed_arrays),
-                fetch_list=fetch_names, kind="run_steps")
-            # persistent-cache consult BEFORE tracing: a hit means another
-            # process already compiled this exact (program, jit config) —
-            # the backend executable loads from the shared disk cache
-            probe = _cc.executor_probe(
-                program, feed_arrays, fetch_names,
-                extra={"kind": "run_steps", "n_steps": n_steps,
-                       "feed_per_step": bool(feed_per_step),
-                       "platform": self.place.device_type,
-                       "amp": _amp.compute_dtype(),
-                       "guard": (guard.cache_token()
-                                 if guard is not None else None),
-                       "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
-            VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan"
-                    f"{' (guarded)' if guard is not None else ''}")
-            plan_fetches = list(fetch_names)
-            if guard is not None:
-                plan_fetches += guard.extra_fetch_names()
-            plan = BlockPlan(program, 0, list(feed_arrays), plan_fetches)
-            if plan.needs_eager:
-                if guard is not None and guard.scale_vars is not None:
+            with _trace.span("executor.trace", n_steps=n_steps):
+                # pre-compile verifier (PADDLE_TPU_VERIFY): milliseconds of
+                # static checks before seconds of trace/compile; strict mode
+                # raises VerifyError here, before any backend work.  Stacked
+                # per-step feeds verify as ONE step's slice.
+                _analysis.check_before_compile(
+                    program,
+                    feed=({k: v[0] if getattr(v, "ndim", 0) > 0 else v
+                           for k, v in feed_arrays.items()}
+                          if feed_per_step else feed_arrays),
+                    fetch_list=fetch_names, kind="run_steps")
+                # persistent-cache consult BEFORE tracing: a hit means
+                # another process already compiled this exact (program, jit
+                # config) — the backend executable loads from the shared
+                # disk cache
+                probe = _cc.executor_probe(
+                    program, feed_arrays, fetch_names,
+                    extra={"kind": "run_steps", "n_steps": n_steps,
+                           "feed_per_step": bool(feed_per_step),
+                           "platform": self.place.device_type,
+                           "amp": _amp.compute_dtype(),
+                           "guard": (guard.cache_token()
+                                     if guard is not None else None),
+                           "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
+                VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan"
+                        f"{' (guarded)' if guard is not None else ''}")
+                plan_fetches = list(fetch_names)
+                if guard is not None:
+                    plan_fetches += guard.extra_fetch_names()
+                plan = BlockPlan(program, 0, list(feed_arrays), plan_fetches)
+                if plan.needs_eager:
+                    if guard is not None and guard.scale_vars is not None:
+                        raise RuntimeError(
+                            "dynamic fp16 loss scaling is not supported for "
+                            "programs with data-dependent eager ops")
                     raise RuntimeError(
-                        "dynamic fp16 loss scaling is not supported for "
-                        "programs with data-dependent eager ops")
-                raise RuntimeError(
-                    "run_steps: program contains data-dependent eager "
-                    "ops; use Executor.run per step")
-            if guard is not None and guard.scale_vars:
-                # the scale/good-steps vars are read/written only by the
-                # guarded wrapper (no IR op touches the counter), so
-                # liveness never saw them — gather with the rest of state
-                for n in guard.scale_vars:
-                    if n not in plan.state_in:
-                        plan.state_in.append(n)
+                        "run_steps: program contains data-dependent eager "
+                        "ops; use Executor.run per step")
+                if guard is not None and guard.scale_vars:
+                    # the scale/good-steps vars are read/written only by the
+                    # guarded wrapper (no IR op touches the counter), so
+                    # liveness never saw them — gather with the rest of
+                    # state
+                    for n in guard.scale_vars:
+                        if n not in plan.state_in:
+                            plan.state_in.append(n)
 
-            kfn = build_window_fn(program, plan, guard, n_user, n_steps,
-                                  feed_per_step)
-            device = core.get_jax_device(self.place)
-            donate = self._donate_argnums(device, program)
-            entry = (plan, jax.jit(kfn, donate_argnums=donate), guard)
-            self._cache[key] = entry
-        plan, fn, guard = entry
+                kfn = build_window_fn(program, plan, guard, n_user, n_steps,
+                                      feed_per_step)
+                device = core.get_jax_device(self.place)
+                donate = self._donate_argnums(device, program)
+                # the trailing dict carries per-entry attribution state
+                # (compiled cost analysis, captured lazily under tracing)
+                entry = (plan, jax.jit(kfn, donate_argnums=donate), guard,
+                         {"cost": None})
+                self._cache[key] = entry
+        plan, fn, guard, entry_info = entry
+
+        import contextlib
+        import time as _time
 
         from . import fault as _fault
         from . import profiler as _prof
+        from ..observe import watchdog as _watchdog
 
-        window_start = 0
-        if program._params_grads is not None:
-            window_start = self._step_boundary(_fault, n_steps)
-        g = _guardian.current() if guard is not None else None
-        if g is not None:
-            # one-window-lag sentinel: observe the PREVIOUS dispatch's
-            # aggregated health and apply policy BEFORE this window runs
-            g.on_boundary()
-        state_vals = self._gather_state(program, plan, scope)
-        mut_names = set(plan.state_out)
-        if plan.needs_rng:
-            mut_names.add(RNG_STATE_VAR)
-        if guard is not None and guard.scale_vars:
-            mut_names.update(guard.scale_vars)
-        mut_state = {k: v for k, v in state_vals.items() if k in mut_names}
-        const_state = {k: v for k, v in state_vals.items()
-                       if k not in mut_names}
-        device = core.get_jax_device(self.place)
-        feed_dev = {k: self._put_feed(k, v, device)
-                    for k, v in feed_arrays.items()}
-        sentinel = None
-        dump_state = None
-        if guard is not None:
-            seed_mul, loss_mul = _fault.sentinel_injection_window(
-                window_start, n_steps)
-            sentinel = {
-                "loss_cap": np.float32(g.loss_cap() if g is not None
-                                       else float("inf")),
-                "seed_mul": seed_mul,
-                "loss_mul": loss_mul,
-            }
-            dump_state = state_vals
-            if g is not None and g.config.policy == "dump_and_halt" \
-                    and self._donate_argnums(device, program):
-                # donation invalidates mutated input buffers after the
-                # dispatch; dump mode keeps pre-window device copies alive
-                dump_state = {k: (jnp.array(v, copy=True) if k in mut_names
-                                  else v)
-                              for k, v in state_vals.items()}
-        import time as _time
+        with contextlib.ExitStack() as _tstack:
+            # the window span wraps the WHOLE dispatch cycle, so guardian
+            # trips / cache probes / slo breaches emitted inside it carry
+            # its span id; None (one dict lookup) when tracing is off
+            wspan = _tstack.enter_context(
+                _trace.span("executor.window", n_steps=n_steps,
+                            fresh=fresh_entry))
+            t_host0 = _time.perf_counter()
+            window_start = 0
+            if program._params_grads is not None:
+                window_start = self._step_boundary(_fault, n_steps)
+            g = _guardian.current() if guard is not None else None
+            if g is not None:
+                # one-window-lag sentinel: observe the PREVIOUS dispatch's
+                # aggregated health and apply policy BEFORE this window runs
+                g.on_boundary()
+            t_stage0 = _time.perf_counter()
+            state_vals = self._gather_state(program, plan, scope)
+            mut_names = set(plan.state_out)
+            if plan.needs_rng:
+                mut_names.add(RNG_STATE_VAR)
+            if guard is not None and guard.scale_vars:
+                mut_names.update(guard.scale_vars)
+            mut_state = {k: v for k, v in state_vals.items()
+                         if k in mut_names}
+            const_state = {k: v for k, v in state_vals.items()
+                           if k not in mut_names}
+            device = core.get_jax_device(self.place)
+            feed_dev = {k: self._put_feed(k, v, device)
+                        for k, v in feed_arrays.items()}
+            t_stage1 = _time.perf_counter()
+            sentinel = None
+            dump_state = None
+            if guard is not None:
+                seed_mul, loss_mul = _fault.sentinel_injection_window(
+                    window_start, n_steps)
+                sentinel = {
+                    "loss_cap": np.float32(g.loss_cap() if g is not None
+                                           else float("inf")),
+                    "seed_mul": seed_mul,
+                    "loss_mul": loss_mul,
+                }
+                dump_state = state_vals
+                if g is not None and g.config.policy == "dump_and_halt" \
+                        and self._donate_argnums(device, program):
+                    # donation invalidates mutated input buffers after the
+                    # dispatch; dump mode keeps pre-window device copies
+                    # alive
+                    dump_state = {k: (jnp.array(v, copy=True)
+                                      if k in mut_names else v)
+                                  for k, v in state_vals.items()}
+            if wspan is not None and entry_info.get("cost") is None:
+                # device-time attribution (tracing only — lowering costs
+                # one extra trace, never an extra XLA compile): the
+                # window program's flops/bytes back the device.mfu gauge
+                try:
+                    entry_info["cost"] = _trace.cost_of(fn.lower(
+                        feed_dev, const_state, mut_state, sentinel)) or False
+                except Exception:
+                    entry_info["cost"] = False
 
-        agg = None
-        t = _time.perf_counter()
-        if guard is not None:
-            fetches, new_state, agg = fn(feed_dev, const_state, mut_state,
-                                         sentinel)
-        else:
-            fetches, new_state = fn(feed_dev, const_state, mut_state, None)
+            agg = None
+            t = _time.perf_counter()
+            if guard is not None:
+                fetches, new_state, agg = fn(feed_dev, const_state,
+                                             mut_state, sentinel)
+            else:
+                fetches, new_state = fn(feed_dev, const_state, mut_state,
+                                        None)
+            if wspan is not None or (_prof.is_profiling()
+                                     and guard is None):
+                # attribution needs the true device time; outside tracing/
+                # profiling the dispatch stays async as before
+                jax.block_until_ready((fetches, new_state))
+            t_disp1 = _time.perf_counter()
             if _prof.is_profiling():
-                jax.block_until_ready(fetches)
-        if _prof.is_profiling():
-            _prof.record_event(
-                f"executor_run[{len(plan.ops)}ops x{n_steps}steps]",
-                _time.perf_counter() - t, start=t)
-        # window visibility in the always-on counters (the smoke oracle
-        # counts dispatches; window_steps tracks amortization)
-        _prof.record_counter("executor.dispatches")
-        _prof.record_counter("executor.windows")
-        _prof.record_counter("executor.window_steps", inc=n_steps)
-        if probe is not None:
-            probe.finish(_time.perf_counter() - t, program,
-                         meta={"kind": "run_steps", "n_steps": n_steps})
-        if _fault.active() is not None:
-            new_state = _fault.corrupt_state(new_state)
-        for name, val in new_state.items():
-            scope.set(name, val)
-        self._check_nan_inf(list(new_state.items())
-                            + list(zip(plan.fetch_names, fetches)))
-        if g is not None and agg is not None:
-            g.defer(guard, window_start, agg, {
-                "program": program, "feeds": feed_arrays,
-                "feed_lods": {}, "fetch_names": fetch_names,
-                "state": dump_state, "sentinel": sentinel,
-                "duration_s": _time.perf_counter() - t,
-                "window": {"start": window_start, "n_steps": n_steps,
-                           "feed_per_step": bool(feed_per_step)}})
-        if program._params_grads is not None:
-            from .. import observe
+                _prof.record_event(
+                    f"executor_run[{len(plan.ops)}ops x{n_steps}steps]",
+                    t_disp1 - t, start=t)
+            # window visibility in the always-on counters (the smoke oracle
+            # counts dispatches; window_steps tracks amortization)
+            _prof.record_counter("executor.dispatches")
+            _prof.record_counter("executor.windows")
+            _prof.record_counter("executor.window_steps", inc=n_steps)
+            if probe is not None:
+                probe.finish(t_disp1 - t, program,
+                             meta={"kind": "run_steps", "n_steps": n_steps})
+            if _fault.active() is not None:
+                new_state = _fault.corrupt_state(new_state)
+            for name, val in new_state.items():
+                scope.set(name, val)
+            self._check_nan_inf(list(new_state.items())
+                                + list(zip(plan.fetch_names, fetches)))
+            if g is not None and agg is not None:
+                g.defer(guard, window_start, agg, {
+                    "program": program, "feeds": feed_arrays,
+                    "feed_lods": {}, "fetch_names": fetch_names,
+                    "state": dump_state, "sentinel": sentinel,
+                    "duration_s": t_disp1 - t,
+                    "window": {"start": window_start, "n_steps": n_steps,
+                               "feed_per_step": bool(feed_per_step)}})
+            if program._params_grads is not None:
+                from .. import observe
 
-            # events emitted after the window (checkpoint commits, cache
-            # probes) correlate to its LAST executed step, not its first
-            observe.note_step(window_start + n_steps - 1)
-        return [np.asarray(v) for v in fetches]
+                # events emitted after the window (checkpoint commits, cache
+                # probes) correlate to its LAST executed step, not its first
+                observe.note_step(window_start + n_steps - 1)
+            t_obs1 = _time.perf_counter()
+            if wspan is not None:
+                # child spans: H2D staging / device dispatch / host observe
+                # tail — the step-time breakdown the trace view decomposes a
+                # window into (host_ms = everything not in the other three)
+                _trace.emit_span("executor.stage", t_stage0, t_stage1,
+                                 parent=wspan)
+                _trace.emit_span("executor.dispatch", t, t_disp1,
+                                 parent=wspan, compile=fresh_entry)
+                _trace.emit_span("executor.observe", t_disp1, t_obs1,
+                                 parent=wspan)
+                _trace.note_window_breakdown(
+                    host_ms=((t_stage0 - t_host0) + (t - t_stage1)) * 1e3,
+                    stage_ms=(t_stage1 - t_stage0) * 1e3,
+                    device_ms=(t_disp1 - t) * 1e3,
+                    observe_ms=(t_obs1 - t_disp1) * 1e3)
+                if entry_info.get("cost"):
+                    _trace.note_device_cost(entry_info["cost"],
+                                            t_disp1 - t, n_steps,
+                                            device=device)
+            if program._params_grads is not None:
+                # SLO watchdog: per-step time of this dispatch (no-op
+                # unless PADDLE_SLO is armed)
+                _watchdog.observe_value(
+                    "executor.step_time_s",
+                    (t_obs1 - t_host0) / max(1, n_steps),
+                    step=window_start + n_steps - 1)
+            return [np.asarray(v) for v in fetches]
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
@@ -1017,6 +1082,14 @@ class Executor:
                 "feed_lods": feed_lods, "fetch_names": fetch_names,
                 "state": dump_state, "sentinel": sentinel,
                 "duration_s": _time.perf_counter() - t})
+        if program._params_grads is not None:
+            from ..observe import watchdog as _watchdog
+
+            # SLO watchdog on the per-step training path (no-op unless
+            # PADDLE_SLO is armed); async dispatch means this measures
+            # submit-to-submit pacing, which is what regresses under load
+            _watchdog.observe_value("executor.step_time_s",
+                                    _time.perf_counter() - t, step=step_idx)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         from .lod_tensor import LoDTensor

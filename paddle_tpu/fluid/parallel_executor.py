@@ -242,22 +242,26 @@ class ParallelExecutor:
         runner = self._window_cache.get(key)
         if runner is None:
             from .. import analysis as _analysis
+            from ..observe import trace as _trace
 
-            # stacked (n_steps, batch, ...) windows verify as one step
-            _analysis.check_before_compile(
-                self._program,
-                feed=({k: v[0] if getattr(v, "ndim", 0) > 0 else v
-                       for k, v in feed_arrays.items()}
-                      if feed_per_step else feed_arrays),
-                fetch_list=fetch_names, mesh=self._mesh,
-                kind="pe_run_steps")
-            zero1 = (self._build_strategy.reduce_strategy ==
-                     BuildStrategy.ReduceStrategy.Reduce)
-            runner = ShardedWindowRunner(
-                self._program, list(feed_arrays), fetch_names, self._mesh,
-                n_steps=n_steps, feed_per_step=feed_per_step, zero1=zero1,
-                multihost=self._multihost)
-            self._window_cache[key] = runner
+            with _trace.span("executor.trace", n_steps=n_steps,
+                             mesh=self.mesh_label):
+                # stacked (n_steps, batch, ...) windows verify as one step
+                _analysis.check_before_compile(
+                    self._program,
+                    feed=({k: v[0] if getattr(v, "ndim", 0) > 0 else v
+                           for k, v in feed_arrays.items()}
+                          if feed_per_step else feed_arrays),
+                    fetch_list=fetch_names, mesh=self._mesh,
+                    kind="pe_run_steps")
+                zero1 = (self._build_strategy.reduce_strategy ==
+                         BuildStrategy.ReduceStrategy.Reduce)
+                runner = ShardedWindowRunner(
+                    self._program, list(feed_arrays), fetch_names,
+                    self._mesh, n_steps=n_steps,
+                    feed_per_step=feed_per_step, zero1=zero1,
+                    multihost=self._multihost)
+                self._window_cache[key] = runner
         self._check_initialized(runner.plan)
         return runner.run(feed_arrays, scope=self._scope,
                           return_numpy=return_numpy)

@@ -33,6 +33,7 @@ device's current window.
 from __future__ import annotations
 
 import os
+from collections import deque
 from queue import Empty, Full, Queue
 from threading import Event, Thread
 from typing import Dict, Iterable, Optional, Tuple
@@ -155,34 +156,65 @@ class DevicePrefetcher:
         # training path passes ParallelExecutor.stage_window so windows
         # land on the mesh with the batch axis already dp-sharded
         self._stage_fn = stage_fn
+        # span linkage (observe.trace): the worker thread emits one
+        # "prefetch.stage" span per staged window and queues its id here
+        # (FIFO, mirrors the item queue); the consumer pops it into
+        # ``last_stage_span`` as it takes each window, so the consuming
+        # window's span can carry a ``staged_span`` link even though the
+        # two live on different threads
+        self._stage_spans: deque = deque()
+        self._parent_span = None
+        self.last_stage_span: Optional[str] = None
 
     # -- staging --
     def _stage(self, batches) -> Tuple[Dict[str, object], int]:
         from . import fault as _fault
+        from ..observe import trace as _trace
 
+        sp = _trace.start_span("prefetch.stage", parent=self._parent_span,
+                               count=len(batches))
         _fault.io_delay()  # deterministic slow-input oracle (module doc)
         import jax
 
         window = {name: np.stack([np.asarray(b[name]) for b in batches])
                   for name in batches[0]}
         if self._stage_fn is not None:
-            return self._stage_fn(window), len(batches)
-        if self._device is None:
-            self._device = _resolve_device(self._place)
-        return ({name: jax.device_put(arr, self._device)
-                 for name, arr in window.items()}, len(batches))
+            placed = self._stage_fn(window)
+        else:
+            if self._device is None:
+                self._device = _resolve_device(self._place)
+            placed = {name: jax.device_put(arr, self._device)
+                      for name, arr in window.items()}
+        if sp is not None:
+            sp.end()
+            self._stage_spans.append(sp.span_id)
+        else:
+            self._stage_spans.append(None)
+        return placed, len(batches)
 
     def __iter__(self):
+        from ..observe import trace as _trace
+
+        # staging spans parent to whatever was open when iteration began
+        # (the trainer's epoch span, usually) — NOT to per-window spans,
+        # which come and go while the worker runs ahead
+        self._parent_span = _trace.current()
         wins = _windows(self._source, self.n_steps)
         if self.depth == 0:
             # synchronous mode: stage in the caller's thread, on demand
             for batches in wins:
                 if self._abort.is_set():
                     return
-                yield self._stage(batches)
+                item = self._stage(batches)
+                self.last_stage_span = (self._stage_spans.popleft()
+                                        if self._stage_spans else None)
+                yield item
             return
-        yield from _background_iter(wins, self._stage, self.depth,
-                                    self._abort)
+        for item in _background_iter(wins, self._stage, self.depth,
+                                     self._abort):
+            self.last_stage_span = (self._stage_spans.popleft()
+                                    if self._stage_spans else None)
+            yield item
 
     def close(self) -> None:
         """Stop the staging thread; safe to call repeatedly."""

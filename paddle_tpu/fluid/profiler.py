@@ -52,12 +52,18 @@ def record_event(name: str, seconds: float, start: float = None) -> None:
     """Aggregate one timed host event (executor hooks call this)."""
     if not _on:
         return
+    from ..observe import trace as _trace
+
     reg = _registry()
     reg.record_timing(name, seconds)
     ts = ((start if start is not None else time.perf_counter() - seconds)
           - _t0) * 1e6
+    # stamp the emitting thread so tools/timeline.py renders concurrent
+    # events (prefetch staging vs executor dispatch) on separate rows
+    tid = _trace.thread_tid()
     with reg.lock:
-        _timeline.append({"name": name, "ts": ts, "dur": seconds * 1e6})
+        _timeline.append({"name": name, "ts": ts, "dur": seconds * 1e6,
+                          "tid": tid})
 
 
 def record_counter(name: str, inc: int = 1, value=None) -> None:

@@ -452,8 +452,11 @@ class Trainer:
         so resume lands on the same steps the per-step loop would have
         saved."""
         import itertools
+        import time as _time
 
         from .prefetch import DevicePrefetcher
+        from ..observe import trace as _trace
+        from ..observe import watchdog as _watchdog
 
         last_epoch_saved = None
         iv = self.checkpoint_cfg.step_interval if self.checkpoint_cfg else 0
@@ -472,6 +475,7 @@ class Trainer:
                         if self.parallel_exe is not None else None)
             with DevicePrefetcher(feeds, n_steps=n_steps,
                                   place=self.place, stage_fn=stage_fn) as pf:
+                t_prev = _time.perf_counter()
                 for feed_dev, count in pf:
                     if self.stop_flag:
                         return
@@ -479,15 +483,35 @@ class Trainer:
                     event_handler(begin)
                     fetch = (self.train_func_outputs
                              if begin.fetch_metrics else [])
-                    if self.parallel_exe is not None:
-                        metrics = self.parallel_exe.run_steps(
-                            fetch, feed=feed_dev, n_steps=count,
-                            feed_per_step=True)
-                    else:
-                        metrics = self.exe.run_steps(
-                            self.train_program, feed=feed_dev,
-                            fetch_list=fetch, n_steps=count,
-                            feed_per_step=True)
+                    # the train.window span carries the prefetch link
+                    # (staged_span = the worker-thread span that staged
+                    # THIS window's input) so the trace view stitches the
+                    # async hand-off; the executor's window span nests
+                    # inside it automatically
+                    with _trace.span("train.window", epoch=epoch_id,
+                                     step=step_id,
+                                     staged_span=pf.last_stage_span):
+                        if self.parallel_exe is not None:
+                            metrics = self.parallel_exe.run_steps(
+                                fetch, feed=feed_dev, n_steps=count,
+                                feed_per_step=True)
+                        else:
+                            metrics = self.exe.run_steps(
+                                self.train_program, feed=feed_dev,
+                                fetch_list=fetch, n_steps=count,
+                                feed_per_step=True)
+                        t_now = _time.perf_counter()
+                        # SLO watchdog on window-to-window wall time:
+                        # unlike the executor's metric this INCLUDES
+                        # input-feed stalls (a slow reader / injected IO
+                        # delay regresses it even though dispatch time is
+                        # flat).  Fed inside the span so a breach record
+                        # carries this window's span id.
+                        _watchdog.observe_value(
+                            "train.step_time_s",
+                            (t_now - t_prev) / max(1, count),
+                            step=step_id + count - 1, epoch=epoch_id)
+                    t_prev = t_now
                     last_step = step_id + count - 1
                     event_handler(EndStepEvent(epoch_id, last_step, metrics))
                     if self.checkpoint_cfg and \

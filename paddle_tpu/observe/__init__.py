@@ -48,6 +48,9 @@ __all__ = [
     "disable", "reset", "emit", "span", "note_step", "note_program",
     "note_mesh", "current_step", "current_program", "current_mesh",
     "http_server", "ENV_DIR", "ENV_FLUSH", "ENV_PORT",
+    # submodules re-exported for discoverability: observe.trace (span
+    # tracer + device-time attribution), observe.watchdog (SLO breaches)
+    "trace", "watchdog",
 ]
 
 ENV_DIR = "PADDLE_OBSERVE_DIR"
@@ -252,6 +255,13 @@ def reset() -> None:
     _step = None
     _program = None
     _mesh = None
+    # span tracer + SLO watchdog piggyback on the sink lifecycle: re-arm
+    # their env late-binding with it
+    from . import trace as _trace
+    from . import watchdog as _watchdog
+
+    _trace.reset()
+    _watchdog.reset()
 
 
 def http_server():
@@ -288,7 +298,9 @@ class _NullSpan:
 
 def span(event: str, **fields):
     """Timed-region context manager (emits ``dur_s``); no-op without a
-    sink."""
+    sink.  For PARENTED spans with trace identity use
+    :func:`paddle_tpu.observe.trace.span` — this one predates the tracer
+    and stays for plain flat timings."""
     try:
         sink = get_sink()
         if sink is None:
@@ -296,3 +308,8 @@ def span(event: str, **fields):
         return sink.events.span(event, **fields)
     except Exception:
         return _NullSpan()
+
+
+# submodules imported last (they only import observe lazily, so there is
+# no cycle): observe.trace / observe.watchdog are part of the public API
+from . import trace, watchdog  # noqa: E402,F401  (re-export)

@@ -11,6 +11,10 @@ Commands (default dir: $PADDLE_OBSERVE_DIR, overridable via --dir)::
     python -m paddle_tpu.observe serve [--port 9102]
                                      # /metrics + /healthz over the
                                      # aggregated fleet view
+    python -m paddle_tpu.observe trace [--trace-id ID]
+                                     # span trees: every trace in the
+                                     # merged stream as an indented tree
+                                     # (durations, host:rank:gen stamps)
     python -m paddle_tpu.observe --smoke
                                      # CI round-trip oracle (tier-1, <2s
                                      # after interpreter start; pattern of
@@ -82,6 +86,56 @@ def cmd_export(args) -> int:
     print(json.dumps({"out": args.out, "events": len(recs),
                       "pids": len({(r.get('host'), r.get('rank'))
                                    for r in recs})}))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Render the merged span stream as per-trace trees (the text twin of
+    the chrome-trace export: same records, no browser needed)."""
+    from .fleet import fleet_events
+
+    recs = fleet_events(_dir_or_die(args))
+    spans = [r for r in recs if r.get("span_id")]
+    by_trace = {}
+    for r in spans:
+        by_trace.setdefault(r.get("trace_id") or "?", []).append(r)
+    if args.trace_id:
+        by_trace = {k: v for k, v in by_trace.items()
+                    if k.startswith(args.trace_id)}
+    for trace_id in sorted(by_trace):
+        recs_t = by_trace[trace_id]
+        ids = {r["span_id"] for r in recs_t}
+        kids = {}
+        roots = []
+        for r in recs_t:
+            parent = r.get("parent_span")
+            if parent and parent in ids:
+                kids.setdefault(parent, []).append(r)
+            else:
+                roots.append(r)
+        print(f"trace {trace_id}  ({len(recs_t)} spans, "
+              f"{len(roots)} roots)")
+
+        def _start(r):
+            return r.get("ts", 0) - (r.get("dur_s") or 0)
+
+        def _walk(r, depth):
+            dur = r.get("dur_s")
+            dur_s = f"{dur * 1e3:10.3f} ms" if dur is not None else " " * 13
+            stamp = f"{r.get('host', '?')}:r{r.get('rank', 0)}" \
+                    f":g{r.get('gen', 0)}"
+            print(f"  {dur_s}  {'  ' * depth}{r.get('event', '?')}"
+                  f"  [{stamp} span={r['span_id'][:8]}]")
+            for k in sorted(kids.get(r["span_id"], []), key=_start):
+                _walk(k, depth + 1)
+
+        for r in sorted(roots, key=_start):
+            _walk(r, 0)
+    if not by_trace:
+        print(json.dumps({"traces": 0,
+                          "note": "no span records found (is tracing "
+                                  "enabled? PADDLE_TRACE / an observe "
+                                  "dir must be set on the traced run)"}))
     return 0
 
 
@@ -233,12 +287,14 @@ def main(argv=None) -> int:
         prog="python -m paddle_tpu.observe",
         description="Inspect / export / serve observability data.")
     ap.add_argument("command", nargs="?", default="summary",
-                    choices=["tail", "summary", "export", "serve"])
+                    choices=["tail", "summary", "export", "serve", "trace"])
     ap.add_argument("--dir", default=None,
                     help="observe dir (default $PADDLE_OBSERVE_DIR)")
     ap.add_argument("--n", type=int, default=20, help="tail: line count")
     ap.add_argument("--event", default=None,
                     help="tail: only this event kind")
+    ap.add_argument("--trace-id", default=None,
+                    help="trace: only traces whose id starts with this")
     ap.add_argument("--out", default="timeline.json",
                     help="export: chrome-trace output path")
     ap.add_argument("--device-trace-dir", default=None,
@@ -252,7 +308,8 @@ def main(argv=None) -> int:
         return cmd_smoke(args)
     try:
         return {"tail": cmd_tail, "summary": cmd_summary,
-                "export": cmd_export, "serve": cmd_serve}[args.command](args)
+                "export": cmd_export, "serve": cmd_serve,
+                "trace": cmd_trace}[args.command](args)
     except BrokenPipeError:
         # `... | head` closing stdout early is normal unix usage, not an
         # error worth a traceback

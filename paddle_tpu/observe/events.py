@@ -84,6 +84,18 @@ class EventLog:
             # topology stamp (dp4xtp2) — only present on sharded runs, so
             # single-device streams keep their exact record shape
             rec["mesh"] = mesh
+        try:
+            from . import trace as _trace
+
+            sp = _trace.current()
+            if sp is not None:
+                # trace stamp: any record emitted inside an open span
+                # (guardian trips, cache probes, slo breaches) joins the
+                # span tree.  Span records override via `fields` below.
+                rec["trace_id"] = sp.trace_id
+                rec["span_id"] = sp.span_id
+        except Exception:
+            pass
         if self.source:
             rec["source"] = self.source
         rec.update(fields)

@@ -191,15 +191,20 @@ def chrome_trace(records: List[dict],
         ts_us = (r.get("ts", t0) - t0) * 1e6
         args = {k: v for k, v in r.items()
                 if k not in ("ts", "event") and v is not None}
+        # span records (the trace module stamps an emitting-thread `tid`)
+        # keep their own thread row, so a prefetch worker's staging spans
+        # never overlap the executor's window spans on one track; legacy
+        # records without a tid keep the per-generation rows
+        tid = r.get("tid", r.get("gen", 0))
         if r.get("dur_s") is not None:
             dur_us = float(r["dur_s"]) * 1e6
             trace_events.append({"ph": "X", "cat": "event",
                                  "ts": ts_us - dur_us, "dur": dur_us,
-                                 "pid": pid, "tid": r.get("gen", 0),
+                                 "pid": pid, "tid": tid,
                                  "name": r.get("event", "?"), "args": args})
         else:
             trace_events.append({"ph": "i", "cat": "event", "ts": ts_us,
-                                 "pid": pid, "tid": r.get("gen", 0),
+                                 "pid": pid, "tid": tid,
                                  "s": "p",
                                  "name": r.get("event", "?"), "args": args})
     for s in counter_samples or []:

@@ -1,0 +1,167 @@
+"""SLO watchdog: rolling robust baselines, ``slo.breach`` run events.
+
+The observe registry answers "what is the number"; this module answers
+"did the number just get WORSE than this run's own normal".  For each
+watched metric it keeps a bounded rolling window and a robust baseline
+(median + MAD — one compile-spike or GC pause cannot drag the baseline
+the way a mean would), and when a new observation exceeds
+``factor x median`` AND clears the MAD noise guard it emits one
+``slo.breach`` run event (stamped like every other record: host / rank /
+gen / step / trace context) plus a ``slo.breaches{metric=...}`` counter.
+That event/counter pair is the hook ROADMAP item 3's shed/scale policy
+consumes: a router can watch the stream (or scrape the counter) instead
+of re-deriving "is p99 regressing" from raw samples.
+
+Fed from the paths that matter (all no-ops until ``PADDLE_SLO=1``):
+
+ - ``executor.step_time_s``  — per-step time of every training dispatch
+   (``Executor.run``/``run_steps`` and the sharded window runner);
+ - ``train.step_time_s``     — the trainer's windowed-loop wall time per
+   step, which INCLUDES input-feed stalls the executor never sees (this
+   is the metric an injected ``PADDLE_FAULT_IO_DELAY_MS`` regresses);
+ - ``serving.latency_s``     — per-request queue+execute latency (tail
+   regressions surface here before the lifetime p99 moves);
+ - ``serving.queue_depth``   — the admission queue depth gauge.
+
+Env contract (``fluid.envcontract``): ``PADDLE_SLO`` arms it,
+``PADDLE_SLO_FACTOR`` (default 3.0) is the regression factor,
+``PADDLE_SLO_WINDOW`` / ``PADDLE_SLO_MIN_SAMPLES`` bound the baseline,
+``PADDLE_SLO_COOLDOWN_S`` rate-limits repeat breaches per metric.
+Baselines keep absorbing observations after a breach, so a *sustained*
+level shift alarms until the window adapts (a page, then quiet), while a
+one-off spike alarms exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["SLOWatchdog", "get_watchdog", "observe_value", "reset"]
+
+
+def _median(sorted_vals) -> float:
+    n = len(sorted_vals)
+    mid = n // 2
+    if n % 2:
+        return float(sorted_vals[mid])
+    return (sorted_vals[mid - 1] + sorted_vals[mid]) / 2.0
+
+
+class SLOWatchdog:
+    """Rolling median+MAD baseline per metric; breach detection on every
+    observation.  Thread-safe (one lock; serving threads and the training
+    loop feed it concurrently)."""
+
+    def __init__(self, window: int = 64, factor: float = 3.0,
+                 min_samples: int = 8, cooldown_s: float = 1.0):
+        self.window = max(4, int(window))
+        self.factor = float(factor)
+        self.min_samples = max(2, int(min_samples))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._series: Dict[str, deque] = {}
+        self._last_breach: Dict[str, float] = {}
+        self.breaches: Dict[str, int] = {}
+
+    def baseline(self, metric: str):
+        """(median, mad, n) of the current rolling window for ``metric``
+        (zeros when empty)."""
+        with self._lock:
+            vals = sorted(self._series.get(metric, ()))
+        if not vals:
+            return 0.0, 0.0, 0
+        med = _median(vals)
+        mad = _median(sorted(abs(v - med) for v in vals))
+        return med, mad, len(vals)
+
+    def observe(self, metric: str, value: float, **ctx) -> bool:
+        """Feed one observation; returns True when it breached.  The
+        check runs against the baseline of PRIOR samples, then the value
+        joins the window (so the breach itself cannot mask a follow-up)."""
+        value = float(value)
+        breach = False
+        med = mad = 0.0
+        with self._lock:
+            d = self._series.get(metric)
+            if d is None:
+                d = self._series[metric] = deque(maxlen=self.window)
+            n = len(d)
+            if n >= self.min_samples:
+                vals = sorted(d)
+                med = _median(vals)
+                mad = _median(sorted(abs(v - med) for v in vals))
+                # factor over the median is the SLO; the MAD term keeps
+                # near-zero-variance metrics from alarming on noise
+                if med > 0.0 and value > med * self.factor \
+                        and value > med + 3.0 * mad:
+                    now = time.perf_counter()
+                    if now - self._last_breach.get(metric, -1e9) \
+                            >= self.cooldown_s:
+                        self._last_breach[metric] = now
+                        self.breaches[metric] = \
+                            self.breaches.get(metric, 0) + 1
+                        breach = True
+            d.append(value)
+        if breach:
+            self._emit(metric, value, med, mad, n, **ctx)
+        return breach
+
+    def _emit(self, metric: str, value: float, med: float, mad: float,
+              n: int, **ctx) -> None:
+        try:
+            from . import emit, registry
+
+            registry().inc("slo.breaches", labels={"metric": metric})
+            emit("slo.breach", metric=metric, value=round(value, 6),
+                 baseline_median=round(med, 6), baseline_mad=round(mad, 6),
+                 factor=self.factor, baseline_n=n, **ctx)
+        except Exception:
+            pass  # the watchdog must never take down what it watches
+
+
+# late-binding singleton (the observe Sink / compile_cache _UNSET pattern:
+# a subprocess that sets PADDLE_SLO before first use is honored)
+_UNSET = object()
+_watchdog = _UNSET
+_wd_lock = threading.Lock()
+
+
+def get_watchdog() -> Optional[SLOWatchdog]:
+    """The process watchdog, or None when ``PADDLE_SLO`` is off."""
+    global _watchdog
+    if _watchdog is _UNSET:
+        with _wd_lock:
+            if _watchdog is _UNSET:
+                try:
+                    from ..fluid import envcontract as ec
+
+                    if not ec.get("PADDLE_SLO"):
+                        _watchdog = None
+                    else:
+                        _watchdog = SLOWatchdog(
+                            window=ec.get("PADDLE_SLO_WINDOW"),
+                            factor=ec.get("PADDLE_SLO_FACTOR"),
+                            min_samples=ec.get("PADDLE_SLO_MIN_SAMPLES"),
+                            cooldown_s=ec.get("PADDLE_SLO_COOLDOWN_S"))
+                except Exception:
+                    _watchdog = None
+    return _watchdog
+
+
+def observe_value(metric: str, value: float, **ctx) -> bool:
+    """Feed the process watchdog; no-op (False) when disarmed."""
+    wd = get_watchdog()
+    if wd is None:
+        return False
+    return wd.observe(metric, value, **ctx)
+
+
+def reset() -> None:
+    """Drop the singleton and re-arm env late-binding (test hook, called
+    from ``observe.reset``)."""
+    global _watchdog
+    with _wd_lock:
+        _watchdog = _UNSET

@@ -210,6 +210,7 @@ class ElasticSupervisor:
             observe_dir
             or os.environ.get("PADDLE_OBSERVE_DIR", "").strip()
             or os.path.join(self.workdir, "observe"))
+        from ..observe import trace as _trace
         from ..observe.events import EventLog, host_name
 
         os.makedirs(self.observe_dir, exist_ok=True)
@@ -220,6 +221,13 @@ class ElasticSupervisor:
         self.incidents = IncidentLog(
             os.path.join(self.workdir, "incidents.jsonl"),
             mirror=self._observe_log)
+        # ONE trace id for the whole supervised run (adopted from an
+        # inherited PADDLE_TRACEPARENT when this supervisor is itself a
+        # child): each generation gets a span under it and workers
+        # inherit `trace_id + generation span` via PADDLE_TRACEPARENT, so
+        # kill-and-resume stitches into one cross-process trace tree
+        self.trace_id = _trace.trace_context()[0]
+        self._gen_span: Optional[dict] = None
 
     # -- public --
     def run(self) -> dict:
@@ -239,6 +247,7 @@ class ElasticSupervisor:
             procs, logs = self._launch(gen)
             verdict = self._watch(procs, logs, gen, start)
             self._teardown(procs, gen)
+            self._end_generation(gen, verdict)
             for lf in logs:
                 lf.close()
             if verdict == "finished":
@@ -267,6 +276,13 @@ class ElasticSupervisor:
             except OSError:
                 pass
         os.makedirs(self.compile_cache_dir, exist_ok=True)
+        from ..observe import trace as _trace
+
+        # open this generation's span (closed by _end_generation with the
+        # verdict); workers parent their root spans to it via the
+        # traceparent handoff below
+        self._gen_span = {"span_id": _trace.new_span_id(),
+                          "t0": time.time(), "generation": gen}
         env = {"PADDLE_ELASTIC_HB_DIR": self.hb_dir,
                "PADDLE_ELASTIC_GENERATION": str(gen),
                # workers append their own decisions (guardian numerics
@@ -278,7 +294,11 @@ class ElasticSupervisor:
                # every generation's events + metric snapshots land in one
                # shared observe dir (per-(host, rank, gen) files; the
                # fleet aggregator joins them at end of run)
-               "PADDLE_OBSERVE_DIR": self.observe_dir}
+               "PADDLE_OBSERVE_DIR": self.observe_dir,
+               # trace stitching: every worker's spans join THIS run's
+               # trace, parented to this generation's span
+               "PADDLE_TRACEPARENT": _trace.format_traceparent(
+                   self.trace_id, self._gen_span["span_id"])}
         env.update(self.extra_env)
         if gen == 0:
             env.update(self.fault_env)
@@ -341,6 +361,24 @@ class ElasticSupervisor:
                     return "failed"
             time.sleep(self.poll_interval)
 
+    def _end_generation(self, gen: int, verdict: str) -> None:
+        """Close the generation span: one ``elastic.generation`` duration
+        record per generation, all sharing the run trace id — the rows a
+        merged trace view stitches worker spans under."""
+        sp = self._gen_span
+        if sp is None:
+            return
+        self._gen_span = None
+        try:
+            now = time.time()
+            self._observe_log.emit(
+                "elastic.generation", ts=now,
+                dur_s=round(now - sp["t0"], 6),
+                trace_id=self.trace_id, span_id=sp["span_id"],
+                parent_span=None, tid=0, generation=gen, verdict=verdict)
+        except Exception:
+            pass  # span bookkeeping must never fail the supervisor
+
     def _teardown(self, procs, gen: int) -> None:
         """Kill the whole pod: one lost worker wedges every collective, so
         partial survival has no value — the generation is the failure
@@ -376,7 +414,8 @@ class ElasticSupervisor:
                 "incidents": list(self.incidents.events),
                 "incident_log": self.incidents.path,
                 "observe_dir": self.observe_dir,
-                "fleet_snapshot": fleet_path}
+                "fleet_snapshot": fleet_path,
+                "trace_id": self.trace_id}
 
 
 def main(argv=None) -> int:

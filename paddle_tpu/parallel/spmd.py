@@ -740,6 +740,7 @@ class ShardedWindowRunner:
                             donate_argnums=(2,) if self.donate else ())
         self._compiled = None
         self.collectives: Optional[dict] = None
+        self.cost: Optional[dict] = None
 
     # -- placement --
     def place_feed_window(self, feed: Dict[str, object]):
@@ -776,7 +777,15 @@ class ShardedWindowRunner:
 
     def _note_collectives(self) -> None:
         """Read the optimized HLO of the just-compiled window executable
-        and publish what GSPMD inserted as mesh-labeled gauges."""
+        and publish what GSPMD inserted as mesh-labeled gauges — plus the
+        executable's cost analysis (flops / bytes accessed), which backs
+        the ``device.mfu{mesh=...}`` attribution gauges per dispatch."""
+        from ..observe import trace as _trace
+
+        try:
+            self.cost = _trace.cost_of(self._compiled)
+        except Exception:
+            self.cost = None
         try:
             txt = self._compiled.as_text()
         except Exception:
@@ -795,7 +804,8 @@ class ShardedWindowRunner:
                          n_steps=self.n_steps,
                          collective_bytes=self.collectives["bytes"],
                          collective_count=self.collectives["count"],
-                         by_kind=self.collectives["by_kind"])
+                         by_kind=self.collectives["by_kind"],
+                         flops=(self.cost or {}).get("flops"))
         except Exception:
             pass  # accounting must never fail the run it measures
 
@@ -805,6 +815,7 @@ class ShardedWindowRunner:
         """One fused window: place, dispatch, commit state back to the
         scope.  Returns the LAST step's fetches (mirrors
         ``Executor.run_steps``)."""
+        import contextlib
         import time as _time
 
         from ..fluid import fault as _fault
@@ -813,22 +824,40 @@ class ShardedWindowRunner:
         from ..fluid.executor import Executor
         from .. import compile_cache as _cc
         from .. import observe
+        from ..observe import trace as _trace
+        from ..observe import watchdog as _watchdog
 
         scope = scope or global_scope()
-        gb = self.program.global_block()
-        feed_arrays = {}
-        for k, v in dict(feed or {}).items():
-            if isinstance(v, jax.Array):
-                feed_arrays[k] = v
-                continue
-            arr = np.asarray(v)
-            if gb._has_var_recursive(k):
-                want = core.np_dtype(gb._var_recursive(k).dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
-            feed_arrays[k] = arr
-        feed_dev = self.place_feed_window(feed_arrays)
+        _tstack = contextlib.ExitStack()
+        with _tstack:
+            wspan = _tstack.enter_context(
+                _trace.span("executor.window", n_steps=self.n_steps,
+                            mesh=self.label))
+            t_host0 = _time.perf_counter()
+            gb = self.program.global_block()
+            feed_arrays = {}
+            for k, v in dict(feed or {}).items():
+                if isinstance(v, jax.Array):
+                    feed_arrays[k] = v
+                    continue
+                arr = np.asarray(v)
+                if gb._has_var_recursive(k):
+                    want = core.np_dtype(gb._var_recursive(k).dtype)
+                    if arr.dtype != want:
+                        arr = arr.astype(want)
+                feed_arrays[k] = arr
+            t_feed0 = _time.perf_counter()
+            feed_dev = self.place_feed_window(feed_arrays)
+            t_feed1 = _time.perf_counter()
+            return self._run_placed(
+                feed_arrays, feed_dev, scope, return_numpy, wspan,
+                t_host0, t_feed0, t_feed1, _time, _fault, _guardian,
+                _prof, Executor, _cc, observe, _trace, _watchdog)
 
+    def _run_placed(self, feed_arrays, feed_dev, scope, return_numpy,
+                    wspan, t_host0, t_feed0, t_feed1, _time, _fault,
+                    _guardian, _prof, Executor, _cc, observe, _trace,
+                    _watchdog):
         window_start = 0
         if self.program._params_grads is not None:
             window_start = Executor._step_boundary(_fault, self.n_steps)
@@ -837,7 +866,9 @@ class ShardedWindowRunner:
             # one-window-lag sentinel: observe the PREVIOUS dispatch's
             # aggregated health and apply policy BEFORE this window runs
             g.on_boundary()
+        t_state0 = _time.perf_counter()
         state_vals = self.step.place_state(scope)
+        t_state1 = _time.perf_counter()
         mut_names = set(self.plan.state_out)
         if self.plan.needs_rng:
             mut_names.add(RNG_STATE_VAR)
@@ -873,21 +904,26 @@ class ShardedWindowRunner:
         probe = None
         t = _time.perf_counter()
         if self._compiled is None:
-            probe = _cc.executor_probe(
-                self.program, feed_arrays, self.fetch_names,
-                extra=self.step.cache_extra(
-                    kind="sharded_window", n_steps=self.n_steps,
-                    feed_per_step=self.feed_per_step, donate=self.donate,
-                    guard=(self.guard.cache_token()
-                           if self.guard is not None else None)),
-                spec_table=table_signature(self.specs))
-            # AOT compile once; the same Compiled serves every window AND
-            # yields the optimized HLO for the collective gauges, with no
-            # second trace/compile through the jit dispatch path
-            self._compiled = self._jit.lower(
-                feed_dev, const_state, mut_state, sentinel).compile()
-            self._note_collectives()
+            with _trace.span("executor.compile", mesh=self.label,
+                             n_steps=self.n_steps):
+                probe = _cc.executor_probe(
+                    self.program, feed_arrays, self.fetch_names,
+                    extra=self.step.cache_extra(
+                        kind="sharded_window", n_steps=self.n_steps,
+                        feed_per_step=self.feed_per_step,
+                        donate=self.donate,
+                        guard=(self.guard.cache_token()
+                               if self.guard is not None else None)),
+                    spec_table=table_signature(self.specs))
+                # AOT compile once; the same Compiled serves every window
+                # AND yields the optimized HLO for the collective gauges +
+                # the cost analysis behind device.mfu, with no second
+                # trace/compile through the jit dispatch path
+                self._compiled = self._jit.lower(
+                    feed_dev, const_state, mut_state, sentinel).compile()
+                self._note_collectives()
         observe.note_mesh(self.label)
+        t_disp0 = _time.perf_counter()
         agg = None
         if self.guard is not None:
             fetches, new_state, agg = self._compiled(
@@ -895,9 +931,13 @@ class ShardedWindowRunner:
         else:
             fetches, new_state = self._compiled(
                 feed_dev, const_state, mut_state, sentinel)
-            if _prof.is_profiling():
-                jax.block_until_ready(fetches)
-        dt = _time.perf_counter() - t
+        if wspan is not None or (_prof.is_profiling()
+                                 and self.guard is None):
+            # device-time attribution needs the dispatch retired; outside
+            # tracing/profiling it stays async as before
+            jax.block_until_ready((fetches, new_state))
+        t_disp1 = _time.perf_counter()
+        dt = t_disp1 - t
         if _prof.is_profiling():
             _prof.record_event(
                 f"executor_run[{len(self.plan.ops)}ops "
@@ -930,6 +970,35 @@ class ShardedWindowRunner:
                            "feed_per_step": self.feed_per_step}})
         if self.program._params_grads is not None:
             observe.note_step(window_start + self.n_steps - 1)
+        t_obs1 = _time.perf_counter()
+        if wspan is not None:
+            # per-window breakdown: feed/state staging, device dispatch,
+            # host observe tail — all mesh-labeled, all under the window
+            # span (prefetch-staged feeds show ~zero stage time here; the
+            # staging span then lives on the prefetch worker's thread row)
+            _trace.emit_span("executor.stage", t_feed0, t_feed1,
+                             parent=wspan, what="feed")
+            _trace.emit_span("executor.stage", t_state0, t_state1,
+                             parent=wspan, what="state")
+            _trace.emit_span("executor.dispatch", t_disp0, t_disp1,
+                             parent=wspan, mesh=self.label)
+            _trace.emit_span("executor.observe", t_disp1, t_obs1,
+                             parent=wspan)
+            stage_ms = ((t_feed1 - t_feed0) + (t_state1 - t_state0)) * 1e3
+            _trace.note_window_breakdown(
+                host_ms=max(0.0, (t_disp0 - t_host0) * 1e3 - stage_ms),
+                stage_ms=stage_ms,
+                device_ms=(t_disp1 - t_disp0) * 1e3,
+                observe_ms=(t_obs1 - t_disp1) * 1e3,
+                mesh=self.label)
+            if self.cost:
+                _trace.note_device_cost(self.cost, t_disp1 - t_disp0,
+                                        self.n_steps, mesh=self.label)
+        if self.program._params_grads is not None:
+            _watchdog.observe_value(
+                "executor.step_time_s",
+                (t_obs1 - t_host0) / max(1, self.n_steps),
+                step=window_start + self.n_steps - 1, mesh=self.label)
         if return_numpy:
             return [np.asarray(self.step.fetch_to_host(v)) for v in fetches]
         return list(fetches)

@@ -130,7 +130,8 @@ class ServingConfig:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "sig", "future", "deadline", "t_submit")
+    __slots__ = ("feed", "rows", "sig", "future", "deadline", "t_submit",
+                 "t_taken", "span")
 
     def __init__(self, feed, rows, sig, future, deadline, t_submit):
         self.feed = feed          # name -> ndarray, leading dim == rows
@@ -139,6 +140,8 @@ class _Request:
         self.future = future
         self.deadline = deadline  # absolute perf_counter time or None
         self.t_submit = t_submit
+        self.t_taken = None       # when the batcher popped it (perf time)
+        self.span = None          # observe.trace request span (or None)
 
 
 class ServingEngine:
@@ -236,6 +239,13 @@ class ServingEngine:
                 raise EngineOverloaded(
                     f"queue full ({self.config.max_queue_depth} pending); "
                     f"request shed")
+            from ..observe import trace as _trace
+
+            # request-scoped span (admitted requests only — sheds fail
+            # before this): opened on the client thread, closed by the
+            # batcher thread at future-resolve, decomposed by the queue/
+            # batch/dispatch child spans _dispatch emits
+            req.span = _trace.start_span("serving.request", rows=rows)
             self._queue.append(req)
             self.metrics.inc("submitted")
             self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -331,6 +341,7 @@ class ServingEngine:
             # drain() must not conclude "all done" while the batcher holds
             # requests that left the queue but have not dispatched yet
             self._inflight += 1
+            first.t_taken = time.perf_counter()
             batch, rows = [first], first.rows
             flush_at = first.t_submit + self.config.max_wait_ms / 1000.0
             while rows < self.config.max_batch_size:
@@ -341,6 +352,7 @@ class ServingEngine:
                         break
                     self._queue.popleft()
                     self._inflight += 1
+                    nxt.t_taken = time.perf_counter()
                     batch.append(nxt)
                     rows += nxt.rows
                     continue
@@ -354,12 +366,15 @@ class ServingEngine:
 
     def _dispatch(self, batch: List[_Request]):
         from ..fluid import fault as _fault
+        from ..observe import trace as _trace
 
         now = time.perf_counter()
         live: List[_Request] = []
         for req in batch:
             if req.deadline is not None and now > req.deadline:
                 self.metrics.inc("expired")
+                if req.span is not None:
+                    req.span.end(status="expired")
                 req.future.set_exception(RequestTimeout(
                     f"deadline expired after "
                     f"{(now - req.t_submit) * 1e3:.1f} ms in queue"))
@@ -370,6 +385,8 @@ class ServingEngine:
                 _fault.serving_request()
             except BaseException as exc:  # InjectedFault is a BaseException
                 self.metrics.inc("failed")
+                if req.span is not None:
+                    req.span.end(status="injected_fault")
                 req.future.set_exception(exc)
                 continue
             live.append(req)
@@ -377,6 +394,7 @@ class ServingEngine:
             return
         rows = sum(r.rows for r in live)
         bucket = self._bucket(rows)
+        t_disp0 = time.perf_counter()
         try:
             outs, dur = self._run_bucket(
                 {name: np.concatenate([r.feed[name] for r in live], axis=0)
@@ -385,10 +403,13 @@ class ServingEngine:
         except BaseException as exc:
             for req in live:
                 self.metrics.inc("failed")
+                if req.span is not None:
+                    req.span.end(status="error")
                 req.future.set_exception(
                     exc if isinstance(exc, Exception)
                     else RuntimeError(repr(exc)))
             return
+        t_disp1 = time.perf_counter()
         self.metrics.inc("dispatches")
         self.metrics.observe_batch(rows, bucket, seconds=dur)
         # scatter: slice each batched fetch back to per-request spans
@@ -406,7 +427,25 @@ class ServingEngine:
             start += req.rows
             self.metrics.inc("completed")
             self.metrics.observe_latency(done - req.t_submit)
+            if req.span is not None:
+                # the request's latency decomposition: queue wait ->
+                # batch assembly -> device dispatch -> result scatter,
+                # each a child of the request span (the dispatch interval
+                # is shared batch-wide; per-request records keep p99
+                # decomposable without cross-request joins)
+                taken = req.t_taken if req.t_taken is not None else t_disp0
+                _trace.emit_span("serving.queue", req.t_submit, taken,
+                                 parent=req.span)
+                _trace.emit_span("serving.batch", taken, t_disp0,
+                                 parent=req.span)
+                _trace.emit_span("serving.dispatch", t_disp0, t_disp1,
+                                 parent=req.span, bucket=bucket,
+                                 batch_rows=rows)
+                _trace.emit_span("serving.resolve", t_disp1,
+                                 time.perf_counter(), parent=req.span)
             req.future.set_result(res)
+            if req.span is not None:
+                req.span.end(status="ok", bucket=bucket)
 
     def _run_bucket(self, feed: Dict[str, np.ndarray], rows: int,
                     bucket: int):

@@ -67,6 +67,11 @@ class ServingMetrics:
     def set_gauge(self, name: str, value) -> None:
         self._reg.set_gauge(name, value)
         _global_registry().set_gauge(f"serving.{name}", value)
+        if name == "queue_depth":
+            from ..observe import watchdog as _watchdog
+
+            # SLO watchdog on admission-queue depth (no-op unless armed)
+            _watchdog.observe_value("serving.queue_depth", value)
 
     def observe_latency(self, seconds: float) -> None:
         """One completed request's queue+execute latency."""
@@ -74,6 +79,11 @@ class ServingMetrics:
             self._lat[self._lat_n % self._window] = float(seconds)
             self._lat_n += 1
         _global_registry().observe("serving.latency_s", seconds)
+        from ..observe import watchdog as _watchdog
+
+        # per-request latency feeds the SLO watchdog: a p99 regression IS
+        # individual requests regressing past the rolling baseline
+        _watchdog.observe_value("serving.latency_s", seconds)
         # profiler hook: no-op unless a profiler session is active
         from ..fluid import profiler as _prof
 
@@ -149,8 +159,14 @@ class ServingMetrics:
     @staticmethod
     def window(prev: dict, cur: dict) -> dict:
         """Interval rates between two ``snapshot()`` dicts (cur - prev):
-        current throughput/shed-rate/occupancy, immune to uptime decay."""
-        dt = cur.get("elapsed_s", 0) - prev.get("elapsed_s", 0)
+        current throughput/shed-rate/occupancy, immune to uptime decay.
+
+        An EMPTY interval (identical snapshots, zero elapsed time, no
+        padded rows) is well-defined zeros across the board — never
+        None/NaN/ZeroDivision — so the ``/metrics`` endpoint and the
+        bench tool can emit every field unconditionally (ISSUE 9
+        satellite)."""
+        dt = max(0.0, cur.get("elapsed_s", 0) - prev.get("elapsed_s", 0))
         delta: Dict[str, float] = {
             k: cur.get(k, 0) - prev.get(k, 0)
             for k in ("completed", "submitted", "failed", "shed", "expired",
@@ -162,7 +178,7 @@ class ServingMetrics:
                                 if dt > 0 else 0.0)
         out["mean_batch_occupancy"] = (
             round(delta["rows_real"] / delta["rows_padded"], 4)
-            if delta["rows_padded"] else None)
+            if delta["rows_padded"] else 0.0)
         return out
 
     def interval(self) -> dict:

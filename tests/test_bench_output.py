@@ -1,0 +1,67 @@
+"""bench.py output-channel contract (ISSUE 9 satellite).
+
+The BENCH driver parses stdout; round 5's JSON tail was polluted by
+``tpu_probe_*`` retry/wedge diagnostics interleaved with the metric
+lines.  Contract now: EVERY stdout line is a clean metric JSON line
+(the last one the combined record), and probe diagnostics go to stderr.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (repo-root module)
+
+
+def test_probe_diagnostics_go_to_stderr(monkeypatch, capsys):
+    """A wedged probe's retry/give-up records land on stderr as JSON;
+    stdout stays empty for the metric lines to come."""
+    monkeypatch.setattr(bench, "_probe_once", lambda timeout: "wedged")
+    monkeypatch.setenv("BENCH_PROBE_BUDGET", "2")
+    monkeypatch.setenv("BENCH_PROBE_PAUSE", "120")
+    platform, status = bench.probe_platform(timeout=0.1)
+    assert platform == "cpu" and status == "wedged_budget_exhausted"
+    out, err = capsys.readouterr()
+    assert out == ""  # the metric channel stays clean
+    events = [json.loads(line) for line in err.splitlines() if line]
+    assert events and events[-1]["event"] == "tpu_probe_gave_up"
+
+
+def test_probe_crash_diagnostics_go_to_stderr(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_probe_once", lambda timeout: "crashed")
+    platform, status = bench.probe_platform(timeout=0.1)
+    assert platform == "cpu" and status == "probe_crashed"
+    out, err = capsys.readouterr()
+    assert out == ""
+    events = [json.loads(line) for line in err.splitlines() if line]
+    assert events[-1]["event"] == "tpu_probe_crashed"
+
+
+@pytest.mark.slow
+def test_bench_stdout_every_line_parses(tmp_path):
+    """Regression: run the real driver (tiny CPU mnist) and parse every
+    stdout line as JSON — the driver's tail capture must never see a
+    non-JSON or diagnostic line again."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "mnist",
+                "BENCH_MNIST_STEPS": "3", "BENCH_MNIST_BS": "16",
+                "BENCH_PROBE_TIMEOUT": "120"})
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=420, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert lines, "no stdout at all"
+    parsed = [json.loads(l) for l in lines]  # every line must parse
+    last = parsed[-1]
+    assert last.get("metric", "").startswith("mnist")
+    assert last.get("value", 0) > 0
+    # probe events, if any fired, are NOT in the metric stream
+    assert not any(str(p.get("event", "")).startswith("tpu_probe")
+                   for p in parsed)

@@ -300,6 +300,53 @@ def test_metrics_snapshot_shape(tmp_path):
     eng.shutdown()
 
 
+def test_metrics_empty_interval_well_defined_zeros(tmp_path):
+    """ISSUE 9 satellite: window()/interval() over an EMPTY interval
+    (identical snapshots / zero traffic) return finite zeros — never
+    None/NaN/ZeroDivisionError — and the /metrics endpoint exposes the
+    interval gauges on an idle engine."""
+    import json
+    import math
+    import urllib.request
+
+    from paddle_tpu.serving import ServingConfig, ServingMetrics, \
+        create_serving_engine
+    from paddle_tpu.observe.export import parse_prometheus_text
+
+    m = ServingMetrics()
+    s = m.snapshot()
+    win = ServingMetrics.window(s, s)  # identical snapshots: dt == 0
+    for key in ("qps", "dispatch_rate", "mean_batch_occupancy",
+                "interval_s", "completed", "rows_padded"):
+        v = win[key]
+        assert isinstance(v, (int, float)) and math.isfinite(v), (key, v)
+        assert v == 0, (key, v)
+    # interval() with no traffic between calls: same contract
+    m.interval()
+    win2 = m.interval()
+    assert win2["completed"] == 0 and win2["qps"] == 0.0
+    assert win2["mean_batch_occupancy"] == 0.0
+    json.dumps(win2)  # json-clean (no NaN)
+
+    _save_mlp(tmp_path)
+    eng = create_serving_engine(
+        _cfg(tmp_path), ServingConfig(max_batch_size=4, max_wait_ms=2.0,
+                                      metrics_port=0))
+    try:
+        base = f"http://127.0.0.1:{eng.metrics_server.port}"
+        # scrape twice so the second interval window is truly empty
+        for _ in range(2):
+            text = urllib.request.urlopen(f"{base}/metrics",
+                                          timeout=10).read().decode()
+        assert "NaN" not in text and "nan" not in text.lower().split()
+        parsed = parse_prometheus_text(text)
+        for g in ("serving_interval_qps", "serving_interval_dispatch_rate",
+                  "serving_interval_batch_occupancy"):
+            assert parsed["gauges"].get(g) == 0, (g, parsed["gauges"])
+    finally:
+        eng.shutdown()
+
+
 @pytest.mark.slow
 def test_serving_soak_throughput(tmp_path):
     """Soak: sustained concurrent traffic with mixed row counts for ~8s;

@@ -65,7 +65,13 @@ class Timeline:
             host = log.get("host") or label
             self._chrome.emit_pid(f"paddle_tpu:{host}", pid)
             for ev in log.get("events", []):
-                self._chrome.emit_region(ev["ts"], ev["dur"], pid, 0, "Op",
+                # spans render as complete ("X") events on their OWN
+                # thread row (the profiler stamps tid per emitting
+                # thread), so prefetch-worker staging no longer overlaps
+                # executor dispatch on one track; legacy logs without a
+                # tid keep row 0
+                self._chrome.emit_region(ev["ts"], ev["dur"], pid,
+                                         ev.get("tid", 0), "Op",
                                          ev["name"])
             for s in log.get("counters", []):
                 self._chrome.emit_counter(s["ts"], pid, s["name"],
