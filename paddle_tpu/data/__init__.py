@@ -1,0 +1,37 @@
+"""paddle_tpu.data — the checkpointable streaming data plane (ISSUE 10).
+
+A sharded, prefetching input pipeline whose ITERATOR POSITION is a
+checkpoint artifact: state blobs commit atomically with model state under
+the serial-dir ``_SUCCESS`` protocol (one per host rank), and a resumed
+run consumes the byte-identical sample sequence an uninterrupted run
+would have, starting at the first un-committed sample.  Operate guide:
+docs/DATA.md; the resume semantics are part of docs/ROBUSTNESS.md.
+
+    from paddle_tpu import data
+
+    pipe = (data.from_reader(sample_reader)
+                .shard_by_mesh()          # per-host slice of PADDLE_TPU_MESH
+                .shuffle(512, seed=7)     # resumable, keyed on (seed, epoch)
+                .batch(64))
+    trainer.train(..., reader=pipe)       # Trainer commits/restores state
+
+Pieces: :mod:`pipeline` (the CheckpointableIterator protocol + stages),
+:mod:`sharding` (mesh → per-host shard assignment), :mod:`prefetch`
+(window staging whose uncommitted lookahead is replayed, never lost),
+:mod:`checkpoint` (the per-rank ``data_state`` blob under ``_SUCCESS``).
+"""
+
+from .checkpoint import (DATA_STATE_PREFIX, data_state_path,
+                         load_data_state, save_data_state)
+from .pipeline import (CheckpointableIterator, Pipeline, from_reader,
+                       is_checkpointable, note_data_wait, timed)
+from .prefetch import CheckpointablePrefetcher
+from .sharding import data_axis_extent, shard_spec
+
+__all__ = [
+    "CheckpointableIterator", "Pipeline", "from_reader",
+    "is_checkpointable", "note_data_wait", "timed",
+    "CheckpointablePrefetcher", "shard_spec", "data_axis_extent",
+    "DATA_STATE_PREFIX", "data_state_path", "save_data_state",
+    "load_data_state",
+]

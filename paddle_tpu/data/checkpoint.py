@@ -1,0 +1,87 @@
+"""Data-plane state blobs under the checkpoint ``_SUCCESS`` protocol.
+
+One small JSON blob per host rank (``data_state_<rank>.json``), written
+into the STAGED serial directory before its ``_SUCCESS`` marker is
+committed — so iterator position and model state are one atomic unit:
+either both survive a kill or neither does, and the serial scroll-delete
+prunes them together.  Wired into both checkpoint writers:
+
+ - ``fluid.trainer.save_checkpoint(data_state=...)`` (single-host serial
+   dirs) and ``load_checkpoint`` — which treats an unreadable blob like
+   an unreadable param file and FALLS BACK to the previous complete
+   serial (a corrupt cursor silently resuming at the wrong sample is the
+   exact failure this subsystem exists to kill);
+ - ``parallel.multihost.save_sharded_serial(data_state=...)`` — every
+   process writes its own rank's blob before the all-writers barrier, so
+   process 0's ``_SUCCESS`` covers the whole fleet's data plane.
+
+``PADDLE_FAULT_SHARD_CORRUPT=1`` truncates the next write (one-shot):
+the deterministic oracle for the fallback path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+__all__ = ["DATA_STATE_PREFIX", "data_state_path", "save_data_state",
+           "load_data_state"]
+
+DATA_STATE_PREFIX = "data_state_"
+_VERSION = 1
+
+
+def data_state_path(dirname: str, rank: int) -> str:
+    return os.path.join(dirname, f"{DATA_STATE_PREFIX}{int(rank)}.json")
+
+
+def save_data_state(dirname: str, state: dict, rank: int = 0) -> str:
+    """Write one rank's iterator-state blob into a staged serial dir.
+
+    tmp + rename so a concurrent reader never sees a torn write; the blob
+    only becomes trusted when the CALLER commits the dir's ``_SUCCESS``
+    marker.  Consults the shard-corrupt fault hook (truncated payload)
+    so tests can deterministically exercise the load-side fallback."""
+    from ..fluid import fault as _fault
+
+    payload = json.dumps({"version": _VERSION, "rank": int(rank),
+                          "state": state})
+    if _fault.shard_corrupt():
+        payload = payload[:max(1, len(payload) // 2)]
+    path = data_state_path(dirname, rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    _fault.io_delay()
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load_data_state(dirname: str, rank: int = 0) -> Optional[dict]:
+    """Read one rank's blob from a COMMITTED serial dir.
+
+    Returns ``None`` when the serial simply has no data state (a
+    checkpoint from before this subsystem, or a resume onto a rank the
+    save never had) — the caller falls back to legacy sample-skip
+    replay.  Raises ``IOError`` when a blob EXISTS but cannot be read
+    (truncation, version drift): the caller must treat the whole serial
+    as unreadable and fall back to the previous complete one, exactly
+    like a corrupt param file."""
+    path = data_state_path(dirname, rank)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        version = int(payload["version"])
+        state = payload["state"]
+    except (ValueError, KeyError, TypeError) as exc:
+        raise IOError(
+            f"data_state blob {path} is unreadable ({exc!r}) — treating "
+            f"this serial as corrupt") from exc
+    if version != _VERSION:
+        raise IOError(
+            f"data_state blob {path} has version {version}, this build "
+            f"reads {_VERSION}")
+    return state

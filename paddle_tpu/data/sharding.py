@@ -1,0 +1,95 @@
+"""Per-host data-shard assignment derived from the named mesh (ISSUE 10).
+
+The PR 7 mesh (``PADDLE_TPU_MESH=dp4,tp2`` → ``parallel.mesh``) fixes how
+the GLOBAL batch is laid out over devices: the ``dp`` axis consumes
+distinct samples, every other axis (tp/fsdp/pp/…) replicates them.  The
+data plane must agree with that layout per HOST: two hosts whose devices
+sit in the same dp group must read the SAME samples (their tp shards see
+one batch), hosts in different dp groups must read DISJOINT samples, and
+the union over all hosts must cover the dataset exactly once per dp
+group.  :func:`shard_spec` reduces that to the round-robin
+``(num_shards, shard_index)`` pair ``Pipeline.shard`` consumes; hosts are
+assumed laid out process-major along the dp axis — the layout
+``mesh_from_spec`` builds (device order enumerates later axes fastest).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["shard_spec", "data_axis_extent"]
+
+#: mesh axes that consume distinct samples (every other axis replicates
+#: the batch — tp shards activations, fsdp shards weights, pp stages see
+#: the same microbatch stream)
+DATA_AXES = ("dp",)
+
+
+def data_axis_extent(mesh) -> int:
+    """The product of data-consuming axis extents of ``mesh`` (a
+    ``jax.sharding.Mesh``, a ``"dp4,tp2"`` spec string, or ``None`` for
+    the ``PADDLE_TPU_MESH`` env spec).  1 when the mesh has no dp axis —
+    a tp/mp-only mesh replicates the whole batch."""
+    axes = _axes_of(mesh)
+    extent = 1
+    for name in DATA_AXES:
+        extent *= int(axes.get(name, 1))
+    return extent
+
+
+def _axes_of(mesh) -> dict:
+    if mesh is None or isinstance(mesh, str):
+        from ..parallel.mesh import env_mesh_spec, parse_mesh_spec
+
+        spec = env_mesh_spec() if mesh is None else mesh
+        return parse_mesh_spec(spec) if spec else {}
+    # a jax.sharding.Mesh (or anything mesh-shaped): axis name -> extent
+    return {name: int(mesh.shape[name]) for name in mesh.axis_names}
+
+
+def shard_spec(mesh=None, host_rank: Optional[int] = None,
+               num_hosts: Optional[int] = None) -> Tuple[int, int]:
+    """This host's data shard for ``mesh``: ``(num_shards, shard_index)``.
+
+    ``mesh`` may be a ``jax.sharding.Mesh``, a spec string (``"dp2,tp2"``)
+    or ``None`` (the ``PADDLE_TPU_MESH`` env spec; no spec = single-group
+    dp, one shard).  ``host_rank`` / ``num_hosts`` default to the
+    multihost process index/count.  With dp extent D over H hosts:
+
+     - ``H == 1``      → ``(1, 0)``: one host feeds every dp group (the
+       sharded window runner splits the batch locally);
+     - ``D % H == 0``  → ``(H, host_rank)``: each host owns D/H dp groups
+       and reads a distinct 1/H of the data;
+     - ``H % D == 0``  → ``(D, host_rank // (H // D))``: H/D hosts share
+       each dp group and read IDENTICAL data (their devices split the
+       batch along tp/fsdp, not along samples);
+     - anything else is a layout error, raised by name rather than left
+       to surface as silent sample overlap.
+
+    Distinct shard indices partition the stream (``Pipeline.shard`` is
+    round-robin), so no sample is read twice or lost across the fleet.
+    """
+    if num_hosts is None or host_rank is None:
+        from ..parallel import multihost
+
+        if num_hosts is None:
+            num_hosts = multihost.process_count()
+        if host_rank is None:
+            host_rank = multihost.process_index()
+    num_hosts, host_rank = int(num_hosts), int(host_rank)
+    if num_hosts < 1 or not 0 <= host_rank < num_hosts:
+        raise ValueError(
+            f"shard_spec: need 0 <= host_rank < num_hosts, got "
+            f"rank={host_rank} of {num_hosts}")
+    extent = data_axis_extent(mesh)
+    if num_hosts == 1:
+        return 1, 0
+    if extent % num_hosts == 0:
+        return num_hosts, host_rank
+    if num_hosts % extent == 0:
+        return extent, host_rank // (num_hosts // extent)
+    raise ValueError(
+        f"shard_spec: dp extent {extent} and host count {num_hosts} do "
+        f"not tile (need one to divide the other) — mesh "
+        f"{_axes_of(mesh) or 'dp (default)'} cannot be fed by {num_hosts} "
+        f"hosts without sample overlap")

@@ -248,6 +248,19 @@ declare("PADDLE_FAULT_SERVE_FAIL_EVERY", "int", 0, "fault",
         "Fail every Nth serving request with InjectedFault")
 declare("PADDLE_FAULT_CACHE_CORRUPT", "bool", False, "fault",
         "Deterministically corrupt the next compile-cache read")
+declare("PADDLE_FAULT_DATA_STALL_MS", "float", 0.0, "fault",
+        "Stall the input pipeline per pulled sample (ms)")
+declare("PADDLE_FAULT_DATA_STALL_AT", "int", None, "fault",
+        "Fire the data stall once, at this source sample cursor")
+declare("PADDLE_FAULT_SHARD_CORRUPT", "bool", False, "fault",
+        "Truncate the next data_state blob write (one-shot)")
+
+# -- data plane --
+declare("PADDLE_DATA_CKPT", "bool", True, "data",
+        "Commit/restore checkpointable-reader state with checkpoints "
+        "(0 falls back to legacy sample-skip replay)")
+declare("PADDLE_DATA_STALL_EVENT_MS", "float", 100.0, "data",
+        "Input waits above this emit a data.stall run event")
 
 
 # ---------------------------------------------------------------------------

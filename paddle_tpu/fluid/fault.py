@@ -53,6 +53,15 @@ worker processes:
                                   oracle for the cache's fallback path:
                                   the run must recompile fresh and still
                                   succeed — see paddle_tpu.compile_cache)
+    PADDLE_FAULT_DATA_STALL_MS=t  stall the input pipeline t ms per pulled
+                                  sample (slow reader); with
+                                  PADDLE_FAULT_DATA_STALL_AT=N the stall
+                                  fires ONCE, at source-cursor N — the
+                                  SLO-breach oracle for train.data_wait_s
+    PADDLE_FAULT_SHARD_CORRUPT=1  truncate the next data_state blob write
+                                  (one-shot): the resumed run must detect
+                                  the corrupt cursor and fall back to the
+                                  previous complete serial
     PADDLE_FAULT_MODE=exit|raise  crash flavor: hard process exit (default)
                                   or an InjectedFault raise (in-process
                                   tests of the recovery path)
@@ -82,8 +91,8 @@ __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
     "barrier_stall", "serving_request", "sentinel_injection",
-    "sentinel_injection_window", "cache_corrupt", "current_step",
-    "KILL_EXIT_CODE",
+    "sentinel_injection_window", "cache_corrupt", "data_stall",
+    "shard_corrupt", "current_step", "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -111,6 +120,9 @@ class FaultPlan:
                  barrier_stall_s: float = 0.0,
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
                  cache_corrupt: bool = False,
+                 data_stall_ms: float = 0.0,
+                 data_stall_at: Optional[int] = None,
+                 shard_corrupt: bool = False,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -132,12 +144,18 @@ class FaultPlan:
         self.serve_delay_ms = float(serve_delay_ms)
         self.serve_fail_every = int(serve_fail_every)
         self.cache_corrupt = bool(cache_corrupt)
+        self.data_stall_ms = float(data_stall_ms)
+        self.data_stall_at = None if data_stall_at is None \
+            else int(data_stall_at)
+        self.shard_corrupt = bool(shard_corrupt)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
         self._nan_fired = False
         self._stall_fired = False
         self._serve_count = 0
+        self._data_stall_fired = False
+        self._shard_corrupt_fired = False
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultPlan"]:
@@ -151,6 +169,7 @@ class FaultPlan:
         rank = env.get("PADDLE_FAULT_RANK", "").strip()
         ginf = env.get("PADDLE_FAULT_GRAD_INF_STEP", "").strip()
         spike = env.get("PADDLE_FAULT_LOSS_SPIKE_STEP", "").strip()
+        stall_at = env.get("PADDLE_FAULT_DATA_STALL_AT", "").strip()
         return cls(
             kill_step=int(kill) if kill else None,
             ckpt_crash=env.get("PADDLE_FAULT_CKPT_CRASH", "").strip() or None,
@@ -166,6 +185,10 @@ class FaultPlan:
             serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
             serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
             cache_corrupt=env.get("PADDLE_FAULT_CACHE_CORRUPT", "").strip()
+            .lower() in ("1", "true", "yes"),
+            data_stall_ms=getf("PADDLE_FAULT_DATA_STALL_MS"),
+            data_stall_at=int(stall_at) if stall_at else None,
+            shard_corrupt=env.get("PADDLE_FAULT_SHARD_CORRUPT", "").strip()
             .lower() in ("1", "true", "yes"),
             rank=int(rank) if rank else None,
             mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
@@ -360,6 +383,38 @@ def cache_corrupt() -> bool:
     plan = active()
     return (plan is not None and plan.cache_corrupt
             and plan._applies_to_this_rank())
+
+
+def data_stall(index: int) -> None:
+    """Input-pipeline stall injection, consulted by the pipeline source
+    once per pulled sample (``index`` is the source's epoch cursor).
+    With ``data_stall_at`` unset the stall applies to EVERY sample (a
+    constantly slow reader); with it set, the stall fires exactly once,
+    at that cursor — the deterministic oracle for the data-wait SLO
+    (one window's ``train.data_wait_s`` spikes, the watchdog breaches)."""
+    plan = active()
+    if plan is None or plan.data_stall_ms <= 0 \
+            or not plan._applies_to_this_rank():
+        return
+    if plan.data_stall_at is None:
+        time.sleep(plan.data_stall_ms / 1000.0)
+    elif not plan._data_stall_fired and int(index) == plan.data_stall_at:
+        plan._data_stall_fired = True
+        time.sleep(plan.data_stall_ms / 1000.0)
+
+
+def shard_corrupt() -> bool:
+    """Data-state corruption oracle: True exactly once when armed — the
+    next ``data_state`` blob write is truncated mid-payload, so the
+    resumed run must detect the corrupt cursor at load time and fall
+    back to the previous complete serial (never resume at a garbage
+    position)."""
+    plan = active()
+    if plan is None or not plan.shard_corrupt or plan._shard_corrupt_fired \
+            or not plan._applies_to_this_rank():
+        return False
+    plan._shard_corrupt_fired = True
+    return True
 
 
 def barrier_stall(tag: str = "") -> None:

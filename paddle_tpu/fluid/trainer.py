@@ -155,9 +155,14 @@ def wait_for_checkpoints(checkpoint_dir=None):
 
 def save_checkpoint(executor, checkpoint_dir, main_program,
                     trainer_args=None, max_num_checkpoints=3,
-                    background=False):
-    """Write serial dir -> persistables -> trainer args -> _SUCCESS, then
-    scroll-delete old serials (ref: trainer.py:663,1190).
+                    background=False, data_state=None):
+    """Write serial dir -> persistables -> trainer args -> data state ->
+    _SUCCESS, then scroll-delete old serials (ref: trainer.py:663,1190).
+
+    ``data_state`` (a ``paddle_tpu.data`` iterator-state blob) commits
+    under the SAME _SUCCESS marker as the model state — either both
+    survive a kill or neither does, so resume can restart the input
+    pipeline exactly at the first un-committed sample.
 
     background=True snapshots the persistables to host memory NOW (one
     D2H sync) and does the file IO in a daemon thread; _SUCCESS is still
@@ -186,7 +191,7 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
     if not background:
         io.save_persistables(executor, cur, main_program)
         _finish_checkpoint(checkpoint_dir, cur, trainer_args,
-                           max_num_checkpoints)
+                           max_num_checkpoints, data_state=data_state)
         return serial
     from .executor import global_scope
     from .io import _resolve_vars, is_persistable, snapshot_vars
@@ -197,8 +202,11 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
     def write():
         try:
             io.write_var_files(cur, snapshot)
+            # data_state is a small host dict snapshotted by the caller,
+            # so the background writer commits the same cursor the train
+            # loop saw at the checkpoint boundary
             _finish_checkpoint(checkpoint_dir, cur, trainer_args,
-                               max_num_checkpoints)
+                               max_num_checkpoints, data_state=data_state)
         except BaseException as exc:  # surfaced by wait_for_checkpoints
             # a half-written serial is junk forever (it never gets
             # _SUCCESS and the pruner skips incomplete dirs) — remove it
@@ -217,12 +225,18 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
 
 
 def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
-                       max_num_checkpoints):
+                       max_num_checkpoints, data_state=None):
     from . import fault as _fault
 
     if trainer_args is not None:
         with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
             json.dump(trainer_args, f)
+    if data_state is not None:
+        from ..data.checkpoint import save_data_state
+
+        save_data_state(cur, data_state,
+                        rank=int(os.environ.get("PADDLE_TRAINER_ID",
+                                                "0") or 0))
     # fault hooks bracket the commit point: a crash 'before' leaves an
     # unmarked dir restore must skip; 'after' leaves a complete serial a
     # crash cannot un-commit
@@ -244,22 +258,31 @@ def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
 
 def load_checkpoint(executor, checkpoint_dir, main_program):
     """Restore the newest complete checkpoint; returns its trainer args
-    (or None when no checkpoint exists).
+    (or None when no checkpoint exists).  When the serial carries a
+    ``data_state`` blob for this rank, it is returned under the
+    ``"data_state"`` key so the Trainer can restart the input pipeline
+    exactly where the commit left it.
 
     Corruption fallback: a serial can carry _SUCCESS yet still be
-    unreadable (bit rot / truncation AFTER the marker was committed).
-    Rather than killing the restore, fall back serial-by-serial to the
-    newest complete checkpoint that actually loads — losing a few steps
-    beats losing the run.  Only if EVERY complete serial is unreadable does
+    unreadable (bit rot / truncation AFTER the marker was committed) —
+    and that includes the data_state blob: a garbage cursor silently
+    resuming at the wrong sample is as bad as garbage weights.  Rather
+    than killing the restore, fall back serial-by-serial to the newest
+    complete checkpoint that actually loads — losing a few steps beats
+    losing the run.  Only if EVERY complete serial is unreadable does
     the error surface (silently training from scratch would be worse)."""
     complete = [s for s, name in _serial_dirs(checkpoint_dir)
                 if os.path.exists(os.path.join(
                     checkpoint_dir, name, SUCCESS_MARK))]
     last_exc = None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
     for serial in reversed(complete):
         cur = os.path.join(checkpoint_dir, f"{CKPT_PREFIX}_{serial}")
         try:
             io.load_persistables(executor, cur, main_program)
+            from ..data.checkpoint import load_data_state
+
+            data_state = load_data_state(cur, rank=rank)
         except Exception as exc:
             from .log import LOG
 
@@ -267,11 +290,14 @@ def load_checkpoint(executor, checkpoint_dir, main_program):
                 f"to the previous complete serial")
             last_exc = exc
             continue
+        args = {}
         args_path = os.path.join(cur, TRAINER_ARGS_FILE)
         if os.path.exists(args_path):
             with open(args_path) as f:
-                return json.load(f)
-        return {}
+                args = json.load(f)
+        if data_state is not None:
+            args["data_state"] = data_state
+        return args
     if last_exc is not None:
         raise IOError(
             f"no loadable checkpoint under {checkpoint_dir}: every "
@@ -340,6 +366,11 @@ class Trainer:
             self.parallel_exe = ParallelExecutor(
                 loss_name=self.loss.name, main_program=self.train_program)
 
+        # data-plane exact resume (paddle_tpu.data): the restored serial's
+        # iterator-state blob, handed to a checkpointable reader in train()
+        self._restored_data_state = None
+        self._data_exact_resume = False
+        self._ckpt_reader = None
         if self.checkpoint_cfg:
             args = load_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir,
                                    self.train_program)
@@ -348,6 +379,7 @@ class Trainer:
                 # step_id records the last COMPLETED step; absent (a
                 # checkpoint saved outside the Trainer loop) means none
                 self.checkpoint_cfg.step_id = int(args.get("step_id", -1)) + 1
+                self._restored_data_state = args.get("data_state")
         elif param_path:
             io.load_persistables(self.exe, param_path, self.train_program)
 
@@ -373,6 +405,22 @@ class Trainer:
         feeder = DataFeeder(feed_list=feed_order, place=self.place,
                             program=self.train_program)
         from . import envcontract
+
+        # checkpointable readers (paddle_tpu.data pipelines) get EXACT
+        # resume: the restored state blob repositions the pipeline at the
+        # first un-committed sample, so the loops below renumber instead
+        # of replaying (skip_until) — and every checkpoint from here on
+        # commits the reader's cursor next to the model state
+        self._ckpt_reader = None
+        self._data_exact_resume = False
+        from ..data import is_checkpointable
+
+        if reader is not None and is_checkpointable(reader) \
+                and envcontract.get("PADDLE_DATA_CKPT"):
+            self._ckpt_reader = reader
+            if self._restored_data_state is not None:
+                reader.restore(self._restored_data_state)
+                self._data_exact_resume = True
 
         spd = int(envcontract.get("PADDLE_TPU_SPD") or 0)
         try:
@@ -408,7 +456,18 @@ class Trainer:
             skip_until = (self.checkpoint_cfg.step_id
                           if self.checkpoint_cfg and
                           epoch_id == self.checkpoint_cfg.epoch_id else 0)
-            for step_id, data in enumerate(reader()):
+            start_step = 0
+            if skip_until and self._data_exact_resume:
+                # the restored pipeline already points at the first
+                # un-committed sample: renumber the enumeration instead
+                # of consuming skip_until replayed batches
+                start_step, skip_until = skip_until, 0
+            data_iter = reader()
+            if self._ckpt_reader is not None:
+                from .. import data as _data
+
+                data_iter = _data.timed(data_iter, epoch=epoch_id)
+            for step_id, data in enumerate(data_iter, start=start_step):
                 if self.stop_flag:
                     return
                 if step_id < skip_until:
@@ -426,10 +485,12 @@ class Trainer:
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 if self.checkpoint_cfg and \
                         (step_id + 1) % self.checkpoint_cfg.step_interval == 0:
-                    self._save_checkpoint(epoch_id, step_id)
+                    self._save_checkpoint(epoch_id, step_id,
+                                          data_state=self._data_state())
             if self.checkpoint_cfg and \
                     (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0:
-                self._save_checkpoint(epoch_id, -1, end_of_epoch=True)
+                self._save_checkpoint(epoch_id, -1, end_of_epoch=True,
+                                      data_state=self._data_state())
                 last_epoch_saved = epoch_id
             event_handler(EndEpochEvent(epoch_id))
         # the guardian's sentinel observes each step one boundary late;
@@ -441,7 +502,8 @@ class Trainer:
         if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
             # final state is always captured so resume never replays work
             # (skipped when the in-loop epoch save already wrote it)
-            self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True)
+            self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True,
+                                  data_state=self._data_state())
 
     def _train_loop_windowed(self, start_epoch, num_epochs, event_handler,
                              reader, feeder, n_steps):
@@ -465,16 +527,32 @@ class Trainer:
             skip_until = (self.checkpoint_cfg.step_id
                           if self.checkpoint_cfg and
                           epoch_id == self.checkpoint_cfg.epoch_id else 0)
-            feeds = itertools.islice(
-                (feeder.feed(data) for data in reader()), skip_until, None)
+            feeds = (feeder.feed(data) for data in reader())
+            if skip_until and not self._data_exact_resume:
+                feeds = itertools.islice(feeds, skip_until, None)
+            # exact resume: the restored pipeline already points at the
+            # first un-committed sample, so nothing is sliced off — the
+            # step numbering below still starts at the resume step
             step_id = skip_until
             # sharded runs stage windows with the batch axis ALREADY
             # dp-sharded (stage_window), so the prefetch thread's H2D
             # overlap covers the mesh placement too
             stage_fn = (self.parallel_exe.stage_window
                         if self.parallel_exe is not None else None)
-            with DevicePrefetcher(feeds, n_steps=n_steps,
-                                  place=self.place, stage_fn=stage_fn) as pf:
+            if self._ckpt_reader is not None:
+                from ..data import CheckpointablePrefetcher
+
+                # snapshots iterator state per staged window so the
+                # checkpoint below commits the WINDOW boundary it refers
+                # to, not the prefetch head (lookahead is replayed)
+                prefetcher = CheckpointablePrefetcher(
+                    feeds, self._ckpt_reader, n_steps=n_steps,
+                    place=self.place, stage_fn=stage_fn)
+            else:
+                prefetcher = DevicePrefetcher(feeds, n_steps=n_steps,
+                                              place=self.place,
+                                              stage_fn=stage_fn)
+            with prefetcher as pf:
                 t_prev = _time.perf_counter()
                 for feed_dev, count in pf:
                     if self.stop_flag:
@@ -516,11 +594,16 @@ class Trainer:
                     event_handler(EndStepEvent(epoch_id, last_step, metrics))
                     if self.checkpoint_cfg and \
                             (last_step + 1) // iv > step_id // iv:
-                        self._save_checkpoint(epoch_id, last_step)
+                        self._save_checkpoint(
+                            epoch_id, last_step,
+                            data_state=(pf.last_state
+                                        if self._ckpt_reader is not None
+                                        else None))
                     step_id += count
             if self.checkpoint_cfg and \
                     (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0:
-                self._save_checkpoint(epoch_id, -1, end_of_epoch=True)
+                self._save_checkpoint(epoch_id, -1, end_of_epoch=True,
+                                      data_state=self._data_state())
                 last_epoch_saved = epoch_id
             event_handler(EndEpochEvent(epoch_id))
         # same teardown as the per-step loop: surface a last-window trip,
@@ -529,7 +612,8 @@ class Trainer:
 
         _guardian.flush()
         if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
-            self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True)
+            self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True,
+                                  data_state=self._data_state())
 
     def test(self, reader, feed_order):
         feeder = DataFeeder(feed_list=feed_order, place=self.place,
@@ -557,13 +641,23 @@ class Trainer:
             self.exe, self.train_program)
 
     # -- internal --
-    def _save_checkpoint(self, epoch_id, step_id, end_of_epoch=False):
+    def _data_state(self):
+        """The active checkpointable reader's cursor (None otherwise) —
+        taken at the loop's commit boundary, i.e. pointing at the first
+        sample no completed step has consumed."""
+        if self._ckpt_reader is None:
+            return None
+        return self._ckpt_reader.state()
+
+    def _save_checkpoint(self, epoch_id, step_id, end_of_epoch=False,
+                         data_state=None):
         args = {"epoch_id": epoch_id + 1 if end_of_epoch else epoch_id,
                 "step_id": -1 if end_of_epoch else step_id}
         save_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir,
                         self.train_program, trainer_args=args,
                         max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
-                        background=self.checkpoint_cfg.async_save)
+                        background=self.checkpoint_cfg.async_save,
+                        data_state=data_state)
 
 
 class Inferencer:

@@ -19,6 +19,11 @@ Fed from the paths that matter (all no-ops until ``PADDLE_SLO=1``):
  - ``train.step_time_s``     — the trainer's windowed-loop wall time per
    step, which INCLUDES input-feed stalls the executor never sees (this
    is the metric an injected ``PADDLE_FAULT_IO_DELAY_MS`` regresses);
+ - ``train.data_wait_s``     — time the training loop blocked waiting on
+   the input pipeline (``paddle_tpu.data.note_data_wait``: the prefetch
+   consumer's per-window wait, or the per-step loop's batch pull) — an
+   injected ``PADDLE_FAULT_DATA_STALL_MS`` stall breaches here and also
+   emits a ``data.stall`` run event;
  - ``serving.latency_s``     — per-request queue+execute latency (tail
    regressions surface here before the lifetime p99 moves);
  - ``serving.queue_depth``   — the admission queue depth gauge.

@@ -375,16 +375,23 @@ def latest_complete_sharded(root: str) -> int:
 
 def save_sharded_serial(state: dict, root: str, serial: int,
                         meta: Optional[dict] = None,
-                        max_num: Optional[int] = None) -> str:
+                        max_num: Optional[int] = None,
+                        data_state: Optional[dict] = None) -> str:
     """Commit ``state`` as <root>/checkpoint_<serial>/ under the _SUCCESS
     protocol.  ``serial`` is caller-assigned (typically the global step) so
     every process independently derives the same value with no filesystem
     race; restore hands the resume point back via ``meta``.
 
-    Ordering: shards -> barrier (all writers done) -> [p0] meta + _SUCCESS
-    -> barrier (everyone may now trust the serial) -> [p0] prune.  The
-    fault hooks bracket the _SUCCESS write exactly like the single-process
-    trainer checkpoint."""
+    ``data_state`` is this RANK's input-pipeline cursor
+    (``paddle_tpu.data``): every process writes its own
+    ``data_state_<rank>.json`` blob before the all-writers barrier, so
+    process 0's single _SUCCESS commit covers the whole fleet's data
+    plane atomically with the model shards.
+
+    Ordering: shards (+ data state) -> barrier (all writers done) ->
+    [p0] meta + _SUCCESS -> barrier (everyone may now trust the serial)
+    -> [p0] prune.  The fault hooks bracket the _SUCCESS write exactly
+    like the single-process trainer checkpoint."""
     import json as _json
     import shutil
 
@@ -393,6 +400,10 @@ def save_sharded_serial(state: dict, root: str, serial: int,
     cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
     os.makedirs(cur, exist_ok=True)
     save_sharded(state, cur)
+    if data_state is not None:
+        from ..data.checkpoint import save_data_state
+
+        save_data_state(cur, data_state, rank=process_index())
     barrier(f"ckpt_shards_{serial}")
     if process_index() == 0:
         if meta is not None:
@@ -421,12 +432,16 @@ def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
     """Restore the newest complete serial under ``root``.
 
     Returns (serial, meta, state) or (-1, None, None) when no complete
-    checkpoint exists.  A complete-but-unreadable serial (truncated shard
-    after commit) falls back to the previous complete one, mirroring
-    trainer.load_checkpoint.  ``clean_incomplete`` removes unmarked serial
-    dirs left by a dead generation (process 0 only, behind a barrier) so a
-    resumed run re-using their serial numbers never mixes stale shards
-    with fresh ones."""
+    checkpoint exists.  When the serial carries a ``data_state`` blob for
+    THIS rank it is returned under ``meta["data_state"]`` so the worker
+    can restart its input pipeline at the first un-committed sample; an
+    unreadable blob condemns the whole serial (fallback), absence just
+    means legacy step-replay resume.  A complete-but-unreadable serial
+    (truncated shard after commit) falls back to the previous complete
+    one, mirroring trainer.load_checkpoint.  ``clean_incomplete`` removes
+    unmarked serial dirs left by a dead generation (process 0 only,
+    behind a barrier) so a resumed run re-using their serial numbers
+    never mixes stale shards with fresh ones."""
     import json as _json
     import shutil
 
@@ -445,6 +460,9 @@ def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
         cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
         try:
             state = load_sharded(cur, mesh, specs)
+            from ..data.checkpoint import load_data_state
+
+            data_state = load_data_state(cur, rank=process_index())
         except Exception as exc:
             from ..fluid.log import LOG
 
@@ -457,6 +475,8 @@ def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
         if os.path.exists(meta_path):
             with open(meta_path) as f:
                 meta = _json.load(f)
+        if data_state is not None:
+            meta["data_state"] = data_state
         return serial, meta, state
     if last_exc is not None:
         raise IOError(

@@ -33,12 +33,27 @@ def shuffle(reader, buf_size, seed=None):
     """Buffered shuffle.  ``seed`` pins the permutation to a private
     ``random.Random`` (NOT the global module state some other library may
     have reseeded), so data order is reproducible — and therefore
-    recordable/replayable by the guardian's flight recorder.  Each fresh
-    iteration restarts from the same seed; pass a per-epoch seed for
-    epoch-varying order.  ``seed=None`` keeps independent randomness."""
+    recordable/replayable by the guardian's flight recorder.
+
+    Each call of the returned reader is one EPOCH, and epoch ``e``'s RNG
+    is derived from ``(seed, e)`` — not one stream threaded across
+    epochs — so epoch N's order is reproducible directly: a restarted
+    run calls ``data_reader.set_epoch(N)`` and gets epoch N's exact
+    permutation without replaying epochs ``0..N-1`` (the resumable-
+    shuffle contract ``paddle_tpu.data`` builds on; one shared stream
+    silently drifts the order on every restart).  A fresh decorator
+    starts at epoch 0, so same-seed decorators still agree.  String
+    seeding hashes via sha512, so the order also reproduces across
+    processes.  ``seed=None`` keeps independent randomness."""
+    epoch_box = [0]
+
+    def set_epoch(epoch):
+        epoch_box[0] = int(epoch)
 
     def data_reader():
-        rng = random.Random(seed)
+        epoch = epoch_box[0]
+        epoch_box[0] = epoch + 1
+        rng = random.Random(None if seed is None else f"{seed}|{epoch}")
         buf = []
         for e in reader():
             buf.append(e)
@@ -52,6 +67,7 @@ def shuffle(reader, buf_size, seed=None):
             for b in buf:
                 yield b
 
+    data_reader.set_epoch = set_epoch
     return data_reader
 
 

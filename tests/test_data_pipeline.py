@@ -1,0 +1,495 @@
+"""paddle_tpu.data: the checkpointable streaming data plane (ISSUE 10).
+
+Covers the CheckpointableIterator protocol (state/restore round trips at
+arbitrary cursors, including mid-shuffle-buffer), per-epoch shuffle
+reproducibility without replay, mesh-derived shard assignment as a
+partition (dp4, dp2xtp2), data-state blobs committed under the _SUCCESS
+protocol on both checkpoint paths with corrupt-blob fallback, the
+prefetcher's staged-but-uncommitted replay semantics, Trainer exact
+resume (per-step and windowed loops, bitwise), the data-stall SLO
+oracle, and the PR 6 overlap oracle extended to the new wrapper."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import data, observe
+from paddle_tpu.fluid import fault
+from paddle_tpu.fluid import trainer as trainer_mod
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _reader(n=64, dim=3):
+    def sample_reader():
+        for i in range(n):
+            yield (np.full((dim,), i, np.float32), i)
+
+    return sample_reader
+
+
+def _ids(batches):
+    return [s[1] for b in batches for s in b]
+
+
+def _build(n=64, shard=(1, 0), buf=16, seed=7, batch=4):
+    return (data.from_reader(_reader(n))
+                .shard(*shard)
+                .shuffle(buf, seed=seed)
+                .batch(batch))
+
+
+# ---------------------------------------------------------------------------
+# protocol round trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stop_after", [0, 1, 3, 5, 7, 15])
+def test_state_restore_resumes_exact_sequence(stop_after):
+    """Snapshot after ``stop_after`` batches (cursors landing at buffer
+    boundaries AND mid-buffer), restore a fresh pipeline, and the tail is
+    byte-identical to the uninterrupted run's."""
+    ref = list(iter(_build()))
+    pipe = _build()
+    it = iter(pipe)
+    head = [next(it) for _ in range(stop_after)]
+    state = pipe.state()
+    # the blob is small JSON (committable with every checkpoint)
+    assert len(json.dumps(state)) < 2000
+    restored = _build()
+    restored.restore(json.loads(json.dumps(state)))
+    tail = list(restored())
+    got = [np.concatenate([s[0] for s in b]) for b in head + tail]
+    want = [np.concatenate([s[0] for s in b]) for b in ref]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.tobytes() == w.tobytes()
+
+
+def test_epoch_order_reproducible_without_replay():
+    """Epoch N's order comes straight from (seed, N): a fresh pipeline
+    positioned at epoch 2 yields epoch 2's exact order with no replay of
+    epochs 0-1, and the three epochs are distinct permutations of the
+    same samples."""
+    pipe = _build(n=32)
+    epochs = [_ids(list(pipe())) for _ in range(3)]
+    assert all(sorted(e) == list(range(32)) for e in epochs)
+    assert len({tuple(e) for e in epochs}) == 3
+    direct = _build(n=32)
+    direct.set_epoch(2)
+    assert _ids(list(iter(direct))) == epochs[2]
+
+
+def test_restore_mid_later_epoch():
+    """State taken mid-epoch 1 restores to epoch 1's cursor (the blob
+    carries the epoch; nothing of epoch 0 is consumed on restore)."""
+    pipe = _build(n=32)
+    list(pipe())  # epoch 0
+    it = pipe()   # epoch 1
+    head = _ids([next(it) for _ in range(3)])
+    state = pipe.state()
+    restored = _build(n=32)
+    restored.restore(state)
+    tail = _ids(list(restored()))
+    direct = _build(n=32)
+    direct.set_epoch(1)
+    assert head + tail == _ids(list(iter(direct)))
+
+
+def test_unseeded_shuffle_not_checkpointable():
+    pipe = data.from_reader(_reader(8)).shuffle(4)
+    with pytest.raises(ValueError, match="not checkpointable"):
+        pipe.state()
+
+
+def test_legacy_reader_adapter_cursor():
+    """from_reader wraps an opaque generator with a sample-count cursor:
+    restore replays exactly ``cursor`` samples and continues."""
+    pipe = data.from_reader(_reader(10))
+    it = iter(pipe)
+    head = [next(it) for _ in range(4)]
+    state = pipe.state()
+    assert state["stage"]["cursor"] == 4
+    restored = data.from_reader(_reader(10))
+    restored.restore(state)
+    assert [s[1] for s in restored()] == [4, 5, 6, 7, 8, 9]
+
+
+# ---------------------------------------------------------------------------
+# shard assignment
+# ---------------------------------------------------------------------------
+
+
+def test_shard_partition_no_overlap_no_loss():
+    all_ids = [set(_ids(list(iter(
+        data.from_reader(_reader(33)).shard(4, i).batch(1)))))
+        for i in range(4)]
+    assert set.union(*all_ids) == set(range(33))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not all_ids[i] & all_ids[j]
+
+
+@pytest.mark.parametrize("spec,hosts,expected", [
+    # dp4 over 4 hosts: one dp group per host, 4-way partition
+    ("dp4", 4, [(4, 0), (4, 1), (4, 2), (4, 3)]),
+    # dp2,tp2 over 4 hosts: tp peers share a dp group and read IDENTICAL
+    # data; the two dp groups partition the stream
+    ("dp2,tp2", 4, [(2, 0), (2, 0), (2, 1), (2, 1)]),
+    # dp4,tp2 over 2 hosts: each host owns 2 dp groups, 2-way partition
+    ("dp4,tp2", 2, [(2, 0), (2, 1)]),
+    # tp-only mesh replicates the batch: every host reads everything
+    ("tp4", 4, [(1, 0), (1, 0), (1, 0), (1, 0)]),
+])
+def test_mesh_shard_assignment_partitions(spec, hosts, expected):
+    got = [data.shard_spec(spec, host_rank=r, num_hosts=hosts)
+           for r in range(hosts)]
+    assert got == expected
+    # the assignment induces a partition of the dataset over the DISTINCT
+    # shards, and hosts sharing a shard see byte-identical streams
+    streams = {}
+    for r, (n, i) in enumerate(got):
+        seq = _ids(list(iter(
+            data.from_reader(_reader(24)).shard(n, i).batch(1))))
+        streams.setdefault((n, i), []).append(seq)
+    for seqs in streams.values():
+        assert all(s == seqs[0] for s in seqs)
+    distinct = [seqs[0] for seqs in streams.values()]
+    flat = [x for s in distinct for x in s]
+    assert sorted(flat) == list(range(24))
+
+
+def test_mesh_shard_assignment_also_takes_mesh_objects():
+    from paddle_tpu.parallel.mesh import mesh_from_spec
+
+    mesh = mesh_from_spec("dp2,tp2")
+    assert data.shard_spec(mesh, host_rank=1, num_hosts=2) == (2, 1)
+
+
+def test_indivisible_mesh_host_layout_raises():
+    with pytest.raises(ValueError, match="do not tile"):
+        data.shard_spec("dp3", host_rank=0, num_hosts=2)
+    with pytest.raises(ValueError, match="host_rank"):
+        data.shard_spec("dp4", host_rank=4, num_hosts=4)
+
+
+# ---------------------------------------------------------------------------
+# observe counters + stall oracle
+# ---------------------------------------------------------------------------
+
+
+def test_data_counters():
+    before = observe.registry().snapshot().get("counters", {})
+    list(iter(_build(n=32, batch=8)))
+    after = observe.registry().snapshot()["counters"]
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    assert delta("data.samples") == 32
+    assert delta("data.bytes") >= 32 * 3 * 4  # 3 float32 per sample
+
+
+def test_injected_stall_breaches_slo_and_emits_data_stall(
+        tmp_path, monkeypatch):
+    """The data-wait SLO oracle: a one-shot 200 ms stall injected at a
+    late sample makes that window's train.data_wait_s a >3x outlier over
+    the established baseline — the watchdog emits slo.breach, and the
+    wait also crosses PADDLE_DATA_STALL_EVENT_MS, emitting data.stall."""
+    monkeypatch.setenv("PADDLE_OBSERVE_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_SLO", "1")
+    monkeypatch.setenv("PADDLE_SLO_MIN_SAMPLES", "8")
+    fault.install(fault.FaultPlan(data_stall_ms=200.0, data_stall_at=48,
+                                  mode="raise"))
+    pipe = _build(n=80, batch=4, buf=4)
+    feeds = ({"x": np.stack([s[0] for s in b])} for b in pipe())
+    with data.CheckpointablePrefetcher(feeds, pipe, n_steps=1,
+                                       place=fluid.CPUPlace(), depth=0) as pf:
+        for _ in pf:
+            pass
+    observe.get_sink().flush()
+    events = []
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("events-") and fn.endswith(".jsonl"):
+            with open(tmp_path / fn) as f:
+                events.extend(json.loads(ln) for ln in f if ln.strip())
+    breaches = [e for e in events if e["event"] == "slo.breach"]
+    assert any(e.get("metric") == "train.data_wait_s" for e in breaches), \
+        [e["event"] for e in events]
+    assert any(e["event"] == "data.stall" for e in events)
+    assert observe.registry().snapshot()["counters"].get(
+        'slo.breaches{metric="train.data_wait_s"}', 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# prefetcher: staged-but-uncommitted is replayed
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_state_tracks_consumed_not_staged():
+    """With depth=2 the staging thread runs ahead of the consumer; the
+    committed state must follow CONSUMPTION — restore from last_state
+    after k windows replays every staged-but-unconsumed window."""
+    ref = _ids(list(iter(_build(n=64))))
+    pipe = _build(n=64)
+    feeds = ({"x": np.stack([s[0] for s in b]),
+              "i": np.array([s[1] for s in b])} for b in pipe())
+    consumed = []
+    pf = data.CheckpointablePrefetcher(feeds, pipe, n_steps=2,
+                                       place=fluid.CPUPlace(), depth=2)
+    states = []
+    for k, (feed_dev, count) in enumerate(pf):
+        consumed.extend(int(x) for x in np.asarray(feed_dev["i"]).reshape(-1))
+        states.append(pf.last_state)
+        if k == 2:
+            break
+    pf.close()
+    for k, state in enumerate(states):
+        restored = _build(n=64)
+        restored.restore(state)
+        tail = _ids(list(restored()))
+        n_committed = (k + 1) * 2 * 4  # windows x n_steps x batch
+        assert consumed[:n_committed] + tail == ref, k
+
+
+def test_prefetcher_overlap_oracle_under_injected_io_delay():
+    """The PR 6 overlap oracle extended to the checkpointable wrapper:
+    under PADDLE_FAULT_IO_DELAY_MS the prefetched pipeline's wall clock
+    stays below the synchronous depth=0 baseline — checkpointability
+    must not cost the overlap."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    n_windows, spd, delay_ms, busy_s = 6, 2, 40, 0.04
+
+    def run_loop(depth):
+        pipe = _build(n=n_windows * spd * 4, batch=4)
+        feeds = ({"x": np.stack([s[0] for s in b]),
+                  "y": np.stack([np.full((1,), s[1], np.float32)
+                                 for s in b])} for b in pipe())
+        fault.install(fault.FaultPlan(io_delay_ms=delay_ms, mode="raise"))
+        t0 = time.perf_counter()
+        with data.CheckpointablePrefetcher(
+                feeds, pipe, n_steps=spd, place=fluid.CPUPlace(),
+                depth=depth) as pf:
+            for feed_dev, count in pf:
+                exe.run_steps(prog, feed=feed_dev, fetch_list=[loss],
+                              n_steps=count, feed_per_step=True)
+                time.sleep(busy_s)
+        fault.clear()
+        return time.perf_counter() - t0
+
+    run_loop(2)  # compile outside the timed comparison
+    t_sync = run_loop(0)
+    t_pre = run_loop(2)
+    hideable = (n_windows - 1) * delay_ms / 1000.0
+    assert t_pre < t_sync - 0.5 * hideable, (t_sync, t_pre)
+
+
+# ---------------------------------------------------------------------------
+# data_state under the _SUCCESS protocol
+# ---------------------------------------------------------------------------
+
+
+def _train_funcs():
+    def train_func():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+
+    return train_func, lambda: fluid.optimizer.SGD(learning_rate=0.05)
+
+
+def _labelled_reader(n):
+    def sample_reader():
+        for i in range(n):
+            yield (np.full((3,), i, np.float32),
+                   np.full((1,), i * 0.5, np.float32))
+
+    return sample_reader
+
+
+def _run_trainer(ckpt_dir, stop_at=None, n=48, num_epochs=2):
+    """One Trainer run over a checkpointable pipeline; returns (steps
+    trained, final weight, the trainer)."""
+    from paddle_tpu.fluid import framework
+
+    framework.fresh_session()
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    train_func, opt_func = _train_funcs()
+    pipe = (data.from_reader(_labelled_reader(n))
+                .shuffle(16, seed=5).batch(8))
+    cfg = fluid.CheckpointConfig(ckpt_dir, step_interval=2)
+    tr = fluid.Trainer(train_func=train_func, optimizer_func=opt_func,
+                       place=fluid.CPUPlace(), checkpoint_config=cfg)
+    steps = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            steps.append((ev.epoch, ev.step))
+            if stop_at is not None and ev.step >= stop_at:
+                tr.stop()
+
+    tr.train(num_epochs=num_epochs, event_handler=handler, reader=pipe,
+             feed_order=["x", "y"])
+    from paddle_tpu.fluid.executor import global_scope
+
+    w = np.asarray(global_scope().get("fc_0.w_0")).copy()
+    return steps, w, tr
+
+
+def test_data_state_committed_under_success_marker(tmp_path):
+    """Every serial a checkpointable-reader run commits carries the
+    data_state blob next to _SUCCESS, and it round-trips through
+    load_checkpoint."""
+    _run_trainer(str(tmp_path), num_epochs=1)
+    serials = trainer_mod._serial_dirs(str(tmp_path))
+    assert serials
+    for _, name in serials:
+        d = os.path.join(str(tmp_path), name)
+        assert os.path.exists(os.path.join(d, "_SUCCESS"))
+        assert os.path.exists(data.data_state_path(d, 0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    train_func, opt_func = _train_funcs()
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        loss = train_func()
+        opt_func().minimize(loss, startup)
+    exe.run(startup)
+    args = trainer_mod.load_checkpoint(exe, str(tmp_path), prog)
+    assert args["data_state"]["version"] == 1
+    assert args["data_state"]["epoch_done"] is True  # end-of-epoch save
+
+
+def test_trainer_exact_resume_bitwise_per_step(tmp_path):
+    ref_steps, ref_w, _ = _run_trainer(str(tmp_path / "ref"))
+    s0, _, _ = _run_trainer(str(tmp_path / "a"), stop_at=2)
+    s1, w, tr = _run_trainer(str(tmp_path / "a"))
+    assert tr._data_exact_resume
+    # commit landed at step 1 (interval 2); the resumed run re-runs the
+    # uncommitted step 2 with the SAME batch and continues — landing on
+    # the uninterrupted run's params BITWISE
+    assert s1[0] == (0, 2)
+    assert np.array_equal(ref_w, w)
+
+
+def test_trainer_exact_resume_bitwise_windowed(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SPD", "2")
+    ref_steps, ref_w, _ = _run_trainer(str(tmp_path / "ref"))
+    _run_trainer(str(tmp_path / "a"), stop_at=2)
+    s1, w, tr = _run_trainer(str(tmp_path / "a"))
+    assert tr._data_exact_resume
+    assert np.array_equal(ref_w, w)
+
+
+def test_corrupt_data_state_falls_back_to_previous_serial(tmp_path):
+    """A corrupt data_state blob condemns its serial: load falls back to
+    the previous complete one (params AND cursor from the older serial,
+    never a mixed state)."""
+    _run_trainer(str(tmp_path), num_epochs=1)
+    serials = trainer_mod._serial_dirs(str(tmp_path))
+    assert len(serials) >= 2
+    newest = os.path.join(str(tmp_path), serials[-1][1])
+    prev = os.path.join(str(tmp_path), serials[-2][1])
+    with open(data.data_state_path(newest, 0), "w") as f:
+        f.write('{"version": 1, "ran')  # truncated write after _SUCCESS
+    exe = fluid.Executor(fluid.CPUPlace())
+    train_func, opt_func = _train_funcs()
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        loss = train_func()
+        opt_func().minimize(loss, startup)
+    exe.run(startup)
+    args = trainer_mod.load_checkpoint(exe, str(tmp_path), prog)
+    want = data.load_data_state(prev, 0)
+    assert args["data_state"] == want
+    # and the params came from the SAME (previous) serial
+    from paddle_tpu.fluid.executor import global_scope
+
+    with open(os.path.join(prev, "fc_0.w_0"), "rb") as f:
+        w_prev = np.load(f)
+    assert np.array_equal(np.asarray(global_scope().get("fc_0.w_0")),
+                          w_prev)
+
+
+def test_shard_corrupt_fault_is_one_shot(tmp_path):
+    fault.install(fault.FaultPlan(shard_corrupt=True))
+    data.save_data_state(str(tmp_path), {"cursor": 1}, rank=0)
+    with pytest.raises(IOError, match="unreadable"):
+        data.load_data_state(str(tmp_path), 0)
+    # one-shot: the next write commits clean
+    data.save_data_state(str(tmp_path), {"cursor": 2}, rank=0)
+    assert data.load_data_state(str(tmp_path), 0) == {"cursor": 2}
+
+
+def test_sharded_serial_carries_per_rank_data_state(tmp_path):
+    """The multihost path: data_state rides save_sharded_serial under the
+    same _SUCCESS barrier, comes back via meta, and a corrupt blob falls
+    back to the previous complete serial."""
+    from paddle_tpu.parallel import multihost as mh
+
+    state = {"w": np.arange(4, dtype=np.float32)}
+    mh.save_sharded_serial(state, str(tmp_path), serial=0,
+                           meta={"step": 0}, data_state={"cursor": 8})
+    mh.save_sharded_serial(state, str(tmp_path), serial=1,
+                           meta={"step": 1}, data_state={"cursor": 16})
+    serial, meta, _ = mh.load_sharded_latest(str(tmp_path), None, {})
+    assert (serial, meta["data_state"]) == (1, {"cursor": 16})
+    blob = data.data_state_path(
+        os.path.join(str(tmp_path), "checkpoint_1"), 0)
+    with open(blob, "w") as f:
+        f.write("{{{")
+    serial, meta, _ = mh.load_sharded_latest(str(tmp_path), None, {})
+    assert (serial, meta["data_state"]) == (0, {"cursor": 8})
+
+
+# ---------------------------------------------------------------------------
+# satellites: decorator shuffle epochs, smoke tool
+# ---------------------------------------------------------------------------
+
+
+def test_decorator_shuffle_per_epoch_rng():
+    """reader.decorator.shuffle derives epoch N's RNG from (seed, N):
+    successive iterations permute differently, and set_epoch(N) on a
+    FRESH decorator reproduces epoch N's order with no replay."""
+    from paddle_tpu.reader import decorator
+
+    src = lambda: iter(range(32))  # noqa: E731
+    r = decorator.shuffle(src, 16, seed=9)
+    e0, e1, e2 = list(r()), list(r()), list(r())
+    assert sorted(e0) == sorted(e1) == list(range(32))
+    assert len({tuple(e0), tuple(e1), tuple(e2)}) == 3
+    fresh = decorator.shuffle(src, 16, seed=9)
+    fresh.set_epoch(2)
+    assert list(fresh()) == e2
+    # and epoch numbering continues from the pinned epoch
+    assert list(fresh()) != e2
+
+
+def test_data_smoke_tool():
+    import tools.data_smoke as smoke
+
+    report = smoke.main()
+    assert report["ok"], report
+    assert report["elapsed_s"] < 5.0

@@ -1,0 +1,253 @@
+"""The data-plane kill-and-resume oracle (ISSUE 10 acceptance).
+
+A SUPERVISED 2-process run — each rank feeding its own mesh-derived shard
+through a checkpointable sharded+shuffled+batched+prefetched pipeline
+into the WINDOWED Trainer loop — is killed mid-epoch by an injected
+fault.  The restarted generation restores model params AND iterator state
+from the newest ``_SUCCESS``-committed serial and must consume the
+byte-identical sample sequence an uninterrupted run would have, per
+shard: generation 1's recorded batch digests are exactly the reference
+tail starting at the first un-committed sample (no skip, no double-
+consume), generation 0's are a prefix (prefetch lookahead included — the
+staged-but-uncommitted windows are REPLAYED by generation 1), and the
+final parameters match the uninterrupted run bitwise.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import data
+from paddle_tpu.parallel.elastic import ElasticSupervisor
+from paddle_tpu.parallel.master import Backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_PROC = 2
+N_SAMPLES = 96          # per the whole dataset; 48 per shard -> 12 batches
+BATCH = 4
+SPD = 2                 # windowed loop: 2 steps per dispatch
+STEP_INTERVAL = 3
+KILL_STEP = 7           # mid-epoch, inside window [6, 7]
+SEED = 13
+
+
+def _sample_reader():
+    for i in range(N_SAMPLES):
+        x = np.full((4,), float(i), np.float32)
+        yield (x, x[:1] * 0.5)
+
+
+def _build_pipe(rank, record=None):
+    pipe = (data.from_reader(_sample_reader)
+                .shard_by_mesh("dp2", host_rank=rank, num_hosts=N_PROC)
+                .shuffle(16, seed=SEED)
+                .batch(BATCH))
+    return pipe.map(record) if record is not None else pipe
+
+
+def _digest(batch):
+    h = hashlib.sha1()
+    for sample in batch:
+        for a in sample:
+            h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+WORKER = f"""
+import os, sys, json, hashlib
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+# this oracle is about the DATA plane; opt out of the supervisor's shared
+# compile cache — this container's jaxlib CPU backend intermittently
+# segfaults EXECUTING a deserialized cached executable for the windowed
+# program (reproducible without any of this PR's code; the cache's own
+# warm-start oracle lives in test_compile_cache/test_spmd_window)
+os.environ.pop("PADDLE_COMPILE_CACHE_DIR", None)
+
+sys.path.insert(0, {REPO!r})
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+gen = int(os.environ.get("PADDLE_ELASTIC_GENERATION", "0"))
+workdir = os.environ["DATA_TEST_DIR"]
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import data
+import tests.test_data_resume as spec
+
+seq_log = os.path.join(workdir, "seq_r%d_g%d.jsonl" % (rank, gen))
+
+def record(batch):
+    # appended from the prefetcher's STAGING thread, in pipeline order:
+    # generation 0's log is a prefix(+lookahead) of the reference
+    # sequence, generation 1's starts at the restored cursor
+    with open(seq_log, "a") as f:
+        f.write(json.dumps({{"digest": spec._digest(batch)}}) + "\\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return batch
+
+fluid.default_main_program().random_seed = 7
+fluid.default_startup_program().random_seed = 7
+pipe = spec._build_pipe(rank, record=record)
+
+def train_func():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    return fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+
+cfg = fluid.CheckpointConfig(os.path.join(workdir, "ckpt_r%d" % rank),
+                             step_interval=spec.STEP_INTERVAL)
+trainer = fluid.Trainer(
+    train_func=train_func,
+    optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    place=fluid.CPUPlace(), checkpoint_config=cfg)
+resume_step = cfg.step_id
+steps = []
+
+def handler(ev):
+    if isinstance(ev, fluid.EndStepEvent):
+        steps.append(ev.step)
+
+trainer.train(num_epochs=1, event_handler=handler, reader=pipe,
+              feed_order=["x", "y"])
+
+from paddle_tpu.fluid.executor import global_scope
+
+w = np.asarray(global_scope().get("fc_0.w_0"))
+with open(os.path.join(workdir, "result_r%d_g%d.json" % (rank, gen)),
+          "w") as f:
+    json.dump({{"resume_step": resume_step, "steps": steps,
+               "exact": bool(trainer._data_exact_resume),
+               "w_digest": hashlib.sha1(w.tobytes()).hexdigest()}}, f)
+"""
+
+
+def _read_digests(path):
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for ln in f:
+            try:
+                out.append(json.loads(ln)["digest"])
+            except (ValueError, KeyError):
+                pass  # a line torn by the injected kill
+    return out
+
+
+def test_supervised_kill_and_resume_exact_sample_sequence(tmp_path):
+    workdir = str(tmp_path)
+    worker_py = os.path.join(workdir, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER)
+
+    sup = ElasticSupervisor(
+        f"{sys.executable} {worker_py}", nproc=N_PROC, workdir=workdir,
+        hb_timeout=120.0, poll_interval=0.2, max_restarts=2,
+        backoff=Backoff(base=0.2, factor=1.0), deadline=240.0,
+        extra_env={
+            "DATA_TEST_DIR": workdir,
+            "PADDLE_TPU_SPD": str(SPD),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1 "
+                         "--xla_cpu_enable_concurrency_optimized_scheduler"
+                         "=false",
+        },
+        fault_env={"PADDLE_FAULT_KILL_STEP": str(KILL_STEP)})
+    result = sup.run()
+
+    def _tails():
+        outs = []
+        for fn in sorted(os.listdir(workdir)):
+            if fn.startswith("worker_") and fn.endswith(".log"):
+                with open(os.path.join(workdir, fn), "rb") as f:
+                    outs.append(f"== {fn} ==\n"
+                                + f.read()[-1500:].decode("utf-8", "replace"))
+        return "\n".join(outs)
+
+    assert result["status"] == "finished", (result, _tails())
+    assert result["generations"] == 2, (result, _tails())
+    exits = [e for e in result["incidents"] if e["event"] == "worker_exit"]
+    assert exits and exits[0]["exit_code"] == 137
+
+    # uninterrupted reference sequence per shard, straight from the data
+    # plane (no training needed: the pipeline is the contract)
+    refs = {r: [_digest(b) for b in iter(_build_pipe(r))]
+            for r in range(N_PROC)}
+    n_batches = N_SAMPLES // N_PROC // BATCH
+    assert all(len(v) == n_batches for v in refs.values())
+    # shards are disjoint streams
+    assert not set(refs[0]) & set(refs[1])
+
+    for rank in range(N_PROC):
+        with open(os.path.join(workdir,
+                               f"result_r{rank}_g1.json")) as f:
+            res = json.load(f)
+        # the resumed generation provably did EXACT resume: it restarted
+        # at the first step after the last committed one, not at 0
+        assert res["exact"], res
+        resume = res["resume_step"]
+        assert 0 < resume <= KILL_STEP, res
+        # first resumed window event = its last step, counted from resume
+        assert res["steps"][0] == resume + SPD - 1, res
+
+        g0 = _read_digests(os.path.join(workdir,
+                                        f"seq_r{rank}_g0.jsonl"))
+        g1 = _read_digests(os.path.join(workdir,
+                                        f"seq_r{rank}_g1.jsonl"))
+        ref = refs[rank]
+        # gen 0 staged a prefix of the reference order (prefetch may have
+        # staged past the kill point — that lookahead was never trained)
+        assert g0 == ref[:len(g0)], rank
+        assert len(g0) >= resume
+        # THE oracle: generation 1 consumed exactly the reference tail
+        # from the first un-committed batch — byte-identical, no skips,
+        # no double-consume, lookahead replayed
+        assert g1 == ref[resume:], (rank, resume, len(g1))
+
+    # and the trained trajectory matches an uninterrupted run bitwise:
+    # same model, same pipeline, no faults, in-process
+    os.environ["PADDLE_TPU_SPD"] = str(SPD)
+    try:
+        for rank in range(N_PROC):
+            from paddle_tpu.fluid import framework
+
+            framework.fresh_session()
+            fluid.default_main_program().random_seed = 7
+            fluid.default_startup_program().random_seed = 7
+            pipe = _build_pipe(rank)
+
+            def train_func():
+                x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(input=x, size=1, act=None)
+                return fluid.layers.mean(
+                    fluid.layers.square_error_cost(input=pred, label=y))
+
+            cfg = fluid.CheckpointConfig(
+                os.path.join(workdir, f"refckpt_r{rank}"),
+                step_interval=STEP_INTERVAL)
+            trainer = fluid.Trainer(
+                train_func=train_func,
+                optimizer_func=lambda: fluid.optimizer.SGD(
+                    learning_rate=0.05),
+                place=fluid.CPUPlace(), checkpoint_config=cfg)
+            trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                          reader=pipe, feed_order=["x", "y"])
+            from paddle_tpu.fluid.executor import global_scope
+
+            w = np.asarray(global_scope().get("fc_0.w_0"))
+            with open(os.path.join(workdir,
+                                   f"result_r{rank}_g1.json")) as f:
+                res = json.load(f)
+            assert hashlib.sha1(w.tobytes()).hexdigest() == \
+                res["w_digest"], rank
+    finally:
+        os.environ.pop("PADDLE_TPU_SPD", None)

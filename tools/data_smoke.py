@@ -1,0 +1,102 @@
+"""Checkpointable data-plane smoke (CPU, < 5 s).
+
+The CI oracle for the streaming input pipeline (ISSUE 10): a sharded +
+shuffled + batched + device-prefetched pipeline must (a) partition the
+dataset across shards with no overlap and no loss, (b) round-trip its
+cursor through ``state()``/``restore()`` mid-epoch — the restored
+pipeline yields the byte-identical tail of an uninterrupted run, even
+though the prefetcher had staged windows past the commit point — and
+(c) reproduce epoch N's shuffled order directly, with no replay of
+earlier epochs.
+
+Run directly (``python tools/data_smoke.py``) or from tier-1 via
+``tests/test_data_pipeline.py::test_data_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SAMPLES = 128
+BATCH = 4
+N_STEPS = 2  # window size for the prefetcher
+
+
+def main() -> dict:
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import data
+
+    t0 = time.perf_counter()
+
+    def sample_reader():
+        for i in range(N_SAMPLES):
+            yield (np.full((3,), i, np.float32), i)
+
+    def build(shard_index=0):
+        return (data.from_reader(sample_reader)
+                    .shard(2, shard_index)
+                    .shuffle(16, seed=11)
+                    .batch(BATCH))
+
+    def ids(batches):
+        return [s[1] for b in batches for s in b]
+
+    # (a) shards partition the dataset: no overlap, no loss
+    shard_ids = [set(ids(list(iter(build(i))))) for i in range(2)]
+    partition_ok = (not (shard_ids[0] & shard_ids[1])
+                    and shard_ids[0] | shard_ids[1] == set(range(N_SAMPLES)))
+
+    # (b) prefetched checkpoint/restore round trip: consume 3 windows,
+    # commit pf.last_state, restore a FRESH pipeline there — consumed +
+    # restored-tail must equal the uninterrupted sequence exactly
+    ref = ids(list(iter(build())))
+    pipe = build()
+    feeds = ({"x": np.stack([s[0] for s in b]),
+              "i": np.array([s[1] for s in b])} for b in pipe())
+    consumed = []
+    with data.CheckpointablePrefetcher(feeds, pipe, n_steps=N_STEPS,
+                                       place=fluid.CPUPlace(),
+                                       depth=2) as pf:
+        for k, (feed_dev, count) in enumerate(pf):
+            consumed.extend(int(x) for x in
+                            np.asarray(feed_dev["i"]).reshape(-1))
+            if k == 2:
+                state = pf.last_state
+                break
+    restored = build()
+    restored.restore(state)
+    tail = ids(list(restored()))
+    resume_ok = consumed + tail == ref
+
+    # (c) epoch 1's order reproduces directly (no epoch-0 replay) and
+    # differs from epoch 0's
+    two_epochs = build()
+    e0 = ids(list(two_epochs()))
+    e1 = ids(list(two_epochs()))
+    direct = build()
+    direct.set_epoch(1)
+    epoch_ok = ids(list(iter(direct))) == e1 and e0 != e1
+
+    report = {
+        "ok": bool(partition_ok and resume_ok and epoch_ok),
+        "partition_ok": bool(partition_ok),
+        "resume_ok": bool(resume_ok),
+        "epoch_ok": bool(epoch_ok),
+        "consumed_before_restore": len(consumed),
+        "shard_sizes": [len(s) for s in shard_ids],
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
