@@ -18,7 +18,12 @@ context), run by :func:`verify_program`:
    annotated param dims, column/row chain conflicts, pre-compile
    collective-bytes estimate;
  - **contracts** (AN301/AN302, AN401/AN402): donation hazards and the
-   fp16-loss-scale / eager-window runtime rejects, pre-compile.
+   fp16-loss-scale / eager-window runtime rejects, pre-compile;
+ - **memcheck** (AN501-AN503): pre-flight peak-HBM estimate from the same
+   shape facts (params + optimizer slots + activation high-water,
+   donation-aware, shard-divided), diagnosed against
+   ``PADDLE_MEM_BUDGET_MB`` and cross-checked against the compiled
+   ``memory.peak_bytes`` truth gauge (``observe.memory``).
 
 Execution wiring: ``Executor.run``/``run_steps`` and ``ParallelExecutor``
 call :func:`check_before_compile` on every jit-cache miss, gated by
@@ -63,6 +68,9 @@ CODES = {
     "AN302": "fetch aliases donated training state",
     "AN401": "fp16 loss-scale program on the per-step PE path",
     "AN402": "data-dependent eager ops inside a fused window",
+    "AN501": "pre-flight peak-HBM estimate",
+    "AN502": "estimated peak HBM exceeds PADDLE_MEM_BUDGET_MB",
+    "AN503": "estimated peak HBM within 10% of PADDLE_MEM_BUDGET_MB",
 }
 
 
@@ -100,6 +108,8 @@ class Report:
     kind: str = "run"
     mesh: Optional[str] = None
     collective_bytes_est: Optional[int] = None
+    #: the AN5xx pre-flight peak-HBM estimate (memcheck pass), or None
+    memory_estimate: Optional[dict] = None
 
     def by_severity(self, severity: str) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == severity]
@@ -228,11 +238,15 @@ def verify_program(program=None, feed=None, fetch_list=None, mesh=None,
 
     live = guarded(run_structure_pass, program, block_idx,
                    list(feed_infos), fetch_names, diags)
-    guarded(run_infer_pass, program, block_idx, feed_infos, diags,
-            batch_hint, live)
+    env = guarded(run_infer_pass, program, block_idx, feed_infos, diags,
+                  batch_hint, live)
     est = guarded(run_spmd_pass, program, axes, feed_infos, fetch_names,
                   diags, concrete)
     guarded(run_contract_pass, program, fetch_names, kind, diags)
+    from .memcheck import run_memcheck_pass
+
+    mem_est = guarded(run_memcheck_pass, program, block_idx, env or {},
+                      axes, feed_infos, fetch_names, diags, batch_hint)
 
     order = {s: i for i, s in enumerate(SEVERITIES)}
     diags.sort(key=lambda d: (order.get(d.severity, 9),
@@ -240,7 +254,7 @@ def verify_program(program=None, feed=None, fetch_list=None, mesh=None,
     return Report(diagnostics=diags,
                   duration_ms=(time.perf_counter() - t0) * 1e3,
                   kind=kind, mesh=_axes_label(axes) if axes else None,
-                  collective_bytes_est=est)
+                  collective_bytes_est=est, memory_estimate=mem_est)
 
 
 # -- executor integration ---------------------------------------------------

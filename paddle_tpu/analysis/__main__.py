@@ -97,6 +97,7 @@ def cmd_lint(args) -> int:
             "duration_ms": round(report.duration_ms, 3),
             "errors": len(report.errors), "warns": len(report.warnings),
             "collective_bytes_est": report.collective_bytes_est,
+            "memory_estimate": report.memory_estimate,
             "diagnostics": [d.to_dict() for d in report.diagnostics]}))
     else:
         print(report.format("info" if args.verbose else "warn"))

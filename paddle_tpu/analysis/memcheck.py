@@ -1,0 +1,325 @@
+"""Pass 5: pre-flight peak-HBM estimation (the AN5xx family).
+
+Answers "will this program fit, and what is it spending HBM on?" BEFORE
+any trace or compile — the memory twin of the AN204 collective estimate,
+built on the same shape/dtype facts the infer pass already derived:
+
+ - **persistent bytes**: parameters, optimizer accumulators and every
+   other persistable var, each divided by its spec-table shard extent
+   (the Megatron column/row parity from ``spmd_check._chain``: embedding
+   and even-order linear weights split over ``fsdp``×``tp``, odd orders
+   over ``tp``×``fsdp``; accumulators follow their owning param);
+ - **transient high-water**: a liveness walk over the block — every
+   non-persistable var (activations, gradients, feeds) goes live at its
+   producing op (feeds at op 0) and dies after its last consumer; the
+   high-water mark is the max live sum over op positions, with
+   batch-leading tensors divided by the mesh's ``dp`` extent.  Gradients
+   need no separate term: ``append_backward`` materializes them as
+   ordinary block vars, so the walk prices them where they actually live;
+ - **donation**: a donating training program updates state in place
+   (input and output buffers alias); with donation off every mutated
+   persistable needs a second buffer, which is added back.
+
+The estimate lands as one AN501 info note (and on the
+``analysis.mem_peak_est`` gauge, next to the post-compile
+``memory.peak_bytes`` truth it is cross-checked against in tests).  With
+``PADDLE_MEM_BUDGET_MB`` set, an over-budget estimate is AN502 — an
+*error*, so ``PADDLE_TPU_VERIFY=strict`` refuses the program before
+compile — and a >90% estimate is the AN503 headroom warning.  Per-op
+attribution: the top live tensors at the high-water point are named in
+the diagnostics and returned in the estimate dict (``top``), so the
+answer to "what is it spending HBM on" is op-granular, not one number.
+
+Unknown shapes degrade silently: vars the infer pass could not type
+contribute nothing (never a false positive), and a program with no
+sizable facts yields no estimate at all.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..fluid.framework import Parameter, Program
+
+_SKIP_OPS = frozenset(["feed", "fetch", "read", "create_py_reader"])
+
+
+def _dtype_bytes(dtype) -> Optional[int]:
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except (TypeError, ValueError):
+        return None
+
+
+def _nbytes(info) -> Optional[int]:
+    """VarInfo (shape, dtype) -> bytes, None when unknown."""
+    if info is None:
+        return None
+    shape, dtype = info
+    item = _dtype_bytes(dtype)
+    if item is None:
+        return None
+    n = 1
+    for d in shape:
+        if d is None or int(d) < 0:
+            return None
+        n *= int(d)
+    return n * item
+
+
+def _declared_info(block, name, batch_hint: int):
+    if not block._has_var_recursive(name):
+        return None
+    v = block._var_recursive(name)
+    if v.shape is None or v.dtype is None:
+        return None
+    try:
+        return (tuple(batch_hint if d in (-1, None) else int(d)
+                      for d in v.shape), str(np.dtype(v.dtype)))
+    except TypeError:
+        return None
+
+
+def _param_divisors(program: Program, axes: Dict[str, int]
+                    ) -> Dict[str, int]:
+    """Per-var shard divisor under the canonical spec table: chain-parity
+    column/row splits for 2-D linear/embedding weights (checked for
+    divisibility, like ``spmd.infer_param_specs`` degradation), with
+    accumulators inheriting their owner's divisor."""
+    from .spmd_check import _chain
+
+    tp = axes.get("tp", axes.get("mp", 1))
+    fsdp = axes.get("fsdp", 1)
+    gb = program.global_block()
+    div: Dict[str, int] = {}
+    if tp <= 1 and fsdp <= 1:
+        return div
+    order_of: Dict[str, Optional[int]] = {}
+    for _idx, _op_type, name, order in _chain(program):
+        if name not in order_of:
+            order_of[name] = order
+    shapes: Dict[str, tuple] = {}
+    for name, order in order_of.items():
+        v = gb.vars.get(name)
+        if v is None or not isinstance(v, Parameter) or v.shape is None \
+                or len(v.shape) != 2:
+            continue
+        shape = tuple(int(d) for d in v.shape)
+        shapes[name] = shape
+        # embedding/even order: P(fsdp, tp); odd order: P(tp, fsdp)
+        if order is None or order % 2 == 0:
+            spec = (fsdp, tp)
+        else:
+            spec = (tp, fsdp)
+        d = 1
+        for dim, ext in zip(shape, spec):
+            if ext > 1 and dim % ext == 0:
+                d *= ext
+        if d > 1:
+            div[name] = d
+    # accumulators follow their param (same-shape; the optimizer registry
+    # first, the name-containment fallback for deserialized programs)
+    acc_owner = getattr(program, "_accumulator_owner", {}) or {}
+    for name, v in gb.vars.items():
+        if name in div or not getattr(v, "persistable", False) \
+                or v.shape is None:
+            continue
+        shape = tuple(int(d) if d is not None else -1 for d in v.shape)
+        owner = acc_owner.get(name)
+        if owner is None:
+            owner = next((p for p in shapes if p in name), None)
+        if owner in div and shapes.get(owner) == shape:
+            div[name] = div[owner]
+    return div
+
+
+def estimate_program_memory(program: Program, env: Dict[str, object],
+                            axes: Dict[str, int],
+                            feed_infos: Dict[str, object],
+                            fetch_names, batch_hint: int = 8,
+                            block_idx: int = 0) -> Optional[dict]:
+    """The pre-flight peak-HBM estimate (per device, bytes).  ``env`` is
+    the infer pass's name -> (shape, dtype) environment; ``axes`` the
+    mesh's {axis: extent} map (empty = single device).  Returns None when
+    nothing sizable is known."""
+    from ..fluid import envcontract
+
+    block = program.block(block_idx)
+    gb = program.global_block()
+    dp = axes.get("dp", 1)
+    pdiv = _param_divisors(program, axes)
+
+    def info_of(name):
+        info = env.get(name)
+        if info is None:
+            info = _declared_info(block, name, batch_hint)
+        return info
+
+    def is_persistable(name) -> bool:
+        return block._has_var_recursive(name) \
+            and block._var_recursive(name).persistable
+
+    # -- persistent residency: every persistable var, shard-divided --
+    persistent = 0
+    persistent_known = 0
+    per_param: Dict[str, int] = {}
+    for name, v in gb.vars.items():
+        if not getattr(v, "persistable", False):
+            continue
+        b = _nbytes(info_of(name))
+        if b is None:
+            continue
+        b //= max(1, pdiv.get(name, 1))
+        persistent += b
+        persistent_known += 1
+        per_param[name] = b
+
+    # -- transient high-water: liveness walk over the kept ops --
+    ops = [op for op in block.ops if op.type not in _SKIP_OPS]
+    first_write: Dict[str, int] = {}
+    last_use: Dict[str, int] = {}
+    produced_by: Dict[str, tuple] = {}
+    for name in feed_infos:
+        first_write.setdefault(name, 0)
+        last_use.setdefault(name, 0)
+    for idx, op in enumerate(ops):
+        for name in op.input_arg_names:
+            if name:
+                last_use[name] = idx
+        for name in op.output_arg_names:
+            if name:
+                first_write.setdefault(name, idx)
+                last_use[name] = max(last_use.get(name, idx), idx)
+                produced_by.setdefault(name, (idx, op.type))
+    for name in fetch_names:
+        if name in first_write:
+            last_use[name] = len(ops) - 1
+
+    def transient_bytes(name) -> Optional[int]:
+        b = _nbytes(info_of(name))
+        if b is None:
+            return None
+        info = info_of(name)
+        if dp > 1 and info and info[0] and len(info[0]) >= 1 \
+                and int(info[0][0]) % dp == 0 and int(info[0][0]) >= dp:
+            # batch-leading tensors shard over the data axis
+            b //= dp
+        return b
+
+    delta = [0] * (len(ops) + 2)
+    sized: List[tuple] = []  # (name, bytes, birth, death)
+    for name, birth in first_write.items():
+        if is_persistable(name):
+            continue
+        b = transient_bytes(name)
+        if not b:
+            continue
+        death = last_use.get(name, birth)
+        delta[birth] += b
+        delta[death + 1] -= b
+        sized.append((name, b, birth, death))
+    high_water = 0
+    hw_idx = 0
+    running = 0
+    for i in range(len(ops) + 1):
+        running += delta[i]
+        if running > high_water:
+            high_water, hw_idx = running, i
+
+    # -- donation: non-donating programs double-buffer mutated state --
+    donate = program._params_grads is not None \
+        and bool(envcontract.get("PADDLE_TPU_DONATE"))
+    donation_extra = 0
+    if program._params_grads is not None and not donate:
+        mutated = {n for op in ops for n in op.output_arg_names
+                   if n and is_persistable(n)}
+        donation_extra = sum(
+            b for n, b in per_param.items() if n in mutated)
+
+    if persistent_known == 0 and not sized:
+        return None
+
+    # -- per-op attribution at the high-water point --
+    top = []
+    for name, b, birth, death in sized:
+        if birth <= hw_idx <= death:
+            op_idx, op_type = produced_by.get(name, (None, "feed"))
+            top.append({"var": name, "bytes": int(b), "op_idx": op_idx,
+                        "op_type": op_type})
+    top.sort(key=lambda r: -r["bytes"])
+    top = top[:5]
+
+    peak = persistent + donation_extra + high_water
+    return {
+        "peak_bytes": int(peak),
+        "persistent_bytes": int(persistent),
+        "transient_high_water_bytes": int(high_water),
+        "donation_extra_bytes": int(donation_extra),
+        "donated": bool(donate),
+        "high_water_op_idx": int(hw_idx),
+        "mesh_axes": dict(axes),
+        "top": top,
+    }
+
+
+def run_memcheck_pass(program: Program, block_idx: int,
+                      env: Dict[str, object], axes: Dict[str, int],
+                      feed_infos: Dict[str, object], fetch_names,
+                      diags: list, batch_hint: int = 8) -> Optional[dict]:
+    """Append the AN5xx diagnostics; returns the estimate dict (None when
+    nothing is statically sizable)."""
+    from . import Diagnostic
+    from ..fluid import envcontract
+
+    est = estimate_program_memory(program, env or {}, axes, feed_infos,
+                                  fetch_names, batch_hint=batch_hint,
+                                  block_idx=block_idx)
+    if est is None:
+        return None
+    mb = est["peak_bytes"] / (1 << 20)
+    label = "x".join(f"{a}{n}" for a, n in axes.items()) or "single-device"
+    attrib = ", ".join(
+        f"{r['var']}[{r['bytes']}B"
+        + (f" @op#{r['op_idx']}({r['op_type']})"
+           if r["op_idx"] is not None else "") + "]"
+        for r in est["top"][:3])
+    diags.append(Diagnostic(
+        "AN501", "info",
+        f"pre-flight peak-HBM estimate: {mb:.2f} MB per device on "
+        f"{label} (persistent {est['persistent_bytes']} B + transient "
+        f"high-water {est['transient_high_water_bytes']} B at op "
+        f"#{est['high_water_op_idx']}"
+        + (f" + non-donated state {est['donation_extra_bytes']} B"
+           if est["donation_extra_bytes"] else "")
+        + (f"; top live: {attrib}" if attrib else "") + ")",
+        hint="compare with the memory.peak_bytes gauge after compile"))
+    try:
+        from .. import observe
+
+        observe.registry().set_gauge(
+            "analysis.mem_peak_est", float(est["peak_bytes"]),
+            labels={"mesh": label} if axes else None)
+    except Exception:
+        pass
+    budget_mb = envcontract.get("PADDLE_MEM_BUDGET_MB")
+    if budget_mb is not None:
+        budget_mb = float(budget_mb)
+        if mb > budget_mb:
+            diags.append(Diagnostic(
+                "AN502", "error",
+                f"estimated peak HBM {mb:.2f} MB exceeds "
+                f"PADDLE_MEM_BUDGET_MB={budget_mb:g} on {label}"
+                + (f"; top live: {attrib}" if attrib else ""),
+                hint="shrink the batch/window, shard over more mesh axes, "
+                     "or raise the budget — this program would "
+                     "RESOURCE_EXHAUSTED after seconds of compile"))
+        elif mb > 0.9 * budget_mb:
+            diags.append(Diagnostic(
+                "AN503", "warn",
+                f"estimated peak HBM {mb:.2f} MB is within 10% of "
+                f"PADDLE_MEM_BUDGET_MB={budget_mb:g} on {label}",
+                hint="fragmentation and padding eat the remaining "
+                     "headroom first; treat this as over budget"))
+    return est

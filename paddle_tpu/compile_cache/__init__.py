@@ -101,12 +101,14 @@ class _Probe:
     AND compiles).  ``finish`` is idempotent and never raises — cache
     bookkeeping must not fail the run it measures."""
 
-    __slots__ = ("store", "fp", "hit", "done")
+    __slots__ = ("store", "fp", "hit", "done", "manifest")
 
-    def __init__(self, store: CompileCacheStore, fp: str, hit: bool):
+    def __init__(self, store: CompileCacheStore, fp: str, hit: bool,
+                 manifest: Optional[dict] = None):
         self.store = store
         self.fp = fp
         self.hit = hit
+        self.manifest = manifest
         self.done = False
 
     def finish(self, seconds: float, program=None,
@@ -128,6 +130,18 @@ class _Probe:
                          fingerprint=self.fp[:12],
                          first_dispatch_s=round(float(seconds), 6),
                          kind=(meta or {}).get("kind"))
+            if self.hit and isinstance(self.manifest, dict) \
+                    and isinstance(self.manifest.get("memory"), dict):
+                # the per-executable memory table persisted at compile
+                # time: a warm start republishes the memory.peak_bytes
+                # gauge family WITHOUT re-lowering anything
+                from ..observe import memory as _obsmem
+
+                _obsmem.note_compiled_memory(
+                    self.manifest["memory"],
+                    mesh=self.manifest.get("mesh"),
+                    kind=self.manifest.get("kind"),
+                    n_steps=self.manifest.get("n_steps"), cached=True)
             if not self.hit and program is not None:
                 m = dict(meta or {})
                 m["compile_seconds"] = round(float(seconds), 6)
@@ -160,12 +174,12 @@ def executor_probe(program, feed_arrays=None, fetch_names=None,
         fp = program_fingerprint(program, feeds=feeds,
                                  fetches=list(fetch_names or []),
                                  extra=extra, spec_table=spec_table)
-        hit = store.get(fp) is not None
+        manifest = store.get(fp)
         from .. import observe
 
         # every event the run emits from here on correlates to this program
         observe.note_program(fp[:12])
-        return _Probe(store, fp, hit)
+        return _Probe(store, fp, manifest is not None, manifest)
     except Exception:
         try:
             from ..fluid import profiler as _prof

@@ -1,13 +1,25 @@
-"""Estimate a Program's training memory footprint (ref:
-python/paddle/fluid/contrib/memory_usage_calc.py — sums var sizes with a
-batch-size substitution for the -1 dim and reports a low/high band).
+"""DEPRECATED shim: estimate a Program's training memory footprint.
 
-On TPU the estimate approximates HBM residency of the jitted step:
-parameters + optimizer accumulators persist; activations are bounded by
-the per-var sum (XLA's actual liveness reuse makes the true peak lower, so
-the band below brackets it the same way the reference's +-30% does)."""
+The hand-rolled sum-every-var heuristic this module shipped (ref:
+python/paddle/fluid/contrib/memory_usage_calc.py) is retired — it priced
+every intermediate at full size forever, with no liveness, no donation
+and no sharding awareness.  :func:`memory_usage` keeps its public
+signature but now delegates to the real pre-flight estimator,
+``paddle_tpu.analysis.memcheck.estimate_program_memory`` (the AN5xx
+verifier pass: persistent state + activation high-water over the block,
+donation-aware), and brackets that estimate the same ±30% the reference
+did.  New code should call the estimator directly — or read the
+``memory.peak_bytes`` compiled-truth gauge (``paddle_tpu.observe.memory``)
+after lowering — instead of this band.
+
+The legacy math survives as :func:`_legacy_memory_usage` purely so the
+regression suite can prove the delegation is same-or-better against the
+compiled truth.
+"""
 
 from __future__ import annotations
+
+import warnings
 
 import numpy as np
 
@@ -20,11 +32,9 @@ DTYPE_TO_SIZE = {
 }
 
 
-def memory_usage(program: Program = None, batch_size: int = 1):
-    """Returns (low_MB, high_MB) for one training step at batch_size."""
-    program = program or default_main_program()
-    if batch_size <= 0:
-        raise ValueError("batch_size must be positive")
+def _legacy_memory_usage(program: Program, batch_size: int):
+    """The retired heuristic: sum EVERY var at full size (no liveness),
+    ±30% band.  Kept only as the regression baseline."""
     total = 0.0
     for var in program.list_vars():
         shape = var.shape
@@ -38,5 +48,36 @@ def memory_usage(program: Program = None, batch_size: int = 1):
             continue
         total += float(np.prod(dims)) * item if dims else item
     mb = total / (1024.0 ** 2)
-    # the reference brackets its estimate at +-30%
+    return mb * 0.7, mb * 1.3
+
+
+def memory_usage(program: Program = None, batch_size: int = 1):
+    """Returns (low_MB, high_MB) for one training step at batch_size.
+
+    DEPRECATED: delegates to the AN5xx pre-flight estimator
+    (``paddle_tpu.analysis.memcheck``); prefer calling that directly, or
+    reading the compiled ``memory.peak_bytes`` gauge."""
+    warnings.warn(
+        "fluid.contrib.memory_usage_calc.memory_usage is deprecated; use "
+        "paddle_tpu.analysis.memcheck.estimate_program_memory (pre-flight)"
+        " or the memory.peak_bytes gauge (compiled truth) instead",
+        DeprecationWarning, stacklevel=2)
+    program = program or default_main_program()
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    try:
+        from ...analysis import _feed_infos
+        from ...analysis.infer import run_infer_pass
+        from ...analysis.memcheck import estimate_program_memory
+
+        feed_infos, _ = _feed_infos(program, None, batch_size)
+        env = run_infer_pass(program, 0, feed_infos, [], batch_size)
+        est = estimate_program_memory(program, env, {}, feed_infos, [],
+                                      batch_hint=batch_size)
+    except Exception:
+        est = None
+    if est is None or est.get("peak_bytes", 0) <= 0:
+        return _legacy_memory_usage(program, batch_size)
+    mb = est["peak_bytes"] / (1024.0 ** 2)
+    # keep the reference's ±30% bracket around the (much tighter) center
     return mb * 0.7, mb * 1.3

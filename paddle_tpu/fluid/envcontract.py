@@ -254,6 +254,22 @@ declare("PADDLE_FAULT_DATA_STALL_AT", "int", None, "fault",
         "Fire the data stall once, at this source sample cursor")
 declare("PADDLE_FAULT_SHARD_CORRUPT", "bool", False, "fault",
         "Truncate the next data_state blob write (one-shot)")
+declare("PADDLE_FAULT_MEM_PRESSURE", "float", 0.0, "fault",
+        "Synthesize a memory leak: after PADDLE_FAULT_MEM_PRESSURE_AT "
+        "ledger observations, add this many MB of phantom live bytes, "
+        "doubling per observation (deterministic memory.live_bytes "
+        "breach / budget-overrun oracle)")
+declare("PADDLE_FAULT_MEM_PRESSURE_AT", "int", 8, "fault",
+        "Ledger observation count at which the synthetic leak starts "
+        "(past the SLO watchdog's min-samples baseline)")
+
+# -- memory observability --
+declare("PADDLE_MEM_BUDGET_MB", "float", None, "memory",
+        "Per-device HBM budget: the AN502 pre-flight verifier pass and "
+        "the live-buffer ledger diagnose programs/residency exceeding it")
+declare("PADDLE_MEM_WATERMARK", "bool", True, "memory",
+        "Emit memory.watermark run events (live/high-water bytes) at "
+        "window boundaries (0 keeps the gauges but silences the events)")
 
 # -- data plane --
 declare("PADDLE_DATA_CKPT", "bool", True, "data",

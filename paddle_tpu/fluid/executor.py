@@ -798,14 +798,26 @@ class Executor:
                                       if k in mut_names else v)
                                   for k, v in state_vals.items()}
             if wspan is not None and entry_info.get("cost") is None:
-                # device-time attribution (tracing only — lowering costs
-                # one extra trace, never an extra XLA compile): the
-                # window program's flops/bytes back the device.mfu gauge
+                # device-time + memory attribution (tracing only): the
+                # lowering costs one extra trace; reading memory_analysis
+                # additionally needs a compile, so the traced first window
+                # of an entry pays one extra backend compile (deduped by
+                # the persistent backend cache when enabled) — the price
+                # of the memory.peak_bytes truth gauge on this path
                 try:
-                    entry_info["cost"] = _trace.cost_of(fn.lower(
-                        feed_dev, const_state, mut_state, sentinel)) or False
+                    lowered = fn.lower(feed_dev, const_state, mut_state,
+                                       sentinel)
+                    entry_info["cost"] = _trace.cost_of(lowered) or False
+                    from ..observe import memory as _obsmem
+
+                    entry_info["memory"] = _obsmem.memory_stats(
+                        lowered.compile()) or False
+                    _obsmem.note_compiled_memory(
+                        entry_info["memory"] or None, kind="run_steps",
+                        n_steps=n_steps)
                 except Exception:
-                    entry_info["cost"] = False
+                    entry_info.setdefault("cost", False)
+                    entry_info["memory"] = False
 
             agg = None
             t = _time.perf_counter()
@@ -831,8 +843,12 @@ class Executor:
             _prof.record_counter("executor.windows")
             _prof.record_counter("executor.window_steps", inc=n_steps)
             if probe is not None:
-                probe.finish(t_disp1 - t, program,
-                             meta={"kind": "run_steps", "n_steps": n_steps})
+                meta = {"kind": "run_steps", "n_steps": n_steps}
+                if isinstance(entry_info.get("memory"), dict):
+                    # per-executable memory table in the cache manifest:
+                    # a warm start re-reports it without re-lowering
+                    meta["memory"] = entry_info["memory"]
+                probe.finish(t_disp1 - t, program, meta=meta)
             if _fault.active() is not None:
                 new_state = _fault.corrupt_state(new_state)
             for name, val in new_state.items():
@@ -849,10 +865,15 @@ class Executor:
                                "feed_per_step": bool(feed_per_step)}})
             if program._params_grads is not None:
                 from .. import observe
+                from ..observe import memory as _obsmem
 
                 # events emitted after the window (checkpoint commits, cache
                 # probes) correlate to its LAST executed step, not its first
                 observe.note_step(window_start + n_steps - 1)
+                # live-buffer ledger: scope residency + watermark at the
+                # window boundary (gauges, high-water, watchdog feed)
+                _obsmem.note_scope_live(scope, scope_label="train",
+                                        step=window_start + n_steps - 1)
             t_obs1 = _time.perf_counter()
             if wspan is not None:
                 # child spans: H2D staging / device dispatch / host observe
@@ -1083,6 +1104,7 @@ class Executor:
                 "state": dump_state, "sentinel": sentinel,
                 "duration_s": _time.perf_counter() - t})
         if program._params_grads is not None:
+            from ..observe import memory as _obsmem
             from ..observe import watchdog as _watchdog
 
             # SLO watchdog on the per-step training path (no-op unless
@@ -1090,6 +1112,10 @@ class Executor:
             # submit-to-submit pacing, which is what regresses under load
             _watchdog.observe_value("executor.step_time_s",
                                     _time.perf_counter() - t, step=step_idx)
+            # ledger gauges only (quiet): per-step watermark EVENTS would
+            # flood the stream — windows own the event cadence
+            _obsmem.note_scope_live(scope, scope_label="train",
+                                    step=step_idx, emit_event=False)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         from .lod_tensor import LoDTensor
@@ -1108,6 +1134,59 @@ class Executor:
                 v = jnp.array(v, copy=True)
             out.append(LoDTensor(v, lod_box.get(n)))
         return out
+
+    def compiled_memory_stats(self, program, feed, fetch_list, scope=None):
+        """Compiled-truth memory stats for one (program, feed)
+        specialization: AOT lower + compile the SAME traced step
+        ``Executor.run`` would jit and read the backend's
+        ``memory_analysis()``.  Costs one backend compile (deduped by the
+        persistent backend cache when enabled) — callers own that
+        decision: ``ServingEngine.warmup`` (the precompile path by
+        definition) and the memcheck cross-check tests.  Returns the
+        ``observe.memory.memory_stats`` dict, or None (eager-island
+        programs, backends without memory analysis)."""
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list or []]
+        feed_arrays = {}
+        for k, v in dict(feed or {}).items():
+            arr, lod = self._coerce_feed(program, k, v)
+            if lod:
+                return None  # LoD programs re-trace per lod; no one truth
+            feed_arrays[k] = arr
+        program = self._prune_for_unfed(program, feed_arrays, fetch_names,
+                                        scope)
+        plan = BlockPlan(program, 0, list(feed_arrays), fetch_names)
+        if plan.needs_eager:
+            return None
+        try:
+            fn = self._build(program, plan)
+            device = core.get_jax_device(self.place)
+
+            def norm(v):
+                # a scope that last committed a SHARDED run holds mesh
+                # arrays; gather them so the probe lowers single-device
+                if isinstance(v, jax.Array) and len(v.devices()) > 1:
+                    v = np.asarray(v)
+                return jax.device_put(jnp.asarray(v), device)
+
+            state_vals = {k: norm(v) for k, v in
+                          self._gather_state(program, plan, scope).items()}
+            mut_names = set(plan.state_out)
+            if plan.needs_rng:
+                mut_names.add(RNG_STATE_VAR)
+            mut_state = {k: v for k, v in state_vals.items()
+                         if k in mut_names}
+            const_state = {k: v for k, v in state_vals.items()
+                           if k not in mut_names}
+            feed_dev = {k: jax.device_put(jnp.asarray(v), device)
+                        for k, v in feed_arrays.items()}
+            compiled = fn.lower(feed_dev, const_state, mut_state).compile()
+            from ..observe import memory as _obsmem
+
+            return _obsmem.memory_stats(compiled)
+        except Exception:
+            return None
 
     # -- helpers --
     @staticmethod

@@ -62,6 +62,14 @@ worker processes:
                                   (one-shot): the resumed run must detect
                                   the corrupt cursor and fall back to the
                                   previous complete serial
+    PADDLE_FAULT_MEM_PRESSURE=mb  synthesize a memory leak: starting at the
+                                  PADDLE_FAULT_MEM_PRESSURE_AT-th (default
+                                  8th) live-buffer-ledger observation, add
+                                  mb MB of phantom live bytes, DOUBLING per
+                                  observation — the deterministic oracle
+                                  for the memory.live_bytes SLO breach and
+                                  the PADDLE_MEM_BUDGET_MB over-budget
+                                  event (see observe.memory)
     PADDLE_FAULT_MODE=exit|raise  crash flavor: hard process exit (default)
                                   or an InjectedFault raise (in-process
                                   tests of the recovery path)
@@ -92,7 +100,8 @@ __all__ = [
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
     "barrier_stall", "serving_request", "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
-    "shard_corrupt", "current_step", "KILL_EXIT_CODE",
+    "shard_corrupt", "mem_pressure_bytes", "current_step",
+    "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -123,6 +132,8 @@ class FaultPlan:
                  data_stall_ms: float = 0.0,
                  data_stall_at: Optional[int] = None,
                  shard_corrupt: bool = False,
+                 mem_pressure_mb: float = 0.0,
+                 mem_pressure_at: int = 8,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -148,6 +159,8 @@ class FaultPlan:
         self.data_stall_at = None if data_stall_at is None \
             else int(data_stall_at)
         self.shard_corrupt = bool(shard_corrupt)
+        self.mem_pressure_mb = float(mem_pressure_mb)
+        self.mem_pressure_at = int(mem_pressure_at)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
@@ -156,6 +169,7 @@ class FaultPlan:
         self._serve_count = 0
         self._data_stall_fired = False
         self._shard_corrupt_fired = False
+        self._mem_pressure_calls = 0
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultPlan"]:
@@ -190,6 +204,8 @@ class FaultPlan:
             data_stall_at=int(stall_at) if stall_at else None,
             shard_corrupt=env.get("PADDLE_FAULT_SHARD_CORRUPT", "").strip()
             .lower() in ("1", "true", "yes"),
+            mem_pressure_mb=getf("PADDLE_FAULT_MEM_PRESSURE"),
+            mem_pressure_at=int(getf("PADDLE_FAULT_MEM_PRESSURE_AT", 8)),
             rank=int(rank) if rank else None,
             mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
         )
@@ -415,6 +431,24 @@ def shard_corrupt() -> bool:
         return False
     plan._shard_corrupt_fired = True
     return True
+
+
+def mem_pressure_bytes() -> int:
+    """Synthetic-leak oracle, consulted by the live-buffer ledger once per
+    observation: zero until the ``mem_pressure_at``-th call, then
+    ``mem_pressure_mb`` MB doubling per observation — deterministic
+    monotonic growth that trips the SLO watchdog's factor-over-median
+    breach (and, with ``PADDLE_MEM_BUDGET_MB`` set, the over-budget
+    event) within a few windows, like a real accumulating leak."""
+    plan = active()
+    if plan is None or plan.mem_pressure_mb <= 0 \
+            or not plan._applies_to_this_rank():
+        return 0
+    plan._mem_pressure_calls += 1
+    past = plan._mem_pressure_calls - plan.mem_pressure_at
+    if past <= 0:
+        return 0
+    return int(plan.mem_pressure_mb * (1 << 20)) << min(past - 1, 16)
 
 
 def barrier_stall(tag: str = "") -> None:

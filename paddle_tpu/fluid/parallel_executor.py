@@ -194,6 +194,12 @@ class ParallelExecutor:
         fetches, new_state = step(feed_dev, state_vals)
         for name, val in new_state.items():
             self._scope.set(name, val)
+        if self._program._params_grads is not None:
+            from ..observe import memory as _obsmem
+
+            # ledger gauges only — per-step events would flood the stream
+            _obsmem.note_scope_live(self._scope, scope_label="train",
+                                    mesh=self.mesh_label, emit_event=False)
         if return_numpy:
             return [step.fetch_to_host(v) for v in fetches]
         return list(fetches)

@@ -165,6 +165,10 @@ class DevicePrefetcher:
         self._stage_spans: deque = deque()
         self._parent_span = None
         self.last_stage_span: Optional[str] = None
+        # live-buffer ledger (observe.memory): bytes of each staged-but-
+        # unconsumed window, FIFO next to the stage spans — staging adds
+        # to the "prefetch" scope, consumption hands the bytes off
+        self._staged_bytes: deque = deque()
 
     # -- staging --
     def _stage(self, batches) -> Tuple[Dict[str, object], int]:
@@ -190,6 +194,12 @@ class DevicePrefetcher:
             self._stage_spans.append(sp.span_id)
         else:
             self._stage_spans.append(None)
+        from ..observe import memory as _obsmem
+
+        nbytes = sum(int(getattr(v, "nbytes", 0) or 0)
+                     for v in placed.values())
+        self._staged_bytes.append(nbytes)
+        _obsmem.adjust_staged(nbytes)
         return placed, len(batches)
 
     def __iter__(self):
@@ -208,13 +218,23 @@ class DevicePrefetcher:
                 item = self._stage(batches)
                 self.last_stage_span = (self._stage_spans.popleft()
                                         if self._stage_spans else None)
+                self._consume_staged()
                 yield item
             return
         for item in _background_iter(wins, self._stage, self.depth,
                                      self._abort):
             self.last_stage_span = (self._stage_spans.popleft()
                                     if self._stage_spans else None)
+            self._consume_staged()
             yield item
+
+    def _consume_staged(self) -> None:
+        """Hand the oldest staged window's bytes off the prefetch scope
+        (ownership moved to the consumer's dispatch)."""
+        if self._staged_bytes:
+            from ..observe import memory as _obsmem
+
+            _obsmem.adjust_staged(-self._staged_bytes.popleft())
 
     def close(self) -> None:
         """Stop the staging thread; safe to call repeatedly."""

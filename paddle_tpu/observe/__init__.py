@@ -49,8 +49,9 @@ __all__ = [
     "note_mesh", "current_step", "current_program", "current_mesh",
     "http_server", "ENV_DIR", "ENV_FLUSH", "ENV_PORT",
     # submodules re-exported for discoverability: observe.trace (span
-    # tracer + device-time attribution), observe.watchdog (SLO breaches)
-    "trace", "watchdog",
+    # tracer + device-time attribution), observe.watchdog (SLO breaches),
+    # observe.memory (HBM accounting + live-buffer ledger)
+    "trace", "watchdog", "memory",
 ]
 
 ENV_DIR = "PADDLE_OBSERVE_DIR"
@@ -255,13 +256,15 @@ def reset() -> None:
     _step = None
     _program = None
     _mesh = None
-    # span tracer + SLO watchdog piggyback on the sink lifecycle: re-arm
-    # their env late-binding with it
+    # span tracer + SLO watchdog + memory ledger piggyback on the sink
+    # lifecycle: re-arm their env late-binding / clear their state with it
+    from . import memory as _memory
     from . import trace as _trace
     from . import watchdog as _watchdog
 
     _trace.reset()
     _watchdog.reset()
+    _memory.reset()
 
 
 def http_server():
@@ -311,5 +314,6 @@ def span(event: str, **fields):
 
 
 # submodules imported last (they only import observe lazily, so there is
-# no cycle): observe.trace / observe.watchdog are part of the public API
-from . import trace, watchdog  # noqa: E402,F401  (re-export)
+# no cycle): observe.trace / observe.watchdog / observe.memory are part
+# of the public API
+from . import memory, trace, watchdog  # noqa: E402,F401  (re-export)

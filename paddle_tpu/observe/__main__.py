@@ -15,6 +15,12 @@ Commands (default dir: $PADDLE_OBSERVE_DIR, overridable via --dir)::
                                      # span trees: every trace in the
                                      # merged stream as an indented tree
                                      # (durations, host:rank:gen stamps)
+    python -m paddle_tpu.observe memory
+                                     # HBM summary: memory.* gauges,
+                                     # latest memory.profile per
+                                     # executable, ledger high-water,
+                                     # serving bucket bytes, over-budget
+                                     # incidents
     python -m paddle_tpu.observe --smoke
                                      # CI round-trip oracle (tier-1, <2s
                                      # after interpreter start; pattern of
@@ -136,6 +142,51 @@ def cmd_trace(args) -> int:
                           "note": "no span records found (is tracing "
                                   "enabled? PADDLE_TRACE / an observe "
                                   "dir must be set on the traced run)"}))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """HBM summary: compiled-truth gauges, latest memory.profile per
+    executable kind/mesh, ledger high-water per (scope, mesh), serving
+    bucket footprints and over-budget incidents — the text answer to
+    'what is this fleet spending device memory on'."""
+    from .fleet import fleet_events, fleet_snapshot
+
+    root = _dir_or_die(args)
+    snap = fleet_snapshot(root)
+    gauges = {name: by for name, by in snap["gauges_by_worker"].items()
+              if name.startswith(("memory.", "serving.bucket_bytes",
+                                  "analysis.mem_peak_est"))}
+    profiles = {}
+    watermarks = {}
+    over_budget = []
+    for r in fleet_events(root):
+        ev = r.get("event")
+        if ev == "memory.profile":
+            key = f"{r.get('kind') or '?'}@{r.get('mesh') or 'single'}"
+            profiles[key] = {k: r.get(k) for k in (
+                "peak_bytes", "argument_bytes", "output_bytes",
+                "temp_bytes", "generated_code_bytes", "cached", "n_steps",
+                "ts")}
+        elif ev == "memory.watermark":
+            key = f"{r.get('scope') or '?'}@{r.get('mesh') or 'single'}"
+            cur = watermarks.get(key, {})
+            watermarks[key] = {
+                "live_bytes": r.get("live_bytes"),
+                "high_water_bytes": max(cur.get("high_water_bytes") or 0,
+                                        r.get("high_water_bytes") or 0),
+                "samples": cur.get("samples", 0) + 1}
+        elif ev == "memory.over_budget":
+            over_budget.append({k: r.get(k) for k in (
+                "ts", "scope", "mesh", "total_bytes", "budget_mb")})
+    print(json.dumps({"root": snap["root"],
+                      "workers": snap["workers"],
+                      "partial": snap.get("partial", []),
+                      "gauges_by_worker": gauges,
+                      "profiles": profiles,
+                      "watermarks": watermarks,
+                      "over_budget": over_budget[-10:]},
+                     indent=1, sort_keys=True))
     return 0
 
 
@@ -287,7 +338,8 @@ def main(argv=None) -> int:
         prog="python -m paddle_tpu.observe",
         description="Inspect / export / serve observability data.")
     ap.add_argument("command", nargs="?", default="summary",
-                    choices=["tail", "summary", "export", "serve", "trace"])
+                    choices=["tail", "summary", "export", "serve", "trace",
+                             "memory"])
     ap.add_argument("--dir", default=None,
                     help="observe dir (default $PADDLE_OBSERVE_DIR)")
     ap.add_argument("--n", type=int, default=20, help="tail: line count")
@@ -309,7 +361,7 @@ def main(argv=None) -> int:
     try:
         return {"tail": cmd_tail, "summary": cmd_summary,
                 "export": cmd_export, "serve": cmd_serve,
-                "trace": cmd_trace}[args.command](args)
+                "trace": cmd_trace, "memory": cmd_memory}[args.command](args)
     except BrokenPipeError:
         # `... | head` closing stdout early is normal unix usage, not an
         # error worth a traceback

@@ -196,6 +196,17 @@ def chrome_trace(records: List[dict],
         # never overlap the executor's window spans on one track; legacy
         # records without a tid keep the per-generation rows
         tid = r.get("tid", r.get("gen", 0))
+        # records carrying a `counters` dict ({metric name: value} — the
+        # memory.watermark events) additionally render as "ph": "C"
+        # counter tracks, so HBM residency draws alongside the spans
+        counters = r.get("counters")
+        if isinstance(counters, dict):
+            for cname, cval in sorted(counters.items()):
+                if isinstance(cval, (int, float)) \
+                        and not isinstance(cval, bool):
+                    trace_events.append({"ph": "C", "pid": pid,
+                                         "ts": ts_us, "name": str(cname),
+                                         "args": {"value": cval}})
         if r.get("dur_s") is not None:
             dur_us = float(r["dur_s"]) * 1e6
             trace_events.append({"ph": "X", "cat": "event",

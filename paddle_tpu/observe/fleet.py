@@ -50,12 +50,21 @@ def _load_metrics(path: str) -> Optional[dict]:
 
 
 def fleet_snapshot(root: str) -> dict:
-    """Aggregate every worker's newest metric snapshot under ``root``."""
+    """Aggregate every worker's newest metric snapshot under ``root``.
+
+    Partial-fleet tolerance (ISSUE 11 satellite): a missing, truncated or
+    corrupt per-rank snapshot must not take the whole view down — the
+    surviving ranks merge, the casualties are listed under ``partial``,
+    and one ``fleet.partial`` run event is emitted (into the aggregating
+    process's own sink, when it has one) so the degradation is visible in
+    the stream instead of silently under-counting the fleet."""
     workers: Dict[str, dict] = {}
     latest: Dict[tuple, dict] = {}  # (host, rank) -> snapshot of max gen
+    partial: List[str] = []
     for path in scan_dir(root)["metrics"]:
         snap = _load_metrics(path)
         if snap is None:
+            partial.append(os.path.basename(path))
             continue
         meta = snap.get("meta", {})
         host = meta.get("host", os.path.basename(path))
@@ -75,10 +84,19 @@ def fleet_snapshot(root: str) -> dict:
         for name, v in snap.get("gauges", {}).items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 gauges.setdefault(name, {})[label] = v
+    if partial:
+        try:
+            from . import emit
+
+            emit("fleet.partial", root=os.path.abspath(root),
+                 skipped=sorted(partial), survivors=sorted(workers))
+        except Exception:
+            pass
     return {"ts": time.time(), "root": os.path.abspath(root),
             "workers": sorted(workers),
             "counters_sum": summed,
             "gauges_by_worker": gauges,
+            "partial": sorted(partial),
             "per_worker": workers}
 
 

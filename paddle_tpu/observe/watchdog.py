@@ -26,7 +26,11 @@ Fed from the paths that matter (all no-ops until ``PADDLE_SLO=1``):
    emits a ``data.stall`` run event;
  - ``serving.latency_s``     — per-request queue+execute latency (tail
    regressions surface here before the lifetime p99 moves);
- - ``serving.queue_depth``   — the admission queue depth gauge.
+ - ``serving.queue_depth``   — the admission queue depth gauge;
+ - ``memory.live_bytes``     — the live-buffer ledger's total device
+   residency (``observe.memory``): monotonic growth across windows or
+   elastic generations breaches like a slow step — leak detection; the
+   ``PADDLE_FAULT_MEM_PRESSURE`` ramp is its deterministic oracle.
 
 Env contract (``fluid.envcontract``): ``PADDLE_SLO`` arms it,
 ``PADDLE_SLO_FACTOR`` (default 3.0) is the regression factor,

@@ -741,6 +741,7 @@ class ShardedWindowRunner:
         self._compiled = None
         self.collectives: Optional[dict] = None
         self.cost: Optional[dict] = None
+        self.memory: Optional[dict] = None
 
     # -- placement --
     def place_feed_window(self, feed: Dict[str, object]):
@@ -780,12 +781,19 @@ class ShardedWindowRunner:
         and publish what GSPMD inserted as mesh-labeled gauges — plus the
         executable's cost analysis (flops / bytes accessed), which backs
         the ``device.mfu{mesh=...}`` attribution gauges per dispatch."""
+        from ..observe import memory as _obsmem
         from ..observe import trace as _trace
 
         try:
             self.cost = _trace.cost_of(self._compiled)
         except Exception:
             self.cost = None
+        # compiled memory truth: the AOT executable is already in hand, so
+        # the memory.peak_bytes{mesh=} gauge family is free on this path
+        self.memory = _obsmem.memory_stats(self._compiled)
+        _obsmem.note_compiled_memory(self.memory, mesh=self.label,
+                                     kind="sharded_window",
+                                     n_steps=self.n_steps)
         try:
             txt = self._compiled.as_text()
         except Exception:
@@ -951,9 +959,13 @@ class ShardedWindowRunner:
         reg.inc("executor.windows", labels=labels)
         reg.inc("executor.window_steps", self.n_steps, labels=labels)
         if probe is not None:
-            probe.finish(dt, self.program,
-                         meta={"kind": "sharded_window",
-                               "n_steps": self.n_steps, "mesh": self.label})
+            meta = {"kind": "sharded_window", "n_steps": self.n_steps,
+                    "mesh": self.label}
+            if isinstance(self.memory, dict):
+                # per-executable memory table in the cache manifest, so a
+                # warm start re-reports HBM truth without re-lowering
+                meta["memory"] = self.memory
+            probe.finish(dt, self.program, meta=meta)
         if _fault.active() is not None:
             new_state = _fault.corrupt_state(new_state)
         for name, val in new_state.items():
@@ -970,6 +982,13 @@ class ShardedWindowRunner:
                            "feed_per_step": self.feed_per_step}})
         if self.program._params_grads is not None:
             observe.note_step(window_start + self.n_steps - 1)
+            from ..observe import memory as _obsmem
+
+            # live-buffer ledger: mesh-labeled scope residency + watermark
+            # at the window boundary
+            _obsmem.note_scope_live(scope, scope_label="train",
+                                    mesh=self.label,
+                                    step=window_start + self.n_steps - 1)
         t_obs1 = _time.perf_counter()
         if wspan is not None:
             # per-window breakdown: feed/state staging, device dispatch,

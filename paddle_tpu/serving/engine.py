@@ -492,9 +492,16 @@ class ServingEngine:
         dispatch.
 
         The bucket manifest (bucket list, per-feed row shapes/dtypes,
-        per-bucket fingerprints) is written ATOMICALLY (tmp+rename) after
-        warmup — including when the cache subsystem is disabled, provided
+        per-bucket fingerprints, per-bucket compiled MEMORY stats) is
+        written ATOMICALLY (tmp+rename) after warmup — including when the
+        cache subsystem is disabled, provided
         ``ServingConfig.manifest_path`` names a destination.
+
+        Memory accounting (ISSUE 11): every dispatched bucket's compiled
+        ``memory_analysis()`` lands on the
+        ``serving.bucket_bytes{bucket=...}`` gauge and in the manifest;
+        a cached re-warm re-reports the SAME numbers from the manifest /
+        store entry without re-lowering anything.
 
         Returns the bucket list.  Safe to call again."""
         from .. import compile_cache as _cc
@@ -510,25 +517,41 @@ class ServingEngine:
         else:
             row_feed = self._rows_from_manifest() or self._zero_rows()
         fps = self._bucket_fingerprints(row_feed)
+        prev_memory = (self._read_manifest() or {}).get("memory", {})
+        mem_table: Dict[str, dict] = {}
         for b in self.config.buckets():
             fp = fps.get(b)
-            if only_missing and store is not None and fp is not None \
-                    and store.get(fp) is not None:
-                # compiled by a prior process into the shared store: the
-                # executable loads lazily from disk on first use
-                self.metrics.inc("warmup_cached")
-                continue
+            if only_missing and store is not None and fp is not None:
+                entry = store.get(fp)
+                if entry is not None:
+                    # compiled by a prior process into the shared store:
+                    # the executable loads lazily from disk on first use,
+                    # and its memory stats re-report from the manifest —
+                    # no re-lowering on the cached re-warm path
+                    self.metrics.inc("warmup_cached")
+                    stats = prev_memory.get(str(b)) or entry.get("memory")
+                    if isinstance(stats, dict):
+                        mem_table[str(b)] = stats
+                        self._note_bucket_memory(b, stats, cached=True)
+                    continue
             feed_b = {k: np.concatenate([v] * b, axis=0)
                       for k, v in row_feed.items()}
             self._run_bucket(feed_b, b, b)
             self.metrics.inc("warmup_dispatches")
+            stats = self._bucket_memory(feed_b)
+            if isinstance(stats, dict):
+                mem_table[str(b)] = stats
+                self._note_bucket_memory(b, stats, cached=False)
             if store is not None and fp is not None:
                 try:
+                    meta = {"kind": "serving_bucket", "bucket": int(b)}
+                    if isinstance(stats, dict):
+                        meta["memory"] = stats
                     store.put(fp, self._pred._program.serialize_to_string(),
-                              {"kind": "serving_bucket", "bucket": int(b)})
+                              meta)
                 except Exception:
                     pass  # cache bookkeeping never fails warmup
-        self._write_manifest(row_feed, fps)
+        self._write_manifest(row_feed, fps, mem_table)
         with self._cond:
             self._warm = True
         from .. import observe
@@ -536,8 +559,36 @@ class ServingEngine:
         observe.emit(
             "serving.warmup", buckets=self.config.buckets(),
             dispatched=self.metrics.counter("warmup_dispatches"),
-            cached=self.metrics.counter("warmup_cached"))
+            cached=self.metrics.counter("warmup_cached"),
+            bucket_bytes={b: s.get("peak_bytes")
+                          for b, s in sorted(mem_table.items())} or None)
         return self.config.buckets()
+
+    def _bucket_memory(self, feed_b) -> Optional[dict]:
+        """Compiled-truth memory stats for one bucket's feed shapes via
+        the executor's AOT probe (one extra backend compile on the
+        warmup/precompile path; the persistent backend cache dedupes it).
+        Best-effort: None never fails warmup."""
+        exe = getattr(self._pred, "_exe", None)
+        prog = getattr(self._pred, "_program", None)
+        if exe is None or prog is None:
+            return None
+        try:
+            return exe.compiled_memory_stats(
+                prog, feed_b, self._fetch_names,
+                scope=getattr(self._pred, "_scope", None))
+        except Exception:
+            return None
+
+    def _note_bucket_memory(self, bucket: int, stats: dict,
+                            cached: bool) -> None:
+        from ..observe import memory as _obsmem
+
+        peak = stats.get("peak_bytes")
+        if isinstance(peak, (int, float)) and peak > 0:
+            self.metrics.note_bucket_bytes(bucket, peak)
+        _obsmem.note_compiled_memory(stats, kind="serving_bucket",
+                                     cached=cached)
 
     # -- bucket manifest + fingerprints --
     def _manifest_path(self) -> Optional[str]:
@@ -575,7 +626,7 @@ class ServingEngine:
             return {}
         return fps
 
-    def _write_manifest(self, row_feed, fps) -> None:
+    def _write_manifest(self, row_feed, fps, mem_table=None) -> None:
         """Atomic (tmp + rename) manifest commit; never fails warmup."""
         path = self._manifest_path()
         if not path:
@@ -590,6 +641,9 @@ class ServingEngine:
                       for k, v in sorted(row_feed.items())],
             "fetches": list(self._fetch_names),
             "fingerprints": {str(b): fp for b, fp in fps.items()},
+            # per-bucket compiled memory stats: the cached re-warm path
+            # re-reports serving.bucket_bytes from here, no re-lowering
+            "memory": dict(mem_table or {}),
         }
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -600,20 +654,29 @@ class ServingEngine:
         except OSError:
             pass
 
-    def _rows_from_manifest(self) -> Optional[Dict[str, np.ndarray]]:
-        """Zero rows shaped from a previously persisted manifest, so a
-        restarted predictor can warm the same bucket set without sample
-        inputs even when the program's var shapes have unknown dims."""
+    def _read_manifest(self) -> Optional[dict]:
+        """The previously persisted bucket manifest, or None."""
         path = self._manifest_path()
         if not path or not os.path.exists(path):
             return None
         try:
             with open(path) as f:
-                manifest = json.load(f)
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _rows_from_manifest(self) -> Optional[Dict[str, np.ndarray]]:
+        """Zero rows shaped from a previously persisted manifest, so a
+        restarted predictor can warm the same bucket set without sample
+        inputs even when the program's var shapes have unknown dims."""
+        manifest = self._read_manifest()
+        if manifest is None:
+            return None
+        try:
             rows = {name: np.zeros((1,) + tuple(int(d) for d in shape),
                                    dtype=dtype)
                     for name, shape, dtype in manifest["feeds"]}
-        except (OSError, ValueError, KeyError, TypeError):
+        except (ValueError, KeyError, TypeError):
             return None
         if set(rows) != set(self._feed_names):
             return None  # stale manifest from another model

@@ -73,6 +73,17 @@ class ServingMetrics:
             # SLO watchdog on admission-queue depth (no-op unless armed)
             _watchdog.observe_value("serving.queue_depth", value)
 
+    def note_bucket_bytes(self, bucket: int, peak_bytes: float) -> None:
+        """Per-bucket compiled HBM footprint (``ServingEngine.warmup``):
+        the ``serving.bucket_bytes{bucket=...}`` gauge in BOTH registries
+        — capacity planning reads it to answer 'how many replicas fit on
+        one device pool' without re-lowering anything."""
+        self._reg.set_gauge("bucket_bytes", float(peak_bytes),
+                            labels={"bucket": int(bucket)})
+        _global_registry().set_gauge("serving.bucket_bytes",
+                                     float(peak_bytes),
+                                     labels={"bucket": int(bucket)})
+
     def observe_latency(self, seconds: float) -> None:
         """One completed request's queue+execute latency."""
         with self._lock:

@@ -238,6 +238,45 @@ def test_fleet_sums_latest_generation_only(tmp_path):
     assert len(snap["workers"]) == 2  # both generations stay visible
 
 
+def test_fleet_partial_merge_truncated_rank(tmp_path, monkeypatch):
+    """ISSUE 11 satellite: a missing/truncated per-rank snapshot must not
+    take the fleet view down — surviving ranks merge, the casualty is
+    listed under ``partial``, and a ``fleet.partial`` run event lands in
+    the aggregating process's own sink."""
+    from paddle_tpu.observe.export import write_snapshot
+
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    for rank, steps in ((0, 10), (1, 25)):
+        write_snapshot(root, {"counters": {"steps": steps}, "gauges": {},
+                              "histograms": {}},
+                       stem=f"metrics-hostA-r{rank}-g0",
+                       meta={"host": "hostA", "rank": rank, "gen": 0})
+    # rank 2's snapshot is torn mid-write (truncated JSON)
+    with open(os.path.join(root, "metrics-hostA-r2-g0.json"), "w") as f:
+        f.write('{"meta": {"host": "hostA", "rank": 2')
+
+    agg_dir = str(tmp_path / "agg_sink")
+    monkeypatch.setenv("PADDLE_OBSERVE_DIR", agg_dir)
+    observe.reset()
+    snap = fleet_snapshot(root)  # must not raise
+    assert snap["counters_sum"]["steps"] == 35  # survivors merged
+    assert len(snap["workers"]) == 2
+    assert snap["partial"] == ["metrics-hostA-r2-g0.json"]
+    sink = observe.get_sink()
+    assert sink is not None
+    recs = [json.loads(line) for line in open(sink.events.path)]
+    partial = [r for r in recs if r["event"] == "fleet.partial"]
+    assert partial and partial[0]["skipped"] == ["metrics-hostA-r2-g0.json"]
+    assert len(partial[0]["survivors"]) == 2
+    # a truncated EVENTS file degrades the same way: torn lines skip
+    with open(os.path.join(root, "events-hostA-r2-g0.jsonl"), "w") as f:
+        f.write('{"ts": 1.0, "event": "ok", "host": "hostA", "rank": 2, '
+                '"gen": 0, "pid": 1}\n{"ts": 2.0, "event": "torn')
+    evs = fleet_events(root)
+    assert [r["event"] for r in evs] == ["ok"]
+
+
 # ---------------------------------------------------------------------------
 # CLI smoke (tier-1 CI round-trip, pattern of tools/cache_ctl.py --smoke)
 # ---------------------------------------------------------------------------

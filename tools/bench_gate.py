@@ -3,11 +3,13 @@
 The BENCH trajectory (BENCH_r01.json, BENCH_r02.json, ...) records each
 round's headline throughputs; this tool diffs the two newest rounds and
 exits non-zero when any shared metric regressed by more than
-``--threshold`` percent.  It is an OPT-IN check (run it from a pre-merge
-hook or by hand), deliberately NOT wired into tier-1 as blocking: the
-CPU-fallback trajectory is still noisy (probe wedges, shared hosts), and
-a gate that cries wolf gets deleted.  When the numbers stabilize, wire
-``python tools/bench_gate.py --threshold 20`` into CI and let it block.
+``--threshold`` percent.  TIER-1 (ISSUE 11, ROADMAP item 2):
+``tests/test_bench_gate.py`` runs it as a blocking test at a 30%
+threshold — set just above the committed r04→r05 noise band (-26.65%
+ResNet on the still-noisy CPU-fallback trajectory), to be ratcheted down
+as the numbers stabilize — so a flat-regression round fails a PR instead
+of landing silently.  Tighter thresholds remain available for pre-merge
+hooks and by-hand runs.
 
 Metric extraction: every line of a round's ``tail`` that parses as JSON
 with ``metric``/``value`` keys contributes (the per-model lines AND the

@@ -1,0 +1,165 @@
+"""Memory-observability smoke (CPU, 8 forced host devices, < 5 s).
+
+The CI oracle for ISSUE 11's three tiers in one run:
+
+ 1. **compiled truth** — a GUARDED dp2×tp2 windowed training run must
+    publish a nonzero ``memory.peak_bytes{mesh=dp2xtp2}`` gauge and a
+    ``memory.profile`` run event read from the real
+    ``compiled.memory_analysis()`` of the AOT window executable;
+ 2. **pre-flight** — the AN501 static estimate for the same program on
+    the same mesh must land within a 4x factor band of the compiled
+    per-device peak (the window stacks N_STEPS feeds the one-step
+    estimate never sees, so the band is wider than the single-device
+    cross-check test's 2x), and a seeded 1 MB budget must produce the
+    exact AN502 over-budget code;
+ 3. **ledger** — the windowed run must leave ``memory.live_bytes`` /
+    ``memory.live_high_water_bytes`` gauges and a ``memory.watermark``
+    event whose ``counters`` field round-trips through the chrome-trace
+    exporter as a ``"ph": "C"`` counter track.
+
+Run directly (``python tools/mem_smoke.py``) or from tier-1 via
+``tests/test_memory.py::test_mem_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in _flags:
+    _flags += " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+os.environ["XLA_FLAGS"] = _flags
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_STEPS = 8
+MESH = "dp2,tp2"
+
+
+def main() -> dict:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import observe
+
+    t0 = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="mem_smoke_")
+    prev_dir = os.environ.get("PADDLE_OBSERVE_DIR")
+    os.environ["PADDLE_OBSERVE_DIR"] = root
+    observe.reset()
+    try:
+        return _run(t0, root)
+    finally:
+        # in-process callers (tests) must not inherit the smoke's sink
+        if prev_dir is None:
+            os.environ.pop("PADDLE_OBSERVE_DIR", None)
+        else:
+            os.environ["PADDLE_OBSERVE_DIR"] = prev_dir
+        observe.reset()
+
+
+def _run(t0, root) -> dict:
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import analysis, observe
+    from paddle_tpu.fluid import guardian
+    from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+    from paddle_tpu.observe.export import chrome_trace
+
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 13
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            loss, startup_program=startup)
+
+    rng = np.random.RandomState(5)
+    feed = {"x": rng.normal(size=(N_STEPS, 8, 16)).astype(np.float32),
+            "y": rng.randint(0, 10, size=(N_STEPS, 8, 1)).astype(np.int64)}
+
+    report = {"ok": False}
+    scope = fluid.Scope()
+    guardian.install(guardian.GuardianConfig(policy="skip"))
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pe = ParallelExecutor(loss_name=loss.name, main_program=prog,
+                                  mesh=MESH)
+            pe.run_steps([loss], feed=feed, n_steps=N_STEPS,
+                         feed_per_step=True)
+            guardian.flush()
+    finally:
+        guardian.disable()
+
+    label = pe.mesh_label
+    gauges = observe.registry().snapshot()["gauges"]
+    peak = gauges.get('memory.peak_bytes{mesh="%s"}' % label, 0)
+    report["mesh"] = label
+    report["peak_bytes"] = int(peak)
+    report["peak_nonzero"] = peak > 0
+    report["live_gauges"] = bool(
+        gauges.get('memory.live_bytes{mesh="%s",scope="train"}' % label)
+        and gauges.get('memory.live_high_water_bytes{mesh="%s",scope='
+                       '"train"}' % label))
+
+    # -- pre-flight estimate vs compiled truth (factor band) --
+    est_report = analysis.verify_program(
+        prog, feed={"x": feed["x"][0], "y": feed["y"][0]},
+        fetch_list=[loss], mesh=MESH, kind="pe_run_steps")
+    est = (est_report.memory_estimate or {}).get("peak_bytes", 0)
+    report["estimate_bytes"] = int(est)
+    ratio = est / peak if peak else 0.0
+    report["estimate_ratio"] = round(ratio, 3)
+    report["estimate_in_band"] = 0.25 <= ratio <= 4.0 if peak else False
+    report["an501"] = "AN501" in {d.code for d in est_report.diagnostics}
+
+    # -- seeded over-budget program -> exact AN502, error severity --
+    os.environ["PADDLE_MEM_BUDGET_MB"] = "0.001"
+    try:
+        over = analysis.verify_program(
+            prog, feed={"x": feed["x"][0], "y": feed["y"][0]},
+            fetch_list=[loss], mesh=MESH, kind="pe_run_steps")
+        report["an502"] = sorted({d.code for d in over.errors}) == ["AN502"]
+    finally:
+        del os.environ["PADDLE_MEM_BUDGET_MB"]
+
+    # -- chrome trace round-trips the memory counter track --
+    sink = observe.get_sink()
+    sink.flush()
+    recs = [json.loads(line) for line in open(sink.events.path)]
+    report["watermark_events"] = sum(
+        1 for r in recs if r.get("event") == "memory.watermark")
+    report["profile_events"] = sum(
+        1 for r in recs if r.get("event") == "memory.profile")
+    trace = json.loads(json.dumps(chrome_trace(recs)))
+    tracks = {e["name"] for e in trace["traceEvents"]
+              if e.get("ph") == "C"}
+    report["counter_track"] = any(
+        name.startswith("memory.live_bytes") for name in tracks)
+
+    report["elapsed_s"] = round(time.perf_counter() - t0, 2)
+    report["ok"] = bool(
+        report["peak_nonzero"] and report["live_gauges"]
+        and report["estimate_in_band"] and report["an501"]
+        and report["an502"] and report["watermark_events"] >= 1
+        and report["profile_events"] >= 1 and report["counter_track"])
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)

@@ -151,8 +151,11 @@ def main() -> dict:
         trace_json = json.loads(json.dumps(chrome_trace(recs)))
         xs = [e for e in trace_json["traceEvents"] if e.get("ph") == "X"]
         report["chrome_x_events"] = len(xs)
+        # duration records only: span-stamped INSTANTS (memory.watermark,
+        # memory.profile, cache hits inside a window) render as "i"/"C"
+        dur_spans = [r for r in spans if r.get("dur_s") is not None]
         report["chrome_round_trip"] = (
-            len(xs) >= len(spans)
+            len(xs) >= len(dur_spans)
             and any(e["args"].get("span_id") for e in xs))
 
         # -- 4. disabled mode: zero spans, no syncs --------------------
