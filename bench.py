@@ -372,9 +372,26 @@ def timed_run(fluid, on_accel, loss, feed, steps, warmup=2):
     return dt, steps, exe, cold
 
 
+def kernel_config():
+    """The active kernel configuration, recorded in EVERY BENCH line so
+    BENCH_r*.json rounds are attributable to kernel changes: ``flash``
+    (Pallas flash attention on/off after the PADDLE_TPU_FLASH/attr/AUTO
+    precedence) and ``fused`` (the pallas_fused families that would
+    dispatch — softmax_xent + optimizer sweeps — under PADDLE_TPU_FUSED)."""
+    try:
+        from paddle_tpu.ops import pallas_fused
+        from paddle_tpu.ops.attention_ops import _flash_decision
+
+        return {"flash": bool(_flash_decision()),
+                "fused": pallas_fused.active_families()}
+    except Exception:
+        return {"flash": False, "fused": []}
+
+
 def result_line(name, value, unit, baseline_key, **extra):
     return {"metric": name, "value": round(value, 2), "unit": unit,
-            "vs_baseline": round(value / BASELINES[baseline_key], 3), **extra}
+            "vs_baseline": round(value / BASELINES[baseline_key], 3),
+            **kernel_config(), **extra}
 
 
 def _env_int(model, name, default):
@@ -634,7 +651,7 @@ def bench_decode(fluid, platform, on_accel):
     return {"metric": f"beam_decode_b{batch}_beam{beam}_len{max_len}"
                       f"_{engine}{'_int8' if int8 else ''}_{platform}",
             "value": round(n_tokens / dt, 2), "unit": "tokens/sec/chip",
-            "vs_baseline": 0.0, **cold,
+            "vs_baseline": 0.0, **kernel_config(), **cold,
             "note": "no published reference decode throughput; absolute "
                     "generation rate ("
                     + ("one compiled while_loop program"
@@ -747,6 +764,13 @@ def main():
         os.environ.setdefault("PADDLE_TPU_FLASH", "1")
     else:
         os.environ.setdefault("PADDLE_TPU_FLASH", "0")
+    # same contract for the fused softmax-xent/optimizer kernels: the axon
+    # tunnel cannot remote-compile Mosaic either, so BENCH_FUSED=1 opts in
+    # explicitly (on a real TPU VM, set it: the fused path is the fast one)
+    if os.environ.get("BENCH_FUSED", "").strip().lower() in ("1", "true"):
+        os.environ.setdefault("PADDLE_TPU_FUSED", "1")
+    else:
+        os.environ.setdefault("PADDLE_TPU_FUSED", "0")
     model = os.environ.get("BENCH_MODEL", "")
     for i, a in enumerate(sys.argv):
         if a == "--model" and i + 1 < len(sys.argv):

@@ -672,7 +672,8 @@ class Executor:
                # contract as Executor.run's cache key)
                _amp.compute_dtype(),
                guard.cache_token() if guard is not None else None,
-               os.environ.get("PADDLE_TPU_FLASH", ""))
+               os.environ.get("PADDLE_TPU_FLASH", ""),
+               os.environ.get("PADDLE_TPU_FUSED", ""))
         entry = self._cache.get(key)
         probe = None
         fresh_entry = entry is None
@@ -704,7 +705,8 @@ class Executor:
                            "amp": _amp.compute_dtype(),
                            "guard": (guard.cache_token()
                                      if guard is not None else None),
-                           "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
+                           "flash": os.environ.get("PADDLE_TPU_FLASH", ""),
+                           "fused": os.environ.get("PADDLE_TPU_FUSED", "")})
                 VLOG(1, f"Executor.run_steps: compiling {n_steps}-step scan"
                         f"{' (guarded)' if guard is not None else ''}")
                 plan_fetches = list(fetch_names)
@@ -959,7 +961,8 @@ class Executor:
                # execution-mode toggles invalidate compiled fns
                _amp.compute_dtype(),
                guard.cache_token() if guard is not None else None,
-               os.environ.get("PADDLE_TPU_FLASH", ""))
+               os.environ.get("PADDLE_TPU_FLASH", ""),
+               os.environ.get("PADDLE_TPU_FUSED", ""))
         entry = self._cache.get(key) if use_program_cache else None
         probe = None
         if entry is None:
@@ -984,7 +987,8 @@ class Executor:
                        "amp": _amp.compute_dtype(),
                        "guard": (guard.cache_token()
                                  if guard is not None else None),
-                       "flash": os.environ.get("PADDLE_TPU_FLASH", "")})
+                       "flash": os.environ.get("PADDLE_TPU_FLASH", ""),
+                           "fused": os.environ.get("PADDLE_TPU_FUSED", "")})
             VLOG(1, f"Executor: compiling block "
                     f"({len(program.global_block().ops)} ops, "
                     f"fetches={fetch_names})")

@@ -163,7 +163,8 @@ class ParallelExecutor:
                # execution-mode toggles invalidate compiled steps (same
                # contract as Executor.run's cache key)
                _amp.compute_dtype(),
-               os.environ.get("PADDLE_TPU_FLASH", ""))
+               os.environ.get("PADDLE_TPU_FLASH", ""),
+               os.environ.get("PADDLE_TPU_FUSED", ""))
         step = self._cache.get(key)
         if step is None:
             from .. import analysis as _analysis
@@ -244,6 +245,7 @@ class ParallelExecutor:
                _amp.compute_dtype(),
                guard.cache_token() if guard is not None else None,
                os.environ.get("PADDLE_TPU_FLASH", ""),
+               os.environ.get("PADDLE_TPU_FUSED", ""),
                self.mesh_label)
         runner = self._window_cache.get(key)
         if runner is None:

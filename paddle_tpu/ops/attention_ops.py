@@ -34,10 +34,19 @@ def ring_attention_op(ctx):
         out = ra.ring_attention(q, k, v, mesh, sp_axis, causal, scale,
                                 bias=bias)
     elif _flash_decision(flash_req):
+        from . import pallas_fused
         from .pallas_flash import bias_supported, flash_attention
 
         if bias_supported(bias, q.shape[0], k.shape[2]):
-            out = flash_attention(q, k, v, bias, scale, causal)
+            if mesh is not None:
+                # tp-sharded lowering: heads stay sharded through the
+                # kernel (GSPMD cannot partition an opaque pallas_call —
+                # a mesh-less wrap would all-gather q/k/v around it)
+                out = pallas_fused.flash_attention_sharded(
+                    q, k, v, bias, scale, causal, mesh,
+                    pallas_fused.flash_tp_axis(q, mesh))
+            else:
+                out = flash_attention(q, k, v, bias, scale, causal)
         else:
             out = ra.full_attention(q, k, v, causal, scale, bias=bias)
     else:
@@ -54,12 +63,13 @@ def _flash_decision(flash_req: int = -1) -> bool:
     attr (1 on / 0 off), then AUTO: on when the backend is a TPU (the
     kernels compile natively on a TPU VM and stream K/V through VMEM —
     ops/pallas_flash.py), off on CPU/GPU (interpret mode is a correctness
-    tool, not a fast path)."""
-    import os
-
+    tool, not a fast path).  Read through the declared env contract
+    (fluid.envcontract) like every other knob."""
     import jax
 
-    v = os.environ.get("PADDLE_TPU_FLASH", "").strip().lower()
+    from ..fluid import envcontract
+
+    v = envcontract.get("PADDLE_TPU_FLASH")
     if v in ("0", "false"):
         return False
     if v in ("1", "true"):

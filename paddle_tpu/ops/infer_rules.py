@@ -296,6 +296,15 @@ for _t in ("uniform_random", "gaussian_random",
     register_infer(_t)(_infer_random)
 
 
+@register_infer("ring_attention")
+def infer_ring_attention(op, ins):
+    """Out mirrors Q — an explicit rule so the verifier never abstractly
+    evaluates the Pallas flash / shard_map lowerings (fast, and priced
+    identically whichever kernel the env gate picks at dispatch time)."""
+    q = _in(ins, "Q")
+    return {"Out": [q]}
+
+
 def _infer_param_update(op, ins):
     """Optimizer-family updates: each '<X>Out' output mirrors input slot
     '<X>' (ParamOut <- Param, MomentOut <- Moment, ...)."""

@@ -41,6 +41,16 @@ def softmax_with_cross_entropy(ctx):
     logits = ctx.input("Logits")
     label = ctx.input("Label")
     from ..fluid import amp
+    from . import pallas_fused
+
+    soft = ctx.attr("soft_label", False)
+    if pallas_fused.fused_decision() \
+            and pallas_fused.xent_fusable(logits, label, soft):
+        # streaming Pallas lowering: the [batch, vocab] probability matrix
+        # never materializes in HBM; backward recomputes P per tile from
+        # the saved logsumexp (ops/pallas_fused.py)
+        return pallas_fused.softmax_xent_op(
+            logits, label, soft, ctx.attr("ignore_index", -100))
 
     in_dtype = logits.dtype
     if amp.is_low_float(in_dtype):

@@ -44,12 +44,31 @@ def sgd(ctx):
     return {"ParamOut": p - _lr(ctx) * g}
 
 
+def _fused_opt_ok(ctx, p, g, out_slots):
+    """Route this update through the single-sweep Pallas kernel?  Gate +
+    static suitability + (under a mesh) spec alignment of param and
+    accumulators — ZeRO-1-diverged updates keep the unfused lowering."""
+    from . import pallas_fused
+
+    if not (pallas_fused.fused_decision() and pallas_fused.opt_fusable(p, g)):
+        return False
+    names = [(ctx.outputs_spec.get(s) or [None])[0] for s in out_slots]
+    return pallas_fused.opt_specs_aligned(names)
+
+
 @register_op("momentum", no_grad_inputs=("Param", "Grad", "Velocity", "LearningRate"))
 def momentum(ctx):
     p, v = ctx.input("Param"), ctx.input("Velocity")
     g = _grad(ctx, p)
     mu = ctx.attr("mu")
     lr = _lr(ctx)
+    if _fused_opt_ok(ctx, p, g, ("ParamOut", "VelocityOut")):
+        from . import pallas_fused
+
+        p_out, v_out = pallas_fused.fused_momentum(
+            p, g, v, lr, mu, ctx.attr("use_nesterov", False),
+            var_name=(ctx.outputs_spec.get("ParamOut") or [None])[0])
+        return {"ParamOut": p_out, "VelocityOut": v_out}
     v_out = mu * v + g
     if ctx.attr("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -69,6 +88,17 @@ def adam(ctx):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx) * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    if _fused_opt_ok(ctx, p, g, ("ParamOut", "Moment1Out", "Moment2Out")):
+        from . import pallas_fused
+
+        # the bias-corrected lr and beta-pow counters are [1]-shaped
+        # scalar math; the sweep fuses the four big buffers
+        po, m1o, m2o = pallas_fused.fused_adam(
+            p, g, m1, m2, lr, b1, b2, eps,
+            var_name=(ctx.outputs_spec.get("ParamOut") or [None])[0])
+        return {"ParamOut": po, "Moment1Out": m1o, "Moment2Out": m2o,
+                "Beta1PowOut": (b1p * b1).reshape(1),
+                "Beta2PowOut": (b2p * b2).reshape(1)}
     m1o = b1 * m1 + (1.0 - b1) * g
     m2o = b2 * m2 + (1.0 - b2) * g * g
     po = p - lr * m1o / (jnp.sqrt(m2o) + eps)

@@ -181,6 +181,31 @@ class mesh_scope:
         return False
 
 
+# -- active spec-table context: the sharded runners publish their state
+# spec table during the trace so per-op fused lowerings (the Pallas
+# optimizer sweeps, ops/pallas_fused.py) can shard_map each update over
+# its param's canonical PartitionSpec instead of forcing GSPMD to
+# all-gather around an opaque pallas_call --
+_ACTIVE_SPECS: List[Dict[str, Optional[P]]] = []
+
+
+def active_param_specs() -> Optional[Dict[str, Optional[P]]]:
+    return _ACTIVE_SPECS[-1] if _ACTIVE_SPECS else None
+
+
+class param_spec_scope:
+    def __init__(self, specs: Dict[str, Optional[P]]):
+        self.specs = specs
+
+    def __enter__(self):
+        _ACTIVE_SPECS.append(self.specs)
+        return self.specs
+
+    def __exit__(self, *exc):
+        _ACTIVE_SPECS.pop()
+        return False
+
+
 def infer_param_specs(program: Program, plan: BlockPlan, mesh: Mesh,
                       tp_axis: str = "mp", zero1: bool = False,
                       dp_axis: str = "dp",
@@ -389,9 +414,10 @@ class ShardedTrainStep:
         self._bdiv = None  # lazy: jax.process_index needs initialized dist
 
         plan = self.plan
+        specs = self.specs
 
         def fn(feed_vals, state_vals):
-            with mesh_scope(mesh):
+            with mesh_scope(mesh), param_spec_scope(specs):
                 return trace_block(program, 0, plan, feed_vals, state_vals)
 
         # input shardings are carried by the placed arrays (place_feed /
@@ -567,7 +593,8 @@ class ShardedTrainStep:
                           for a in self.mesh.axis_names],
                  "multihost": self.multihost,
                  "amp": _amp.compute_dtype(),
-                 "flash": os.environ.get("PADDLE_TPU_FLASH", "")}
+                 "flash": os.environ.get("PADDLE_TPU_FLASH", ""),
+                 "fused": os.environ.get("PADDLE_TPU_FUSED", "")}
         extra.update(self._probe_ctx)
         extra.update(more)
         return extra
@@ -713,8 +740,10 @@ class ShardedWindowRunner:
             donate = Executor._donate_argnums(None, program) != ()
         self.donate = bool(donate)
 
+        specs = self.specs
+
         def trace(feed_vals, state_vals):
-            with mesh_scope(mesh):
+            with mesh_scope(mesh), param_spec_scope(specs):
                 return trace_block(program, 0, plan, feed_vals, state_vals)
 
         rep = NamedSharding(mesh, P())

@@ -6,12 +6,14 @@ are diffed and any shared headline metric that dropped by more than the
 threshold FAILS the suite — a flat-regression round lands as a red test,
 not silently.
 
-Threshold: the committed r04→r05 history already contains a -26.65%
-ResNet drop (the CPU-fallback trajectory is noisy — probe wedges, shared
-hosts; exactly why the gate stayed opt-in), so the tier-1 floor starts
-just above that band at 30% and should be RATCHETED DOWN as the numbers
-stabilize.  The gate itself is exercised against synthetic rounds (clear
-regression → exit 1) so a silently-broken gate cannot pass vacuously.
+Threshold: the tier-1 floor started at 30% (just above the committed
+r04→r05 -26.65% ResNet noise band on the CPU-fallback trajectory) and is
+now RATCHETED to 20% (ISSUE 12): the fused-kernel layer landed headroom
+and the newest committed rounds sit inside the tighter band, so a
+regression that size is a finding, not noise.  Keep ratcheting as BENCH
+stabilizes.  The gate itself is exercised against synthetic rounds
+(clear regression → exit 1) so a silently-broken gate cannot pass
+vacuously.
 """
 
 import json
@@ -22,7 +24,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: tier-1 tolerated drop, percent — ratchet DOWN as BENCH stabilizes
-TIER1_THRESHOLD_PCT = 30.0
+TIER1_THRESHOLD_PCT = 20.0
 
 
 def _run_gate(args):

@@ -91,6 +91,38 @@ def test_flash_pallas_backward_matches_references(causal, with_bias):
                                    err_msg=f"{n} vs full autodiff")
 
 
+def test_flash_bias_backward_gradcheck_and_no_grad_contract():
+    """ISSUE 12 satellite: interpret-mode gradcheck of flash attention
+    with key-padding bias + causal against the ring_attention
+    .full_attention reference, differentiating ALL FOUR operands — and
+    the bias-no-grad contract as an executable assertion (it was only a
+    comment): the bias cotangent is exactly zero (the bias derives from
+    input padding and is never trained), while q/k/v grads still match
+    the reference computed WITH the bias on the path."""
+    rng = np.random.RandomState(7)
+    q, k, v = _qkv(rng, t=32)
+    bias = _key_bias(rng, 2, 32)
+    do = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    _, vjp = jax.vjp(lambda q, k, v, b: flash_attention(
+        q, k, v, b, causal=True, block_q=16, block_k=16), q, k, v, bias)
+    dq, dk, dv, dbias = vjp(do)
+
+    # the no-grad contract, executable: exact zeros, right shape/dtype
+    assert dbias.shape == bias.shape and dbias.dtype == bias.dtype
+    np.testing.assert_array_equal(np.asarray(dbias),
+                                  np.zeros_like(np.asarray(bias)))
+
+    # gradcheck vs full_attention autodiff (bias and causal both live)
+    _, vjp_full = jax.vjp(lambda q, k, v: full_attention(
+        q, k, v, True, bias=bias), q, k, v)
+    fq, fk, fv = vjp_full(do)
+    for got, ref, n in ((dq, fq, "dq"), (dk, fk, "dk"), (dv, fv, "dv")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"{n} vs full autodiff")
+
+
 def test_flash_backward_is_pallas():
     """The vjp must run the hand-scheduled kernels, not the jnp fallback:
     the backward jaxpr contains pallas_call primitives."""

@@ -1,0 +1,195 @@
+"""Per-kernel microbench: fused Pallas lowerings vs their XLA references.
+
+BENCH_r*.json tracks whole-model throughput; this tool times each fused
+kernel FAMILY in isolation (forward + backward where it exists) against
+the unfused XLA lowering it replaces, so a BENCH trajectory move is
+attributable to a specific kernel ("the layer that finally moves
+vs_baseline" — ISSUE 12).  One JSON line per kernel::
+
+    {"kernel": "softmax_xent", "shape": [4096, 32000],
+     "fused_ms": 1.91, "unfused_ms": 3.42, "speedup": 1.79,
+     "max_err": 2.4e-07, "backend": "tpu"}
+
+On a CPU backend the Pallas kernels run in INTERPRET mode — a correctness
+tool, not a fast path — so ``speedup < 1`` there is expected and the
+numbers matter only on a real TPU VM.  ``--smoke`` shrinks every shape
+and asserts parity (max_err) instead of judging speed; tier-1 runs it via
+``tests/test_pallas_fused.py::test_bench_kernels_smoke``.
+
+Usage::
+
+    python tools/bench_kernels.py [--smoke] [--steps N] [--kernel NAME]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, args, steps):
+    fn(*args)  # compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        out = fn(*args)
+    import jax
+
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def _err(a, b):
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    import numpy as np
+
+    return float(max(np.abs(np.asarray(x, np.float32)
+                            - np.asarray(y, np.float32)).max()
+                     for x, y in zip(la, lb)))
+
+
+def bench_softmax_xent(smoke, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops import pallas_fused as pf
+
+    r, v = (64, 512) if smoke else (4096, 32000)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(r, v)).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, v, size=(r, 1)).astype(np.int32))
+
+    def fused(x):
+        loss, _ = pf.softmax_xent(x, lab)
+        return jnp.sum(loss)
+
+    def unfused(x):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        return jnp.sum(-jnp.take_along_axis(logp, lab.astype(jnp.int64), 1))
+
+    f_g = jax.jit(jax.value_and_grad(fused))
+    u_g = jax.jit(jax.value_and_grad(unfused))
+    return {"kernel": "softmax_xent", "shape": [r, v],
+            "fused_ms": round(_timeit(f_g, (x,), steps), 3),
+            "unfused_ms": round(_timeit(u_g, (x,), steps), 3),
+            "max_err": _err(f_g(x), u_g(x))}
+
+
+def bench_flash_attention(smoke, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas_flash import flash_attention
+    from paddle_tpu.parallel.ring_attention import full_attention
+
+    b, h, t, d = (1, 2, 64, 16) if smoke else (4, 8, 1024, 64)
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, t, d)).astype(np.float32))
+               for _ in range(3))
+    bq = bk = 32 if smoke else 256
+
+    f = jax.jit(jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, block_q=bq, block_k=bk) ** 2),
+        argnums=(0, 1, 2)))
+    u = jax.jit(jax.grad(lambda q, k, v: jnp.sum(full_attention(
+        q, k, v, True) ** 2), argnums=(0, 1, 2)))
+    return {"kernel": "flash_attention", "shape": [b, h, t, d],
+            "fused_ms": round(_timeit(f, (q, k, v), steps), 3),
+            "unfused_ms": round(_timeit(u, (q, k, v), steps), 3),
+            "max_err": _err(f(q, k, v), u(q, k, v))}
+
+
+def _bench_opt(name, smoke, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops import pallas_fused as pf
+
+    n = (8 * 1024) if smoke else (16 * 1024 * 1024)
+    rng = np.random.RandomState(2)
+    p, g, a1, a2 = (jnp.asarray(rng.normal(size=(n // 128, 128))
+                                .astype(np.float32)) for _ in range(4))
+    a2 = jnp.abs(a2)
+    lr = jnp.float32(0.01)
+
+    if name == "adam":
+        f = jax.jit(lambda p, g, a1, a2: pf.fused_adam(
+            p, g, a1, a2, lr, 0.9, 0.999, 1e-8))
+
+        def u(p, g, a1, a2):
+            m1o = 0.9 * a1 + 0.1 * g
+            m2o = 0.999 * a2 + 0.001 * g * g
+            return p - lr * m1o / (jnp.sqrt(m2o) + 1e-8), m1o, m2o
+
+        u = jax.jit(u)
+        args = (p, g, a1, a2)
+    else:
+        f = jax.jit(lambda p, g, a1: pf.fused_momentum(
+            p, g, a1, lr, 0.9, False))
+
+        def u(p, g, a1):
+            vo = 0.9 * a1 + g
+            return p - lr * vo, vo
+
+        u = jax.jit(u)
+        args = (p, g, a1)
+    return {"kernel": name, "shape": [n],
+            "fused_ms": round(_timeit(f, args, steps), 3),
+            "unfused_ms": round(_timeit(u, args, steps), 3),
+            "max_err": _err(f(*args), u(*args))}
+
+
+KERNELS = {
+    "softmax_xent": bench_softmax_xent,
+    "flash_attention": bench_flash_attention,
+    "adam": lambda s, n: _bench_opt("adam", s, n),
+    "momentum": lambda s, n: _bench_opt("momentum", s, n),
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes; assert parity, ignore speed")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="timed iterations per kernel")
+    ap.add_argument("--kernel", choices=sorted(KERNELS),
+                    help="bench one kernel family only")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    backend = jax.default_backend()
+    steps = args.steps or (3 if args.smoke else 20)
+    ok = True
+    for name in ([args.kernel] if args.kernel else sorted(KERNELS)):
+        try:
+            row = KERNELS[name](args.smoke, steps)
+            row["backend"] = backend
+            row["interpret"] = backend != "tpu"
+            if row["fused_ms"] > 0:
+                row["speedup"] = round(row["unfused_ms"] / row["fused_ms"], 2)
+            if row["max_err"] > 1e-3:
+                row["error"] = f"parity failure: max_err {row['max_err']}"
+                ok = False
+        except Exception as exc:  # a failing kernel must not mask others
+            row = {"kernel": name, "error": f"{type(exc).__name__}: {exc}",
+                   "backend": backend}
+            ok = False
+        print(json.dumps(row), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
