@@ -446,11 +446,15 @@ def note_data_wait(wait_s: float, **ctx) -> None:
     try:
         from .. import observe
         from ..fluid import envcontract
-        from ..observe import watchdog
+        from ..observe import goodput, watchdog
 
         wait_s = float(wait_s)
         observe.registry().inc("data.wait_ms", wait_s * 1000.0)
         watchdog.observe_value("train.data_wait_s", wait_s, **ctx)
+        # input-starved wall-clock is data_wait-state time in the goodput
+        # ledger (the fraction an autoscaler reads drops when the pipeline
+        # cannot keep the device fed)
+        goodput.note("data_wait", wait_s)
         if wait_s * 1000.0 > float(envcontract.get(
                 "PADDLE_DATA_STALL_EVENT_MS")):
             observe.emit("data.stall", wait_ms=round(wait_s * 1000.0, 3),

@@ -220,6 +220,19 @@ declare("PADDLE_SLO_MIN_SAMPLES", "int", 8, "observe",
         "Baseline samples required before the watchdog may fire")
 declare("PADDLE_SLO_COOLDOWN_S", "float", 1.0, "observe",
         "Minimum seconds between breach events for one metric")
+declare("PADDLE_GOODPUT", "bool", True, "observe",
+        "Arm the always-on goodput accumulator (wall-clock state "
+        "counters + goodput.fraction gauge; 0 disables all accounting)")
+declare("PADDLE_GOODPUT_REPORT_S", "float", 30.0, "observe",
+        "Seconds between periodic goodput.report run events")
+declare("PADDLE_GOODPUT_SCAN_S", "float", 5.0, "observe",
+        "Elastic supervisor's straggler-scan interval over the fleet "
+        "event stream (0 disables the in-flight scan)")
+declare("PADDLE_GOODPUT_STRAGGLER_FACTOR", "float", 1.5, "observe",
+        "Flag a rank whose median step time exceeds factor x the other "
+        "ranks' median (plus their 3xMAD noise guard)")
+declare("PADDLE_GOODPUT_MIN_SAMPLES", "int", 4, "observe",
+        "Window samples required per rank before the skew test may flag")
 
 # -- fault injection (PADDLE_FAULT_* family; deterministic test faults) --
 declare("PADDLE_FAULT_", "prefix", None, "fault",
@@ -269,6 +282,14 @@ declare("PADDLE_FAULT_MEM_PRESSURE", "float", 0.0, "fault",
 declare("PADDLE_FAULT_MEM_PRESSURE_AT", "int", 8, "fault",
         "Ledger observation count at which the synthetic leak starts "
         "(past the SLO watchdog's min-samples baseline)")
+declare("PADDLE_FAULT_STRAGGLER_RANK", "int", None, "fault",
+        "Deterministic straggler oracle: slow down exactly this trainer "
+        "rank (ignores PADDLE_FAULT_RANK — the two faults may target "
+        "different ranks in one scenario)")
+declare("PADDLE_FAULT_STRAGGLER_MS", "float", 0.0, "fault",
+        "Per-step delay (ms) injected into the straggler rank's step "
+        "boundary — inflates its window spans so the skew detector "
+        "must flag it")
 
 # -- memory observability --
 declare("PADDLE_MEM_BUDGET_MB", "float", None, "memory",

@@ -678,10 +678,14 @@ class Executor:
         probe = None
         fresh_entry = entry is None
         if entry is None:
+            import time as _t
+
             from .log import VLOG
             from .. import analysis as _analysis
             from .. import compile_cache as _cc
+            from ..observe import goodput as _goodput
 
+            t_trace0 = _t.perf_counter()
             with _trace.span("executor.trace", n_steps=n_steps):
                 # pre-compile verifier (PADDLE_TPU_VERIFY): milliseconds of
                 # static checks before seconds of trace/compile; strict mode
@@ -739,6 +743,11 @@ class Executor:
                 entry = (plan, jax.jit(kfn, donate_argnums=donate), guard,
                          {"cost": None})
                 self._cache[key] = entry
+            if program._params_grads is not None:
+                # host tracing/verification is compile-state wall-clock
+                # (the backend compile itself lands in the first dispatch,
+                # booked below)
+                _goodput.note("compile", _t.perf_counter() - t_trace0)
         plan, fn, guard, entry_info = entry
 
         import contextlib
@@ -903,6 +912,17 @@ class Executor:
                     "executor.step_time_s",
                     (t_obs1 - t_host0) / max(1, n_steps),
                     step=window_start + n_steps - 1)
+                from ..observe import goodput as _goodput
+
+                # goodput ledger: a fresh entry's first dispatch is
+                # compile cost (lazy jit), everything else device compute
+                disp = t_disp1 - t
+                if fresh_entry:
+                    _goodput.note("compile", disp)
+                    _goodput.note("device",
+                                  max(0.0, (t_obs1 - t_host0) - disp))
+                else:
+                    _goodput.note("device", t_obs1 - t_host0)
             return [np.asarray(v) for v in fetches]
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
@@ -965,6 +985,7 @@ class Executor:
                os.environ.get("PADDLE_TPU_FUSED", ""))
         entry = self._cache.get(key) if use_program_cache else None
         probe = None
+        fresh_run_entry = entry is None
         if entry is None:
             from .log import VLOG
             from .. import analysis as _analysis
@@ -1120,6 +1141,12 @@ class Executor:
             # flood the stream — windows own the event cadence
             _obsmem.note_scope_live(scope, scope_label="train",
                                     step=step_idx, emit_event=False)
+            from ..observe import goodput as _goodput
+
+            # per-step training dispatch: a fresh entry's first dispatch
+            # is compile cost (lazy jit), everything after device compute
+            _goodput.note("compile" if fresh_run_entry else "device",
+                          _time.perf_counter() - t)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         from .lod_tensor import LoDTensor
@@ -1229,6 +1256,10 @@ class Executor:
                 fired = _fault.on_step()
             else:
                 _fault.advance(n_steps)
+            # straggler oracle: the armed rank's sleep lands here, INSIDE
+            # the window span, so its per-step time inflates like a real
+            # slow chip's and the skew detector must flag it
+            _fault.straggler_delay(n_steps)
         else:
             _fault._step += n_steps  # keep the index flowing for the guardian
         from .. import observe

@@ -62,6 +62,18 @@ worker processes:
                                   (one-shot): the resumed run must detect
                                   the corrupt cursor and fall back to the
                                   previous complete serial
+    PADDLE_FAULT_STRAGGLER_RANK=r
+                                  deterministic straggler oracle: rank r
+                                  sleeps PADDLE_FAULT_STRAGGLER_MS ms per
+                                  training step at the step boundary —
+                                  INSIDE the executor window span, so the
+                                  rank's per-step time inflates exactly
+                                  like a thermally-throttled / failing
+                                  chip's would and the cross-rank skew
+                                  detector (observe.fleet.rank_skew) must
+                                  flag it.  Keyed on its own rank knob, NOT
+                                  PADDLE_FAULT_RANK: one scenario may kill
+                                  rank 0 while rank 1 straggles.
     PADDLE_FAULT_MEM_PRESSURE=mb  synthesize a memory leak: starting at the
                                   PADDLE_FAULT_MEM_PRESSURE_AT-th (default
                                   8th) live-buffer-ledger observation, add
@@ -100,8 +112,8 @@ __all__ = [
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
     "barrier_stall", "serving_request", "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
-    "shard_corrupt", "mem_pressure_bytes", "current_step",
-    "KILL_EXIT_CODE",
+    "shard_corrupt", "mem_pressure_bytes", "straggler_delay",
+    "current_step", "KILL_EXIT_CODE",
 ]
 
 #: exit code of an injected kill — 128+9, what a real SIGKILL reports
@@ -134,6 +146,8 @@ class FaultPlan:
                  shard_corrupt: bool = False,
                  mem_pressure_mb: float = 0.0,
                  mem_pressure_at: int = 8,
+                 straggler_rank: Optional[int] = None,
+                 straggler_ms: float = 0.0,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -161,6 +175,9 @@ class FaultPlan:
         self.shard_corrupt = bool(shard_corrupt)
         self.mem_pressure_mb = float(mem_pressure_mb)
         self.mem_pressure_at = int(mem_pressure_at)
+        self.straggler_rank = None if straggler_rank is None \
+            else int(straggler_rank)
+        self.straggler_ms = float(straggler_ms)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
@@ -206,6 +223,11 @@ class FaultPlan:
             .lower() in ("1", "true", "yes"),
             mem_pressure_mb=getf("PADDLE_FAULT_MEM_PRESSURE"),
             mem_pressure_at=int(getf("PADDLE_FAULT_MEM_PRESSURE_AT", 8)),
+            straggler_rank=int(env.get("PADDLE_FAULT_STRAGGLER_RANK",
+                                       "").strip() or -1)
+            if env.get("PADDLE_FAULT_STRAGGLER_RANK", "").strip()
+            else None,
+            straggler_ms=getf("PADDLE_FAULT_STRAGGLER_MS"),
             rank=int(rank) if rank else None,
             mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
         )
@@ -449,6 +471,21 @@ def mem_pressure_bytes() -> int:
     if past <= 0:
         return 0
     return int(plan.mem_pressure_mb * (1 << 20)) << min(past - 1, 16)
+
+
+def straggler_delay(n_steps: int = 1) -> None:
+    """Straggler oracle: the armed rank sleeps ``straggler_ms`` per step
+    at the training step boundary (a fused window sleeps once for its
+    whole span).  Deliberately keyed on ``straggler_rank`` alone —
+    ``PADDLE_FAULT_RANK`` scopes the OTHER faults, so a kill on rank 0
+    and a straggler on rank 1 compose in one scenario."""
+    plan = active()
+    if plan is None or plan.straggler_ms <= 0:
+        return
+    if plan.straggler_rank is not None and plan.straggler_rank != \
+            int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0):
+        return
+    time.sleep(plan.straggler_ms * max(1, int(n_steps)) / 1000.0)
 
 
 def barrier_stall(tag: str = "") -> None:

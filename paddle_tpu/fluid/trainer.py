@@ -189,9 +189,22 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
                 serial += 1
         _ckpt_reserved[root] = serial
     if not background:
+        import time as _t
+
+        from ..observe import goodput as _goodput
+
+        t0 = _t.perf_counter()
         io.save_persistables(executor, cur, main_program)
         _finish_checkpoint(checkpoint_dir, cur, trainer_args,
                            max_num_checkpoints, data_state=data_state)
+        dur = _t.perf_counter() - t0
+        # synchronous save blocks the training loop: checkpoint-state
+        # wall-clock in the goodput ledger, one span in the event stream
+        _goodput.note("checkpoint", dur)
+        from .. import observe as _observe
+
+        _observe.emit("checkpoint.save", serial=int(serial),
+                      dur_s=round(dur, 6))
         return serial
     from .executor import global_scope
     from .io import _resolve_vars, is_persistable, snapshot_vars
@@ -201,12 +214,24 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
 
     def write():
         try:
+            import time as _t
+
+            t0 = _t.perf_counter()
             io.write_var_files(cur, snapshot)
             # data_state is a small host dict snapshotted by the caller,
             # so the background writer commits the same cursor the train
             # loop saw at the checkpoint boundary
             _finish_checkpoint(checkpoint_dir, cur, trainer_args,
                                max_num_checkpoints, data_state=data_state)
+            from .. import observe as _observe
+
+            # background IO overlaps training, so it is NOT goodput
+            # checkpoint-state time — the span is still recorded (the
+            # ledger's device-over-checkpoint priority keeps overlapped
+            # windows productive)
+            _observe.emit("checkpoint.save", serial=int(serial),
+                          dur_s=round(_t.perf_counter() - t0, 6),
+                          background=True)
         except BaseException as exc:  # surfaced by wait_for_checkpoints
             # a half-written serial is junk forever (it never gets
             # _SUCCESS and the pruner skips incomplete dirs) — remove it
@@ -244,6 +269,19 @@ def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
     with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
         f.write("")
     _fault.ckpt_crash_point("after")
+    try:
+        from .. import observe as _observe
+
+        # the single-process commit point, twin of multihost's: the
+        # committed step feeds heartbeat progress-at-death and the
+        # goodput ledger's lost-work pricing
+        step = (trainer_args or {}).get("step_id")
+        if not isinstance(step, int) or step < 0:
+            step = _observe.current_step()
+        _observe.note_commit_step(step)
+        _observe.emit("checkpoint.commit", path=cur, step=step)
+    except Exception:
+        pass  # telemetry must never fail the commit it describes
     # scroll-delete: keep newest max_num_checkpoints complete serials,
     # only ever deleting COMPLETE ones older than the newest keepers (an
     # in-flight async serial has no _SUCCESS yet and must survive)
@@ -497,8 +535,10 @@ class Trainer:
         # flush here so a trip on the LAST step still raises/dumps instead
         # of dying silently with the loop
         from . import guardian as _guardian
+        from ..observe import goodput as _goodput
 
         _guardian.flush()
+        _goodput.report(force=True)
         if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
             # final state is always captured so resume never replays work
             # (skipped when the in-loop epoch save already wrote it)
@@ -607,10 +647,12 @@ class Trainer:
                 last_epoch_saved = epoch_id
             event_handler(EndEpochEvent(epoch_id))
         # same teardown as the per-step loop: surface a last-window trip,
-        # capture final state
+        # capture final state, flush a final goodput report
         from . import guardian as _guardian
+        from ..observe import goodput as _goodput
 
         _guardian.flush()
+        _goodput.report(force=True)
         if self.checkpoint_cfg and last_epoch_saved != num_epochs - 1:
             self._save_checkpoint(num_epochs - 1, -1, end_of_epoch=True,
                                   data_state=self._data_state())

@@ -46,12 +46,14 @@ from .registry import MetricsRegistry
 __all__ = [
     "MetricsRegistry", "EventLog", "registry", "get_sink", "configure",
     "disable", "reset", "emit", "span", "note_step", "note_program",
-    "note_mesh", "current_step", "current_program", "current_mesh",
+    "note_mesh", "note_commit_step", "current_step", "current_program",
+    "current_mesh", "current_commit_step",
     "http_server", "ENV_DIR", "ENV_FLUSH", "ENV_PORT",
     # submodules re-exported for discoverability: observe.trace (span
     # tracer + device-time attribution), observe.watchdog (SLO breaches),
-    # observe.memory (HBM accounting + live-buffer ledger)
-    "trace", "watchdog", "memory",
+    # observe.memory (HBM accounting + live-buffer ledger),
+    # observe.goodput (wall-clock state accounting + straggler ledger)
+    "trace", "watchdog", "memory", "goodput",
 ]
 
 ENV_DIR = "PADDLE_OBSERVE_DIR"
@@ -72,6 +74,7 @@ _registry = MetricsRegistry()
 _step: Optional[int] = None
 _program: Optional[str] = None
 _mesh: Optional[str] = None
+_commit_step: Optional[int] = None
 
 
 def registry() -> MetricsRegistry:
@@ -100,6 +103,15 @@ def note_mesh(label: Optional[str]) -> None:
     _mesh = label
 
 
+def note_commit_step(step: Optional[int]) -> None:
+    """Record the last CHECKPOINT-COMMITTED step (set at every _SUCCESS
+    write, single-process and sharded).  Heartbeat files carry it so
+    ``incidents.jsonl`` shows progress-at-death and the goodput ledger can
+    price the work a restart loses (``last_step - commit_step``)."""
+    global _commit_step
+    _commit_step = step
+
+
 def current_step() -> Optional[int]:
     return _step
 
@@ -110,6 +122,10 @@ def current_program() -> Optional[str]:
 
 def current_mesh() -> Optional[str]:
     return _mesh
+
+
+def current_commit_step() -> Optional[int]:
+    return _commit_step
 
 
 # ---------------------------------------------------------------------------
@@ -246,7 +262,7 @@ def disable() -> None:
 def reset() -> None:
     """Close the sink, clear the registry and context, and re-arm env
     late-binding.  Test-harness hook (tests/conftest.py)."""
-    global _sink, _step, _program, _mesh
+    global _sink, _step, _program, _mesh, _commit_step
     with _sink_lock:
         if _sink not in (None, _UNSET):
             _sink.close()
@@ -256,8 +272,11 @@ def reset() -> None:
     _step = None
     _program = None
     _mesh = None
-    # span tracer + SLO watchdog + memory ledger piggyback on the sink
-    # lifecycle: re-arm their env late-binding / clear their state with it
+    _commit_step = None
+    # span tracer + SLO watchdog + memory ledger + goodput accumulator
+    # piggyback on the sink lifecycle: re-arm their env late-binding /
+    # clear their state with it
+    from . import goodput as _goodput
     from . import memory as _memory
     from . import trace as _trace
     from . import watchdog as _watchdog
@@ -265,6 +284,7 @@ def reset() -> None:
     _trace.reset()
     _watchdog.reset()
     _memory.reset()
+    _goodput.reset()
 
 
 def http_server():
@@ -314,6 +334,6 @@ def span(event: str, **fields):
 
 
 # submodules imported last (they only import observe lazily, so there is
-# no cycle): observe.trace / observe.watchdog / observe.memory are part
-# of the public API
-from . import memory, trace, watchdog  # noqa: E402,F401  (re-export)
+# no cycle): observe.trace / observe.watchdog / observe.memory /
+# observe.goodput are part of the public API
+from . import goodput, memory, trace, watchdog  # noqa: E402,F401  (re-export)

@@ -3,7 +3,19 @@
 Commands (default dir: $PADDLE_OBSERVE_DIR, overridable via --dir)::
 
     python -m paddle_tpu.observe tail [--n 20] [--event guardian_trip]
-                                     # newest merged events, one JSON/line
+                                     [--follow] [--grep PATTERN]
+                                     # newest merged events, one JSON/line;
+                                     # --follow poll-tails the whole fleet
+                                     # dir (new generations picked up
+                                     # live), --grep regex-filters lines
+    python -m paddle_tpu.observe goodput
+                                     # wall-clock state ledger from the
+                                     # persisted stream: per-rank + fleet
+                                     # seconds by state (device/compile/
+                                     # data_wait/checkpoint/barrier/
+                                     # restart/idle), goodput fraction,
+                                     # restarts priced in lost steps,
+                                     # cross-rank straggler verdicts
     python -m paddle_tpu.observe summary
                                      # aggregated fleet snapshot JSON
     python -m paddle_tpu.observe export --out trace.json
@@ -54,13 +66,53 @@ def _dir_or_die(args) -> str:
 
 
 def cmd_tail(args) -> int:
-    from .fleet import fleet_events
+    import re as _re
+
+    from .fleet import fleet_events, follow_events
+
+    root = _dir_or_die(args)
+    grep = _re.compile(args.grep) if args.grep else None
+
+    def keep(rec, line) -> bool:
+        if args.event and rec.get("event") != args.event:
+            return False
+        return grep is None or bool(grep.search(line))
+
+    recs = fleet_events(root)
+    shown = [r for r in recs if keep(r, json.dumps(r))]
+    for rec in shown[-args.n:]:
+        print(json.dumps(rec))
+    if not args.follow:
+        return 0
+    # live fleet debugging: poll-based tail -f over every event file in
+    # the dir (new generations' files join automatically; the history
+    # above is not re-printed)
+    try:
+        for rec in follow_events(root, poll_s=args.interval,
+                                 from_end=True):
+            line = json.dumps(rec)
+            if keep(rec, line):
+                print(line, flush=True)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_goodput(args) -> int:
+    """The fleet-health answer (ISSUE 13): how much wall-clock trained,
+    where the rest went, what each restart cost, and which rank drags —
+    all re-derived from the persisted event stream, no live process."""
+    from .fleet import fleet_events, rank_skew
+    from .goodput import build_ledger
 
     recs = fleet_events(_dir_or_die(args))
-    if args.event:
-        recs = [r for r in recs if r.get("event") == args.event]
-    for rec in recs[-args.n:]:
-        print(json.dumps(rec))
+    ledger = build_ledger(recs)
+    skew = rank_skew(recs)
+    out = {k: ledger[k] for k in ("workers", "ranks", "states", "total_s",
+                                  "fraction", "restarts",
+                                  "straggler_events")}
+    out["skew"] = skew
+    print(json.dumps(out, indent=1, sort_keys=True))
     return 0
 
 
@@ -84,12 +136,21 @@ def cmd_summary(args) -> int:
 def cmd_export(args) -> int:
     from .export import chrome_trace
     from .fleet import fleet_events
+    from .goodput import build_ledger
 
     recs = fleet_events(_dir_or_die(args))
-    trace = chrome_trace(recs, device_trace_dir=args.device_trace_dir)
+    # the ledger's swept per-rank state segments draw as a "goodput
+    # state" thread row under each rank's spans
+    try:
+        segments = build_ledger(recs)["segments"]
+    except Exception:
+        segments = None
+    trace = chrome_trace(recs, device_trace_dir=args.device_trace_dir,
+                         goodput_segments=segments)
     with open(args.out, "w") as f:
         json.dump(trace, f)
     print(json.dumps({"out": args.out, "events": len(recs),
+                      "goodput_segments": len(segments or []),
                       "pids": len({(r.get('host'), r.get('rank'))
                                    for r in recs})}))
     return 0
@@ -339,12 +400,18 @@ def main(argv=None) -> int:
         description="Inspect / export / serve observability data.")
     ap.add_argument("command", nargs="?", default="summary",
                     choices=["tail", "summary", "export", "serve", "trace",
-                             "memory"])
+                             "memory", "goodput"])
     ap.add_argument("--dir", default=None,
                     help="observe dir (default $PADDLE_OBSERVE_DIR)")
     ap.add_argument("--n", type=int, default=20, help="tail: line count")
     ap.add_argument("--event", default=None,
                     help="tail: only this event kind")
+    ap.add_argument("--follow", "-f", action="store_true",
+                    help="tail: keep polling for new events (tail -f)")
+    ap.add_argument("--grep", default=None,
+                    help="tail: only lines matching this regex")
+    ap.add_argument("--interval", type=float, default=0.5,
+                    help="tail --follow: poll interval seconds")
     ap.add_argument("--trace-id", default=None,
                     help="trace: only traces whose id starts with this")
     ap.add_argument("--out", default="timeline.json",
@@ -361,7 +428,8 @@ def main(argv=None) -> int:
     try:
         return {"tail": cmd_tail, "summary": cmd_summary,
                 "export": cmd_export, "serve": cmd_serve,
-                "trace": cmd_trace, "memory": cmd_memory}[args.command](args)
+                "trace": cmd_trace, "memory": cmd_memory,
+                "goodput": cmd_goodput}[args.command](args)
     except BrokenPipeError:
         # `... | head` closing stdout early is normal unix usage, not an
         # error worth a traceback

@@ -170,14 +170,24 @@ def _pid_table(records: Iterable[dict]) -> Dict[tuple, int]:
     return pids
 
 
+#: chrome-trace tid reserved for the per-rank goodput state track (far
+#: above any real thread's first-use index)
+GOODPUT_TID = 9999
+
+
 def chrome_trace(records: List[dict],
                  counter_samples: Optional[List[dict]] = None,
-                 device_trace_dir: Optional[str] = None) -> dict:
+                 device_trace_dir: Optional[str] = None,
+                 goodput_segments: Optional[List[dict]] = None) -> dict:
     """Merged event records -> chrome://tracing JSON dict.
 
     ``records`` come from :func:`events.merge_events`; ``counter_samples``
     are the profiler session's (ts, name, value) samples (emitted as
-    ``"ph": "C"`` on pid 0)."""
+    ``"ph": "C"`` on pid 0).  ``goodput_segments`` (the swept per-rank
+    state intervals from :func:`goodput.build_ledger`) render as one
+    dedicated "goodput state" thread row per (host, rank) — the
+    wall-clock state track drawn under that rank's spans, so a restart
+    gap or data stall is visible at a glance."""
     trace_events: List[dict] = []
     pids = _pid_table(records)
     if not pids:
@@ -186,6 +196,25 @@ def chrome_trace(records: List[dict],
         trace_events.append({"name": "process_name", "ph": "M", "pid": pid,
                              "args": {"name": f"{host}:r{rank}"}})
     t0 = min((r.get("ts", 0) for r in records), default=0)
+    if goodput_segments:
+        seen_pids = set()
+        for seg in goodput_segments:
+            pid = pids.get((seg.get("host", "?"), seg.get("rank", 0)))
+            if pid is None:
+                continue
+            if pid not in seen_pids:
+                seen_pids.add(pid)
+                trace_events.append(
+                    {"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": GOODPUT_TID,
+                     "args": {"name": "goodput state"}})
+            ts_us = (seg["t0"] - t0) * 1e6
+            trace_events.append(
+                {"ph": "X", "cat": "goodput", "ts": ts_us,
+                 "dur": max(0.0, (seg["t1"] - seg["t0"]) * 1e6),
+                 "pid": pid, "tid": GOODPUT_TID,
+                 "name": f"state:{seg.get('state', '?')}",
+                 "args": {"state": seg.get("state")}})
     for r in records:
         pid = pids.get((r.get("host", "?"), r.get("rank", 0)), 0)
         ts_us = (r.get("ts", t0) - t0) * 1e6

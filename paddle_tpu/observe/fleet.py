@@ -29,7 +29,8 @@ from typing import Dict, List, Optional
 
 from .events import merge_events
 
-__all__ = ["scan_dir", "fleet_snapshot", "fleet_events", "write_fleet"]
+__all__ = ["scan_dir", "fleet_snapshot", "fleet_events", "write_fleet",
+           "rank_skew", "follow_events"]
 
 METRICS_GLOB = "metrics-*.json"
 EVENTS_GLOB = "events-*.jsonl"
@@ -103,6 +104,147 @@ def fleet_snapshot(root: str) -> dict:
 def fleet_events(root: str) -> List[dict]:
     """Every worker generation's events, one wall-clock-ordered stream."""
     return merge_events(scan_dir(root)["events"])
+
+
+def _median(vals) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def rank_skew(records: List[dict], *, factor: float = 1.5,
+              min_samples: int = 4, gen: Optional[int] = None,
+              warmup: int = 2) -> dict:
+    """Cross-rank step-time skew over the merged event stream (ISSUE 13).
+
+    Per worker ``host:r<rank>``, per-step times come from the
+    ``executor.window`` spans every rank already emits (``dur_s`` /
+    ``n_steps``); per-rank BARRIER wait totals ride along for context.
+    The straggler test is leave-one-out median+MAD: a rank is flagged
+    when its median step time exceeds ``factor`` x the median of the
+    OTHER ranks' medians AND clears their 3xMAD noise guard — the
+    leave-one-out form keeps a 2-rank fleet decidable (a plain fleet
+    median+MAD can never flag one of two ranks: the outlier drags the
+    baseline it is judged against).
+
+    Each (worker, generation)'s first ``warmup`` windows and any
+    ``fresh``-flagged window (lazy jit compile inside the span) are
+    EXCLUDED: warm-up transients are 10-100x steady state, so a freshly
+    restarted rank with few samples would otherwise read as a straggler
+    of its own recovery.  Needs >= ``min_samples`` STEADY samples on the
+    candidate AND at least one other qualified rank; returns per-rank
+    stats and the flagged stragglers (empty when the fleet is
+    single-rank or too young)."""
+    raw: Dict[str, Dict[int, List[tuple]]] = {}
+    barrier: Dict[str, float] = {}
+    meta: Dict[str, dict] = {}
+    for r in records:
+        if r.get("source") == "supervisor":
+            continue
+        if gen is not None and int(r.get("gen", 0) or 0) != gen:
+            continue
+        key = f"{r.get('host', '?')}:r{r.get('rank', 0)}"
+        ev = r.get("event")
+        dur = r.get("dur_s")
+        if ev == "executor.window" and dur is not None:
+            n = max(1, int(r.get("n_steps") or 1))
+            g = int(r.get("gen", 0) or 0)
+            raw.setdefault(key, {}).setdefault(g, []).append(
+                (float(r.get("ts", 0.0)), float(dur) / n,
+                 bool(r.get("fresh"))))
+            meta.setdefault(key, {"host": r.get("host", "?"),
+                                  "rank": int(r.get("rank", 0) or 0)})
+        elif ev == "barrier.wait" and dur is not None:
+            barrier[key] = barrier.get(key, 0.0) + float(dur)
+    steps: Dict[str, List[float]] = {}
+    for key, by_gen in raw.items():
+        vals: List[float] = []
+        for g, samples in by_gen.items():
+            samples.sort()
+            vals.extend(v for _, v, fresh in samples[warmup:] if not fresh)
+        if vals:
+            steps[key] = vals
+    ranks = {}
+    for key, vals in steps.items():
+        ranks[key] = {"median_step_s": round(_median(vals), 6),
+                      "n": len(vals),
+                      "barrier_wait_s": round(barrier.get(key, 0.0), 6),
+                      **meta[key]}
+    qualified = {k: v for k, v in ranks.items() if v["n"] >= min_samples}
+    stragglers = []
+    for key, own in qualified.items():
+        others = [v["median_step_s"] for k, v in qualified.items()
+                  if k != key]
+        if not others:
+            continue
+        baseline = _median(others)
+        mad = _median([abs(x - baseline) for x in others])
+        if baseline > 0.0 and own["median_step_s"] > baseline * factor \
+                and own["median_step_s"] > baseline + 3.0 * mad:
+            stragglers.append({
+                "worker": key, "host": own["host"], "rank": own["rank"],
+                "median_step_s": own["median_step_s"],
+                "baseline_step_s": round(baseline, 6),
+                "ratio": round(own["median_step_s"] / baseline, 3),
+                "n": own["n"]})
+    stragglers.sort(key=lambda s: -s["ratio"])
+    return {"ranks": ranks, "stragglers": stragglers, "factor": factor,
+            "min_samples": min_samples, "gen": gen}
+
+
+def follow_events(root: str, poll_s: float = 0.5, stop_check=None,
+                  from_end: bool = False):
+    """Poll-based ``tail -f`` over every event file under ``root``: yields
+    new records (wall-clock ordered per poll) as they are appended, and
+    picks up files that appear later (a new generation's worker).  Torn
+    trailing lines are left in the buffer until their newline lands.
+    ``from_end=True`` skips the files' existing content (the CLI prints
+    the history itself, then follows only what is NEW; files appearing
+    mid-follow still stream from their start).  ``stop_check`` (callable
+    -> bool) ends the generator — the CLI's ``--follow`` loop runs until
+    interrupted; tests pass a flag."""
+    import time as _time
+
+    offsets: Dict[str, int] = {}
+    buffers: Dict[str, str] = {}
+    if from_end:
+        for path in scan_dir(root)["events"]:
+            try:
+                offsets[path] = os.path.getsize(path)
+            except OSError:
+                pass
+    while stop_check is None or not stop_check():
+        batch: List[dict] = []
+        for path in scan_dir(root)["events"]:
+            try:
+                with open(path) as f:
+                    f.seek(offsets.get(path, 0))
+                    chunk = f.read()
+                    offsets[path] = f.tell()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            data = buffers.get(path, "") + chunk
+            lines = data.split("\n")
+            buffers[path] = lines.pop()  # "" when chunk ended on newline
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    batch.append(json.loads(line))
+                except ValueError:
+                    continue
+        batch.sort(key=lambda r: r.get("ts", 0))
+        for rec in batch:
+            yield rec
+        if stop_check is not None and stop_check():
+            return
+        _time.sleep(max(0.05, float(poll_s)))
 
 
 def write_fleet(root: str, path: Optional[str] = None) -> Optional[str]:

@@ -30,7 +30,14 @@ Fed from the paths that matter (all no-ops until ``PADDLE_SLO=1``):
  - ``memory.live_bytes``     — the live-buffer ledger's total device
    residency (``observe.memory``): monotonic growth across windows or
    elastic generations breaches like a slow step — leak detection; the
-   ``PADDLE_FAULT_MEM_PRESSURE`` ramp is its deterministic oracle.
+   ``PADDLE_FAULT_MEM_PRESSURE`` ramp is its deterministic oracle;
+ - ``goodput.stall_s``       — per-interval stall-state time from the
+   goodput accumulator (``observe.goodput``: data waits, barrier waits,
+   synchronous checkpoint commits), so a run whose stall profile
+   regresses breaches even while raw step time stays flat.  Straggler
+   findings land in the SAME stream as ``straggler.detected{rank=}``
+   records (emitted by the elastic supervisor's skew scan), next to the
+   ``slo.breach`` events an autoscaler already consumes.
 
 Env contract (``fluid.envcontract``): ``PADDLE_SLO`` arms it,
 ``PADDLE_SLO_FACTOR`` (default 3.0) is the regression factor,

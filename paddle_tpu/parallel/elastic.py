@@ -73,19 +73,34 @@ def heartbeat_path(hb_dir: str, rank: int) -> str:
 
 
 def write_heartbeat(hb_dir: str, step: Optional[int] = None,
-                    rank: Optional[int] = None) -> None:
+                    rank: Optional[int] = None,
+                    commit_step: Optional[int] = None) -> None:
     """Atomically publish this worker's liveness (tmp + rename, so the
     supervisor never reads a torn write).  Cheap enough for every step:
-    one small file per rank, rewritten in place."""
+    one small file per rank, rewritten in place.
+
+    ``commit_step`` is the last CHECKPOINT-COMMITTED step (defaults to
+    the process-wide ``observe.note_commit_step`` context, stamped at
+    every _SUCCESS write) — so the heartbeat a dead worker leaves behind
+    prices the restart: ``step - commit_step`` is the work the fleet
+    re-trains, and the supervisor copies both into the worker_exit /
+    heartbeat_timeout incident (progress-at-death)."""
     if rank is None:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if commit_step is None:
+        try:
+            from ..observe import current_commit_step
+
+            commit_step = current_commit_step()
+        except Exception:
+            commit_step = None
     try:
         os.makedirs(hb_dir, exist_ok=True)
         path = heartbeat_path(hb_dir, rank)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"ts": time.time(), "step": step, "rank": int(rank),
-                       "pid": os.getpid()}, f)
+                       "pid": os.getpid(), "commit_step": commit_step}, f)
         os.replace(tmp, path)
     except OSError:
         # liveness reporting must never kill the training it reports on
@@ -228,6 +243,20 @@ class ElasticSupervisor:
         # kill-and-resume stitches into one cross-process trace tree
         self.trace_id = _trace.trace_context()[0]
         self._gen_span: Optional[dict] = None
+        # in-flight straggler scan over the shared observe dir (ISSUE 13):
+        # every scan interval the supervisor re-derives cross-rank step
+        # skew from the workers' own window spans and emits one
+        # straggler.detected incident per (generation, rank) — the
+        # autoscaler-facing signal next to slo.breach in the same stream
+        from ..fluid import envcontract as _ec
+
+        self.goodput_scan_s = float(_ec.get("PADDLE_GOODPUT_SCAN_S"))
+        self.straggler_factor = float(
+            _ec.get("PADDLE_GOODPUT_STRAGGLER_FACTOR"))
+        self.straggler_min_samples = int(
+            _ec.get("PADDLE_GOODPUT_MIN_SAMPLES"))
+        self._stragglers_flagged: set = set()
+        self._last_scan = 0.0
 
     # -- public --
     def run(self) -> dict:
@@ -338,8 +367,15 @@ class ElasticSupervisor:
                    if rc is not None and rc != 0]
             if bad:
                 rank, rc = bad[0]
+                # progress-at-death from the rank's last heartbeat: the
+                # step it reached vs the step its newest _SUCCESS covers —
+                # what the restart re-trains (the goodput ledger prices
+                # lost_steps from exactly this record)
+                hb = read_heartbeat(self.hb_dir, rank) or {}
                 self.incidents.log(
                     "worker_exit", generation=gen, rank=rank, exit_code=rc,
+                    last_step=hb.get("step"),
+                    commit_step=hb.get("commit_step"),
                     log_tail=_tail(logs[rank].name))
                 return "failed"
             now = time.time()
@@ -357,14 +393,48 @@ class ElasticSupervisor:
                         "heartbeat_timeout", generation=gen, rank=rank,
                         stale_s=round(now - last, 3),
                         last_step=hb.get("step") if hb else None,
+                        commit_step=hb.get("commit_step") if hb else None,
                         log_tail=_tail(logs[rank].name))
                     return "failed"
+            if self.goodput_scan_s > 0 \
+                    and now - self._last_scan >= self.goodput_scan_s:
+                self._last_scan = now
+                self._scan_stragglers(gen)
             time.sleep(self.poll_interval)
+
+    def _scan_stragglers(self, gen: int) -> None:
+        """One skew pass over the fleet's window spans; each flagged rank
+        gets ONE ``straggler.detected`` incident per generation (mirrored
+        into the run-event stream next to the watchdog's slo.breach
+        records).  Never fails the supervisor."""
+        try:
+            from ..observe.fleet import fleet_events, rank_skew
+
+            skew = rank_skew(fleet_events(self.observe_dir),
+                             factor=self.straggler_factor,
+                             min_samples=self.straggler_min_samples,
+                             gen=gen)
+        except Exception:
+            return
+        for s in skew["stragglers"]:
+            key = (gen, s["worker"])
+            if key in self._stragglers_flagged:
+                continue
+            self._stragglers_flagged.add(key)
+            self.incidents.log(
+                "straggler.detected", generation=gen, rank=s["rank"],
+                host=s["host"], median_step_s=s["median_step_s"],
+                baseline_step_s=s["baseline_step_s"], ratio=s["ratio"],
+                n=s["n"], factor=self.straggler_factor)
 
     def _end_generation(self, gen: int, verdict: str) -> None:
         """Close the generation span: one ``elastic.generation`` duration
         record per generation, all sharing the run trace id — the rows a
-        merged trace view stitches worker spans under."""
+        merged trace view stitches worker spans under.  A final straggler
+        scan runs first so a generation shorter than the scan interval
+        still gets its skew verdict."""
+        if self.goodput_scan_s > 0:
+            self._scan_stragglers(gen)
         sp = self._gen_span
         if sp is None:
             return

@@ -112,7 +112,7 @@ def process_count() -> int:
     return jax.process_count() if _initialized else 1
 
 
-def barrier(tag: str = "barrier", timeout_s: float = 300.0) -> None:
+def barrier(tag: str = "barrier", timeout_s: float = 300.0) -> float:
     """Pod-wide rendezvous (no-op in a 1-process world).  THE hook point
     for the wedged-collective fault: an armed barrier stall sleeps here,
     which is exactly where a real wedged host stops heartbeating from.
@@ -121,12 +121,21 @@ def barrier(tag: str = "barrier", timeout_s: float = 300.0) -> None:
     real timeout — a dead peer surfaces as an error here instead of a
     silent infinite hang, and no device computation is involved, so it
     also works on hosts whose backend cannot run multiprocess XLA);
-    falls back to a device sync when no coordination client exists."""
+    falls back to a device sync when no coordination client exists.
+
+    Returns this rank's wait time (seconds): per-rank barrier-wait is the
+    straggler signature in a gang-scheduled fleet — the SLOW rank arrives
+    last and waits ~zero, every healthy rank's wait inflates — so each
+    wait is published as a ``barrier.wait`` run event + counter and
+    ``barrier``-state goodput time (ISSUE 13)."""
+    import time as _t
+
     from ..fluid import fault as _fault
 
     _fault.barrier_stall(tag)
     if not _initialized:
-        return
+        return 0.0
+    t0 = _t.perf_counter()
     client = getattr(
         __import__("jax._src.distributed", fromlist=["global_state"])
         .global_state, "client", None)
@@ -136,6 +145,17 @@ def barrier(tag: str = "barrier", timeout_s: float = 300.0) -> None:
         from jax.experimental import multihost_utils as mhu
 
         mhu.sync_global_devices(tag)
+    dur = _t.perf_counter() - t0
+    try:
+        from .. import observe
+        from ..observe import goodput as _goodput
+
+        observe.registry().inc("barrier.wait_seconds", dur)
+        observe.emit("barrier.wait", tag=tag, dur_s=round(dur, 6))
+        _goodput.note("barrier", dur)
+    except Exception:
+        pass  # accounting must never wedge the rendezvous it measures
+    return dur
 
 
 def heartbeat(step: Optional[int] = None) -> None:
@@ -394,9 +414,11 @@ def save_sharded_serial(state: dict, root: str, serial: int,
     like the single-process trainer checkpoint."""
     import json as _json
     import shutil
+    import time as _t
 
     from ..fluid import fault as _fault
 
+    t_save0 = _t.perf_counter()
     cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
     os.makedirs(cur, exist_ok=True)
     save_sharded(state, cur)
@@ -404,7 +426,7 @@ def save_sharded_serial(state: dict, root: str, serial: int,
         from ..data.checkpoint import save_data_state
 
         save_data_state(cur, data_state, rank=process_index())
-    barrier(f"ckpt_shards_{serial}")
+    barrier_s = barrier(f"ckpt_shards_{serial}")
     if process_index() == 0:
         if meta is not None:
             with open(os.path.join(cur, META_FILE), "w") as f:
@@ -418,7 +440,21 @@ def save_sharded_serial(state: dict, root: str, serial: int,
         # the commit point: after _SUCCESS the serial is trusted, and the
         # run-event stream shows which step's state survives a restart
         observe.emit("checkpoint.commit", serial=int(serial), path=cur)
-    barrier(f"ckpt_commit_{serial}")
+    barrier_s += barrier(f"ckpt_commit_{serial}")
+    from .. import observe
+    from ..observe import goodput as _goodput
+
+    # all ranks' shards are now covered by p0's _SUCCESS: record the
+    # committed step so heartbeats price work-at-risk, book the IO as
+    # checkpoint-state time (barrier waits already counted by barrier()),
+    # and leave one per-rank checkpoint.save span in the stream
+    commit_step = meta.get("step") if isinstance(meta, dict) else None
+    observe.note_commit_step(int(commit_step) if commit_step is not None
+                             else int(serial))
+    dur = _t.perf_counter() - t_save0
+    _goodput.note("checkpoint", max(0.0, dur - barrier_s))
+    observe.emit("checkpoint.save", serial=int(serial),
+                 dur_s=round(dur, 6), barrier_s=round(barrier_s, 6))
     if process_index() == 0 and max_num is not None:
         complete = [(s, n) for s, n in _sharded_serial_dirs(root)
                     if os.path.exists(os.path.join(root, n, SUCCESS_MARK))]

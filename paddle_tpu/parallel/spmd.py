@@ -616,6 +616,7 @@ class ShardedTrainStep:
                 extra=self.cache_extra(kind="sharded_step"),
                 spec_table=table_signature(self.specs))
         observe.note_mesh(self.label)
+        fresh = not self._dispatched
         t0 = _time.perf_counter()
         out = self._fn(feed, state)
         self._dispatched = True
@@ -626,6 +627,12 @@ class ShardedTrainStep:
             jax.block_until_ready(out)
             probe.finish(_time.perf_counter() - t0, self.program,
                          meta={"kind": "sharded_step", "mesh": self.label})
+        if self.program._params_grads is not None:
+            from ..observe import goodput as _goodput
+
+            # per-step sharded dispatch: first call compiles (lazy jit)
+            _goodput.note("compile" if fresh else "device",
+                          _time.perf_counter() - t0, mesh=self.label)
         return out
 
 
@@ -940,6 +947,7 @@ class ShardedWindowRunner:
 
         probe = None
         t = _time.perf_counter()
+        fresh_compile = self._compiled is None
         if self._compiled is None:
             with _trace.span("executor.compile", mesh=self.label,
                              n_steps=self.n_steps):
@@ -1047,6 +1055,16 @@ class ShardedWindowRunner:
                 "executor.step_time_s",
                 (t_obs1 - t_host0) / max(1, self.n_steps),
                 step=window_start + self.n_steps - 1, mesh=self.label)
+            from ..observe import goodput as _goodput
+
+            # goodput ledger: the one-off AOT lower+compile is compile
+            # state; the rest of the window is device compute
+            cdur = t_disp0 - t if fresh_compile else 0.0
+            if cdur > 0.0:
+                _goodput.note("compile", cdur, mesh=self.label)
+            _goodput.note("device",
+                          max(0.0, (t_obs1 - t_host0) - cdur),
+                          mesh=self.label)
         if return_numpy:
             return [np.asarray(self.step.fetch_to_host(v)) for v in fetches]
         return list(fetches)

@@ -1,0 +1,509 @@
+"""Goodput accounting + straggler detection (ISSUE 13).
+
+Unit tests cover the live accumulator (state counters, fraction gauge,
+re-warm booking, periodic reports), the offline ledger (priority sweep,
+restart-gap attribution, lost-work pricing), the leave-one-out
+median+MAD skew test, the straggler fault oracle, heartbeat
+``commit_step``, ``tail --follow`` and the chrome state track.
+
+The headline test is the SUPERVISED 2-rank, 2-generation oracle: a pod
+with an injected straggler on rank 1 (``PADDLE_FAULT_STRAGGLER_RANK``) and
+a kill on rank 0 is torn down and resumed; from the PERSISTED event
+stream alone, ``observe goodput`` must report a state breakdown summing
+to wall-clock, a ``straggler.detected`` record naming the injected rank,
+restart time attributed to the generation gap (priced in lost steps),
+and a goodput fraction strictly below an uninterrupted (same-faults,
+no-kill) reference run's.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.observe import fleet, goodput
+from paddle_tpu.observe.export import GOODPUT_TID, chrome_trace
+from paddle_tpu.parallel.elastic import (ElasticSupervisor, read_heartbeat,
+                                         write_heartbeat)
+from paddle_tpu.parallel.master import Backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# live accumulator
+# ---------------------------------------------------------------------------
+
+
+def test_accumulator_counters_fraction_and_report(tmp_path):
+    observe.configure(str(tmp_path), flush_s=60.0)
+    acc = goodput.GoodputAccumulator(report_s=3600.0,
+                                     t0=time.time() - 10.0, gen=0)
+    acc.note("device", 4.0)
+    acc.note("data_wait", 1.0)
+    acc.note("checkpoint", 0.5)
+    flat = observe.registry().flat()
+    assert flat['goodput.seconds{state="device"}'] == pytest.approx(4.0)
+    assert flat['goodput.seconds{state="data_wait"}'] == pytest.approx(1.0)
+    assert 0.0 < flat["goodput.fraction"] < 1.0
+    snap = acc.snapshot()
+    assert snap["fraction"] == pytest.approx(4.0 / snap["elapsed_s"],
+                                             rel=0.05)
+    # states + idle account for the whole elapsed window
+    assert sum(snap["states"].values()) == pytest.approx(snap["elapsed_s"],
+                                                         rel=0.01)
+    rep = acc.maybe_report(force=True)
+    assert rep is not None
+    recs = fleet.fleet_events(str(tmp_path))
+    assert any(r["event"] == "goodput.report"
+               and r["states"]["device"] == pytest.approx(4.0)
+               for r in recs)
+
+
+def test_accumulator_books_rewarm_as_restart_for_gen_gt_0():
+    # a RESTARTED generation's pre-first-window time (imports, jax init,
+    # checkpoint load) is restart-state; a cold start's is not
+    acc = goodput.GoodputAccumulator(report_s=3600.0,
+                                     t0=time.time() - 8.0, gen=1)
+    acc.note("compile", 2.0)
+    acc.note("device", 0.5)
+    assert acc.seconds["restart"] == pytest.approx(5.5, abs=0.2)
+    cold = goodput.GoodputAccumulator(report_s=3600.0,
+                                      t0=time.time() - 8.0, gen=0)
+    cold.note("device", 0.5)
+    assert cold.seconds["restart"] == 0.0
+
+
+def test_module_note_is_noop_when_disarmed(monkeypatch):
+    monkeypatch.setenv("PADDLE_GOODPUT", "0")
+    goodput.reset()
+    goodput.note("device", 1.0)
+    assert goodput.get_accumulator() is None
+    assert "goodput.fraction" not in observe.registry().flat()
+
+
+# ---------------------------------------------------------------------------
+# offline ledger
+# ---------------------------------------------------------------------------
+
+T0 = 1000.0
+
+
+def _rec(dt, event, rank=0, gen=0, **kw):
+    return {"ts": T0 + dt, "event": event, "host": "h", "rank": rank,
+            "gen": gen, **kw}
+
+
+def test_ledger_states_sum_to_wall_and_price_restart():
+    recs = [
+        _rec(1.0, "executor.trace", dur_s=1.0),
+        _rec(2.0, "executor.window", dur_s=0.8, n_steps=2),
+        _rec(3.0, "executor.window", dur_s=0.8, n_steps=2),
+        _rec(3.5, "data.stall", wait_ms=400.0),
+        _rec(4.0, "checkpoint.save", dur_s=0.4),
+        # supervisor incident: progress-at-death for the restart pricing
+        {"ts": T0 + 4.0, "event": "worker_exit", "generation": 0,
+         "rank": 0, "last_step": 9, "commit_step": 5, "host": "h",
+         "source": "supervisor"},
+        _rec(8.0, "executor.window", dur_s=0.5, n_steps=2, gen=1),
+    ]
+    led = goodput.build_ledger(recs)
+    states = led["states"]
+    assert states["device"] == pytest.approx(2.1)
+    assert states["compile"] == pytest.approx(1.0)
+    assert states["data_wait"] == pytest.approx(0.4)
+    assert states["checkpoint"] == pytest.approx(0.4)
+    assert states["restart"] == pytest.approx(3.5)
+    rank = led["ranks"]["h:r0"]
+    # the acceptance bound: breakdown sums to wall-clock (the sweep makes
+    # it exact; +-5% is the contract)
+    assert abs(rank["coverage"] - 1.0) < 0.05
+    assert sum(states.values()) == pytest.approx(rank["wall_s"])
+    assert led["fraction"] == pytest.approx(2.1 / 8.0)
+    (restart,) = led["restarts"]
+    assert restart["from_gen"] == 0 and restart["to_gen"] == 1
+    assert restart["gap_s"] == pytest.approx(3.5)
+    assert restart["lost_steps"] == 4  # step 9 reached, step 5 committed
+
+
+def test_ledger_priorities():
+    recs = [
+        # async checkpoint fully overlapping a running window: the window
+        # stays productive (device > checkpoint)
+        _rec(2.0, "executor.window", dur_s=1.0, n_steps=2),
+        _rec(1.9, "checkpoint.save", dur_s=0.5, background=True),
+        # compile-flagged dispatch beats the window it nests in
+        _rec(4.0, "executor.window", dur_s=1.0, n_steps=2),
+        _rec(3.9, "executor.dispatch", dur_s=0.7, compile=True),
+    ]
+    led = goodput.build_ledger(recs)
+    states = led["ranks"]["h:r0"]["states"]
+    assert states["checkpoint"] == pytest.approx(0.0)
+    assert states["compile"] == pytest.approx(0.7)
+    assert states["device"] == pytest.approx(2.0 - 0.7)
+
+
+def test_ledger_ignores_supervisor_timeline():
+    recs = [
+        _rec(1.0, "executor.window", dur_s=0.5, n_steps=1),
+        {"ts": T0 + 50.0, "event": "elastic.generation", "dur_s": 49.0,
+         "host": "h", "rank": 0, "gen": 0, "source": "supervisor"},
+    ]
+    led = goodput.build_ledger(recs)
+    # the supervisor's own records must not stretch a worker's wall
+    assert led["ranks"]["h:r0"]["wall_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+
+def _skew_records(slow_ratio, n=6):
+    recs = []
+    for i in range(n):
+        recs.append(_rec(float(i), "executor.window", rank=0,
+                         dur_s=0.02, n_steps=2))
+        recs.append(_rec(float(i), "executor.window", rank=1,
+                         dur_s=0.02 * slow_ratio, n_steps=2))
+    return recs
+
+
+def test_rank_skew_flags_two_rank_straggler():
+    skew = fleet.rank_skew(_skew_records(8.0))
+    (s,) = skew["stragglers"]
+    assert s["rank"] == 1 and s["ratio"] == pytest.approx(8.0)
+    # each (rank, gen)'s first 2 warm-up windows are excluded from samples
+    assert skew["ranks"]["h:r0"]["n"] == 4
+
+
+def test_rank_skew_below_factor_and_min_samples_quiet():
+    assert fleet.rank_skew(_skew_records(1.3))["stragglers"] == []
+    # too young: neither rank qualifies
+    assert fleet.rank_skew(_skew_records(8.0, n=4))["stragglers"] == []
+    # single rank: nothing to compare against
+    solo = [r for r in _skew_records(8.0) if r["rank"] == 1]
+    assert fleet.rank_skew(solo)["stragglers"] == []
+
+
+def test_rank_skew_ignores_warmup_and_compile_windows():
+    """A freshly RESTARTED rank's first windows carry lazy-jit compile
+    (10-100x steady state); with few post-restart samples a naive median
+    would flag the recovering rank as its own straggler (seen live in the
+    verification drill).  Warm-up/fresh windows must not count."""
+    recs = _skew_records(1.0)  # two healthy equal ranks...
+    # ...but rank 0 restarted into gen 1 and its first windows compiled
+    recs += [
+        _rec(10.0, "executor.window", rank=0, gen=1, dur_s=1.5, n_steps=2,
+             fresh=True),
+        _rec(11.0, "executor.window", rank=0, gen=1, dur_s=0.4, n_steps=2),
+        _rec(12.0, "executor.window", rank=0, gen=1, dur_s=0.02,
+             n_steps=2),
+        _rec(13.0, "executor.window", rank=0, gen=1, dur_s=0.02,
+             n_steps=2),
+    ]
+    skew = fleet.rank_skew(recs, min_samples=3)
+    assert skew["stragglers"] == [], skew
+    # gen-scoped scan: rank 0 has too few STEADY gen-1 samples to judge
+    assert fleet.rank_skew(recs, gen=1, min_samples=3)["stragglers"] == []
+
+
+def test_straggler_fault_delays_only_named_rank(monkeypatch):
+    from paddle_tpu.fluid import fault
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    fault.install(fault.FaultPlan(straggler_rank=1, straggler_ms=50.0))
+    try:
+        t0 = time.perf_counter()
+        fault.straggler_delay(2)
+        assert time.perf_counter() - t0 >= 0.09  # 2 steps x 50 ms
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        t0 = time.perf_counter()
+        fault.straggler_delay(2)
+        assert time.perf_counter() - t0 < 0.05
+    finally:
+        fault.clear()
+
+
+def test_straggler_env_contract_parses():
+    from paddle_tpu.fluid import fault
+
+    plan = fault.FaultPlan.from_env(
+        {"PADDLE_FAULT_STRAGGLER_RANK": "1",
+         "PADDLE_FAULT_STRAGGLER_MS": "25"})
+    assert plan.straggler_rank == 1
+    assert plan.straggler_ms == 25.0
+
+
+# ---------------------------------------------------------------------------
+# satellites: heartbeat commit_step, tail --follow, chrome state track
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_carries_commit_step(tmp_path):
+    observe.note_commit_step(23)
+    write_heartbeat(str(tmp_path), step=28, rank=0)
+    hb = read_heartbeat(str(tmp_path), 0)
+    assert hb["step"] == 28 and hb["commit_step"] == 23
+    # explicit argument wins over the process context
+    write_heartbeat(str(tmp_path), step=30, rank=1, commit_step=7)
+    assert read_heartbeat(str(tmp_path), 1)["commit_step"] == 7
+
+
+def test_follow_events_tails_appends_and_new_files(tmp_path):
+    root = str(tmp_path)
+    path = os.path.join(root, "events-h-r0-g0.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"ts": 1.0, "event": "a"}) + "\n")
+    got, stop = [], threading.Event()
+
+    def run():
+        for rec in fleet.follow_events(root, poll_s=0.05,
+                                       stop_check=stop.is_set):
+            got.append(rec)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    with open(path, "a") as f:
+        f.write(json.dumps({"ts": 2.0, "event": "b"}) + "\n")
+        f.write('{"torn')  # incomplete line must stay buffered
+    # a NEW file (a later generation's worker) is picked up mid-follow
+    with open(os.path.join(root, "events-h-r0-g1.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": 3.0, "event": "c"}) + "\n")
+    deadline = time.time() + 5.0
+    while time.time() < deadline and len(got) < 3:
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=5.0)
+    assert [r["event"] for r in got] == ["a", "b", "c"]
+
+
+def test_follow_events_from_end_skips_history(tmp_path):
+    """The CLI prints history itself, then follows only NEW records."""
+    root = str(tmp_path)
+    path = os.path.join(root, "events-h-r0-g0.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"ts": 1.0, "event": "old"}) + "\n")
+    got, stop = [], threading.Event()
+
+    def run():
+        for rec in fleet.follow_events(root, poll_s=0.05,
+                                       stop_check=stop.is_set,
+                                       from_end=True):
+            got.append(rec)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    with open(path, "a") as f:
+        f.write(json.dumps({"ts": 2.0, "event": "new"}) + "\n")
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not got:
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=5.0)
+    assert [r["event"] for r in got] == ["new"]
+
+
+def test_chrome_trace_goodput_state_track():
+    recs = [
+        _rec(1.0, "executor.window", dur_s=0.5, n_steps=1),
+        _rec(4.0, "executor.window", dur_s=0.5, n_steps=1, gen=1),
+    ]
+    led = goodput.build_ledger(recs)
+    assert any(s["state"] == "restart" for s in led["segments"])
+    trace = chrome_trace(recs, goodput_segments=led["segments"])
+    track = [e for e in trace["traceEvents"]
+             if e.get("tid") == GOODPUT_TID and e.get("ph") == "X"]
+    assert {e["name"] for e in track} == {"state:device", "state:restart"}
+    names = [e for e in trace["traceEvents"]
+             if e.get("name") == "thread_name"
+             and e.get("tid") == GOODPUT_TID]
+    assert names and names[0]["args"]["name"] == "goodput state"
+
+
+def test_goodput_smoke_tool():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "goodput_smoke.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert report["ok"], report
+    assert report["elapsed_s"] < 20.0, report
+
+
+# ---------------------------------------------------------------------------
+# THE oracle: supervised 2-rank, 2-generation straggler + kill-and-resume
+# ---------------------------------------------------------------------------
+
+N_PROC = 2
+N_STEPS_TOTAL = 24
+BATCH = 4
+SPD = 2
+STEP_INTERVAL = 8
+KILL_STEP = 21
+STRAGGLER_MS = 20.0
+
+WORKER = f"""
+import os, sys, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+# data-plane oracle convention (tests/test_data_resume.py): opt out of the
+# supervisor's shared compile cache — this container's jaxlib CPU backend
+# intermittently segfaults EXECUTING deserialized cached executables
+os.environ.pop("PADDLE_COMPILE_CACHE_DIR", None)
+
+sys.path.insert(0, {REPO!r})
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import data
+
+fluid.default_main_program().random_seed = 7
+fluid.default_startup_program().random_seed = 7
+
+def reader():
+    rng = np.random.RandomState(5 + rank)
+    for _ in range({N_STEPS_TOTAL} * {BATCH}):
+        yield (rng.normal(size=(4,)).astype(np.float32),
+               rng.normal(size=(1,)).astype(np.float32))
+
+pipe = data.from_reader(reader).batch({BATCH})
+
+def train_func():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    return fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+
+workdir = os.environ["GOODPUT_TEST_DIR"]
+cfg = fluid.CheckpointConfig(os.path.join(workdir, "ckpt_r%d" % rank),
+                             step_interval={STEP_INTERVAL})
+trainer = fluid.Trainer(
+    train_func=train_func,
+    optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    place=fluid.CPUPlace(), checkpoint_config=cfg)
+trainer.train(num_epochs=1, event_handler=lambda ev: None, reader=pipe,
+              feed_order=["x", "y"])
+"""
+
+
+def _run_supervised(workdir, kill: bool, monkeypatch):
+    worker_py = os.path.join(workdir, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER)
+    # fast supervisor-side skew scan; 2 ranks need a low sample floor
+    # (the killed rank only completes a handful of windows)
+    monkeypatch.setenv("PADDLE_GOODPUT_SCAN_S", "0.5")
+    monkeypatch.setenv("PADDLE_GOODPUT_MIN_SAMPLES", "3")
+    fault_env = {
+        # rank 1 straggles; the stall + kill are scoped to rank 0
+        "PADDLE_FAULT_STRAGGLER_RANK": "1",
+        "PADDLE_FAULT_STRAGGLER_MS": str(STRAGGLER_MS),
+        "PADDLE_FAULT_DATA_STALL_MS": "20",
+        "PADDLE_FAULT_RANK": "0",
+    }
+    if kill:
+        fault_env["PADDLE_FAULT_KILL_STEP"] = str(KILL_STEP)
+    sup = ElasticSupervisor(
+        f"{sys.executable} {worker_py}", nproc=N_PROC, workdir=workdir,
+        hb_timeout=120.0, poll_interval=0.2, max_restarts=2,
+        backoff=Backoff(base=0.4, factor=1.0), deadline=240.0,
+        extra_env={
+            "GOODPUT_TEST_DIR": workdir,
+            "PADDLE_TPU_SPD": str(SPD),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1 "
+                         "--xla_cpu_enable_concurrency_optimized_scheduler"
+                         "=false",
+        },
+        fault_env=fault_env)
+    result = sup.run()
+
+    def _tails():
+        outs = []
+        for fn in sorted(os.listdir(workdir)):
+            if fn.startswith("worker_") and fn.endswith(".log"):
+                with open(os.path.join(workdir, fn), "rb") as f:
+                    outs.append(
+                        f"== {fn} ==\n"
+                        + f.read()[-1500:].decode("utf-8", "replace"))
+        return "\n".join(outs)
+
+    assert result["status"] == "finished", (result, _tails())
+    return result, fleet.fleet_events(result["observe_dir"])
+
+
+def test_supervised_straggler_and_restart_oracle(tmp_path, monkeypatch):
+    faulty_dir = str(tmp_path / "faulty")
+    ref_dir = str(tmp_path / "ref")
+    os.makedirs(faulty_dir)
+    os.makedirs(ref_dir)
+    result, events = _run_supervised(faulty_dir, kill=True, monkeypatch=monkeypatch)
+    assert result["generations"] == 2, result
+
+    # -- the injected straggler is DETECTED with the right rank label,
+    #    from the in-flight supervisor scan over the workers' own spans
+    detected = [r for r in events if r.get("event") == "straggler.detected"]
+    assert detected, [r.get("event") for r in events][-40:]
+    assert all(d["rank"] == 1 for d in detected), detected
+    assert any(d["generation"] == 0 and d["ratio"] > 1.5 for d in detected)
+    # it also landed in incidents.jsonl next to worker_exit
+    assert any(e["event"] == "straggler.detected"
+               for e in result["incidents"])
+
+    # -- worker_exit carries progress-at-death (heartbeat commit_step)
+    exits = [e for e in result["incidents"] if e["event"] == "worker_exit"]
+    assert exits and exits[0]["exit_code"] == 137
+    assert isinstance(exits[0].get("commit_step"), int)
+    assert exits[0]["last_step"] > exits[0]["commit_step"]
+
+    # -- the ledger, re-derived from the persisted stream with no re-run
+    led = goodput.build_ledger(events)
+    for key, rank in led["ranks"].items():
+        assert abs(rank["coverage"] - 1.0) < 0.05, (key, rank)
+    assert led["states"]["device"] > 0.0
+    assert led["states"]["data_wait"] > 0.0  # rank 0's injected stalls
+    assert 0.0 < led["fraction"] < 1.0
+
+    # -- restart/re-warm time is attributed to the generation gap and
+    #    priced in lost steps from the incident's progress-at-death
+    assert led["states"]["restart"] > 0.5, led["states"]
+    gaps = [r for r in led["restarts"] if r["from_gen"] == 0]
+    assert len(gaps) == N_PROC, led["restarts"]
+    assert all(g["gap_s"] > 0.5 for g in gaps)
+    killed = [g for g in gaps if g["rank"] == exits[0]["rank"]]
+    assert killed and killed[0]["lost_steps"] > 0, gaps
+
+    # -- the CLI answers the same from the same files
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observe", "goodput",
+         "--dir", result["observe_dir"]],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    cli = json.loads(out.stdout)
+    assert cli["fraction"] == pytest.approx(led["fraction"])
+    assert cli["straggler_events"], cli.get("straggler_events")
+
+    # -- an uninterrupted run (same straggler + stall, NO kill) has a
+    #    strictly higher goodput fraction: the preemption's restart gap
+    #    is pure lost wall-clock
+    ref_result, ref_events = _run_supervised(ref_dir, kill=False,
+                                             monkeypatch=monkeypatch)
+    assert ref_result["generations"] == 1
+    ref_led = goodput.build_ledger(ref_events)
+    assert ref_led["states"]["restart"] == pytest.approx(0.0)
+    assert led["fraction"] < ref_led["fraction"], \
+        (led["fraction"], ref_led["fraction"], led["states"],
+         ref_led["states"])

@@ -1,0 +1,164 @@
+"""Goodput-accounting smoke (CPU, < 5 s) — the ISSUE 13 CI oracle.
+
+A 16-step guarded training window is fed through a checkpointable data
+pipeline with ONE injected 150 ms input stall (``PADDLE_FAULT_DATA_STALL_MS``
+at a fixed source cursor), under a temp observe dir:
+
+ - the live accumulator must book nonzero ``data_wait``-state time and a
+   goodput fraction strictly inside (0, 1) (the stall and the compile
+   guarantee wall-clock the device did not train);
+ - ``goodput.seconds{state=...}`` counters and a forced ``goodput.report``
+   event must exist;
+ - the ``python -m paddle_tpu.observe goodput`` CLI must re-derive a
+   ledger FROM THE PERSISTED STREAM ALONE whose per-worker states sum to
+   its wall-clock (coverage == 1) with nonzero device AND stall time.
+
+Run directly (``python tools/goodput_smoke.py``) or from tier-1 via
+``tests/test_goodput.py::test_goodput_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_STEPS = 16
+BATCH = 8
+STALL_MS = 150.0
+STALL_AT = 4  # source sample cursor the one-shot stall fires at
+
+
+def main() -> dict:
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import data, observe
+    from paddle_tpu.fluid import fault
+    from paddle_tpu.observe import goodput
+
+    t_start = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="goodput_smoke_")
+    report = {"ok": False, "root": root}
+    try:
+        observe.configure(root, flush_s=60.0)
+        fault.install(fault.FaultPlan(data_stall_ms=STALL_MS,
+                                      data_stall_at=STALL_AT))
+
+        prog, startup = fluid.Program(), fluid.Program()
+        prog.random_seed = startup.random_seed = 11
+        with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=16, act="relu")
+            pred = fluid.layers.fc(input=h, size=1, act=None)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(
+                loss, startup_program=startup)
+
+        rng = np.random.RandomState(3)
+
+        def reader():
+            for _ in range(N_STEPS * BATCH):
+                yield (rng.normal(size=(8,)).astype(np.float32),
+                       rng.normal(size=(1,)).astype(np.float32))
+
+        pipe = data.from_reader(reader).batch(BATCH)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            # pull the whole window through the instrumented iterator so
+            # every batch's wait (incl. the injected stall) is accounted,
+            # then run all 16 steps as ONE dispatch
+            feeds = []
+            for batch in data.timed(pipe()):
+                feeds.append(
+                    {"x": np.stack([s[0] for s in batch]),
+                     "y": np.stack([s[1] for s in batch])})
+                if len(feeds) == N_STEPS:
+                    break
+            window = {k: np.stack([f[k] for f in feeds])
+                      for k in feeds[0]}
+            (lv,) = exe.run_steps(prog, feed=window, fetch_list=[loss],
+                                  n_steps=N_STEPS, feed_per_step=True)
+        report["last_loss"] = float(np.asarray(lv).reshape(-1)[0])
+        goodput.report(force=True)
+
+        acc = goodput.get_accumulator()
+        snap = acc.snapshot() if acc is not None else {}
+        report["live_states"] = snap.get("states", {})
+        report["live_fraction"] = snap.get("fraction")
+        report["live_ok"] = bool(
+            snap
+            and snap["states"]["data_wait"] >= STALL_MS / 1e3 * 0.9
+            and snap["states"]["device"] > 0.0
+            and 0.0 < snap["fraction"] < 1.0)
+        flat = observe.registry().flat()
+        report["counter_ok"] = \
+            flat.get('goodput.seconds{state="data_wait"}', 0.0) > 0.0
+        # flush the sink so the subprocess CLI sees the persisted stream
+        sink = observe.get_sink()
+        if sink is not None:
+            sink.flush()
+
+        # -- CLI round-trip: ledger re-derived from the files alone
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observe", "goodput",
+             "--dir", root],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        report["cli_rc"] = out.returncode
+        ledger = json.loads(out.stdout) if out.returncode == 0 else {}
+        states = ledger.get("states", {})
+        ranks = ledger.get("ranks", {})
+        report["ledger_states"] = states
+        report["ledger_fraction"] = ledger.get("fraction")
+        report["ledger_ok"] = bool(
+            out.returncode == 0
+            and states.get("device", 0) > 0
+            and states.get("data_wait", 0) > 0
+            and 0.0 < ledger.get("fraction", 0) < 1.0
+            and all(abs(r["coverage"] - 1.0) < 0.05
+                    for r in ranks.values()))
+
+        # goodput.report landed in the stream
+        from paddle_tpu.observe.fleet import fleet_events
+
+        report["report_events"] = sum(
+            1 for r in fleet_events(root)
+            if r.get("event") == "goodput.report")
+        report["elapsed_s"] = round(time.perf_counter() - t_start, 2)
+        report["ok"] = bool(report["live_ok"] and report["counter_ok"]
+                            and report["ledger_ok"]
+                            and report["report_events"] >= 1)
+    except Exception as exc:  # a broken smoke must still print its JSON
+        import traceback
+
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["trace"] = traceback.format_exc(limit=5)
+    finally:
+        try:
+            from paddle_tpu import observe as _obs
+            from paddle_tpu.fluid import fault as _fault
+
+            _fault.clear()
+            _obs.reset()
+        except Exception:
+            pass
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
