@@ -26,7 +26,7 @@ import os
 from typing import Optional
 
 __all__ = ["DATA_STATE_PREFIX", "data_state_path", "save_data_state",
-           "load_data_state"]
+           "load_data_state", "load_all_data_states", "remap_data_state"]
 
 DATA_STATE_PREFIX = "data_state_"
 _VERSION = 1
@@ -85,3 +85,90 @@ def load_data_state(dirname: str, rank: int = 0) -> Optional[dict]:
             f"data_state blob {path} has version {version}, this build "
             f"reads {_VERSION}")
     return state
+
+
+def load_all_data_states(dirname: str) -> dict:
+    """Every rank's blob from a COMMITTED serial dir: ``rank -> state``.
+
+    The reshard-on-load path needs the WHOLE dead fleet's cursors (a
+    dp4 serial resumed on dp2 merges two shard streams per new rank),
+    not just this rank's.  Empty dict = legacy serial with no data
+    plane; a blob that exists but cannot be read raises ``IOError``
+    exactly like :func:`load_data_state` — the caller condemns the
+    serial."""
+    out = {}
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(DATA_STATE_PREFIX)
+                and name.endswith(".json")):
+            continue
+        try:
+            rank = int(name[len(DATA_STATE_PREFIX):-len(".json")])
+        except ValueError:
+            continue
+        state = load_data_state(dirname, rank)
+        if state is not None:
+            out[rank] = state
+    return out
+
+
+def remap_data_state(dirname: str, old_layout: dict,
+                     new_num_shards: int, new_shard_index: int):
+    """This rank's resharded cursor from a serial committed under a
+    DIFFERENT shard layout.
+
+    ``old_layout`` maps each dead-fleet rank to its ``(num_shards,
+    shard_index)`` pair (recorded in the serial's meta at save time, or
+    re-derived via :func:`~paddle_tpu.data.sharding.shard_layout`).
+    tp/fsdp peers — ranks sharing one shard index — read identical data,
+    so their blobs must agree byte-for-byte (the ``shard_spec``
+    identical-data rule); they collapse to one cursor per stream before
+    :func:`~paddle_tpu.data.sharding.merge_cursor_states` re-keys the
+    streams onto ``(new_num_shards, new_shard_index)``.
+
+    Returns ``None`` when the serial carries no data states (legacy
+    resume); raises ``ValueError`` by name on any inconsistency — a
+    wrong guess here silently drops or double-consumes samples, which is
+    the exact failure this subsystem exists to kill."""
+    from .sharding import merge_cursor_states
+
+    states = load_all_data_states(dirname)
+    if not states:
+        return None
+    shard_counts = set()
+    by_shard: dict = {}
+    for rank, state in sorted(states.items()):
+        pair = old_layout.get(rank, old_layout.get(str(rank)))
+        if pair is None:
+            raise ValueError(
+                f"remap_data_state: serial has a cursor for rank {rank} "
+                f"but the recorded shard layout covers only ranks "
+                f"{sorted(old_layout)} — cannot tell which stream it "
+                f"indexes")
+        n, i = int(pair[0]), int(pair[1])
+        shard_counts.add(n)
+        prev = by_shard.get(i)
+        if prev is None:
+            by_shard[i] = state
+        elif json.dumps(prev, sort_keys=True) != json.dumps(state,
+                                                           sort_keys=True):
+            raise ValueError(
+                f"remap_data_state: ranks sharing shard stream {i} "
+                f"committed DIFFERENT cursors — tp/fsdp peers must read "
+                f"identical data; the serial is inconsistent")
+    if len(shard_counts) != 1:
+        raise ValueError(
+            f"remap_data_state: recorded layout mixes shard counts "
+            f"{sorted(shard_counts)}")
+    old_n = shard_counts.pop()
+    if sorted(by_shard) != list(range(old_n)):
+        # the RECORDED stream count is authoritative: blobs covering only
+        # a subset must not silently masquerade as a smaller fleet
+        raise ValueError(
+            f"remap_data_state: serial records {old_n} shard stream(s) "
+            f"but cursors cover only {sorted(by_shard)} — a missing "
+            f"stream would silently drop its unconsumed samples")
+    return merge_cursor_states(by_shard, new_num_shards, new_shard_index)

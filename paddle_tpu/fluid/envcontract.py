@@ -179,6 +179,10 @@ declare("PADDLE_LOCAL_DEVICE_IDS", "str", None, "parallel",
         "Comma-separated local device ids visible to this process")
 
 # -- elastic supervisor --
+declare("PADDLE_TPU_MESH_LADDER", "str", None, "elastic",
+        "Semicolon-ordered mesh downgrade ladder, largest first (e.g. "
+        "'dp4;dp2;dp1'): after a permanent host loss the supervisor "
+        "relaunches on the largest entry the survivor census can run")
 declare("PADDLE_ELASTIC_HB_DIR", "path", None, "elastic",
         "Heartbeat directory the supervisor watches (set per generation)")
 declare("PADDLE_ELASTIC_INCIDENTS", "path", None, "elastic",
@@ -290,6 +294,12 @@ declare("PADDLE_FAULT_STRAGGLER_MS", "float", 0.0, "fault",
         "Per-step delay (ms) injected into the straggler rank's step "
         "boundary — inflates its window spans so the skew detector "
         "must flag it")
+declare("PADDLE_FAULT_HOST_LOSS_RANK", "int", None, "fault",
+        "Permanent host loss: this rank exits hard at the armed step "
+        "boundary and drops a host_lost marker the supervisor census "
+        "reads — the replacement fleet is SMALLER (mesh-ladder oracle)")
+declare("PADDLE_FAULT_HOST_LOSS_AT_STEP", "int", 0, "fault",
+        "Training step at which the host-loss fault fires")
 
 # -- memory observability --
 declare("PADDLE_MEM_BUDGET_MB", "float", None, "memory",

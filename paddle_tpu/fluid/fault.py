@@ -40,6 +40,19 @@ worker processes:
     PADDLE_FAULT_BARRIER_STALL=s  sleep s seconds before the next collective
                                   barrier (one-shot), simulating a wedged
                                   host that trips the supervisor's timeout
+    PADDLE_FAULT_HOST_LOSS_RANK=r
+                                  permanent host loss: rank r exits hard at
+                                  the PADDLE_FAULT_HOST_LOSS_AT_STEP step
+                                  boundary AND drops a host_lost marker in
+                                  the supervisor's heartbeat dir, so the
+                                  survivor census sees a smaller fleet —
+                                  unlike kill-at-step, the replacement
+                                  generation cannot be the same size; the
+                                  deterministic oracle for the supervisor's
+                                  mesh-ladder downgrade (PADDLE_TPU_MESH_
+                                  LADDER).  Keyed on its own rank knob like
+                                  the straggler, so it composes with other
+                                  rank-scoped faults in one scenario.
     PADDLE_FAULT_SERVE_DELAY_MS=t sleep t ms per serving-engine request
                                   (slow-model / GC-pause simulation on the
                                   inference path)
@@ -148,6 +161,8 @@ class FaultPlan:
                  mem_pressure_at: int = 8,
                  straggler_rank: Optional[int] = None,
                  straggler_ms: float = 0.0,
+                 host_loss_rank: Optional[int] = None,
+                 host_loss_at_step: int = 0,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -178,6 +193,9 @@ class FaultPlan:
         self.straggler_rank = None if straggler_rank is None \
             else int(straggler_rank)
         self.straggler_ms = float(straggler_ms)
+        self.host_loss_rank = None if host_loss_rank is None \
+            else int(host_loss_rank)
+        self.host_loss_at_step = int(host_loss_at_step)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
@@ -228,6 +246,11 @@ class FaultPlan:
             if env.get("PADDLE_FAULT_STRAGGLER_RANK", "").strip()
             else None,
             straggler_ms=getf("PADDLE_FAULT_STRAGGLER_MS"),
+            host_loss_rank=int(env.get("PADDLE_FAULT_HOST_LOSS_RANK",
+                                       "").strip() or -1)
+            if env.get("PADDLE_FAULT_HOST_LOSS_RANK", "").strip()
+            else None,
+            host_loss_at_step=int(getf("PADDLE_FAULT_HOST_LOSS_AT_STEP")),
             rank=int(rank) if rank else None,
             mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
         )
@@ -278,17 +301,47 @@ def current_step() -> int:
     return _step
 
 
+def _host_loss_fire(plan: FaultPlan, lo: int, hi: int) -> None:
+    """Permanent-host-loss oracle: when the armed rank reaches its step,
+    drop a ``host_lost_g<gen>_r<rank>`` marker into the supervisor's
+    heartbeat dir (the survivor census input — this "host" never
+    rejoins) and crash hard.  Keyed on ``host_loss_rank`` alone, like the
+    straggler, so it composes with PADDLE_FAULT_RANK-scoped faults."""
+    if plan.host_loss_rank is None:
+        return
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    if plan.host_loss_rank != rank:
+        return
+    if not lo <= plan.host_loss_at_step < hi:
+        return
+    hb_dir = os.environ.get("PADDLE_ELASTIC_HB_DIR")
+    if hb_dir:
+        gen = os.environ.get("PADDLE_ELASTIC_GENERATION", "0") or "0"
+        try:
+            os.makedirs(hb_dir, exist_ok=True)
+            with open(os.path.join(hb_dir,
+                                   f"host_lost_g{gen}_r{rank}"), "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            pass  # the crash below still fires; census just sees a kill
+    plan._crash(
+        f"host loss (rank {rank}) at step {plan.host_loss_at_step}")
+
+
 def on_step(step: Optional[int] = None) -> int:
     """Training-step boundary, called BEFORE the step executes.  ``step``
     pins the index explicitly (resume-aware callers); default is an
-    internal monotonic per-process counter.  Fires kill-at-step-N."""
+    internal monotonic per-process counter.  Fires kill-at-step-N and
+    the permanent host-loss fault."""
     global _step
     if step is not None:
         _step = int(step)
     plan = active()
-    if plan is not None and plan.kill_step is not None \
-            and _step == plan.kill_step and plan._applies_to_this_rank():
-        plan._crash(f"kill at step {_step}")
+    if plan is not None:
+        if plan.kill_step is not None and _step == plan.kill_step \
+                and plan._applies_to_this_rank():
+            plan._crash(f"kill at step {_step}")
+        _host_loss_fire(plan, _step, _step + 1)
     fired = _step
     if step is None:
         _step += 1
@@ -299,14 +352,17 @@ def on_step(step: Optional[int] = None) -> int:
 
 def advance(n: int) -> None:
     """Bulk step advance for fused multi-step dispatches (run_steps): a
-    kill armed anywhere inside the window fires before the dispatch — the
-    finest kill granularity a single XLA dispatch allows."""
+    kill (or host loss) armed anywhere inside the window fires before
+    the dispatch — the finest granularity a single XLA dispatch
+    allows."""
     global _step
     plan = active()
-    if plan is not None and plan.kill_step is not None \
-            and _step <= plan.kill_step < _step + n \
-            and plan._applies_to_this_rank():
-        plan._crash(f"kill inside step window [{_step}, {_step + n})")
+    if plan is not None:
+        if plan.kill_step is not None \
+                and _step <= plan.kill_step < _step + n \
+                and plan._applies_to_this_rank():
+            plan._crash(f"kill inside step window [{_step}, {_step + n})")
+        _host_loss_fire(plan, _step, _step + n)
     _step += n
 
 

@@ -279,7 +279,17 @@ def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
         if not isinstance(step, int) or step < 0:
             step = _observe.current_step()
         _observe.note_commit_step(step)
-        _observe.emit("checkpoint.commit", path=cur, step=step)
+        # mesh-labeled like multihost's commit (ISSUE 14): the env spec
+        # covers workers whose topology never dispatched a sharded
+        # runner in this process (note_mesh context unset)
+        commit_fields = {"path": cur, "step": step}
+        if _observe.current_mesh() is None:
+            from ..parallel.mesh import axes_label, axes_of
+
+            tag = axes_label(axes_of(None))
+            if tag is not None:
+                commit_fields["mesh"] = tag
+        _observe.emit("checkpoint.commit", **commit_fields)
     except Exception:
         pass  # telemetry must never fail the commit it describes
     # scroll-delete: keep newest max_num_checkpoints complete serials,

@@ -360,14 +360,25 @@ def _sweep(intervals: List[Tuple[float, float, str]], t0: float,
 def _restart_pricing(records: List[dict], per: Dict[str, dict]) -> List[dict]:
     """One entry per (rank, generation gap), priced in lost steps where a
     worker_exit/heartbeat_timeout incident carries progress-at-death
-    (``last_step`` vs heartbeat ``commit_step`` — ISSUE 13 satellite)."""
+    (``last_step`` vs heartbeat ``commit_step`` — ISSUE 13 satellite).
+    A supervisor ``mesh.downgrade`` incident covering the gap's target
+    generation additionally prices the TOPOLOGY transition
+    (``mesh_from``/``mesh_to``/``nproc_from``/``nproc_to`` — ISSUE 14):
+    a restart that also shrank the mesh is a different cost class from a
+    same-size relaunch, and the ledger is where an autoscaler reads
+    that."""
     deaths: Dict[Tuple[int, int], dict] = {}
+    downgrades: Dict[int, dict] = {}
     for r in records:
         if r.get("event") in ("worker_exit", "heartbeat_timeout"):
             g = r.get("generation")
             rk = r.get("rank")
             if g is not None and rk is not None:
                 deaths[(int(g), int(rk))] = r
+        elif r.get("event") == "mesh.downgrade":
+            g = r.get("generation")
+            if g is not None:
+                downgrades[int(g)] = r
     out: List[dict] = []
     for key, w in sorted(per.items()):
         gens = sorted(w["gens"])
@@ -383,6 +394,12 @@ def _restart_pricing(records: List[dict], per: Dict[str, dict]) -> List[dict]:
                 entry["commit_step"] = commit
                 if isinstance(last, int) and isinstance(commit, int):
                     entry["lost_steps"] = max(0, last - commit)
+            down = downgrades.get(b)
+            if down is not None:
+                entry["mesh_from"] = down.get("from_mesh")
+                entry["mesh_to"] = down.get("to_mesh")
+                entry["nproc_from"] = down.get("from_nproc")
+                entry["nproc_to"] = down.get("to_nproc")
             out.append(entry)
     return out
 

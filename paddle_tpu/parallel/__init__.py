@@ -7,17 +7,18 @@ TPU-native replacement for the reference's distributed stack (SURVEY.md §2.5,
 
 from .local_sgd import AsyncLocalSGDTrainer
 from .mesh import (make_mesh, make_mesh_nd, local_device_count,
-                   mesh_from_spec, mesh_label, parse_mesh_spec,
-                   env_mesh_spec, MESH_ENV)
+                   mesh_from_spec, mesh_label, axes_of, axes_label,
+                   parse_mesh_spec, env_mesh_spec, MESH_ENV)
+from .reshard import ReshardError
 from .spmd import (batch_spec, collective_stats, infer_param_specs,
                    shard_program_step, table_signature, ShardedTrainStep,
                    ShardedWindowRunner, SpecLayout)
 from .master import Task, TaskDispatcher, task_reader
 
 __all__ = ["make_mesh", "make_mesh_nd", "local_device_count",
-           "mesh_from_spec", "mesh_label", "parse_mesh_spec",
-           "env_mesh_spec", "MESH_ENV", "batch_spec", "collective_stats",
-           "infer_param_specs", "shard_program_step", "table_signature",
-           "ShardedTrainStep", "ShardedWindowRunner", "SpecLayout",
-           "Task", "TaskDispatcher", "task_reader",
-           "AsyncLocalSGDTrainer"]
+           "mesh_from_spec", "mesh_label", "axes_of", "axes_label",
+           "parse_mesh_spec", "env_mesh_spec", "MESH_ENV", "batch_spec",
+           "collective_stats", "infer_param_specs", "shard_program_step",
+           "table_signature", "ShardedTrainStep", "ShardedWindowRunner",
+           "SpecLayout", "ReshardError", "Task", "TaskDispatcher",
+           "task_reader", "AsyncLocalSGDTrainer"]

@@ -59,8 +59,56 @@ from .master import Backoff
 
 __all__ = [
     "write_heartbeat", "read_heartbeat", "heartbeat_path",
+    "host_loss_markers", "viable_mesh",
     "IncidentLog", "ElasticSupervisor",
 ]
+
+#: marker files the permanent-host-loss fault drops into the heartbeat
+#: dir (``host_lost_g<gen>_r<rank>``, written by ``fluid.fault`` at the
+#: moment the doomed rank exits).  Unlike heartbeats they are never
+#: cleaned between generations: each one is a host that will NOT come
+#: back, and the supervisor's survivor census subtracts them all.
+HOST_LOSS_PREFIX = "host_lost_"
+
+
+def host_loss_markers(hb_dir: str) -> list:
+    """All permanent-host-loss markers under ``hb_dir`` (sorted names)."""
+    try:
+        return sorted(n for n in os.listdir(hb_dir)
+                      if n.startswith(HOST_LOSS_PREFIX))
+    except OSError:
+        return []
+
+
+def viable_mesh(ladder: List[str], survivors: int,
+                devices_per_host: int = 1) -> Optional[tuple]:
+    """The largest ladder entry the surviving fleet can run: first spec
+    (ladder order = preference order, largest first) whose device
+    requirement fits on ``survivors`` hosts AND whose dp extent tiles
+    with the process count it implies (``data.sharding.shard_spec`` —
+    a mesh the data plane cannot feed is not viable).  Returns
+    ``(spec, nproc)`` or ``None`` when nothing on the ladder fits."""
+    from ..data.sharding import shard_spec
+    from .mesh import parse_mesh_spec
+
+    devices_per_host = max(1, int(devices_per_host))
+    for spec in ladder:
+        try:
+            axes = parse_mesh_spec(spec)
+        except ValueError:
+            continue  # a typo'd rung must not wedge the downgrade
+        need = 1
+        for extent in axes.values():
+            need *= int(extent)
+        nproc = max(1, -(-need // devices_per_host))  # ceil division
+        if nproc > max(0, int(survivors)):
+            continue
+        try:
+            shard_spec(spec, host_rank=0, num_hosts=nproc)
+        except ValueError:
+            continue
+        return spec, nproc
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -196,11 +244,13 @@ class ElasticSupervisor:
                  fault_env: Optional[Dict[str, str]] = None,
                  deadline: Optional[float] = None,
                  compile_cache_dir: Optional[str] = None,
-                 observe_dir: Optional[str] = None):
+                 observe_dir: Optional[str] = None,
+                 mesh_ladder: Optional[str] = None):
         if nproc < 1:
             raise ValueError("nproc must be >= 1")
         self.entry = entry
         self.nproc = int(nproc)
+        self.initial_nproc = int(nproc)
         self.workdir = os.path.abspath(workdir)
         self.hb_timeout = float(hb_timeout)
         self.poll_interval = float(poll_interval)
@@ -257,6 +307,23 @@ class ElasticSupervisor:
             _ec.get("PADDLE_GOODPUT_MIN_SAMPLES"))
         self._stragglers_flagged: set = set()
         self._last_scan = 0.0
+        # mesh downgrade ladder (ISSUE 14): after a permanent host loss
+        # the supervisor relaunches on the largest rung the survivor
+        # census can run (smaller fleet + PADDLE_TPU_MESH rewritten for
+        # every next-generation worker) instead of burning the restart
+        # budget against a barrier the dead host will never reach.  The
+        # reshard-on-load path (parallel.reshard) makes the downgraded
+        # fleet able to CONSUME the bigger fleet's checkpoint.
+        ladder_raw = (mesh_ladder
+                      if mesh_ladder is not None
+                      else _ec.get("PADDLE_TPU_MESH_LADDER")) or ""
+        self.mesh_ladder = [s.strip() for s in ladder_raw.split(";")
+                            if s.strip()]
+        self.mesh_spec: Optional[str] = (
+            self.extra_env.get("PADDLE_TPU_MESH")
+            or _ec.get("PADDLE_TPU_MESH")
+            or (self.mesh_ladder[0] if self.mesh_ladder else None))
+        self._unviable = False
 
     # -- public --
     def run(self) -> dict:
@@ -284,9 +351,58 @@ class ElasticSupervisor:
                 return self._summary("finished", generations)
             if verdict == "deadline":
                 break  # no point relaunching into an expired budget
+            if gen < self.max_restarts:
+                self._maybe_downgrade(gen)
+                if self._unviable:
+                    break  # nothing on the ladder fits the survivors
         self.incidents.log("restart_budget_exhausted",
                            max_restarts=self.max_restarts)
         return self._summary("failed", generations)
+
+    def _maybe_downgrade(self, gen: int) -> None:
+        """Survivor census + mesh-ladder pick before relaunching.
+
+        Heartbeat-dir ``host_lost_*`` markers (dropped by the
+        PADDLE_FAULT_HOST_LOSS oracle; in production, by a node-death
+        notifier) are hosts that will NOT rejoin.  With none, the
+        relaunch keeps its size and mesh (the classic kill-and-resume
+        path).  With losses and a ladder, the next generation runs the
+        largest viable rung: fewer workers, ``PADDLE_TPU_MESH``
+        rewritten, and one ``mesh.downgrade`` incident the goodput
+        ledger prices the transition from.  No viable rung marks the
+        run unviable (summary: failed) — restarting a fleet that cannot
+        form is the exact budget-burn this exists to stop."""
+        lost = host_loss_markers(self.hb_dir)
+        if not lost:
+            return
+        survivors = max(0, self.initial_nproc - len(lost))
+        if survivors >= self.nproc:
+            return  # losses already absorbed by an earlier downgrade
+        if not self.mesh_ladder:
+            # no ladder: keep legacy behavior (same-size relaunch) but
+            # leave the census in the incident trail for the postmortem
+            self.incidents.log("host_loss", generation=gen,
+                               survivors=survivors, lost=lost,
+                               ladder=[])
+            return
+        pick = viable_mesh(self.mesh_ladder, survivors,
+                           self.devices_per_host or 1)
+        if pick is None:
+            self._unviable = True
+            self.incidents.log("mesh.unviable", generation=gen,
+                               survivors=survivors, lost=lost,
+                               ladder=self.mesh_ladder)
+            return
+        spec, nproc = pick
+        if spec == self.mesh_spec and nproc == self.nproc:
+            return
+        self.incidents.log(
+            "mesh.downgrade", generation=gen + 1,
+            from_mesh=self.mesh_spec, to_mesh=spec,
+            from_nproc=self.nproc, to_nproc=nproc,
+            survivors=survivors, lost=lost)
+        self.mesh_spec = spec
+        self.nproc = nproc
 
     # -- internals --
     def _launch(self, gen: int):
@@ -299,7 +415,11 @@ class ElasticSupervisor:
             from tools.pod_launch import make_launch_plan
 
         os.makedirs(self.hb_dir, exist_ok=True)
-        for rank in range(self.nproc):  # stale liveness must not mask death
+        # stale liveness must not mask death — clear up to the LARGEST
+        # fleet this run ever launched (a downgraded generation must not
+        # read a dead bigger fleet's heartbeats); host_lost_* markers
+        # stay, they are the permanent-loss census
+        for rank in range(self.initial_nproc):
             try:
                 os.remove(heartbeat_path(self.hb_dir, rank))
             except OSError:
@@ -329,6 +449,11 @@ class ElasticSupervisor:
                "PADDLE_TRACEPARENT": _trace.format_traceparent(
                    self.trace_id, self._gen_span["span_id"])}
         env.update(self.extra_env)
+        if self.mesh_spec:
+            # the supervisor owns the topology per generation: a
+            # downgraded fleet's workers see the LADDER-PICKED mesh, not
+            # the one the launch env froze in
+            env["PADDLE_TPU_MESH"] = self.mesh_spec
         if gen == 0:
             env.update(self.fault_env)
         port = _free_port()
@@ -349,7 +474,7 @@ class ElasticSupervisor:
                 cwd=self.workdir))
             logs.append(lf)
         self.incidents.log("generation_start", generation=gen, port=port,
-                           nproc=self.nproc,
+                           nproc=self.nproc, mesh=self.mesh_spec,
                            compile_cache_dir=self.compile_cache_dir,
                            fault_env=sorted(self.fault_env) if gen == 0
                            else [])
@@ -511,6 +636,10 @@ def main(argv=None) -> int:
     ap.add_argument("--observe-dir", default=None,
                     help="unified observability dir shared by all "
                          "generations (default: <workdir>/observe)")
+    ap.add_argument("--mesh-ladder", default=None,
+                    help="semicolon-ordered downgrade ladder, largest "
+                         "first (e.g. 'dp4;dp2;dp1'); default "
+                         "PADDLE_TPU_MESH_LADDER")
     ap.add_argument("--env", action="append", default=[], metavar="K=V")
     args = ap.parse_args(argv)
     extra = {}
@@ -525,7 +654,8 @@ def main(argv=None) -> int:
         deadline=args.deadline, devices_per_host=args.devices_per_host,
         extra_env=extra or None,
         compile_cache_dir=args.compile_cache_dir,
-        observe_dir=args.observe_dir)
+        observe_dir=args.observe_dir,
+        mesh_ladder=args.mesh_ladder)
     result = sup.run()
     print(json.dumps(result))
     return 0 if result["status"] == "finished" else 1

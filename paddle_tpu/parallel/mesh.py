@@ -115,6 +115,31 @@ def mesh_label(mesh: Mesh) -> str:
     return "x".join(f"{a}{mesh.shape[a]}" for a in mesh.axis_names)
 
 
+def axes_of(mesh=None) -> Dict[str, int]:
+    """Ordered ``{axis: extent}`` for a ``jax.sharding.Mesh``, a spec
+    string (``"dp4,tp2"``), an already-parsed ``[[name, extent], ...]``
+    list (the form checkpoint meta records), or ``None`` (the
+    ``PADDLE_TPU_MESH`` env spec).  ``{}`` when nothing is known — the
+    one normal form every mesh consumer (data sharding, reshard-on-load,
+    checkpoint meta) compares topologies in."""
+    if mesh is None:
+        spec = env_mesh_spec()
+        return parse_mesh_spec(spec) if spec else {}
+    if isinstance(mesh, str):
+        return parse_mesh_spec(mesh)
+    if isinstance(mesh, (list, tuple)):
+        return {str(a): int(e) for a, e in mesh}
+    return {name: int(mesh.shape[name]) for name in mesh.axis_names}
+
+
+def axes_label(axes: Dict[str, int]) -> Optional[str]:
+    """``{"dp": 4, "tp": 2}`` -> ``dp4xtp2`` (None for an empty dict) —
+    the :func:`mesh_label` form for topologies known only by shape."""
+    if not axes:
+        return None
+    return "x".join(f"{a}{int(e)}" for a, e in axes.items())
+
+
 def make_mesh_nd(**axes) -> Mesh:
     """N-D mesh from named axis sizes, e.g. ``make_mesh_nd(dp=2, mp=2,
     pp=2)``.  Axis order = keyword order (python dicts preserve it); later

@@ -393,10 +393,41 @@ def latest_complete_sharded(root: str) -> int:
     return -1
 
 
+def serial_meta_topology(mesh=None) -> dict:
+    """The topology stamp every sharded serial's meta carries: the mesh
+    axes this fleet laid state out with (an explicit ``mesh``,  the
+    active SPMD mesh, or the ``PADDLE_TPU_MESH`` env spec — whichever is
+    known), the process count, and every rank's data-shard assignment.
+    ``parallel.reshard`` reads exactly these keys to decide whether a
+    resume needs re-layout and how to remap the per-rank cursors."""
+    from ..data.sharding import shard_layout
+    from .mesh import axes_of
+
+    if mesh is None:
+        from .spmd import active_mesh
+
+        mesh = active_mesh()
+    axes = axes_of(mesh)
+    procs = max(1, process_count())
+    out = {"process_count": procs}
+    if axes:
+        out["mesh_axes"] = [[a, int(e)] for a, e in axes.items()]
+    try:
+        out["data_shards"] = {
+            str(r): [int(n), int(i)]
+            for r, (n, i) in shard_layout(mesh, procs).items()}
+    except ValueError:
+        # a topology/host pair the data plane cannot tile never trained
+        # a pipeline; record nothing rather than a wrong layout
+        pass
+    return out
+
+
 def save_sharded_serial(state: dict, root: str, serial: int,
                         meta: Optional[dict] = None,
                         max_num: Optional[int] = None,
-                        data_state: Optional[dict] = None) -> str:
+                        data_state: Optional[dict] = None,
+                        mesh=None) -> str:
     """Commit ``state`` as <root>/checkpoint_<serial>/ under the _SUCCESS
     protocol.  ``serial`` is caller-assigned (typically the global step) so
     every process independently derives the same value with no filesystem
@@ -408,6 +439,14 @@ def save_sharded_serial(state: dict, root: str, serial: int,
     process 0's single _SUCCESS commit covers the whole fleet's data
     plane atomically with the model shards.
 
+    ``meta`` always lands on disk (an empty dict when the caller passed
+    none) and is always enriched with the fleet topology
+    (:func:`serial_meta_topology`: ``mesh_axes`` / ``process_count`` /
+    per-rank ``data_shards``) — the record ``parallel.reshard`` needs to
+    resume this serial on a DIFFERENT mesh.  ``mesh`` pins the topology
+    explicitly; by default the active SPMD mesh or the
+    ``PADDLE_TPU_MESH`` env spec is recorded.
+
     Ordering: shards (+ data state) -> barrier (all writers done) ->
     [p0] meta + _SUCCESS -> barrier (everyone may now trust the serial)
     -> [p0] prune.  The fault hooks bracket the _SUCCESS write exactly
@@ -417,6 +456,7 @@ def save_sharded_serial(state: dict, root: str, serial: int,
     import time as _t
 
     from ..fluid import fault as _fault
+    from .mesh import axes_label
 
     t_save0 = _t.perf_counter()
     cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
@@ -426,11 +466,15 @@ def save_sharded_serial(state: dict, root: str, serial: int,
         from ..data.checkpoint import save_data_state
 
         save_data_state(cur, data_state, rank=process_index())
+    meta = dict(meta or {})
+    topo = serial_meta_topology(mesh)
+    for key, val in topo.items():
+        meta.setdefault(key, val)
+    mesh_tag = axes_label({a: e for a, e in meta.get("mesh_axes") or []})
     barrier_s = barrier(f"ckpt_shards_{serial}")
     if process_index() == 0:
-        if meta is not None:
-            with open(os.path.join(cur, META_FILE), "w") as f:
-                _json.dump(meta, f)
+        with open(os.path.join(cur, META_FILE), "w") as f:
+            _json.dump(meta, f)
         _fault.ckpt_crash_point("before")
         with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
             f.write("")
@@ -439,7 +483,12 @@ def save_sharded_serial(state: dict, root: str, serial: int,
 
         # the commit point: after _SUCCESS the serial is trusted, and the
         # run-event stream shows which step's state survives a restart
-        observe.emit("checkpoint.commit", serial=int(serial), path=cur)
+        # (mesh-labeled, so the goodput ledger prices a downgraded
+        # generation's commits against the topology they ran on)
+        commit_fields = {"serial": int(serial), "path": cur}
+        if mesh_tag is not None:
+            commit_fields["mesh"] = mesh_tag
+        observe.emit("checkpoint.commit", **commit_fields)
     barrier_s += barrier(f"ckpt_commit_{serial}")
     from .. import observe
     from ..observe import goodput as _goodput
@@ -468,18 +517,36 @@ def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
     """Restore the newest complete serial under ``root``.
 
     Returns (serial, meta, state) or (-1, None, None) when no complete
-    checkpoint exists.  When the serial carries a ``data_state`` blob for
-    THIS rank it is returned under ``meta["data_state"]`` so the worker
-    can restart its input pipeline at the first un-committed sample; an
-    unreadable blob condemns the whole serial (fallback), absence just
-    means legacy step-replay resume.  A complete-but-unreadable serial
-    (truncated shard after commit) falls back to the previous complete
-    one, mirroring trainer.load_checkpoint.  ``clean_incomplete`` removes
-    unmarked serial dirs left by a dead generation (process 0 only,
-    behind a barrier) so a resumed run re-using their serial numbers
-    never mixes stale shards with fresh ones."""
+    checkpoint exists — INCLUDING an absent/empty root and a root whose
+    only serials are unmarked leftovers (the empty-root regression: this
+    function must never fall off the end and hand back a bare ``None``
+    the caller cannot unpack).  When the serial carries a ``data_state``
+    blob for THIS rank it is returned under ``meta["data_state"]`` so
+    the worker can restart its input pipeline at the first un-committed
+    sample; an unreadable blob condemns the whole serial (fallback),
+    absence just means legacy step-replay resume.  A complete-but-
+    unreadable serial (truncated shard after commit) falls back to the
+    previous complete one, mirroring trainer.load_checkpoint.
+
+    Reshard-on-load (ISSUE 14): when the serial's recorded topology
+    (``meta["mesh_axes"]`` / ``meta["process_count"]``) differs from the
+    live one, the load routes through ``parallel.reshard`` — the logical
+    view is assembled from the old fleet's shards, re-laid out under
+    ``mesh``'s shardings, and the per-rank data cursors are merged/split
+    onto this fleet's shard layout; ``meta["resharded"]`` records the
+    transition.  A same-topology load takes the path below untouched.
+    A topology the serial cannot viably land on raises
+    ``reshard.ReshardError`` immediately (older serials are equally
+    unviable — falling back would only bury the named error).
+
+    ``clean_incomplete`` removes unmarked serial dirs left by a dead
+    generation (process 0 only, behind a barrier) so a resumed run
+    re-using their serial numbers never mixes stale shards with fresh
+    ones."""
     import json as _json
     import shutil
+
+    from . import reshard as _reshard
 
     if clean_incomplete:
         if process_index() == 0:
@@ -495,10 +562,22 @@ def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
     for serial in reversed(complete):
         cur = os.path.join(root, f"{SERIAL_PREFIX}_{serial}")
         try:
-            state = load_sharded(cur, mesh, specs)
-            from ..data.checkpoint import load_data_state
+            meta = {}
+            meta_path = os.path.join(cur, META_FILE)
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = _json.load(f)
+            if _reshard.needs_reshard(meta, mesh):
+                state, data_state, info = _reshard.load_resharded(
+                    cur, meta, mesh, specs)
+                meta["resharded"] = info
+            else:
+                state = load_sharded(cur, mesh, specs)
+                from ..data.checkpoint import load_data_state
 
-            data_state = load_data_state(cur, rank=process_index())
+                data_state = load_data_state(cur, rank=process_index())
+        except _reshard.ReshardError:
+            raise
         except Exception as exc:
             from ..fluid.log import LOG
 
@@ -506,11 +585,6 @@ def load_sharded_latest(root: str, mesh: Optional[Mesh], specs: dict,
                 f"falling back to the previous complete serial")
             last_exc = exc
             continue
-        meta = {}
-        meta_path = os.path.join(cur, META_FILE)
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = _json.load(f)
         if data_state is not None:
             meta["data_state"] = data_state
         return serial, meta, state

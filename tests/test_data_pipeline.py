@@ -493,3 +493,146 @@ def test_data_smoke_tool():
     report = smoke.main()
     assert report["ok"], report
     assert report["elapsed_s"] < 5.0
+
+
+# ---------------------------------------------------------------------------
+# cursor remap determinism (ISSUE 14): re-key committed cursors across a
+# mesh change — merged/split streams equal the uninterrupted reference
+# ---------------------------------------------------------------------------
+
+
+def _elastic_pipe(n_samples, num_shards, shard_index, batch, seed=5):
+    """The elastic pipeline shape: GLOBAL shuffle upstream of the shard
+    stage, so every mesh sees one sample order (docs/ROBUSTNESS.md
+    'Resharded resume')."""
+    return (data.from_reader(_reader(n_samples))
+                .shuffle(16, seed=seed)
+                .shard(num_shards, shard_index)
+                .batch(batch))
+
+
+def _committed_states(n_samples, num_shards, batch, batches_each):
+    """Run every shard stream ``batches_each`` batches (one synchronized
+    fleet commit) and return {shard_index: state}, plus what each
+    consumed."""
+    states, consumed = {}, {}
+    for i in range(num_shards):
+        p = _elastic_pipe(n_samples, num_shards, i, batch)
+        it = iter(p)
+        got = []
+        for _ in range(batches_each):
+            got.extend(s[1] for s in next(it))
+        consumed[i] = got
+        states[i] = p.state()
+    return states, consumed
+
+
+@pytest.mark.parametrize("old_n,new_n", [(4, 2), (2, 4), (4, 1), (1, 4),
+                                         (4, 4)])
+def test_cursor_remap_tail_equals_uninterrupted_reference(old_n, new_n):
+    """dp4→dp2 merges two round-robin streams in fixed order; dp2→dp4
+    splits them; 4→4 is the rank-permutation identity.  Every new rank's
+    restored tail must equal the uninterrupted new-mesh reference
+    exactly — and the global cut lands MID shuffle buffer (24 of 96
+    samples consumed, buffer 16), so the donor cursor is a mid-buffer
+    resumable-shuffle cursor."""
+    from paddle_tpu.data.sharding import merge_cursor_states
+
+    n_samples, global_batch = 96, 12
+    states, consumed = _committed_states(
+        n_samples, old_n, global_batch // old_n, batches_each=2)
+    cut = 2 * global_batch  # samples the old fleet committed, all shards
+
+    tails = []
+    for j in range(new_n):
+        cursor = merge_cursor_states(states, new_n, j)
+        p = _elastic_pipe(n_samples, new_n, j, global_batch // new_n)
+        p.restore(cursor)
+        tail = _ids(list(iter(p)))
+        ref = _ids(list(iter(_elastic_pipe(n_samples, new_n, j,
+                                           global_batch // new_n))))
+        assert tail == ref[cut // new_n:], (old_n, new_n, j)
+        tails.extend(tail)
+
+    # no sample dropped or duplicated across the mesh change
+    everything = sorted(sum(consumed.values(), []) + tails)
+    assert everything == list(range(n_samples))
+
+
+def test_cursor_remap_mid_buffer_state_shape():
+    """The donor cursor really is mid-buffer: the shuffle stage's offset
+    is strictly inside the permuted buffer at the cut."""
+    states, _ = _committed_states(96, 4, 3, batches_each=2)
+    donor = states[3]
+    shuffle_node = donor["stage"]["up"]["up"]
+    assert shuffle_node["kind"] == "shuffle"
+    assert 0 < shuffle_node["off"] < 16
+
+
+def test_cursor_remap_named_errors():
+    from paddle_tpu.data.sharding import merge_cursor_states
+
+    states, _ = _committed_states(96, 4, 3, batches_each=2)
+    # shard counts that do not tile
+    with pytest.raises(ValueError, match="do not tile"):
+        merge_cursor_states(states, 3, 0)
+    # a missing stream (non-contiguous here; a contiguous subset is
+    # caught by remap_data_state against the RECORDED stream count —
+    # covered in test_reshard.py's unviable-mesh oracle)
+    partial = {i: states[i] for i in (0, 1, 3)}
+    with pytest.raises(ValueError, match="one cursor per old shard"):
+        merge_cursor_states(partial, 2, 0)
+    # streams committed at different steps
+    p = _elastic_pipe(96, 4, 1, 3)
+    it = iter(p)
+    for _ in range(3):
+        next(it)
+    skewed = dict(states)
+    skewed[1] = p.state()
+    with pytest.raises(ValueError, match="not aligned"):
+        merge_cursor_states(skewed, 2, 0)
+    # per-shard shuffle (shard BELOW shuffle in the state tree) cannot be
+    # remapped — the order is private to the old layout
+    per_shard = {}
+    for i in range(2):
+        p = _build(n=32, shard=(2, i), batch=4)  # shard().shuffle().batch()
+        it = iter(p)
+        next(it)
+        per_shard[i] = p.state()
+    with pytest.raises(ValueError, match="BELOW the shard stage"):
+        merge_cursor_states(per_shard, 1, 0)
+
+
+def test_remap_data_state_collapses_tp_peers(tmp_path):
+    """A dp2×tp2 fleet writes four rank blobs covering two shard streams
+    (tp peers read identical data); the remap dedupes peers via the
+    identical-data rule and merges the two streams onto dp4 splits."""
+    from paddle_tpu.data.checkpoint import remap_data_state, save_data_state
+
+    states, consumed = _committed_states(96, 2, 6, batches_each=2)
+    d = str(tmp_path)
+    # ranks 0,1 share shard 0; ranks 2,3 share shard 1 (shard_spec's
+    # H%D==0 layout for dp2 over 4 hosts)
+    layout = {0: (2, 0), 1: (2, 0), 2: (2, 1), 3: (2, 1)}
+    for rank, (_, i) in layout.items():
+        save_data_state(d, states[i], rank=rank)
+
+    tails = []
+    for j in range(4):
+        cursor = remap_data_state(d, layout, 4, j)
+        p = _elastic_pipe(96, 4, j, 3)
+        p.restore(cursor)
+        tail = _ids(list(iter(p)))
+        ref = _ids(list(iter(_elastic_pipe(96, 4, j, 3))))
+        assert tail == ref[6:], j  # 24 committed globally = 6 per dp4 rank
+        tails.extend(tail)
+    everything = sorted(sum(consumed.values(), []) + tails)
+    assert everything == list(range(96))
+
+    # a peer whose blob disagrees is an inconsistent serial, by name
+    bad = _elastic_pipe(96, 2, 0, 6)
+    it = iter(bad)
+    next(it)
+    save_data_state(d, bad.state(), rank=1)
+    with pytest.raises(ValueError, match="DIFFERENT cursors"):
+        remap_data_state(d, layout, 4, 0)

@@ -1,0 +1,540 @@
+"""Elastic resharding (ISSUE 14): resume any serial on any viable mesh.
+
+Bit-exactness oracle: for every mesh pair in {dp4→dp2, dp2→dp4,
+dp2tp2→dp4, same-shape rank permutation} the resharded state equals the
+serial's assembled logical view element-for-element, and a same-topology
+load takes the existing fast path with NO reshard code executed.  Plus:
+the ``load_sharded_latest`` empty-root regression, the always-recorded
+topology meta, cursor remap through the real serial protocol, the
+supervisor's mesh-ladder pick, and the host-loss fault hook.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel import multihost as mh
+from paddle_tpu.parallel import reshard
+from paddle_tpu.parallel.mesh import mesh_from_spec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _state(mesh=None, specs=None):
+    """A small mixed-shape state; placed under mesh shardings if given."""
+    rng = np.random.RandomState(7)
+    host = {
+        "w_col": rng.normal(size=(8, 4)).astype(np.float32),
+        "w_row": rng.normal(size=(4, 8)).astype(np.float32),
+        "bias": rng.normal(size=(8,)).astype(np.float32),
+        "steps": np.int64(13),
+    }
+    if mesh is None:
+        return host
+    out = {}
+    for n, v in host.items():
+        sh = NamedSharding(mesh, (specs or {}).get(n, P()))
+        out[n] = jax.device_put(v, sh)
+    return out
+
+
+def _assert_bitwise(resharded, logical):
+    assert set(resharded) == set(logical)
+    for n in logical:
+        np.testing.assert_array_equal(np.asarray(resharded[n]),
+                                      np.asarray(logical[n]), err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the empty-root regression
+# ---------------------------------------------------------------------------
+
+
+def test_load_sharded_latest_empty_root_regression(tmp_path):
+    """No complete serial — absent root, empty root, or only unmarked
+    leftovers — must return the documented (-1, None, None) tuple, never
+    a bare None the caller cannot unpack (and never raise)."""
+    missing = str(tmp_path / "never_created")
+    assert mh.load_sharded_latest(missing, None, {}) == (-1, None, None)
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert mh.load_sharded_latest(empty, None, {}) == (-1, None, None)
+
+    # a dead generation's unmarked serial is cleaned, not read — and the
+    # return shape stays the documented triple either way
+    leftover = str(tmp_path / "leftover")
+    os.makedirs(os.path.join(leftover, "checkpoint_5", "shard_0"))
+    assert mh.load_sharded_latest(
+        leftover, None, {}, clean_incomplete=False) == (-1, None, None)
+    assert mh.load_sharded_latest(leftover, None, {}) == (-1, None, None)
+    assert not os.path.exists(os.path.join(leftover, "checkpoint_5"))
+
+
+# ---------------------------------------------------------------------------
+# satellite: topology always recorded in serial meta
+# ---------------------------------------------------------------------------
+
+
+def test_serial_meta_records_topology(tmp_path):
+    """Every save_sharded_serial lands meta.json with mesh_axes /
+    process_count / per-rank data_shards — even when the caller passes
+    no meta at all (the record reshard-on-load needs)."""
+    root = str(tmp_path / "ck")
+    mesh = mesh_from_spec("dp2,tp2")
+    mh.save_sharded_serial(_state(), root, serial=0, mesh=mesh)
+    with open(os.path.join(root, "checkpoint_0", "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["mesh_axes"] == [["dp", 2], ["tp", 2]]
+    assert meta["process_count"] == 1
+    assert meta["data_shards"] == {"0": [1, 0]}
+
+    # caller meta is preserved, enrichment only fills gaps
+    mh.save_sharded_serial(_state(), root, serial=1, mesh=mesh,
+                           meta={"step": 41, "process_count": 99})
+    with open(os.path.join(root, "checkpoint_1", "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["step"] == 41 and meta["process_count"] == 99
+
+
+def test_commit_event_carries_mesh_label(tmp_path):
+    """checkpoint.commit run events are mesh-labeled, so the goodput
+    ledger can attribute a downgraded generation's commits."""
+    from paddle_tpu import observe
+
+    obs_dir = str(tmp_path / "obs")
+    observe.configure(obs_dir)
+    try:
+        mh.save_sharded_serial(_state(), str(tmp_path / "ck"), serial=0,
+                               mesh=mesh_from_spec("dp4"))
+        observe.get_sink().flush()
+        recs = []
+        for fn in os.listdir(obs_dir):
+            if fn.startswith("events-"):
+                with open(os.path.join(obs_dir, fn)) as f:
+                    recs += [json.loads(ln) for ln in f if ln.strip()]
+        commits = [r for r in recs if r["event"] == "checkpoint.commit"]
+        assert commits and commits[0]["mesh"] == "dp4"
+    finally:
+        observe.disable()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: reshard-on-load bit-exactness, every mesh pair
+# ---------------------------------------------------------------------------
+
+TP_SPECS = {"w_col": P(None, "tp"), "w_row": P("tp", None)}
+
+
+@pytest.mark.parametrize("from_spec,from_specs,to_spec,to_specs", [
+    ("dp4", {}, "dp2", {}),
+    ("dp2", {}, "dp4", {}),
+    ("dp2,tp2", TP_SPECS, "dp4", {}),
+    ("dp2", {}, "dp2,tp2", TP_SPECS),
+])
+def test_reshard_on_load_bitwise(tmp_path, from_spec, from_specs, to_spec,
+                                 to_specs):
+    root = str(tmp_path / "ck")
+    mesh_a = mesh_from_spec(from_spec)
+    state = _state(mesh_a, from_specs)
+    mh.save_sharded_serial(state, root, serial=3, meta={"step": 3},
+                           mesh=mesh_a)
+
+    mesh_b = mesh_from_spec(to_spec)
+    serial, meta, back = mh.load_sharded_latest(root, mesh_b, to_specs)
+    assert serial == 3 and meta["step"] == 3
+    # the transition is recorded for the resume log / ledger
+    assert meta["resharded"]["from_mesh"] == from_spec.replace(",", "x")
+    assert meta["resharded"]["to_mesh"] == to_spec.replace(",", "x")
+
+    logical = reshard.assemble_logical(
+        os.path.join(root, "checkpoint_3"))
+    _assert_bitwise(back, logical)
+    _assert_bitwise(back, {n: np.asarray(v) for n, v in state.items()})
+    # and the new layout is really the new mesh's
+    for n in back:
+        want = to_specs.get(n, P())
+        assert back[n].sharding == NamedSharding(mesh_b, want), n
+
+
+def test_same_mesh_takes_fast_path_untouched(tmp_path, monkeypatch):
+    """Same recorded topology → the pre-existing load path runs, bitwise,
+    with NO reshard code executed — including under a mesh-shape-
+    preserving device (rank) permutation."""
+    root = str(tmp_path / "ck")
+    mesh_a = mesh_from_spec("dp2,tp2")
+    state = _state(mesh_a, TP_SPECS)
+    mh.save_sharded_serial(state, root, serial=0, mesh=mesh_a)
+
+    def _boom(*a, **k):
+        raise AssertionError("reshard path executed on a same-mesh load")
+
+    monkeypatch.setattr(reshard, "load_resharded", _boom)
+    monkeypatch.setattr(reshard, "reshard_state", _boom)
+
+    serial, meta, back = mh.load_sharded_latest(root, mesh_a, TP_SPECS)
+    assert serial == 0 and "resharded" not in meta
+    _assert_bitwise(back, {n: np.asarray(v) for n, v in state.items()})
+
+    # same shape, permuted device order: still the fast path, still bitwise
+    devs = list(jax.devices())[:4]
+    perm = mesh_from_spec("dp2,tp2", devices=devs[::-1])
+    serial, meta, back = mh.load_sharded_latest(root, perm, TP_SPECS)
+    assert serial == 0 and "resharded" not in meta
+    _assert_bitwise(back, {n: np.asarray(v) for n, v in state.items()})
+
+
+def test_reshard_assembles_multirank_shards(tmp_path):
+    """A serial written by a MULTI-process fleet (crafted shard dirs with
+    row-sliced shards, the layout save_sharded records) reassembles into
+    the logical view and reshards bitwise onto a live mesh."""
+    root = str(tmp_path / "ck")
+    cur = os.path.join(root, "checkpoint_2")
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    for pid in range(2):
+        d = os.path.join(cur, f"shard_{pid}")
+        os.makedirs(d)
+        rows = slice(pid * 4, pid * 4 + 4)
+        np.save(os.path.join(d, "w.0.npy"), w[rows])
+        manifest = {"process_count": 2, "vars": {
+            "w": {"shape": [8, 4], "dtype": "float32",
+                  "shards": [{"file": "w.0.npy",
+                              "index": [[pid * 4, pid * 4 + 4], [0, 4]]}]}}}
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+    meta = {"step": 2, "mesh_axes": [["dp", 2]], "process_count": 2,
+            "data_shards": {"0": [2, 0], "1": [2, 1]}}
+    with open(os.path.join(cur, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(cur, "_SUCCESS"), "w") as f:
+        f.write("")
+
+    np.testing.assert_array_equal(reshard.assemble_logical(cur)["w"], w)
+    mesh = mesh_from_spec("dp4")
+    serial, meta, back = mh.load_sharded_latest(root, mesh, {})
+    assert serial == 2 and meta["resharded"]["from_processes"] == 2
+    np.testing.assert_array_equal(np.asarray(back["w"]), w)
+
+
+# ---------------------------------------------------------------------------
+# cursor remap through the real serial protocol
+# ---------------------------------------------------------------------------
+
+
+def _pipe(n, i, b):
+    from paddle_tpu import data
+
+    def reader():
+        for k in range(96):
+            yield k
+
+    return data.from_reader(reader).shuffle(16, seed=5).shard(n, i).batch(b)
+
+
+def _consume(pipe, batches):
+    it = iter(pipe)
+    out = []
+    for _ in range(batches):
+        out.extend(next(it))
+    return out
+
+
+def test_serial_reshard_remaps_cursors_dp4_to_dp2(tmp_path):
+    """A dp4 fleet's four committed cursors land in one serial; loading
+    it as a dp2 topology hands each new rank a merged cursor whose tail
+    equals the uninterrupted dp2 reference exactly."""
+    root = str(tmp_path / "ck")
+    cur = os.path.join(root, "checkpoint_4")
+
+    consumed = {}
+    states = {}
+    for r in range(4):
+        p = _pipe(4, r, 3)
+        consumed[r] = _consume(p, 2)          # 6 samples per rank
+        states[r] = p.state()
+
+    # the serial exactly as a 4-proc dp4 fleet commits it
+    mh.save_sharded_serial({"w": np.ones((4,), np.float32)}, root,
+                           serial=4, meta={"step": 4})
+    from paddle_tpu.data.checkpoint import save_data_state
+
+    for r in range(4):
+        save_data_state(cur, states[r], rank=r)
+    with open(os.path.join(cur, "meta.json")) as f:
+        meta = json.load(f)
+    meta.update(mesh_axes=[["dp", 4]], process_count=4,
+                data_shards={str(r): [4, r] for r in range(4)})
+    with open(os.path.join(cur, "meta.json"), "w") as f:
+        json.dump(meta, f)
+
+    for new_rank in range(2):
+        cursor = reshard.remap_cursors(
+            cur, meta, "dp2", rank=new_rank, num_hosts=2)
+        p = _pipe(2, new_rank, 6)
+        p.restore(cursor)
+        tail = [s for b in iter(p) for s in b]
+        ref = [s for b in iter(_pipe(2, new_rank, 6)) for s in b]
+        assert tail == ref[12:], new_rank  # 24 consumed globally = 12/rank
+
+    # no sample dropped or duplicated across the transition
+    tails = []
+    for new_rank in range(2):
+        cursor = reshard.remap_cursors(
+            cur, meta, "dp2", rank=new_rank, num_hosts=2)
+        p = _pipe(2, new_rank, 6)
+        p.restore(cursor)
+        tails += [s for b in iter(p) for s in b]
+    everything = sorted(sum(consumed.values(), []) + tails)
+    assert everything == list(range(96))
+
+
+def test_reshard_named_error_on_unviable_mesh(tmp_path):
+    """A topology the serial cannot land on raises ReshardError by name
+    (and load_sharded_latest does NOT bury it in serial fallback)."""
+    meta = {"mesh_axes": [["dp", 4]], "process_count": 4,
+            "data_shards": {str(r): [4, r] for r in range(4)}}
+    # dp2 over 3 hosts: the data plane itself cannot tile
+    with pytest.raises(reshard.ReshardError, match="not viable"):
+        reshard.check_viable(meta, "dp2", num_hosts=3)
+    # 4 recorded shard streams onto 3: counts do not tile
+    with pytest.raises(reshard.ReshardError, match="do not tile"):
+        reshard.check_viable(meta, "dp3", num_hosts=3)
+
+    # and through the full serial protocol: a dp4 serial whose cursor
+    # set is missing a stream (rank 2/3 blobs lost) cannot resume on a
+    # new topology — ReshardError surfaces by name, NOT buried in the
+    # unreadable-serial fallback loop
+    root = str(tmp_path / "ck")
+    mh.save_sharded_serial(_state(), root, serial=0,
+                           mesh=mesh_from_spec("dp4"))
+    cur = os.path.join(root, "checkpoint_0")
+    from paddle_tpu.data.checkpoint import save_data_state
+
+    for r in range(2):  # only 2 of the 4 shard streams' cursors present
+        save_data_state(cur, _pipe(4, r, 3).state(), rank=r)
+    with open(os.path.join(cur, "meta.json")) as f:
+        cur_meta = json.load(f)
+    cur_meta.update(process_count=4,
+                    data_shards={str(r): [4, r] for r in range(4)})
+    with open(os.path.join(cur, "meta.json"), "w") as f:
+        json.dump(cur_meta, f)
+    with pytest.raises(reshard.ReshardError, match="missing stream"):
+        mh.load_sharded_latest(root, mesh_from_spec("dp2"), {})
+
+
+def test_infer_state_specs_matches_sharded_step():
+    """The resume-time spec derivation equals what ShardedTrainStep
+    would build for the live mesh — the checkpoint lays out exactly
+    like the runner that consumes it."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.spmd import ShardedTrainStep
+
+    fluid.default_main_program().random_seed = 3
+    img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=16, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    mesh = mesh_from_spec("dp2,tp2")
+    step = ShardedTrainStep(prog, ["img", "label"], [loss.name], mesh)
+    specs = reshard.infer_state_specs(prog, ["img", "label"],
+                                      [loss.name], mesh)
+    assert specs == step.specs
+    assert any(spec is not None and any(ax == "tp" for ax in tuple(spec))
+               for spec in specs.values() if spec is not None)
+
+
+def test_needs_reshard_decision_table():
+    dp4 = {"mesh_axes": [["dp", 4]], "process_count": 1}
+    assert not reshard.needs_reshard(dp4, "dp4", num_hosts=1)
+    assert not reshard.needs_reshard(dp4, "dp4,tp1", num_hosts=1)
+    assert reshard.needs_reshard(dp4, "dp2", num_hosts=1)
+    assert reshard.needs_reshard(dp4, "dp2,tp2", num_hosts=1)
+    assert reshard.needs_reshard(dp4, "dp4", num_hosts=2)  # fleet resized
+    # legacy serial: no topology recorded, never reshard
+    assert not reshard.needs_reshard({"step": 7}, "dp2", num_hosts=1)
+    assert not reshard.needs_reshard(None, "dp2", num_hosts=1)
+
+
+# ---------------------------------------------------------------------------
+# supervisor ladder pick + host-loss fault hook
+# ---------------------------------------------------------------------------
+
+
+def test_viable_mesh_ladder_pick():
+    from paddle_tpu.parallel.elastic import viable_mesh
+
+    ladder = ["dp4", "dp2", "dp1"]
+    assert viable_mesh(ladder, survivors=4) == ("dp4", 4)
+    assert viable_mesh(ladder, survivors=3) == ("dp2", 2)
+    assert viable_mesh(ladder, survivors=2) == ("dp2", 2)
+    assert viable_mesh(ladder, survivors=1) == ("dp1", 1)
+    assert viable_mesh(ladder, survivors=0) is None
+    # device-dense hosts: dp4 fits on 2 hosts at 2 chips each
+    assert viable_mesh(ladder, survivors=2,
+                       devices_per_host=2) == ("dp4", 2)
+    # a typo'd rung is skipped, not fatal
+    assert viable_mesh(["dpX", "dp2"], survivors=2) == ("dp2", 2)
+    # dp3 over 2 procs cannot tile the data plane -> skipped
+    assert viable_mesh(["dp3", "dp1"], survivors=2,
+                       devices_per_host=2) == ("dp1", 1)
+
+
+def test_host_loss_fault_marks_and_crashes(tmp_path, monkeypatch):
+    from paddle_tpu.fluid import fault
+
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("PADDLE_ELASTIC_HB_DIR", hb)
+    monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "0")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    fault.install(fault.FaultPlan(host_loss_rank=1, host_loss_at_step=2,
+                                  mode="raise"))
+    try:
+        assert fault.on_step(0) == 0
+        assert fault.on_step(1) == 1
+        with pytest.raises(fault.InjectedFault, match="host loss"):
+            fault.on_step(2)
+        assert os.path.exists(os.path.join(hb, "host_lost_g0_r1"))
+        # a different rank never fires
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        fault.install(fault.FaultPlan(host_loss_rank=1,
+                                      host_loss_at_step=0, mode="raise"))
+        fault.on_step(0)
+        # windowed advance: armed step inside the window fires too
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        fault.install(fault.FaultPlan(host_loss_rank=1,
+                                      host_loss_at_step=5, mode="raise"))
+        with pytest.raises(fault.InjectedFault):
+            fault.advance(8)
+    finally:
+        fault.clear()
+
+
+def test_supervisor_downgrades_on_host_loss(tmp_path):
+    """Census + ladder, no jax in the workers: gen 0 loses one of two
+    'hosts' permanently (marker + exit), the supervisor relaunches ONE
+    dp1 worker instead of two, and the incident trail prices the
+    transition."""
+    import sys
+
+    from paddle_tpu.parallel.elastic import ElasticSupervisor
+    from paddle_tpu.parallel.master import Backoff
+
+    worker = (
+        "import os, sys, time\n"
+        "gen = int(os.environ.get('PADDLE_ELASTIC_GENERATION', '0'))\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "hb = os.environ['PADDLE_ELASTIC_HB_DIR']\n"
+        "open(os.path.join(os.environ['T_DIR'],\n"
+        "     'saw_g%d_r%d_mesh_%s_n_%s' % (gen, rank,\n"
+        "     os.environ.get('PADDLE_TPU_MESH'),\n"
+        "     os.environ['PADDLE_TRAINERS'])), 'w').close()\n"
+        "if gen == 0 and rank == 1:\n"
+        "    open(os.path.join(hb, 'host_lost_g0_r1'), 'w').close()\n"
+        "    os._exit(137)\n"
+        "if gen == 0:\n"
+        "    time.sleep(60)\n"  # would-be survivor; torn down with the pod
+    )
+    wpy = os.path.join(str(tmp_path), "w.py")
+    with open(wpy, "w") as f:
+        f.write(worker)
+    sup = ElasticSupervisor(
+        f"{sys.executable} {wpy}", nproc=2, workdir=str(tmp_path),
+        max_restarts=2, backoff=Backoff(base=0.05, factor=1.0),
+        poll_interval=0.1, extra_env={"T_DIR": str(tmp_path)},
+        mesh_ladder="dp2;dp1")
+    result = sup.run()
+    assert result["status"] == "finished", result
+    events = [e["event"] for e in result["incidents"]]
+    assert "mesh.downgrade" in events
+    down = next(e for e in result["incidents"]
+                if e["event"] == "mesh.downgrade")
+    assert down["from_mesh"] == "dp2" and down["to_mesh"] == "dp1"
+    assert down["from_nproc"] == 2 and down["to_nproc"] == 1
+    assert down["survivors"] == 1 and down["generation"] == 1
+    # generation 1 really ran the downgraded fleet
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "saw_g1_r0_mesh_dp1_n_1"))
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "saw_g1_r1_mesh_dp1_n_1"))
+    gen1 = next(e for e in result["incidents"]
+                if e["event"] == "generation_start" and
+                e["generation"] == 1)
+    assert gen1["nproc"] == 1 and gen1["mesh"] == "dp1"
+
+
+def test_supervisor_unviable_ladder_fails_fast(tmp_path):
+    """When nothing on the ladder fits the survivors, the supervisor
+    stops with mesh.unviable instead of burning the restart budget."""
+    import sys
+
+    from paddle_tpu.parallel.elastic import ElasticSupervisor
+    from paddle_tpu.parallel.master import Backoff
+
+    worker = (
+        "import os\n"
+        "open(os.path.join(os.environ['PADDLE_ELASTIC_HB_DIR'],\n"
+        "     'host_lost_g0_r%s' % os.environ['PADDLE_TRAINER_ID']),\n"
+        "     'w').close()\n"
+        "os._exit(137)\n")
+    wpy = os.path.join(str(tmp_path), "w.py")
+    with open(wpy, "w") as f:
+        f.write(worker)
+    sup = ElasticSupervisor(
+        f"{sys.executable} {wpy}", nproc=2, workdir=str(tmp_path),
+        max_restarts=5, backoff=Backoff(base=0.05, factor=1.0),
+        poll_interval=0.1, mesh_ladder="dp2")
+    result = sup.run()
+    assert result["status"] == "failed"
+    events = [e["event"] for e in result["incidents"]]
+    assert "mesh.unviable" in events
+    # fail-fast: one generation, not max_restarts+1
+    assert result["generations"] == 1
+
+
+def test_goodput_ledger_prices_mesh_transition():
+    """A restart gap whose target generation carries a mesh.downgrade
+    incident is priced with the topology transition."""
+    from paddle_tpu.observe.goodput import build_ledger
+
+    t = 1000.0
+    records = [
+        {"ts": t + 1, "event": "executor.window", "dur_s": 1.0,
+         "host": "h", "rank": 0, "gen": 0, "step": 3},
+        {"ts": t + 2, "event": "worker_exit", "generation": 0, "rank": 0,
+         "last_step": 3, "commit_step": 2, "host": "h", "gen": 0,
+         "source": "supervisor"},
+        {"ts": t + 3, "event": "mesh.downgrade", "generation": 1,
+         "from_mesh": "dp4", "to_mesh": "dp2", "from_nproc": 4,
+         "to_nproc": 2, "source": "supervisor", "host": "h", "gen": 0},
+        {"ts": t + 6, "event": "executor.window", "dur_s": 1.0,
+         "host": "h", "rank": 0, "gen": 1, "step": 4},
+    ]
+    ledger = build_ledger(records)
+    assert len(ledger["restarts"]) == 1
+    entry = ledger["restarts"][0]
+    assert entry["lost_steps"] == 1
+    assert entry["mesh_from"] == "dp4" and entry["mesh_to"] == "dp2"
+    assert entry["nproc_from"] == 4 and entry["nproc_to"] == 2
+
+
+def test_reshard_smoke_tool():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import reshard_smoke
+    finally:
+        sys.path.pop(0)
+    report = reshard_smoke.main()
+    assert report["ok"], report
+    assert report["bitwise_ok"] and report["cursor_ok"]
+    assert report["fastpath_ok"] and report["error_ok"]
+    assert report["elapsed_s"] < 10.0

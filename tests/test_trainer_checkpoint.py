@@ -252,7 +252,11 @@ def test_sharded_serial_protocol(tmp_path):
     assert [s for s, _ in mh._sharded_serial_dirs(root)] == [1, 2]
     assert mh.latest_complete_sharded(root) == 2
     serial, meta, back = mh.load_sharded_latest(root, None, {})
-    assert serial == 2 and meta == {"step": 2}
+    assert serial == 2 and meta["step"] == 2
+    # meta is always topology-stamped now (ISSUE 14): the record a
+    # mesh-changing resume reads to decide whether to reshard
+    assert meta["process_count"] == 1
+    assert meta["data_shards"] == {"0": [1, 0]}
     np.testing.assert_array_equal(back["w"], states[2]["w"])
     np.testing.assert_array_equal(back["b"], states[2]["b"])
 
@@ -271,7 +275,7 @@ def test_sharded_serial_protocol(tmp_path):
     with open(victim, "r+b") as f:
         f.truncate(4)
     serial, meta, back = mh.load_sharded_latest(root, None, {})
-    assert serial == 1 and meta == {"step": 1}
+    assert serial == 1 and meta["step"] == 1
     np.testing.assert_array_equal(back["w"], states[1]["w"])
 
 
@@ -295,7 +299,7 @@ def test_sharded_serial_crash_between_write_and_mark(tmp_path):
     assert os.path.isdir(os.path.join(root, "checkpoint_1"))
     assert mh.latest_complete_sharded(root) == 0
     serial, meta, back = mh.load_sharded_latest(root, None, {})
-    assert serial == 0 and meta == {"step": 0}
+    assert serial == 0 and meta["step"] == 0
     np.testing.assert_array_equal(back["w"], s0["w"])
     # and the restore cleaned the crashed serial away
     assert not os.path.exists(os.path.join(root, "checkpoint_1"))

@@ -1,0 +1,153 @@
+"""Elastic-reshard smoke (CPU, < 10 s).
+
+The CI oracle for reshard-on-load (ISSUE 14): a dp4-sharded serial saved
+on CPU must (a) reload under a dp2 mesh with every param bitwise-equal
+to the serial's assembled logical view, (b) hand each dp2 rank a merged
+data cursor whose restored tail equals the uninterrupted dp2 reference
+exactly, (c) keep the same-mesh load on the untouched fast path, and
+(d) raise the named ``ReshardError`` for a topology the serial cannot
+viably land on.
+
+Run directly (``python tools/reshard_smoke.py``) or from tier-1 via
+``tests/test_reshard.py::test_reshard_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SAMPLES = 96
+BATCH_DP4 = 3
+STEPS_BEFORE = 2
+
+
+def main() -> dict:
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu import data
+    from paddle_tpu.data.checkpoint import save_data_state
+    from paddle_tpu.parallel import multihost as mh
+    from paddle_tpu.parallel import reshard
+    from paddle_tpu.parallel.mesh import mesh_from_spec
+
+    jax.config.update("jax_platforms", "cpu")
+    t0 = time.perf_counter()
+
+    def reader():
+        for i in range(N_SAMPLES):
+            yield i
+
+    def pipe(n, i, b):
+        return (data.from_reader(reader).shuffle(16, seed=3)
+                    .shard(n, i).batch(b))
+
+    with tempfile.TemporaryDirectory() as workdir:
+        root = os.path.join(workdir, "ckpt")
+        mesh4 = mesh_from_spec("dp4")
+        rng = np.random.RandomState(0)
+        state = {
+            "w": jax.device_put(
+                rng.normal(size=(8, 4)).astype(np.float32),
+                NamedSharding(mesh4, P())),
+            "b": jax.device_put(rng.normal(size=(8,)).astype(np.float32),
+                                NamedSharding(mesh4, P())),
+        }
+        mh.save_sharded_serial(state, root, serial=7, meta={"step": 7},
+                               mesh=mesh4)
+        cur = os.path.join(root, "checkpoint_7")
+        # the dp4 fleet's four committed cursors, 2 batches consumed each
+        for r in range(4):
+            p = pipe(4, r, BATCH_DP4)
+            it = iter(p)
+            for _ in range(STEPS_BEFORE):
+                next(it)
+            save_data_state(cur, p.state(), rank=r)
+        with open(os.path.join(cur, "meta.json")) as f:
+            meta = json.load(f)
+        meta.update(process_count=4,
+                    data_shards={str(r): [4, r] for r in range(4)})
+        with open(os.path.join(cur, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+        # (a) reload under dp2: bitwise vs the assembled logical view
+        mesh2 = mesh_from_spec("dp2")
+        serial, got_meta, back = mh.load_sharded_latest(root, mesh2, {})
+        logical = reshard.assemble_logical(cur)
+        bitwise_ok = (serial == 7
+                      and got_meta.get("resharded", {}).get("to_mesh")
+                      == "dp2"
+                      and all(np.array_equal(np.asarray(back[n]),
+                                             logical[n])
+                              for n in logical)
+                      and all(back[n].sharding
+                              == NamedSharding(mesh2, P())
+                              for n in logical))
+
+        # (b) merged cursors: each dp2 rank's restored tail equals the
+        # uninterrupted dp2 reference past the fleet's committed cut
+        cut = STEPS_BEFORE * BATCH_DP4 * 4  # samples the dp4 fleet ate
+        cursor_ok = True
+        for r in range(2):
+            cursor = reshard.remap_cursors(cur, meta, "dp2", rank=r,
+                                           num_hosts=2)
+            p = pipe(2, r, BATCH_DP4 * 2)
+            p.restore(cursor)
+            tail = [s for b in iter(p) for s in b]
+            ref = [s for b in iter(pipe(2, r, BATCH_DP4 * 2)) for s in b]
+            cursor_ok = cursor_ok and tail == ref[cut // 2:]
+
+        # (c) the same-topology load never touches reshard code (a clean
+        # root: recorded mesh dp4, recorded fleet size == live)
+        root_b = os.path.join(workdir, "ckpt_same")
+        mh.save_sharded_serial(state, root_b, serial=7, meta={"step": 7},
+                               mesh=mesh4)
+        calls = []
+        orig = reshard.load_resharded
+        reshard.load_resharded = lambda *a, **k: calls.append(1) or \
+            orig(*a, **k)
+        try:
+            serial, m2, same = mh.load_sharded_latest(root_b, mesh4, {})
+        finally:
+            reshard.load_resharded = orig
+        fastpath_ok = (not calls and serial == 7
+                       and "resharded" not in m2
+                       and all(np.array_equal(np.asarray(same[n]),
+                                              logical[n])
+                               for n in logical))
+
+        # (d) a non-viable topology raises the NAMED error
+        try:
+            reshard.check_viable(meta, "dp3", num_hosts=3)
+            error_ok = False
+        except reshard.ReshardError:
+            error_ok = True
+
+    report = {
+        "ok": bool(bitwise_ok and cursor_ok and fastpath_ok and error_ok),
+        "bitwise_ok": bool(bitwise_ok),
+        "cursor_ok": bool(cursor_ok),
+        "fastpath_ok": bool(fastpath_ok),
+        "error_ok": bool(error_ok),
+        "cut": cut,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
