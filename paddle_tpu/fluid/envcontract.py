@@ -238,6 +238,22 @@ declare("PADDLE_GOODPUT_STRAGGLER_FACTOR", "float", 1.5, "observe",
 declare("PADDLE_GOODPUT_MIN_SAMPLES", "int", 4, "observe",
         "Window samples required per rank before the skew test may flag")
 
+# -- serving (continuous-batching decode path) --
+declare("PADDLE_SERVE_DECODE", "bool", True, "serving",
+        "Continuous-batching decode master switch (0 makes DecodeEngine "
+        "construction refuse — the static request-granularity engine "
+        "remains the only serving path)")
+declare("PADDLE_SERVE_SLOTS", "int", 8, "serving",
+        "Decode slots: concurrent KV-cache-resident streams per engine "
+        "(the fixed leading dim of the one compiled decode step)")
+declare("PADDLE_SERVE_MAX_LEN", "int", 128, "serving",
+        "KV-cache capacity per slot (prompt + generated tokens); "
+        "admission rejects requests that cannot fit")
+declare("PADDLE_SERVE_PREFILL_BUCKETS", "str", "4,8,16", "serving",
+        "Comma-separated prompt-length buckets each compiled once; a "
+        "prompt pads up to its enclosing bucket (executable set = these "
+        "buckets + the one decode step)")
+
 # -- fault injection (PADDLE_FAULT_* family; deterministic test faults) --
 declare("PADDLE_FAULT_", "prefix", None, "fault",
         "Family prefix: any PADDLE_FAULT_* key is part of the injection "
@@ -270,6 +286,10 @@ declare("PADDLE_FAULT_SERVE_DELAY_MS", "float", 0.0, "fault",
         "Per-request serving delay injection (ms)")
 declare("PADDLE_FAULT_SERVE_FAIL_EVERY", "int", 0, "fault",
         "Fail every Nth serving request with InjectedFault")
+declare("PADDLE_FAULT_DECODE_STALL_MS", "float", 0.0, "fault",
+        "Stall every continuous-batching decode tick (ms): deterministic "
+        "inter-token-latency inflation, the serving.intertoken_s SLO "
+        "breach oracle")
 declare("PADDLE_FAULT_CACHE_CORRUPT", "bool", False, "fault",
         "Deterministically corrupt the next compile-cache read")
 declare("PADDLE_FAULT_DATA_STALL_MS", "float", 0.0, "fault",

@@ -1234,8 +1234,17 @@ class Executor:
         clones run concurrently against one shared scope, and a donated
         buffer deleted under a sibling thread's in-flight dispatch is the
         one hazard copy-on-return cannot fix.  ``PADDLE_TPU_DONATE=0``
-        opts out entirely (debugging buffer lifetimes)."""
-        if program is not None and program._params_grads is None:
+        opts out entirely (debugging buffer lifetimes).
+
+        Exception to the inference rule: a program that sets
+        ``_donate_state = True`` (the serving DecodeEngine's decode-step
+        / prefill programs, whose persistable KV cache is rewritten by
+        exactly one engine worker thread per the single-dispatcher
+        contract) opts back in, so the [max_slots, max_len, ...] cache
+        buffers alias window-over-window instead of copying every
+        tick."""
+        if program is not None and program._params_grads is None \
+                and not getattr(program, "_donate_state", False):
             return ()
         from . import envcontract
 

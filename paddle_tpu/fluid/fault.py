@@ -61,6 +61,13 @@ worker processes:
                                   InjectedFault delivered on that request's
                                   future (the engine must isolate it: the
                                   rest of the batch still completes)
+    PADDLE_FAULT_DECODE_STALL_MS=t
+                                  stall every continuous-batching decode
+                                  TICK t ms (DecodeEngine worker loop) —
+                                  inflates inter-token latency on every
+                                  in-flight stream at once, the
+                                  deterministic oracle for the SLO
+                                  watchdog's serving.intertoken_s breach
     PADDLE_FAULT_CACHE_CORRUPT=1  treat every persistent compile-cache
                                   entry load as corrupt (the deterministic
                                   oracle for the cache's fallback path:
@@ -123,7 +130,8 @@ from typing import Optional
 __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
-    "barrier_stall", "serving_request", "sentinel_injection",
+    "barrier_stall", "serving_request", "decode_stall",
+    "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
     "shard_corrupt", "mem_pressure_bytes", "straggler_delay",
     "current_step", "KILL_EXIT_CODE",
@@ -153,6 +161,7 @@ class FaultPlan:
                  loss_spike_factor: float = 1e4,
                  barrier_stall_s: float = 0.0,
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
+                 decode_stall_ms: float = 0.0,
                  cache_corrupt: bool = False,
                  data_stall_ms: float = 0.0,
                  data_stall_at: Optional[int] = None,
@@ -183,6 +192,7 @@ class FaultPlan:
         self.barrier_stall_s = float(barrier_stall_s)
         self.serve_delay_ms = float(serve_delay_ms)
         self.serve_fail_every = int(serve_fail_every)
+        self.decode_stall_ms = float(decode_stall_ms)
         self.cache_corrupt = bool(cache_corrupt)
         self.data_stall_ms = float(data_stall_ms)
         self.data_stall_at = None if data_stall_at is None \
@@ -233,6 +243,7 @@ class FaultPlan:
             barrier_stall_s=getf("PADDLE_FAULT_BARRIER_STALL"),
             serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
             serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
+            decode_stall_ms=getf("PADDLE_FAULT_DECODE_STALL_MS"),
             cache_corrupt=env.get("PADDLE_FAULT_CACHE_CORRUPT", "").strip()
             .lower() in ("1", "true", "yes"),
             data_stall_ms=getf("PADDLE_FAULT_DATA_STALL_MS"),
@@ -465,6 +476,20 @@ def serving_request() -> None:
         if plan._serve_count % plan.serve_fail_every == 0:
             raise InjectedFault(
                 f"injected serving failure (request #{plan._serve_count})")
+
+
+def decode_stall(n_ticks: int = 1) -> None:
+    """Continuous-batching tick stall: the DecodeEngine worker calls this
+    once per iteration (admit -> step -> retire), so an armed stall
+    inflates EVERY in-flight stream's inter-token latency by the same
+    deterministic amount — the oracle for the SLO watchdog breaching on
+    ``serving.intertoken_s`` (unlike SERVE_DELAY_MS, which delays whole
+    requests at batch formation, this models a slow decode step)."""
+    plan = active()
+    if plan is None or plan.decode_stall_ms <= 0 \
+            or not plan._applies_to_this_rank():
+        return
+    time.sleep(plan.decode_stall_ms * max(1, int(n_ticks)) / 1000.0)
 
 
 def cache_corrupt() -> bool:

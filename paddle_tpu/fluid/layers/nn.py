@@ -33,6 +33,7 @@ __all__ = [
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
     "ring_attention", "moe_ffn", "gpipe_mlp_stack",
+    "kv_cache_update", "token_select",
     "transformer_encoder_stack", "transformer_decoder_stack", "cos_sim",
     "multiplex", "pool3d", "random_crop", "rank_loss",
     "image_resize_short", "Print", "load",
@@ -1296,6 +1297,42 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
                "sp_axis": sp_axis,
                "flash": -1 if flash is None else int(bool(flash))})
     return out
+
+def kv_cache_update(cache, new, slots, pos, name=None):
+    """Scatter ``new`` [n, w, ...] into rows of the persistable KV cache
+    ``cache`` [max_slots, max_len, ...] at per-row destinations: row j
+    lands at ``cache[slots[j], pos[j]:pos[j]+w]`` (continuous-batching
+    decode, ops/decode_ops.py).  The op writes the cache var IN PLACE
+    (its output is ``cache`` itself), so the executor commits it as
+    persistent device state after the dispatch — with
+    ``program._donate_state`` the buffer is donated and aliased
+    window-over-window.  Returns ``cache``.  Callers guarantee
+    ``pos + w <= max_len``."""
+    helper = LayerHelper("kv_cache_update", **locals())
+    helper.append_op(
+        type="kv_cache_update",
+        inputs={"Cache": [cache], "New": [new], "Slots": [slots],
+                "Pos": [pos]},
+        outputs={"Out": [cache]})
+    return cache
+
+
+def token_select(logits, mask=None, end_id=0, name=None):
+    """Greedy per-slot next-token choice for the compiled decode step:
+    ``argmax(logits, -1)`` where ``mask`` is truthy, ``end_id``
+    otherwise (inactive/free slots emit inert pad tokens).  logits:
+    [slots, vocab]; mask: optional [slots].  Returns [slots] int64."""
+    helper = LayerHelper("token_select", **locals())
+    out = helper.create_variable_for_type_inference(
+        core.convert_dtype("int64"), stop_gradient=True)
+    out.shape = tuple(logits.shape[:-1])
+    inputs = {"Logits": [logits]}
+    if mask is not None:
+        inputs["Mask"] = [mask]
+    helper.append_op(type="token_select", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"end_id": int(end_id)})
+    return out
+
 
 def _stack_params(helper, dtype, n_layer, d_model, d_inner, decoder,
                   param_attr):

@@ -311,6 +311,256 @@ def forward(cfg, src_len, tgt_len):
     return src_word, tgt_word, lbl_word, avg_cost, logits
 
 
+# ---------------------------------------------------------------------------
+# Step-form decode (ISSUE 15): slot-based KV cache, one compiled decode step
+# ---------------------------------------------------------------------------
+
+
+def decode_lm_config():
+    """Decoder-only LM at CPU-test scale for the continuous-batching
+    serving path (``serving.decode.DecodeEngine``): self-attention only,
+    single head, no dropout — the deterministic greedy-decode model the
+    convoy/bitwise oracles run against."""
+    return Config("decode_lm", src_vocab_size=64, tgt_vocab_size=64,
+                  d_model=16, d_inner=32, n_head=1, n_layer=2,
+                  dropout=0.0, label_smooth=0.0)
+
+
+class DecodeModel:
+    """Step-form decoder-only transformer LM: the decode programs the
+    continuous-batching engine drives token-by-token.
+
+    Three program families, all sharing parameters AND per-layer KV
+    caches BY NAME through one scope:
+
+     - ``startup``: initializes every weight plus the per-layer
+       ``dlm{i}_cache_k/v`` caches — persistable ``[max_slots, max_len,
+       d_model]`` zeros that live device-resident across dispatches (the
+       slot-based KV cache);
+     - ``step_program``: ONE fixed-shape program over ALL slots — embed
+       the last token, project q/k/v, ``kv_cache_update`` this tick's
+       K/V at each slot's write position, attend over the cache row
+       under a host-fed ``-inf`` validity bias, project logits,
+       ``token_select`` greedily.  Fixed ``[max_slots, ...]`` shapes ⇒
+       exactly one executable regardless of which slots are live;
+     - ``prefill_program(plen)``: one program per prompt-length bucket
+       (single request): local causal attention over the prompt window
+       and a ``kv_cache_update`` scatter of the whole K/V prefix into
+       the request's slot at position 0.  No logits — the first decode
+       tick re-derives position ``len-1`` (same weights, same token ⇒
+       bit-identical K/V) and emits the first token, so the executable
+       set stays small.
+
+    Bitwise sequential-equivalence contract: every op is row-independent
+    over the slot dim and masked cache positions contribute EXACTLY zero
+    (the validity bias is ``-inf``, so softmax weights vanish in IEEE
+    rather than shrinking to ~e-30), so a stream's tokens are a function
+    of its own prompt alone — continuous batching cannot perturb them.
+
+    All programs set ``_donate_state`` so the executor donates the cache
+    buffers and XLA aliases them window-over-window (PR 6 machinery)."""
+
+    # decode-step feed names (the engine builds these arrays per tick)
+    DC_TOKENS, DC_POSENC, DC_BIAS, DC_POS, DC_ACTIVE = (
+        "dc_tokens", "dc_posenc", "dc_bias", "dc_pos", "dc_active")
+    # prefill feed names (per admitted request)
+    PF_TOKENS, PF_SLOT = "pf_tokens", "pf_slot"
+
+    def __init__(self, cfg=None, max_slots=None, max_len=None,
+                 prefill_buckets=None, end_id=1, seed=7):
+        from ..fluid import envcontract as _ec
+
+        self.cfg = cfg or decode_lm_config()
+        if self.cfg.dropout:
+            raise ValueError("decode models must be deterministic: "
+                             "build the config with dropout=0")
+        self.max_slots = int(max_slots if max_slots is not None
+                             else _ec.get("PADDLE_SERVE_SLOTS"))
+        self.max_len = int(max_len if max_len is not None
+                           else _ec.get("PADDLE_SERVE_MAX_LEN"))
+        if prefill_buckets is None:
+            raw = _ec.get("PADDLE_SERVE_PREFILL_BUCKETS") or ""
+            prefill_buckets = [int(b) for b in str(raw).split(",") if b]
+        self.prefill_buckets = sorted(
+            {int(b) for b in prefill_buckets if int(b) <= self.max_len})
+        if not self.prefill_buckets:
+            raise ValueError(
+                f"no viable prefill bucket <= max_len ({self.max_len})")
+        self.end_id = int(end_id)
+        self.seed = int(seed)
+        self.vocab_size = int(self.cfg.tgt_vocab_size)
+        self.pos_table = _position_encoding(self.max_len, self.cfg.d_model)
+        self.startup = fluid.Program()
+        self._prefill = {}
+        self.step_program, self.step_fetch = self._build_step()
+
+    # -- graph pieces shared by the step and prefill programs --
+
+    def _cache_var(self, name):
+        """The persistable [S, L, D] cache param (zero-init, frozen)."""
+        from ..fluid.initializer import ConstantInitializer
+        from ..fluid.layers import tensor as _tensor
+
+        return _tensor.create_parameter(
+            shape=[self.max_slots, self.max_len, self.cfg.d_model],
+            dtype="float32",
+            attr=ParamAttr(name=name, trainable=False,
+                           initializer=ConstantInitializer(0.0)))
+
+    def _layer(self, x, i, attn):
+        """One decoder layer over x [n, t, D]; ``attn(q, k, v)`` supplies
+        the cache-backed (step) or windowed-causal (prefill) attention."""
+        d, f = self.cfg.d_model, self.cfg.d_inner
+        proj = dict(num_flatten_dims=2, bias_attr=False)
+        q = layers.fc(x, d, param_attr=ParamAttr(name=f"dlm{i}_q_w"), **proj)
+        k = layers.fc(x, d, param_attr=ParamAttr(name=f"dlm{i}_k_w"), **proj)
+        v = layers.fc(x, d, param_attr=ParamAttr(name=f"dlm{i}_v_w"), **proj)
+        ctx = attn(q, k, v)
+        o = layers.fc(ctx, d, param_attr=ParamAttr(name=f"dlm{i}_o_w"),
+                      **proj)
+        x = layers.layer_norm(
+            layers.elementwise_add(x, o), begin_norm_axis=2,
+            param_attr=ParamAttr(name=f"dlm{i}_ln1_s"),
+            bias_attr=ParamAttr(name=f"dlm{i}_ln1_b"))
+        h = layers.fc(x, f, act="relu",
+                      param_attr=ParamAttr(name=f"dlm{i}_ffn1_w"), **proj)
+        ff = layers.fc(h, d, param_attr=ParamAttr(name=f"dlm{i}_ffn2_w"),
+                       **proj)
+        return layers.layer_norm(
+            layers.elementwise_add(x, ff), begin_norm_axis=2,
+            param_attr=ParamAttr(name=f"dlm{i}_ln2_s"),
+            bias_attr=ParamAttr(name=f"dlm{i}_ln2_b"))
+
+    def _embed(self, tokens, posenc_var):
+        emb = layers.embedding(tokens, size=[self.vocab_size,
+                                             self.cfg.d_model],
+                               param_attr=ParamAttr(name="dlm_emb"))
+        return layers.elementwise_add(
+            layers.scale(emb, scale=self.cfg.d_model ** 0.5), posenc_var,
+            axis=emb.shape and len(emb.shape) - len(posenc_var.shape))
+
+    # -- the one compiled decode step --
+
+    def _build_step(self):
+        s, l = self.max_slots, self.max_len
+        d, v = self.cfg.d_model, self.vocab_size
+        prog = fluid.Program()
+        prog.random_seed = self.startup.random_seed = self.seed
+        prog._donate_state = True  # single engine worker owns dispatch
+        with fluid.program_guard(prog, self.startup), \
+                fluid.unique_name.guard():
+            tokens = layers.data(self.DC_TOKENS, shape=[s, 1],
+                                 dtype="int64", append_batch_size=False)
+            posenc = layers.data(self.DC_POSENC, shape=[s, d],
+                                 dtype="float32", append_batch_size=False)
+            bias = layers.data(self.DC_BIAS, shape=[s, 1, l],
+                               dtype="float32", append_batch_size=False)
+            pos = layers.data(self.DC_POS, shape=[s], dtype="int64",
+                              append_batch_size=False)
+            active = layers.data(self.DC_ACTIVE, shape=[s],
+                                 dtype="float32", append_batch_size=False)
+            slots = layers.assign(np.arange(s, dtype=np.int64))
+
+            x = layers.reshape(self._embed(tokens, posenc), [s, 1, d])
+
+            def cache_attn(q, k, v_, i):
+                ck = self._cache_var(f"dlm{i}_cache_k")
+                cv = self._cache_var(f"dlm{i}_cache_v")
+                # write BEFORE reading so position `pos` (this token)
+                # participates in its own attention window
+                ck = layers.kv_cache_update(ck, k, slots, pos)
+                cv = layers.kv_cache_update(cv, v_, slots, pos)
+                scores = layers.matmul(
+                    layers.scale(q, scale=d ** -0.5), ck,
+                    transpose_y=True)                        # [S, 1, L]
+                probs = layers.softmax(
+                    layers.elementwise_add(scores, bias))
+                return layers.matmul(probs, cv)              # [S, 1, D]
+
+            for i in range(self.cfg.n_layer):
+                x = self._layer(x, i,
+                                lambda q, k, v_, i=i: cache_attn(q, k, v_, i))
+            logits = layers.fc(layers.reshape(x, [s, d]), v,
+                               bias_attr=False,
+                               param_attr=ParamAttr(name="dlm_out_w"))
+            nxt = layers.token_select(logits, mask=active,
+                                      end_id=self.end_id)
+        return prog, nxt.name
+
+    # -- bucketed prefill --
+
+    def bucket_for(self, prompt_len):
+        """Smallest prefill bucket holding ``prompt_len`` (None = none)."""
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        return None
+
+    def prefill_program(self, plen):
+        """The (lazily built, cached) prefill program for bucket ``plen``:
+        one request, prompt padded to ``plen``, K/V prefix scattered into
+        the fed slot at position 0.  Weights come from the step
+        program's startup — this builder's throwaway startup is never
+        run."""
+        prog = self._prefill.get(plen)
+        if prog is not None:
+            return prog
+        if plen not in self.prefill_buckets:
+            raise ValueError(f"{plen} is not a prefill bucket "
+                             f"({self.prefill_buckets})")
+        d = self.cfg.d_model
+        prog, scratch_startup = fluid.Program(), fluid.Program()
+        prog.random_seed = scratch_startup.random_seed = self.seed
+        prog._donate_state = True
+        with fluid.program_guard(prog, scratch_startup), \
+                fluid.unique_name.guard():
+            tokens = layers.data(self.PF_TOKENS, shape=[1, plen],
+                                 dtype="int64", append_batch_size=False)
+            slot = layers.data(self.PF_SLOT, shape=[1], dtype="int64",
+                               append_batch_size=False)
+            start = layers.fill_constant([1], "int64", 0)
+            posenc = layers.assign(self.pos_table[:plen])     # [p, D]
+            x = self._embed(tokens, posenc)                   # [1, p, D]
+
+            def window_attn(q, k, v_, i):
+                ck = self._cache_var(f"dlm{i}_cache_k")
+                cv = self._cache_var(f"dlm{i}_cache_v")
+                layers.kv_cache_update(ck, k, slot, start)
+                layers.kv_cache_update(cv, v_, slot, start)
+                # the prompt window attends within itself (causal); the
+                # cache is write-only here — decode ticks read it
+                scores = layers.matmul(
+                    layers.scale(q, scale=d ** -0.5), k,
+                    transpose_y=True)                        # [1, p, p]
+                scores = layers.elementwise_add(
+                    scores, _shared_causal_bias(plen, plen), axis=1)
+                return layers.matmul(layers.softmax(scores), v_)
+
+            for i in range(self.cfg.n_layer):
+                x = self._layer(x, i,
+                                lambda q, k, v_, i=i: window_attn(q, k, v_, i))
+        self._prefill[plen] = prog
+        return prog
+
+    # -- host-side helpers the engine uses to build tick feeds --
+
+    def posenc_rows(self, positions):
+        """pos_table rows for an int position vector (clipped in-range)."""
+        idx = np.clip(np.asarray(positions, np.int64), 0, self.max_len - 1)
+        return self.pos_table[idx]
+
+    def validity_bias(self, positions):
+        """[S, 1, L] additive bias: 0 where cache index <= pos, -inf
+        elsewhere.  EXACT -inf on purpose — stale cache rows beyond a
+        stream's frontier must contribute exactly zero attention weight
+        (IEEE exp(-inf)=0), which is what makes slot reuse invisible to
+        the generated bits."""
+        pos = np.asarray(positions, np.int64).reshape(-1, 1)
+        idx = np.arange(self.max_len, dtype=np.int64)[None, :]
+        bias = np.where(idx <= pos, 0.0, -np.inf).astype(np.float32)
+        return bias.reshape(len(positions), 1, self.max_len)
+
+
 def build(cfg=None, src_len=64, tgt_len=64, lr=1e-3, warmup_steps=None):
     """Full training graph with Adam (+ optional noam decay).  Returns
     (src_word, tgt_word, lbl_word, avg_cost)."""

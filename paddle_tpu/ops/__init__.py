@@ -25,5 +25,6 @@ from . import (  # noqa: F401
     moe_ops,
     pipeline_ops,
     transformer_ops,
+    decode_ops,
 )
 from . import infer_rules  # noqa: F401,E402  (static infer rules, after impls)

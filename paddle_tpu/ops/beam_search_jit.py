@@ -32,6 +32,48 @@ NEG_INF = -1.0e30  # finite "minus infinity": keeps top_k ties deterministic
                    # and avoids (-inf) + (-inf) edge cases in f32
 
 
+def beam_search_step(step_fn: Callable, states: Sequence, tokens, scores,
+                     finished, *, beam_size: int, vocab_size: int,
+                     end_id: int):
+    """ONE beam-search expansion in step form (ISSUE 15): advance the
+    cell, fan candidates out, select the top-k per source, reorder the
+    cell states along the chosen parents.
+
+    This is the loop body of :func:`beam_search_loop` factored out so an
+    iteration-level scheduler (the serving DecodeEngine) can drive beam
+    decode token-by-token with its own admit/retire policy between
+    steps — same math, one expansion per call.
+
+    tokens/scores/finished: [batch, beam]; states: list of
+    [batch*beam, ...] arrays.  Returns ``(new_tokens, parents,
+    new_scores, new_finished, new_states)`` with parents [batch, beam]
+    int32 (the trace-back row the caller appends to its history)."""
+    B, K = tokens.shape
+    V = int(vocab_size)
+    assert K == int(beam_size)
+    probs, new_states = step_fn(states, tokens.reshape(B * K, 1))
+    logp = jnp.log(jnp.maximum(probs.astype(jnp.float32), 1e-30))
+    cand = scores[:, :, None] + logp.reshape(B, K, V)
+    # ended beam: sole candidate is end_id at its frozen score
+    # (mirrors ops/array_ops.py beam_search's ended-beam branch)
+    cand = jnp.where(finished[:, :, None], NEG_INF, cand)
+    cand = cand.at[:, :, end_id].set(
+        jnp.where(finished, scores, cand[:, :, end_id]))
+
+    top_sc, top_idx = lax.top_k(cand.reshape(B, K * V), K)
+    parent = (top_idx // V).astype(jnp.int32)
+    new_tok = (top_idx % V).astype(jnp.int64)
+    par_fin = jnp.take_along_axis(finished, parent, axis=1)
+    new_fin = par_fin | (new_tok == end_id)
+    # dead lanes (score still NEG_INF) must not flip finished off
+    new_fin = new_fin | (top_sc <= NEG_INF / 2)
+
+    rows = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
+            + parent).reshape(-1)
+    new_states = [s[rows] for s in new_states]
+    return new_tok, parent, top_sc, new_fin, new_states
+
+
 def beam_search_loop(step_fn: Callable, init_states: Sequence,
                      init_ids, init_scores, *, beam_size: int,
                      vocab_size: int, max_len: int, end_id: int):
@@ -79,26 +121,9 @@ def beam_search_loop(step_fn: Callable, init_states: Sequence,
 
     def body(carry):
         t, tokens, scores, finished, states, h_ids, h_par, h_sc = carry
-        probs, new_states = step_fn(states, tokens.reshape(B * K, 1))
-        logp = jnp.log(jnp.maximum(probs.astype(jnp.float32), 1e-30))
-        cand = scores[:, :, None] + logp.reshape(B, K, V)
-        # ended beam: sole candidate is end_id at its frozen score
-        # (mirrors ops/array_ops.py beam_search's ended-beam branch)
-        cand = jnp.where(finished[:, :, None], NEG_INF, cand)
-        cand = cand.at[:, :, end_id].set(
-            jnp.where(finished, scores, cand[:, :, end_id]))
-
-        top_sc, top_idx = lax.top_k(cand.reshape(B, K * V), K)
-        parent = (top_idx // V).astype(jnp.int32)
-        new_tok = (top_idx % V).astype(jnp.int64)
-        par_fin = jnp.take_along_axis(finished, parent, axis=1)
-        new_fin = par_fin | (new_tok == end_id)
-        # dead lanes (score still NEG_INF) must not flip finished off
-        new_fin = new_fin | (top_sc <= NEG_INF / 2)
-
-        rows = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
-                + parent).reshape(-1)
-        new_states = [s[rows] for s in new_states]
+        new_tok, parent, top_sc, new_fin, new_states = beam_search_step(
+            step_fn, states, tokens, scores, finished, beam_size=K,
+            vocab_size=V, end_id=end_id)
 
         h_ids = lax.dynamic_update_index_in_dim(h_ids, new_tok, t, 0)
         h_par = lax.dynamic_update_index_in_dim(h_par, parent, t, 0)

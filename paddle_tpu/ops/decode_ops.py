@@ -1,0 +1,70 @@
+"""Decode-step op surface for continuous batching (ISSUE 15).
+
+Two ops make an autoregressive decode step expressible as a fixed-shape
+fluid program the serving engine can dispatch once per iteration:
+
+ - ``kv_cache_update``: scatter a window of freshly projected K/V rows
+   into a persistable ``[max_slots, max_len, ...]`` cache at per-row
+   (slot, position) destinations.  The op's output IS the cache var
+   (in-place by name), so the executor commits it as persistent state
+   after every dispatch and — with ``program._donate_state`` set — the
+   donation machinery aliases the cache buffer window-over-window
+   instead of copying it (the PR 6 donated-carry idiom, applied to the
+   serving path).
+ - ``token_select``: greedy next-token choice per slot —
+   ``argmax(logits)`` where the slot is active, the ``end_id`` pad token
+   where it is not, so retired/free slots emit inert tokens without a
+   host round trip inside the step.
+
+Both are row-independent over the slot dim on purpose: a slot's token
+stream is a function of its own prompt and cache rows only, which is
+what makes continuous-batching output bitwise identical to per-request
+sequential decode (the ISSUE 15 convoy oracle's correctness half).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+@register_op("kv_cache_update", stateful=True,
+             no_grad_inputs=("Slots", "Pos"))
+def kv_cache_update(ctx):
+    """Cache [S, L, ...], New [n, w, ...], Slots [n] int, Pos [n] int ->
+    Out = Cache with ``New[j]`` written at ``Cache[Slots[j], Pos[j]:
+    Pos[j]+w]``.  Callers keep ``Pos[j] + w <= L`` (the engine's
+    max_len admission check); ``dynamic_update_slice`` clamps anything
+    else rather than corrupting neighbor rows."""
+    cache = ctx.input("Cache")
+    new = ctx.input("New").astype(cache.dtype)
+    slots = ctx.input("Slots").astype(jnp.int32).reshape(-1)
+    pos = ctx.input("Pos").astype(jnp.int32).reshape(-1)
+    rows = jnp.take(cache, slots, axis=0)          # [n, L, ...]
+
+    def write(row, window, p):
+        start = (p,) + (jnp.int32(0),) * (row.ndim - 1)
+        return jax.lax.dynamic_update_slice(row, window, start)
+
+    rows = jax.vmap(write)(rows, new, pos)
+    return {"Out": cache.at[slots].set(rows)}
+
+
+@register_op("token_select", no_grad_inputs=("Mask",))
+def token_select(ctx):
+    """Logits [S, V] (+ optional Mask [S]) -> Out [S] int64: per-slot
+    greedy argmax; inactive slots (mask == 0) emit ``end_id`` so free
+    slots never contribute spurious tokens.  argmax ties break to the
+    lowest index — deterministic for a fixed executable, part of the
+    bitwise sequential-equivalence contract."""
+    logits = ctx.input("Logits")
+    end_id = int(ctx.attr("end_id", 0))
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int64)
+    mask = ctx.input("Mask") if ctx.has_input("Mask") else None
+    if mask is not None:
+        out = jnp.where(mask.reshape(-1) > 0, out, jnp.int64(end_id))
+    return {"Out": out}

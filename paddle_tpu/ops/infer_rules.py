@@ -305,6 +305,64 @@ def infer_ring_attention(op, ins):
     return {"Out": [q]}
 
 
+@register_infer("kv_cache_update")
+def infer_kv_cache_update(op, ins):
+    """Decode-step KV-cache scatter (ISSUE 15): Out mirrors Cache, and the
+    static contract — window fits the cache, index vectors are integer
+    and agree with the window's row count — is exactly what abstract
+    evaluation cannot name (a bad Pos dtype would silently truncate, a
+    too-long window would silently clamp)."""
+    cache, new = _in(ins, "Cache"), _in(ins, "New")
+    slots = _require_int(op, ins, "Slots")
+    pos = _require_int(op, ins, "Pos")
+    if cache is None:
+        return None
+    if new is not None:
+        if len(new[0]) != len(cache[0]):
+            raise InferMismatch(
+                f"kv_cache_update: window {_names(op, 'New')} "
+                f"{list(new[0])} must match cache {_names(op, 'Cache')} "
+                f"{list(cache[0])} rank (rows, window, feature...)")
+        if new[0][1] > cache[0][1]:
+            raise InferMismatch(
+                f"kv_cache_update: window length {new[0][1]} exceeds "
+                f"cache max_len {cache[0][1]} "
+                f"({_names(op, 'New')} vs {_names(op, 'Cache')})")
+        if tuple(new[0][2:]) != tuple(cache[0][2:]):
+            raise InferMismatch(
+                f"kv_cache_update: feature dims {list(new[0][2:])} of "
+                f"{_names(op, 'New')} do not match cache feature dims "
+                f"{list(cache[0][2:])}")
+        for slot_name, v in (("Slots", slots), ("Pos", pos)):
+            if v is not None and int(np.prod(v[0], dtype=np.int64)) \
+                    != new[0][0]:
+                raise InferMismatch(
+                    f"kv_cache_update: {slot_name} {_names(op, slot_name)} "
+                    f"{list(v[0])} must carry one index per window row "
+                    f"({new[0][0]})")
+    return {"Out": [cache]}
+
+
+@register_infer("token_select")
+def infer_token_select(op, ins):
+    """Greedy token choice: Out is [S] int64 off [S, V] logits; an
+    inactive-slot mask must be one value per slot."""
+    logits = _in(ins, "Logits")
+    mask = _in(ins, "Mask")
+    if logits is None:
+        return None
+    if len(logits[0]) < 2:
+        raise InferMismatch(
+            f"token_select: logits {_names(op, 'Logits')} "
+            f"{list(logits[0])} must be [slots, vocab]")
+    if mask is not None and int(np.prod(mask[0], dtype=np.int64)) \
+            != logits[0][0]:
+        raise InferMismatch(
+            f"token_select: mask {_names(op, 'Mask')} {list(mask[0])} "
+            f"must carry one flag per slot ({logits[0][0]})")
+    return {"Out": [(tuple(logits[0][:-1]), "int64")]}
+
+
 def _infer_param_update(op, ins):
     """Optimizer-family updates: each '<X>Out' output mirrors input slot
     '<X>' (ParamOut <- Param, MomentOut <- Moment, ...)."""

@@ -77,6 +77,18 @@ def init(coordinator_addr: Optional[str] = None,
     VLOG(1, f"multihost: jax.distributed.initialize coordinator="
             f"{coordinator_addr} procs={num_processes} id={process_id}")
     try:
+        if jax.config.jax_platforms == "cpu" or \
+                os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # the CPU PJRT client refuses cross-process computations
+            # ("Multiprocess computations aren't implemented on the CPU
+            # backend") unless the gloo collectives implementation is
+            # selected BEFORE backend init — without this, every
+            # multi-process CPU test/run dies at its first collective
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # older jaxlib without the option: keep old behavior
         jax.distributed.initialize(coordinator_addr, num_processes,
                                    process_id, local_device_ids)
     except RuntimeError as exc:

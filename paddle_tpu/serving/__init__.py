@@ -19,10 +19,12 @@ Quick start::
     eng.shutdown()
 """
 
+from .decode import DecodeConfig, DecodeEngine, create_decode_engine
 from .engine import (EngineClosed, EngineOverloaded, RequestTimeout,
                      ServingConfig, ServingEngine, create_serving_engine)
 from .metrics import ServingMetrics
 
 __all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
            "EngineOverloaded", "RequestTimeout", "EngineClosed",
-           "create_serving_engine"]
+           "create_serving_engine",
+           "DecodeEngine", "DecodeConfig", "create_decode_engine"]

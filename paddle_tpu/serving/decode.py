@@ -1,0 +1,544 @@
+"""Continuous batching for autoregressive decode (ISSUE 15 tentpole).
+
+The PR 2 :class:`~paddle_tpu.serving.engine.ServingEngine` batches at
+REQUEST granularity: a batch runs to completion before its members
+resolve, so one long generation convoys every short request behind it,
+and each distinct live-batch shape risks a fresh XLA executable.  This
+module is the canonical fix (the Orca/vLLM iteration-level design,
+shaped TPU-first):
+
+ - **Slot-based KV cache**: the decode state is a persistable
+   ``[max_slots, max_len, d_model]`` pytree of per-layer K/V caches that
+   lives DEVICE-RESIDENT across dispatches (executor scope state, donated
+   buffers aliasing window-over-window — the PR 6 machinery, opted in
+   via ``program._donate_state``).  A request owns one slot from
+   admission to retirement.
+ - **Iteration-level scheduling**: every engine tick runs ONE compiled
+   decode step over ALL slots — fixed ``[max_slots, ...]`` shapes mean
+   exactly one decode executable plus a small bucketed-prefill set, so
+   the compile counter stays flat in steady state no matter how requests
+   arrive (the shape discipline the bucket manifest and compile cache
+   were built for).  New requests enter free slots mid-flight via a
+   bucketed prefill that writes their K/V prefix in place; finished
+   slots retire IMMEDIATELY, so a short request's latency is
+   O(own length), not O(longest cohabitant).
+ - **Worker loop**: ``admit -> step -> retire``, one thread owning every
+   dispatch (single jit-cache writer, donation-safe).
+
+Correctness contract: the decode-step program is row-independent over
+the slot dim and masks stale cache positions with EXACT ``-inf`` bias
+(zero attention weight in IEEE), so generated tokens are bitwise
+identical to per-request sequential decode — continuous batching is
+purely a scheduling change.  :meth:`DecodeEngine.decode_static` keeps
+the request-granularity baseline alive as the convoy oracle's
+comparator.
+
+Observability: ``serving.request`` spans gain ``serving.prefill`` and
+``serving.decode_step`` × N children (iteration-level preemption is
+visible in the span tree); :class:`ServingMetrics` gains TTFT and
+inter-token latency series plus ``slots_active``/``slots_free`` gauges
+mirrored into the process registry; the SLO watchdog watches
+``serving.ttft_s``/``serving.intertoken_s`` (deterministic breach
+oracle: ``PADDLE_FAULT_DECODE_STALL_MS``).
+
+Knobs (``fluid.envcontract``): ``PADDLE_SERVE_DECODE`` (kill switch),
+``PADDLE_SERVE_SLOTS``, ``PADDLE_SERVE_MAX_LEN``,
+``PADDLE_SERVE_PREFILL_BUCKETS``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .engine import EngineClosed, EngineOverloaded, RequestTimeout, _Request
+from .metrics import ServingMetrics
+
+__all__ = ["DecodeConfig", "DecodeEngine", "create_decode_engine"]
+
+
+@dataclass
+class DecodeConfig:
+    """Scheduling policy for a :class:`DecodeEngine`.  The SHAPE knobs
+    (slots, max_len, prefill buckets) live on the model — they define
+    the executable set — while this carries pure policy:
+
+    ``max_queue_depth``    pending requests beyond this shed with
+                           :class:`EngineOverloaded` (same fast-fail
+                           backpressure as the batch engine);
+    ``default_timeout_ms`` per-request deadline when submit() gets none.
+                           Decode deadlines are checked PER TOKEN: a
+                           request can expire mid-generation and free
+                           its slot for the queue;
+    ``idle_wait_s``        worker-condition wait while fully idle.
+    """
+    max_queue_depth: int = 256
+    default_timeout_ms: Optional[float] = None
+    idle_wait_s: float = 0.05
+
+
+class DecodeEngine:
+    """Iteration-level-scheduled generation over one step-form decode
+    model (:class:`paddle_tpu.models.transformer.DecodeModel`).
+
+    ``submit(prompt_ids, max_new_tokens)`` returns a Future of the
+    generated token-id list (greedy decode; ends at the model's
+    ``end_id``, the token budget, or cache capacity).  Use as a context
+    manager or call ``shutdown()``."""
+
+    def __init__(self, model=None, config: Optional[DecodeConfig] = None,
+                 place=None):
+        from ..fluid import envcontract as _ec
+
+        if not _ec.get("PADDLE_SERVE_DECODE"):
+            raise EngineClosed(
+                "continuous-batching decode is disabled "
+                "(PADDLE_SERVE_DECODE=0)")
+        if model is None:
+            from ..models.transformer import DecodeModel
+
+            model = DecodeModel()
+        self.model = model
+        self.config = config or DecodeConfig()
+        self.metrics = ServingMetrics()
+        from ..fluid import core as _core
+        from ..fluid.executor import Executor, Scope
+
+        self._scope = Scope()
+        self._exe = Executor(place if place is not None
+                             else _core.CPUPlace())
+        self._exe.run(model.startup, scope=self._scope)
+        self._cond = threading.Condition(threading.Lock())
+        self._queue: collections.deque = collections.deque()
+        self._slots: List[Optional[_Request]] = [None] * model.max_slots
+        self._n_active = 0
+        self._ticks = 0
+        self._draining = False
+        self._stopped = False
+        # serializes every dispatch: the worker holds it per iteration,
+        # warmup()/decode_static() grab it between iterations
+        self._dispatch_lock = threading.Lock()
+        self.metrics.note_slots(0, model.max_slots)
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-worker")
+        self._worker.start()
+        # piggyback on the process observe endpoint when one is up, like
+        # the batch engine's port-less mode
+        from .. import observe
+
+        srv = observe.http_server()
+        if srv is not None:
+            srv.add_provider(self.metrics.export_snapshot)
+            srv.add_health(self._health)
+
+    def _health(self) -> dict:
+        with self._cond:
+            return {"ok": not self._stopped and not self._draining,
+                    "queue_depth": len(self._queue),
+                    "slots_active": self._n_active,
+                    "slots_free": self.model.max_slots - self._n_active}
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one generation request; returns a Future of the
+        generated token ids (list of int, excluding the prompt)."""
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(not 0 <= t < self.model.vocab_size for t in prompt):
+            raise ValueError(f"prompt token out of vocab range "
+                             f"[0, {self.model.vocab_size})")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.model.bucket_for(len(prompt)) is None:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"prefill bucket ({self.model.prefill_buckets[-1]})")
+        if len(prompt) + max_new > self.model.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceed the KV-cache capacity "
+                f"(max_len {self.model.max_len})")
+        if timeout_ms is None:
+            timeout_ms = self.config.default_timeout_ms
+        now = time.perf_counter()
+        fut: Future = Future()
+        req = _Request(None, 1, None, fut, now + timeout_ms / 1000.0
+                       if timeout_ms else None, now)
+        req.prompt, req.max_new, req.out_tokens = prompt, max_new, []
+        with self._cond:
+            if self._stopped or self._draining:
+                raise EngineClosed("decode engine is draining/stopped")
+            if len(self._queue) >= self.config.max_queue_depth:
+                self.metrics.inc("shed")
+                from .. import observe
+
+                observe.emit("serving.shed", kind="decode",
+                             queue_depth=self.config.max_queue_depth)
+                raise EngineOverloaded(
+                    f"decode queue full ({self.config.max_queue_depth} "
+                    f"pending); request shed")
+            from ..observe import trace as _trace
+
+            req.span = _trace.start_span("serving.request", kind="decode",
+                                         prompt_len=len(prompt),
+                                         max_new=max_new)
+            self._queue.append(req)
+            self.metrics.inc("submitted")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify()
+        return fut
+
+    def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
+                 timeout_ms: Optional[float] = None) -> List[int]:
+        """Blocking submit."""
+        return self.submit(prompt_ids, max_new_tokens,
+                           timeout_ms=timeout_ms).result()
+
+    # ------------------------------------------------------------------
+    # the worker loop: admit -> step -> retire
+    # ------------------------------------------------------------------
+
+    def _loop(self):
+        from ..fluid import fault as _fault
+
+        while True:
+            with self._cond:
+                while not self._queue and not self._n_active \
+                        and not self._stopped:
+                    self._cond.wait(self.config.idle_wait_s)
+                if self._stopped:
+                    break
+            with self._dispatch_lock:
+                # robustness-harness hook: per-tick injected stall (the
+                # deterministic inter-token-latency breach oracle)
+                _fault.decode_stall()
+                self._admit()
+                if self._n_active:
+                    self._tick()
+            with self._cond:
+                self._cond.notify_all()  # drain() watches progress
+        self._fail_leftovers()
+
+    def _fail_leftovers(self):
+        """Worker exit with work still resident (drain timeout path):
+        nothing will ever resolve these futures — fail them loudly."""
+        leftovers = [r for r in self._slots if r is not None]
+        self._slots = [None] * self.model.max_slots
+        self._n_active = 0
+        with self._cond:
+            leftovers += list(self._queue)
+            self._queue.clear()
+        for r in leftovers:
+            self.metrics.inc("failed")
+            if r.span is not None:
+                r.span.end(status="engine_stopped")
+            if not r.future.done():
+                r.future.set_exception(
+                    EngineClosed("decode engine stopped"))
+
+    def _admit(self):
+        """Fill free slots from the queue: one bucketed prefill dispatch
+        per admitted request writes its K/V prefix in place."""
+        while True:
+            free = next((i for i, r in enumerate(self._slots)
+                         if r is None), None)
+            if free is None:
+                return
+            req = None
+            with self._cond:
+                while self._queue:
+                    cand = self._queue.popleft()
+                    now = time.perf_counter()
+                    if cand.deadline is not None and now > cand.deadline:
+                        self.metrics.inc("expired")
+                        if cand.span is not None:
+                            cand.span.end(status="expired")
+                        cand.future.set_exception(RequestTimeout(
+                            f"deadline expired after "
+                            f"{(now - cand.t_submit) * 1e3:.1f} ms in "
+                            f"queue"))
+                        continue
+                    req = cand
+                    break
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+            if req is None:
+                return
+            self._prefill(req, free)
+
+    def _prefill(self, req: _Request, slot: int):
+        from ..observe import trace as _trace
+
+        model = self.model
+        plen = len(req.prompt)
+        bucket = model.bucket_for(plen)
+        tokens = np.zeros((1, bucket), np.int64)
+        tokens[0, :plen] = req.prompt
+        t0 = time.perf_counter()
+        self._run(model.prefill_program(bucket),
+                  {model.PF_TOKENS: tokens,
+                   model.PF_SLOT: np.asarray([slot], np.int64)}, [])
+        t1 = time.perf_counter()
+        req.t_taken = t0
+        req.slot = slot
+        # the first decode tick re-derives position plen-1 (same token,
+        # same weights => bit-identical K/V) and emits the first token
+        req.pos = plen - 1
+        self._slots[slot] = req
+        self._n_active += 1
+        self.metrics.inc("prefills")
+        self.metrics.note_slots(self._n_active,
+                                model.max_slots - self._n_active)
+        if req.span is not None:
+            _trace.emit_span("serving.queue", req.t_submit, t0,
+                             parent=req.span)
+            _trace.emit_span("serving.prefill", t0, t1, parent=req.span,
+                             bucket=bucket, slot=slot, prompt_len=plen)
+
+    def _tick_feeds(self, slots):
+        """Fixed-shape decode-step feeds off the current slot table."""
+        model = self.model
+        s = model.max_slots
+        tokens = np.zeros((s, 1), np.int64)
+        pos = np.zeros((s,), np.int64)
+        active = np.zeros((s,), np.float32)
+        for i, r in enumerate(slots):
+            if r is None:
+                continue
+            active[i] = 1.0
+            tokens[i, 0] = (r.out_tokens[-1] if r.out_tokens
+                            else r.prompt[-1])
+            pos[i] = r.pos
+        return {model.DC_TOKENS: tokens, model.DC_POS: pos,
+                model.DC_ACTIVE: active,
+                model.DC_POSENC:
+                    model.posenc_rows(pos).astype(np.float32),
+                model.DC_BIAS: model.validity_bias(pos)}
+
+    def _step_dispatch(self, slots):
+        """ONE compiled decode step over all slots; returns the [S] next
+        tokens (host ints)."""
+        (nxt,) = self._run(self.model.step_program,
+                           self._tick_feeds(slots),
+                           [self.model.step_fetch])
+        self._ticks += 1
+        self.metrics.inc("decode_ticks")
+        return np.asarray(nxt).reshape(-1)
+
+    def _tick(self):
+        from ..observe import trace as _trace
+
+        model = self.model
+        t0 = time.perf_counter()
+        nxt = self._step_dispatch(self._slots)
+        t1 = time.perf_counter()
+        for i, req in enumerate(list(self._slots)):
+            if req is None:
+                continue
+            tok = int(nxt[i])
+            req.out_tokens.append(tok)
+            req.pos += 1
+            self.metrics.inc("tokens_generated")
+            if len(req.out_tokens) == 1:
+                self.metrics.observe_ttft(t1 - req.t_submit)
+            else:
+                self.metrics.observe_intertoken(t1 - req.t_prev_token)
+            req.t_prev_token = t1
+            if req.span is not None:
+                _trace.emit_span("serving.decode_step", t0, t1,
+                                 parent=req.span, slot=i,
+                                 token_index=len(req.out_tokens) - 1,
+                                 tick=self._ticks)
+            done = (tok == model.end_id
+                    or len(req.out_tokens) >= req.max_new
+                    or req.pos >= model.max_len)
+            if done:
+                self._retire(i)
+            elif req.deadline is not None and t1 > req.deadline:
+                # per-token deadline: expire MID-GENERATION and free the
+                # slot for the queue instead of decoding a dead request
+                self._retire(i, error=RequestTimeout(
+                    f"deadline expired after {len(req.out_tokens)} "
+                    f"generated tokens"))
+
+    def _retire(self, slot: int, error: Optional[Exception] = None):
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._n_active -= 1
+        self.metrics.note_slots(self._n_active,
+                                self.model.max_slots - self._n_active)
+        if error is not None:
+            self.metrics.inc("expired" if isinstance(error, RequestTimeout)
+                             else "failed")
+            if req.span is not None:
+                req.span.end(status="expired"
+                             if isinstance(error, RequestTimeout)
+                             else "error")
+            req.future.set_exception(error)
+            return
+        now = time.perf_counter()
+        self.metrics.inc("completed")
+        self.metrics.observe_latency(now - req.t_submit)
+        if req.span is not None:
+            req.span.end(status="ok", slot=slot,
+                         tokens=len(req.out_tokens))
+        req.future.set_result(list(req.out_tokens))
+
+    # ------------------------------------------------------------------
+    # dispatch plumbing + warmup
+    # ------------------------------------------------------------------
+
+    def _run(self, program, feed, fetch_list):
+        """Executor dispatch with compile-counter accounting: any jit-
+        cache growth under traffic shows up on ``bucket_compiles`` — the
+        fixed-executable-set invariant's counter (must stay flat after
+        warmup)."""
+        before = len(self._exe._cache)
+        outs = self._exe.run(program, feed=feed, fetch_list=fetch_list,
+                             scope=self._scope)
+        grown = len(self._exe._cache) - before
+        if grown > 0:
+            self.metrics.inc("bucket_compiles", grown)
+        return outs
+
+    def executables(self) -> int:
+        """Compiled executables resident in the engine's jit cache (the
+        fixed set: one decode step + one per warmed prefill bucket)."""
+        return len(self._exe._cache)
+
+    def warmup(self) -> int:
+        """Precompile the ENTIRE fixed executable set — the one decode
+        step plus every prefill bucket — before traffic, so steady state
+        never compiles (any later ``bucket_compiles`` growth is a bug:
+        an unplanned shape reached the executor).  Safe to call again;
+        returns the executable count."""
+        model = self.model
+        with self._dispatch_lock:
+            for b in model.prefill_buckets:
+                self._run(model.prefill_program(b),
+                          {model.PF_TOKENS: np.zeros((1, b), np.int64),
+                           model.PF_SLOT: np.zeros((1,), np.int64)}, [])
+                self.metrics.inc("warmup_dispatches")
+            self._step_dispatch([None] * model.max_slots)
+            self.metrics.inc("warmup_dispatches")
+        from .. import observe
+
+        observe.emit("serving.warmup", kind="decode",
+                     prefill_buckets=model.prefill_buckets,
+                     max_slots=model.max_slots, max_len=model.max_len,
+                     executables=self.executables())
+        return self.executables()
+
+    # ------------------------------------------------------------------
+    # static-batching baseline (the convoy oracle's comparator)
+    # ------------------------------------------------------------------
+
+    def decode_static(self, batch: Sequence[Tuple[Sequence[int], int]]
+                      ) -> List[Tuple[List[int], float]]:
+        """Request-granularity batching over the SAME model/executables:
+        admit the whole batch, tick until EVERY member finishes, and
+        resolve all of them at batch end — exactly the convoy the
+        iteration-level scheduler removes.  A one-request batch is the
+        per-request sequential baseline (the bitwise-identity oracle).
+        Returns ``[(tokens, latency_s), ...]``; only callable while the
+        engine is otherwise idle (test/bench comparator, not a serving
+        path)."""
+        if len(batch) > self.model.max_slots:
+            raise ValueError(f"static batch ({len(batch)}) exceeds "
+                             f"max_slots ({self.model.max_slots})")
+        with self._dispatch_lock:
+            if self._n_active or self._queue:
+                raise RuntimeError("decode_static requires an idle engine")
+            slots: List[Optional[_Request]] = [None] * self.model.max_slots
+            t_start = []
+            for i, (prompt, max_new) in enumerate(batch):
+                fut: Future = Future()
+                t0 = time.perf_counter()
+                req = _Request(None, 1, None, fut, None, t0)
+                req.prompt = [int(t) for t in prompt]
+                req.max_new = int(max_new)
+                req.out_tokens = []
+                plen = len(req.prompt)
+                bucket = self.model.bucket_for(plen)
+                tokens = np.zeros((1, bucket), np.int64)
+                tokens[0, :plen] = req.prompt
+                self._run(self.model.prefill_program(bucket),
+                          {self.model.PF_TOKENS: tokens,
+                           self.model.PF_SLOT:
+                               np.asarray([i], np.int64)}, [])
+                req.pos = plen - 1
+                slots[i] = req
+                t_start.append(t0)
+            finished = [False] * len(batch)
+            while not all(finished):
+                live = [r if r is not None and not finished[j] else None
+                        for j, r in enumerate(slots[:len(batch)])]
+                live += [None] * (self.model.max_slots - len(live))
+                nxt = self._step_dispatch(live)
+                for j, req in enumerate(slots[:len(batch)]):
+                    if finished[j]:
+                        continue
+                    tok = int(nxt[j])
+                    req.out_tokens.append(tok)
+                    req.pos += 1
+                    finished[j] = (tok == self.model.end_id
+                                   or len(req.out_tokens) >= req.max_new
+                                   or req.pos >= self.model.max_len)
+            t_end = time.perf_counter()
+            return [(list(slots[j].out_tokens), t_end - t_start[j])
+                    for j in range(len(batch))]
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Stop admitting; wait until every queued and resident request
+        has resolved.  Returns True when fully drained."""
+        deadline = time.perf_counter() + timeout_s
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._queue or self._n_active:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def shutdown(self, timeout_s: float = 60.0) -> bool:
+        ok = self.drain(timeout_s=timeout_s)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout_s)
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def create_decode_engine(cfg=None, config: Optional[DecodeConfig] = None,
+                         **model_kwargs) -> DecodeEngine:
+    """Build a DecodeEngine over a fresh step-form decode model.  ``cfg``
+    is a transformer Config (default: CPU-test-scale decode LM);
+    ``model_kwargs`` forward to DecodeModel (max_slots / max_len /
+    prefill_buckets default from the env contract)."""
+    from ..models.transformer import DecodeModel
+
+    return DecodeEngine(DecodeModel(cfg=cfg, **model_kwargs), config)

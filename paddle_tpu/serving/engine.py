@@ -130,8 +130,20 @@ class ServingConfig:
 
 
 class _Request:
+    """One in-flight request, shared by both engine kinds.
+
+    The batch engine uses the feed/rows/sig batching fields; the decode
+    engine (``serving.decode.DecodeEngine``) grows the per-token state:
+    a KV-cache ``slot``, the prompt and generated ids, the write
+    ``pos``ition, and the per-token timing needed for TTFT/inter-token
+    latency and the per-token deadline check (a deadline can now expire
+    MID-GENERATION, not just in the queue)."""
+
     __slots__ = ("feed", "rows", "sig", "future", "deadline", "t_submit",
-                 "t_taken", "span")
+                 "t_taken", "span",
+                 # per-token decode state (ISSUE 15)
+                 "prompt", "max_new", "slot", "pos", "out_tokens",
+                 "t_prev_token")
 
     def __init__(self, feed, rows, sig, future, deadline, t_submit):
         self.feed = feed          # name -> ndarray, leading dim == rows
@@ -142,6 +154,12 @@ class _Request:
         self.t_submit = t_submit
         self.t_taken = None       # when the batcher popped it (perf time)
         self.span = None          # observe.trace request span (or None)
+        self.prompt = None        # list[int] prompt token ids (decode)
+        self.max_new = 0          # generation budget (decode)
+        self.slot = None          # KV-cache slot while resident (decode)
+        self.pos = 0              # next cache write position (decode)
+        self.out_tokens = None    # generated ids, grown per tick (decode)
+        self.t_prev_token = None  # previous token's perf time (decode)
 
 
 class ServingEngine:

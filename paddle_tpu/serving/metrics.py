@@ -42,7 +42,11 @@ class ServingMetrics:
     #: counters every snapshot reports even when still zero
     COUNTERS = ("submitted", "completed", "failed", "shed", "expired",
                 "dispatches", "bucket_compiles", "warmup_dispatches",
-                "warmup_cached", "rows_real", "rows_padded")
+                "warmup_cached", "rows_real", "rows_padded",
+                # continuous-batching decode (ISSUE 15): iteration-level
+                # scheduling counters, zero-reported on batch engines too
+                # so snapshot consumers never branch on engine kind
+                "prefills", "decode_ticks", "tokens_generated")
 
     def __init__(self, latency_window: int = 4096,
                  registry: Optional[MetricsRegistry] = None):
@@ -51,11 +55,20 @@ class ServingMetrics:
         for k in self.COUNTERS:
             self._reg.inc(k, 0)
         self._reg.set_gauge("queue_depth", 0)
-        # latency ring buffer, seconds; percentile accuracy degrades
-        # gracefully under sustained load instead of growing unboundedly
+        # latency ring buffers, seconds; percentile accuracy degrades
+        # gracefully under sustained load instead of growing unboundedly.
+        # Decode engines additionally track time-to-first-token and
+        # inter-token gaps — the two latencies request-level percentiles
+        # cannot decompose (a long generation with healthy per-token
+        # pacing vs a short one stuck behind a convoy look identical in
+        # completion latency).
         self._window = int(latency_window)
         self._lat = [0.0] * self._window
         self._lat_n = 0  # total observations ever (ring index = n % window)
+        self._ttft = [0.0] * self._window
+        self._ttft_n = 0
+        self._itl = [0.0] * self._window
+        self._itl_n = 0
         self._t0 = time.perf_counter()
         self._last_interval: Optional[dict] = None
 
@@ -100,6 +113,42 @@ class ServingMetrics:
 
         _prof.record_event("serving_request", seconds)
 
+    def observe_ttft(self, seconds: float) -> None:
+        """Time-to-first-token of one decode request (submit -> first
+        generated token): prefill queueing + prefill dispatch + the first
+        decode tick.  Feeds the SLO watchdog as ``serving.ttft_s``."""
+        with self._lock:
+            self._ttft[self._ttft_n % self._window] = float(seconds)
+            self._ttft_n += 1
+        _global_registry().observe("serving.ttft_s", seconds)
+        from ..observe import watchdog as _watchdog
+
+        _watchdog.observe_value("serving.ttft_s", seconds)
+        from ..fluid import profiler as _prof
+
+        _prof.record_event("serving_ttft", seconds)
+
+    def observe_intertoken(self, seconds: float) -> None:
+        """Gap between two consecutive generated tokens of one stream —
+        the per-tick pacing metric iteration-level scheduling exists to
+        protect.  Feeds the SLO watchdog as ``serving.intertoken_s`` (the
+        PADDLE_FAULT_DECODE_STALL_MS breach oracle)."""
+        with self._lock:
+            self._itl[self._itl_n % self._window] = float(seconds)
+            self._itl_n += 1
+        _global_registry().observe("serving.intertoken_s", seconds)
+        from ..observe import watchdog as _watchdog
+
+        _watchdog.observe_value("serving.intertoken_s", seconds)
+
+    def note_slots(self, active: int, free: int) -> None:
+        """Decode slot occupancy: mirrored into BOTH registries (so the
+        process ``/metrics`` endpoint and the fleet aggregator see
+        ``serving.slots_active`` / ``serving.slots_free`` without extra
+        wiring — the ISSUE 15 observability satellite)."""
+        self.set_gauge("slots_active", int(active))
+        self.set_gauge("slots_free", int(free))
+
     def observe_batch(self, real_rows: int, bucket_rows: int,
                       seconds: Optional[float] = None) -> None:
         """One executor dispatch: ``real_rows`` request rows padded into a
@@ -116,14 +165,14 @@ class ServingMetrics:
     def counter(self, name: str) -> int:
         return self._reg.flat().get(name, 0)
 
-    def _percentiles(self, lat, qs):
+    def _percentiles(self, lat, qs, prefix=""):
         if not lat:
-            return {f"p{int(q * 100)}_ms": None for q in qs}
+            return {f"{prefix}p{int(q * 100)}_ms": None for q in qs}
         s = sorted(lat)
         out = {}
         for q in qs:
             idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-            out[f"p{int(q * 100)}_ms"] = round(s[idx] * 1e3, 3)
+            out[f"{prefix}p{int(q * 100)}_ms"] = round(s[idx] * 1e3, 3)
         return out
 
     def snapshot(self) -> dict:
@@ -132,13 +181,22 @@ class ServingMetrics:
             flat = self._reg.flat()
             n = min(self._lat_n, self._window)
             lat = list(self._lat[:n])
+            n_ttft = min(self._ttft_n, self._window)
+            ttft = list(self._ttft[:n_ttft])
+            n_itl = min(self._itl_n, self._window)
+            itl = list(self._itl[:n_itl])
             elapsed = time.perf_counter() - self._t0
         snap = dict(flat)
         snap["elapsed_s"] = round(elapsed, 3)
         snap["qps"] = round(flat.get("completed", 0) / elapsed, 3) \
             if elapsed > 0 else 0.0
         snap.update(self._percentiles(lat, (0.50, 0.95, 0.99)))
+        snap.update(self._percentiles(ttft, (0.50, 0.99), prefix="ttft_"))
+        snap.update(self._percentiles(itl, (0.50, 0.99),
+                                      prefix="intertoken_"))
         snap["latency_samples"] = n
+        snap["ttft_samples"] = n_ttft
+        snap["intertoken_samples"] = n_itl
         rows_real = flat.get("rows_real", 0)
         rows_padded = flat.get("rows_padded", 0)
         snap["mean_batch_occupancy"] = (
@@ -159,6 +217,7 @@ class ServingMetrics:
         for src, dst in (("qps", "serving.interval_qps"),
                          ("dispatch_rate", "serving.interval_dispatch_rate"),
                          ("interval_s", "serving.interval_s"),
+                         ("tokens_per_s", "serving.interval_tokens_per_s"),
                          ("mean_batch_occupancy",
                           "serving.interval_batch_occupancy")):
             v = rates.get(src)
@@ -176,17 +235,24 @@ class ServingMetrics:
         padded rows) is well-defined zeros across the board — never
         None/NaN/ZeroDivision — so the ``/metrics`` endpoint and the
         bench tool can emit every field unconditionally (ISSUE 9
-        satellite)."""
+        satellite; ISSUE 15 extends the same contract to the decode
+        series: ``tokens_per_s`` / ``tick_rate`` are finite zeros on an
+        idle decode engine)."""
         dt = max(0.0, cur.get("elapsed_s", 0) - prev.get("elapsed_s", 0))
         delta: Dict[str, float] = {
             k: cur.get(k, 0) - prev.get(k, 0)
             for k in ("completed", "submitted", "failed", "shed", "expired",
-                      "dispatches", "rows_real", "rows_padded")}
+                      "dispatches", "rows_real", "rows_padded",
+                      "prefills", "decode_ticks", "tokens_generated")}
         out = {"interval_s": round(dt, 3)}
         out.update({k: v for k, v in delta.items()})
         out["qps"] = round(delta["completed"] / dt, 3) if dt > 0 else 0.0
         out["dispatch_rate"] = (round(delta["dispatches"] / dt, 3)
                                 if dt > 0 else 0.0)
+        out["tokens_per_s"] = (round(delta["tokens_generated"] / dt, 3)
+                               if dt > 0 else 0.0)
+        out["tick_rate"] = (round(delta["decode_ticks"] / dt, 3)
+                            if dt > 0 else 0.0)
         out["mean_batch_occupancy"] = (
             round(delta["rows_real"] / delta["rows_padded"], 4)
             if delta["rows_padded"] else 0.0)

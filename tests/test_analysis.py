@@ -118,6 +118,36 @@ def _case_beam_search_decode():
             "run", None)
 
 
+def _case_decode_step():
+    """The continuous-batching decode-step program (ISSUE 15): KV-cache
+    update + token-select op surface must verify CLEAN in strict mode so
+    the serving engine's per-tick dispatch never trips the verifier."""
+    from paddle_tpu.models import transformer
+
+    m = transformer.DecodeModel(cfg=transformer.decode_lm_config(),
+                                max_slots=4, max_len=32,
+                                prefill_buckets=[4])
+    s, l, d = m.max_slots, m.max_len, m.cfg.d_model
+    feed = {m.DC_TOKENS: np.zeros((s, 1), np.int64),
+            m.DC_POSENC: np.zeros((s, d), np.float32),
+            m.DC_BIAS: np.zeros((s, 1, l), np.float32),
+            m.DC_POS: np.zeros((s,), np.int64),
+            m.DC_ACTIVE: np.zeros((s,), np.float32)}
+    return (m.step_program, feed, [m.step_fetch], "run", None)
+
+
+def _case_decode_prefill():
+    """The bucketed prefill program writing a K/V prefix in place."""
+    from paddle_tpu.models import transformer
+
+    m = transformer.DecodeModel(cfg=transformer.decode_lm_config(),
+                                max_slots=4, max_len=32,
+                                prefill_buckets=[8])
+    feed = {m.PF_TOKENS: np.zeros((1, 8), np.int64),
+            m.PF_SLOT: np.zeros((1,), np.int64)}
+    return (m.prefill_program(8), feed, [], "run", None)
+
+
 def _case_guarded_amp_training():
     amp.enable("float16")
     guardian.enable("skip")
@@ -143,6 +173,8 @@ _CASES = {
     "benchmark_resnet": _case_benchmark_resnet,
     "benchmark_transformer_dp_tp": _case_benchmark_transformer_dp_tp,
     "beam_search_decode": _case_beam_search_decode,
+    "decode_step": _case_decode_step,
+    "decode_prefill": _case_decode_prefill,
     "guarded_amp_training": _case_guarded_amp_training,
     "inference_clone": _case_inference_clone,
 }
@@ -203,6 +235,54 @@ def test_seeded_dtype_mismatch_an102():
         fluid.default_main_program(),
         feed={"img": np.zeros((8, 16), np.float32),
               "label": np.zeros((8, 1), np.float32)}, fetch_list=[loss])
+    assert "AN102" in _codes(r, "error"), r.format()
+
+
+def test_seeded_kv_cache_window_overflow_an101():
+    """kv_cache_update window longer than the cache's max_len is a named
+    AN101, not a runtime clamp surprise (ISSUE 15 infer-rule satellite)."""
+    import paddle_tpu.fluid.layers as layers
+
+    cache = fluid.default_main_program().global_block().create_parameter(
+        name="kv_cache", shape=(4, 8, 16), dtype="float32")
+    new = layers.data("new_kv", shape=[1, 12, 16], dtype="float32",
+                      append_batch_size=False)
+    slots = layers.data("slots", shape=[1], dtype="int64",
+                        append_batch_size=False)
+    pos = layers.data("pos", shape=[1], dtype="int64",
+                      append_batch_size=False)
+    out = layers.kv_cache_update(cache, new, slots, pos)
+    r = analysis.verify_program(
+        fluid.default_main_program(),
+        feed={"new_kv": np.zeros((1, 12, 16), np.float32),
+              "slots": np.zeros((1,), np.int64),
+              "pos": np.zeros((1,), np.int64)},
+        fetch_list=[out])
+    errs = [d for d in r.errors if d.code == "AN101"]
+    assert errs, r.format()
+    assert "max_len" in errs[0].message
+
+
+def test_seeded_token_select_float_mask_positions_an102():
+    """A float Pos vector into kv_cache_update would silently truncate at
+    runtime — only the static dtype rule can see it (AN102)."""
+    import paddle_tpu.fluid.layers as layers
+
+    cache = fluid.default_main_program().global_block().create_parameter(
+        name="kv_cache", shape=(4, 8, 16), dtype="float32")
+    new = layers.data("new_kv", shape=[1, 2, 16], dtype="float32",
+                      append_batch_size=False)
+    slots = layers.data("slots", shape=[1], dtype="int64",
+                        append_batch_size=False)
+    pos = layers.data("pos", shape=[1], dtype="float32",
+                      append_batch_size=False)
+    out = layers.kv_cache_update(cache, new, slots, pos)
+    r = analysis.verify_program(
+        fluid.default_main_program(),
+        feed={"new_kv": np.zeros((1, 2, 16), np.float32),
+              "slots": np.zeros((1,), np.int64),
+              "pos": np.zeros((1,), np.float32)},
+        fetch_list=[out])
     assert "AN102" in _codes(r, "error"), r.format()
 
 

@@ -1,0 +1,293 @@
+"""Continuous batching for autoregressive decode (ISSUE 15).
+
+Oracles:
+ - CONVOY: under a mixed short/long workload, short-request p50
+   completion latency with iteration-level scheduling is strictly below
+   the request-granularity static-batching path on the same model, and
+   generated tokens are BITWISE identical to per-request sequential
+   decode (scheduling is the only thing that changed);
+ - FIXED EXECUTABLES: a steady-state run of >= 200 decode ticks with
+   rolling admissions shows zero new compiles, and the span tree shows
+   a long request's ``serving.decode_step`` children interleaved with
+   other requests' steps (iteration-level preemption is visible);
+ - metrics: TTFT / inter-token series, slot gauges mirrored into the
+   process registry and the Prometheus endpoint, empty-window interval
+   zeros for the new series;
+ - fault/SLO: ``PADDLE_FAULT_DECODE_STALL_MS`` deterministically
+   breaches the ``serving.intertoken_s`` watchdog; per-token deadlines
+   expire mid-generation and free the slot;
+ - env contract: ``PADDLE_SERVE_*`` knobs drive the defaults,
+   ``PADDLE_SERVE_DECODE=0`` is a hard kill switch.
+
+One module-scoped engine serves most tests (construction + warmup is
+the expensive part; every assertion below is diff-based, so shared
+counters are fine).  Tests run in definition order under the tier-1
+`-p no:randomly` contract; the drain test is LAST because draining is
+terminal.
+"""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.fluid import fault as _fault
+from paddle_tpu.models import transformer
+from paddle_tpu.serving import (DecodeEngine, EngineClosed, RequestTimeout,
+                                ServingMetrics, create_decode_engine)
+
+
+def _model(slots=4, max_len=192, buckets=(4, 8)):
+    return transformer.DecodeModel(cfg=transformer.decode_lm_config(),
+                                   max_slots=slots, max_len=max_len,
+                                   prefill_buckets=list(buckets))
+
+
+def _prompts(n, rng_seed=0, length=3, vocab=64):
+    rng = np.random.RandomState(rng_seed)
+    return [[int(t) for t in rng.randint(2, vocab - 1, size=length)]
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def eng():
+    engine = DecodeEngine(_model())
+    engine.warmup()
+    yield engine
+    engine.shutdown()
+
+
+def test_convoy_oracle_latency_and_bitwise_identity(eng):
+    """Acceptance: mixed workload — shorts' p50 completion latency with
+    continuous batching strictly below static batching, tokens bitwise
+    identical to per-request sequential decode (greedy path)."""
+    prompts = _prompts(4, rng_seed=3)
+    jobs = [(prompts[0], 48)] + [(p, 6) for p in prompts[1:]]
+
+    # per-request sequential baseline: same model, same executables
+    sequential = [eng.decode_static([j])[0][0] for j in jobs]
+    # static-batching comparator: everyone resolves at batch end
+    static = eng.decode_static(jobs)
+    static_short_p50 = float(np.median([lat for _, lat in static[1:]]))
+    for (toks, _), ref in zip(static, sequential):
+        assert toks == ref  # static batching is also bit-faithful
+
+    done_at = {}
+
+    def stamp(i):
+        def cb(_f):
+            done_at[i] = time.perf_counter()
+        return cb
+
+    t_submit = {}
+    futs = []
+    for i, (p, n) in enumerate(jobs):
+        t_submit[i] = time.perf_counter()
+        f = eng.submit(p, n)
+        f.add_done_callback(stamp(i))
+        futs.append(f)
+    outs = [f.result(timeout=60) for f in futs]
+
+    # correctness: bitwise identical to sequential decode
+    assert outs == sequential
+    # convoy removed: shorts retire long before the long request...
+    assert all(done_at[i] < done_at[0] for i in range(1, 4))
+    # ...and strictly beat their static-batching latency at the p50
+    cont_short_p50 = float(np.median(
+        [done_at[i] - t_submit[i] for i in range(1, 4)]))
+    assert cont_short_p50 < static_short_p50, \
+        (cont_short_p50, static_short_p50)
+
+
+def test_fixed_executables_steady_state_and_span_interleaving(
+        eng, tmp_path):
+    """Acceptance: >= 200 ticks of rolling admissions after warmup with a
+    FLAT compile counter, and the long request's span tree shows >= 2
+    decode_step children with other requests' steps interleaved."""
+    observe.configure(str(tmp_path), flush_s=60.0)
+    snap0 = eng.metrics.snapshot()
+    x0 = eng.executables()
+    prompts = _prompts(110, rng_seed=5)
+    long_fut = eng.submit(prompts[0], 180)  # occupies a slot throughout
+    # rolling admissions: steady short pressure through the other slots
+    short_futs = [eng.submit(p, 6) for p in prompts[1:]]
+    long_fut.result(timeout=120)
+    for f in short_futs:
+        f.result(timeout=120)
+    snap = eng.metrics.snapshot()
+    assert snap["decode_ticks"] - snap0["decode_ticks"] >= 200
+    assert snap["bucket_compiles"] == snap0["bucket_compiles"]  # FLAT
+    assert eng.executables() == x0
+    assert snap["completed"] - snap0["completed"] == len(prompts)
+
+    observe.get_sink().flush()
+    from paddle_tpu.observe.fleet import fleet_events
+
+    recs = fleet_events(str(tmp_path))
+    reqs = [r for r in recs if r.get("event") == "serving.request"]
+    long_req = next(r for r in reqs if r.get("max_new") == 180)
+    steps = [r for r in recs if r.get("event") == "serving.decode_step"]
+    long_steps = sorted((r for r in steps
+                         if r.get("parent_span") == long_req["span_id"]),
+                        key=lambda r: r["ts"])
+    assert len(long_steps) >= 2
+    # iteration-level preemption: another request's decode_step lands
+    # BETWEEN two of the long request's steps
+    t_first, t_last = long_steps[0]["ts"], long_steps[-1]["ts"]
+    others = [r for r in steps
+              if r.get("parent_span") != long_req["span_id"]
+              and t_first < r["ts"] < t_last]
+    assert others, "no interleaved steps from other requests"
+    # prefill child present too (the span-tree satellite)
+    prefills = [r for r in recs if r.get("event") == "serving.prefill"
+                and r.get("parent_span") == long_req["span_id"]]
+    assert len(prefills) == 1
+
+
+def test_metrics_series_gauges_and_endpoint(eng, tmp_path):
+    """TTFT / inter-token percentiles populate; slots_active/slots_free
+    mirror into the process registry AND the Prometheus endpoint (the
+    endpoint equals the snapshot); empty-window interval() extends the
+    finite-zeros contract to the decode series."""
+    # empty-window contract first (fresh metrics, no traffic)
+    m = ServingMetrics()
+    s = m.snapshot()
+    win = ServingMetrics.window(s, s)
+    for key in ("tokens_per_s", "tick_rate", "prefills", "decode_ticks",
+                "tokens_generated", "qps"):
+        assert isinstance(win[key], (int, float)) \
+            and math.isfinite(win[key]) and win[key] == 0, (key, win[key])
+    json.dumps(win)
+
+    observe.configure(str(tmp_path), flush_s=60.0, port=0)
+    # conftest resets providers between tests: re-attach the shared
+    # engine's export to the fresh endpoint (what construction does when
+    # the endpoint predates the engine)
+    observe.http_server().add_provider(eng.metrics.export_snapshot)
+    flat0 = dict(observe.registry().flat())
+    snap0 = eng.metrics.snapshot()
+    for p in _prompts(3, rng_seed=9):
+        eng.generate(p, 5)
+    snap = eng.metrics.snapshot()
+    for key in ("ttft_p50_ms", "ttft_p99_ms", "intertoken_p50_ms",
+                "intertoken_p99_ms"):
+        assert snap[key] is not None and snap[key] >= 0, key
+    assert snap["slots_active"] == 0
+    assert snap["slots_free"] == 4
+    tokens = snap["tokens_generated"]
+    assert tokens - snap0["tokens_generated"] == 15
+    # process-registry mirror (what the fleet aggregator reads)
+    flat = observe.registry().flat()
+    assert flat.get("serving.slots_free") == 4
+    assert flat.get("serving.slots_active") == 0
+    assert flat.get("serving.tokens_generated", 0) \
+        - flat0.get("serving.tokens_generated", 0) == 15
+    # Prometheus endpoint == snapshot
+    import urllib.request
+
+    from paddle_tpu.observe.export import parse_prometheus_text
+
+    port = observe.http_server().port
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    parsed = parse_prometheus_text(text)
+    assert parsed["gauges"].get("serving_slots_free") == 4
+    assert parsed["gauges"].get("serving_slots_active") == 0
+    assert parsed["counters"].get("serving_tokens_generated") == tokens
+
+
+def test_decode_stall_fault_breaches_intertoken_slo(
+        eng, tmp_path, monkeypatch):
+    """PADDLE_FAULT_DECODE_STALL_MS inflates every tick; once the rolling
+    baseline exists, the SLO watchdog must breach serving.intertoken_s —
+    the deterministic oracle the ISSUE 15 fault satellite asks for."""
+    monkeypatch.setenv("PADDLE_SLO", "1")
+    monkeypatch.setenv("PADDLE_SLO_COOLDOWN_S", "0.0")
+    observe.configure(str(tmp_path), flush_s=60.0)
+    try:
+        # build the baseline: healthy ticks, > min_samples observations
+        eng.generate(_prompts(1, rng_seed=1)[0], 12)
+        _fault.install(_fault.FaultPlan(decode_stall_ms=120.0))
+        eng.generate(_prompts(1, rng_seed=2)[0], 4)
+    finally:
+        _fault.clear()
+    flat = observe.registry().flat()
+    breaches = {k: v for k, v in flat.items()
+                if k.startswith("slo.breaches")}
+    assert flat.get(
+        'slo.breaches{metric="serving.intertoken_s"}', 0) >= 1, breaches
+    observe.get_sink().flush()
+    from paddle_tpu.observe.fleet import fleet_events
+
+    ev = [r for r in fleet_events(str(tmp_path))
+          if r.get("event") == "slo.breach"
+          and r.get("metric") == "serving.intertoken_s"]
+    assert ev, "no slo.breach event for serving.intertoken_s"
+
+
+def test_per_token_deadline_expires_mid_generation(eng):
+    """A decode deadline is checked PER TOKEN: a slow generation expires
+    mid-flight with RequestTimeout, frees its slot, and the engine keeps
+    serving."""
+    expired0 = eng.metrics.snapshot()["expired"]
+    try:
+        _fault.install(_fault.FaultPlan(decode_stall_ms=40.0))
+        fut = eng.submit(_prompts(1)[0], 50, timeout_ms=150.0)
+        with pytest.raises(RequestTimeout):
+            fut.result(timeout=60)
+    finally:
+        _fault.clear()
+    snap = eng.metrics.snapshot()
+    assert snap["expired"] == expired0 + 1
+    # the slot is free again and traffic still flows
+    out = eng.generate(_prompts(1, rng_seed=4)[0], 4)
+    assert len(out) == 4
+    assert eng.metrics.snapshot()["slots_free"] == 4
+
+
+def test_submit_validation(eng):
+    with pytest.raises(ValueError):   # empty prompt
+        eng.submit([], 4)
+    with pytest.raises(ValueError):   # out-of-vocab token
+        eng.submit([99999], 4)
+    with pytest.raises(ValueError):   # prompt beyond largest bucket
+        eng.submit(list(range(2, 14)), 4)
+    with pytest.raises(ValueError):   # budget exceeds cache capacity
+        eng.submit([2, 3], 191)
+    with pytest.raises(ValueError):   # zero budget
+        eng.submit([2, 3], 0)
+
+
+def test_env_knobs_and_kill_switch(monkeypatch):
+    monkeypatch.setenv("PADDLE_SERVE_SLOTS", "3")
+    monkeypatch.setenv("PADDLE_SERVE_MAX_LEN", "48")
+    monkeypatch.setenv("PADDLE_SERVE_PREFILL_BUCKETS", "4,16")
+    m = transformer.DecodeModel(cfg=transformer.decode_lm_config())
+    assert m.max_slots == 3 and m.max_len == 48
+    assert m.prefill_buckets == [4, 16]
+    monkeypatch.setenv("PADDLE_SERVE_DECODE", "0")
+    with pytest.raises(EngineClosed):
+        DecodeEngine(m)
+    monkeypatch.delenv("PADDLE_SERVE_DECODE")
+
+
+def test_decode_smoke_tool():
+    """tools/decode_smoke.py is the tier-1 CI entry (< 10 s, JSON 'ok');
+    run its main() in-process so a regression fails here."""
+    import tools.decode_smoke as smoke
+
+    report = smoke.main()
+    assert report["ok"], report
+    assert report["compiles_after_warmup"] == 0
+    assert report["shorts_before_long"] and report["bitwise_sequential"]
+
+
+def test_drain_is_terminal(eng):
+    """LAST on purpose (draining is terminal for the shared engine):
+    drain() completes resident work, then new submits are refused."""
+    assert eng.drain(timeout_s=30)
+    with pytest.raises(EngineClosed):
+        eng.submit([2, 3], 4)

@@ -82,6 +82,11 @@ def _free_port():
     return port
 
 
+# multi-process CPU runs ride the gloo collectives now
+# (parallel.multihost selects them on the CPU backend); this end-to-end
+# spawn exceeds the tier-1 wall-clock budget, so it lives in the slow
+# tier with the serving soak
+@pytest.mark.slow
 def test_dist_4proc_conv_zero1():
     port = _free_port()
     env = dict(os.environ)

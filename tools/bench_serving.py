@@ -18,6 +18,12 @@ seconds, and emits ONE BENCH-style JSON line on stdout:
 Modes:
     --smoke     2-second CPU sanity pass for CI (exit 0 + valid JSON is
                 the contract; tests/tier-2 can parse the line)
+    --decode    continuous-batching decode workload (ISSUE 15): open-loop
+                generation requests with a mixed short/long token-budget
+                distribution through the DecodeEngine; the BENCH line
+                reports tokens/s, TTFT p50/p99, inter-token p99 and the
+                executable count (fixed-set invariant:
+                compiles_after_warmup must be 0)
     default     --duration/--qps as given; --device TPU serves from the
                 accelerator when one is attached
 """
@@ -153,6 +159,100 @@ def _sample_rows(eng):
     return list(eng._zero_rows().values())
 
 
+def run_decode_bench(args) -> dict:
+    """Open-loop mixed-length decode workload through the DecodeEngine.
+
+    Arrivals fire on the --qps schedule; each request draws a token
+    budget from a bimodal distribution (80% short --short-new, 20% long
+    --long-new) — the convoy-forming mix iteration-level scheduling
+    exists for.  Reported rates come from a warm->final
+    ``ServingMetrics.window`` so warmup dead time never dilutes them."""
+    import numpy as np
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import (DecodeConfig, DecodeEngine,
+                                    EngineOverloaded, ServingMetrics)
+
+    model = transformer.DecodeModel(
+        cfg=transformer.decode_lm_config(),
+        max_slots=args.slots, max_len=args.max_len,
+        prefill_buckets=[4, 8])
+    eng = DecodeEngine(model, DecodeConfig(max_queue_depth=args.queue_depth))
+    eng.warmup()
+    warm = eng.metrics.snapshot()
+
+    rng = np.random.RandomState(0)
+    pool = [[int(t) for t in rng.randint(2, model.vocab_size - 1, size=3)]
+            for _ in range(64)]
+    budgets = [args.long_new if rng.random_sample() < 0.2
+               else args.short_new for _ in range(256)]
+
+    results = {"ok": 0, "shed": 0, "err": 0}
+    rlock = threading.Lock()
+
+    def on_done(fut):
+        with rlock:
+            if fut.exception() is None:
+                results["ok"] += 1
+            else:
+                results["err"] += 1
+
+    period = 1.0 / args.qps
+    t_end = time.perf_counter() + args.duration
+    next_fire = time.perf_counter()
+    sent = 0
+    while True:
+        now = time.perf_counter()
+        if now >= t_end:
+            break
+        if now < next_fire:
+            time.sleep(min(next_fire - now, 0.002))
+            continue
+        next_fire += period
+        try:
+            eng.submit(pool[sent % len(pool)],
+                       budgets[sent % len(budgets)]) \
+                .add_done_callback(on_done)
+            sent += 1
+        except EngineOverloaded:
+            with rlock:
+                results["shed"] += 1
+    eng.drain(timeout_s=60.0)
+    snap = eng.metrics.snapshot()
+    executables = eng.executables()
+    eng.shutdown()
+
+    win = ServingMetrics.window(warm, snap)
+    return {
+        "metric": f"serving_decode_openloop_{args.device.lower()}",
+        "value": win["tokens_per_s"],
+        "unit": "tokens/s",
+        "offered_qps": args.qps,
+        "duration_s": args.duration,
+        "window_s": win["interval_s"],
+        "sent": sent,
+        "completed": results["ok"],
+        "shed": results["shed"] + win["shed"],
+        "errors": results["err"],
+        "qps": win["qps"],
+        "tick_rate": win["tick_rate"],
+        "ttft_p50_ms": snap["ttft_p50_ms"],
+        "ttft_p99_ms": snap["ttft_p99_ms"],
+        "intertoken_p99_ms": snap["intertoken_p99_ms"],
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "tokens_generated": snap["tokens_generated"],
+        "executables": executables,
+        "compiles_after_warmup":
+            snap["bucket_compiles"] - warm["bucket_compiles"],
+        "slots": args.slots,
+        "max_len": args.max_len,
+        "short_new": args.short_new,
+        "long_new": args.long_new,
+        "smoke": bool(args.smoke),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model-dir", default="",
@@ -167,15 +267,30 @@ def main(argv=None) -> int:
     p.add_argument("--max-batch-size", type=int, default=16)
     p.add_argument("--max-wait-ms", type=float, default=5.0)
     p.add_argument("--queue-depth", type=int, default=512)
+    p.add_argument("--decode", action="store_true",
+                   help="continuous-batching decode workload (DecodeEngine "
+                        "with a mixed short/long token-budget mix)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="decode slots (concurrent KV-cache streams)")
+    p.add_argument("--max-len", type=int, default=128,
+                   help="decode KV-cache capacity per slot")
+    p.add_argument("--short-new", type=int, default=8,
+                   help="short-request token budget (80%% of arrivals)")
+    p.add_argument("--long-new", type=int, default=64,
+                   help="long-request token budget (20%% of arrivals)")
     p.add_argument("--smoke", action="store_true",
                    help="2-second CPU sanity pass for CI")
     args = p.parse_args(argv)
     if args.smoke:
         args.duration = 2.0
-        args.qps = min(args.qps, 200.0)
+        args.qps = min(args.qps, 40.0 if args.decode else 200.0)
         args.device = "CPU"
+        if args.decode:
+            args.slots = min(args.slots, 4)
+            args.max_len = min(args.max_len, 64)
+            args.long_new = min(args.long_new, 32)
 
-    out = run_bench(args)
+    out = run_decode_bench(args) if args.decode else run_bench(args)
     print(json.dumps(out))
     # smoke contract: the pass fails loudly if nothing was actually served
     if args.smoke and (out["completed"] == 0 or out["p50_ms"] is None):

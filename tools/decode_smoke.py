@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Continuous-batching decode smoke (CPU, < 10 s) — the ISSUE 15 CI oracle.
+
+A mixed-length workload through the DecodeEngine: one LONG generation
+(32 tokens) submitted FIRST, then three short ones (6 tokens each).
+Under request-granularity batching the shorts would convoy behind the
+long request; iteration-level scheduling must retire them early:
+
+ - every short request completes strictly BEFORE the long one;
+ - the compile counter stays FLAT across all traffic after warmup()
+   (the fixed-executable-set invariant: one decode step + the prefill
+   buckets, nothing else);
+ - generated tokens are bitwise identical to per-request sequential
+   decode of the same prompts (``decode_static`` one at a time);
+ - TTFT and inter-token latency series are populated.
+
+Run directly (``python tools/decode_smoke.py``) or from tier-1 via
+``tests/test_decode_engine.py::test_decode_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LONG_NEW = 32
+SHORT_NEW = 6
+N_SHORT = 3
+
+
+def main() -> dict:
+    import numpy as np
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import DecodeEngine
+
+    t_start = time.perf_counter()
+    report = {"ok": False}
+    eng = None
+    try:
+        model = transformer.DecodeModel(cfg=transformer.decode_lm_config(),
+                                        max_slots=4, max_len=64,
+                                        prefill_buckets=[4, 8])
+        eng = DecodeEngine(model)
+        report["executables_after_warmup"] = eng.warmup()
+        compiles0 = eng.metrics.snapshot()["bucket_compiles"]
+
+        rng = np.random.RandomState(7)
+        prompts = [[int(t) for t in rng.randint(2, model.vocab_size - 1,
+                                                size=3)]
+                   for _ in range(1 + N_SHORT)]
+        jobs = [(prompts[0], LONG_NEW)] + \
+               [(p, SHORT_NEW) for p in prompts[1:]]
+
+        # sequential per-request baseline (same executables)
+        sequential = [eng.decode_static([j])[0][0] for j in jobs]
+
+        done_at = {}
+
+        def stamp(i):
+            def cb(_fut):
+                done_at[i] = time.perf_counter()
+            return cb
+
+        futs = []
+        for i, (p, n) in enumerate(jobs):
+            f = eng.submit(p, n)
+            f.add_done_callback(stamp(i))
+            futs.append(f)
+        outs = [f.result(timeout=60) for f in futs]
+
+        report["long_tokens"] = len(outs[0])
+        report["short_tokens"] = [len(o) for o in outs[1:]]
+        report["shorts_before_long"] = all(
+            done_at[i] < done_at[0] for i in range(1, len(jobs)))
+        report["bitwise_sequential"] = outs == sequential
+        snap = eng.metrics.snapshot()
+        report["compiles_after_warmup"] = \
+            snap["bucket_compiles"] - compiles0
+        report["decode_ticks"] = snap["decode_ticks"]
+        report["ttft_p50_ms"] = snap["ttft_p50_ms"]
+        report["intertoken_p50_ms"] = snap["intertoken_p50_ms"]
+        report["slots_free"] = snap.get("slots_free")
+        report["elapsed_s"] = round(time.perf_counter() - t_start, 2)
+        report["ok"] = bool(
+            report["shorts_before_long"]
+            and report["bitwise_sequential"]
+            and report["compiles_after_warmup"] == 0
+            and snap["completed"] == len(jobs)
+            and report["ttft_p50_ms"] is not None
+            and report["intertoken_p50_ms"] is not None)
+    except Exception as exc:  # a broken smoke must still print its JSON
+        import traceback
+
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["trace"] = traceback.format_exc(limit=5)
+    finally:
+        if eng is not None:
+            try:
+                eng.shutdown(timeout_s=10)
+            except Exception:
+                pass
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
