@@ -253,6 +253,20 @@ declare("PADDLE_SERVE_PREFILL_BUCKETS", "str", "4,8,16", "serving",
         "Comma-separated prompt-length buckets each compiled once; a "
         "prompt pads up to its enclosing bucket (executable set = these "
         "buckets + the one decode step)")
+declare("PADDLE_SERVE_SWAP_POLICY", "enum", "drain", "serving",
+        "Hot checkpoint swap in-flight policy: drain = resident slots "
+        "finish on the old serial (admissions pause, nothing sheds), "
+        "immediate = slots continue on the new weights over their old "
+        "KV caches", choices=("drain", "immediate"))
+declare("PADDLE_SERVE_CANARY_REQUESTS", "int", 0, "serving",
+        "Canary probation: completed requests the new serial must serve "
+        "under the SLO watchdog + output-sanity sentinel before "
+        "promotion (0 = promote immediately, no canary)")
+declare("PADDLE_SERVE_SWAP_POLL_S", "float", 2.0, "serving",
+        "Model-registry checkpoint-dir watcher poll interval (seconds)")
+declare("PADDLE_SERVE_SENTINEL_ENTROPY", "float", 0.05, "serving",
+        "Canary sentinel floor (nats): argmax-entropy collapse below "
+        "this across 3 consecutive decode ticks triggers auto-rollback")
 
 # -- fault injection (PADDLE_FAULT_* family; deterministic test faults) --
 declare("PADDLE_FAULT_", "prefix", None, "fault",
@@ -290,6 +304,10 @@ declare("PADDLE_FAULT_DECODE_STALL_MS", "float", 0.0, "fault",
         "Stall every continuous-batching decode tick (ms): deterministic "
         "inter-token-latency inflation, the serving.intertoken_s SLO "
         "breach oracle")
+declare("PADDLE_FAULT_CKPT_POISON_SERIAL", "int", None, "fault",
+        "NaN-poison checkpoint serial n at save time, committed WITH a "
+        "valid _SUCCESS — the structurally-healthy bad checkpoint only "
+        "the serving canary catches (hot-swap rollback oracle)")
 declare("PADDLE_FAULT_CACHE_CORRUPT", "bool", False, "fault",
         "Deterministically corrupt the next compile-cache read")
 declare("PADDLE_FAULT_DATA_STALL_MS", "float", 0.0, "fault",

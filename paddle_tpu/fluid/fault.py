@@ -21,6 +21,17 @@ worker processes:
     PADDLE_FAULT_CKPT_CRASH=before|after
                                   crash during a checkpoint save, just
                                   before / just after the _SUCCESS marker
+    PADDLE_FAULT_CKPT_POISON_SERIAL=n
+                                  NaN-poison every float weight file of
+                                  checkpoint serial n at save time —
+                                  committed WITH a valid _SUCCESS marker,
+                                  unlike the pre-commit corruption hooks:
+                                  the checkpoint looks perfectly healthy
+                                  to the watcher/loader and only the
+                                  serving canary's output-sanity sentinel
+                                  can catch it (the deterministic
+                                  forced-bad-checkpoint oracle for the
+                                  hot-swap auto-rollback path)
     PADDLE_FAULT_IO_DELAY_MS=t    sleep t ms inside every checkpoint write
     PADDLE_FAULT_NAN_VAR=name     overwrite var `name` with NaN once
     PADDLE_FAULT_NAN_STEP=N       ...at step N (default 0)
@@ -129,7 +140,8 @@ from typing import Optional
 
 __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
-    "on_step", "corrupt_state", "ckpt_crash_point", "io_delay",
+    "on_step", "corrupt_state", "ckpt_crash_point", "ckpt_poison",
+    "io_delay",
     "barrier_stall", "serving_request", "decode_stall",
     "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
@@ -153,6 +165,7 @@ class FaultPlan:
 
     def __init__(self, kill_step: Optional[int] = None,
                  ckpt_crash: Optional[str] = None,
+                 ckpt_poison_serial: Optional[int] = None,
                  io_delay_ms: float = 0.0,
                  nan_var: Optional[str] = None, nan_step: int = 0,
                  grad_inf_step: Optional[int] = None,
@@ -181,6 +194,8 @@ class FaultPlan:
             raise ValueError(f"mode must be 'exit' or 'raise', got {mode!r}")
         self.kill_step = None if kill_step is None else int(kill_step)
         self.ckpt_crash = ckpt_crash
+        self.ckpt_poison_serial = None if ckpt_poison_serial is None \
+            else int(ckpt_poison_serial)
         self.io_delay_ms = float(io_delay_ms)
         self.nan_var = nan_var
         self.nan_step = int(nan_step)
@@ -229,9 +244,11 @@ class FaultPlan:
         ginf = env.get("PADDLE_FAULT_GRAD_INF_STEP", "").strip()
         spike = env.get("PADDLE_FAULT_LOSS_SPIKE_STEP", "").strip()
         stall_at = env.get("PADDLE_FAULT_DATA_STALL_AT", "").strip()
+        poison = env.get("PADDLE_FAULT_CKPT_POISON_SERIAL", "").strip()
         return cls(
             kill_step=int(kill) if kill else None,
             ckpt_crash=env.get("PADDLE_FAULT_CKPT_CRASH", "").strip() or None,
+            ckpt_poison_serial=int(poison) if poison else None,
             io_delay_ms=getf("PADDLE_FAULT_IO_DELAY_MS"),
             nan_var=env.get("PADDLE_FAULT_NAN_VAR", "").strip() or None,
             nan_step=int(getf("PADDLE_FAULT_NAN_STEP")),
@@ -449,6 +466,44 @@ def ckpt_crash_point(where: str) -> None:
     if plan is not None and plan.ckpt_crash == where \
             and plan._applies_to_this_rank():
         plan._crash(f"checkpoint crash {where} _SUCCESS")
+
+
+def ckpt_poison(serial: int, dirname: str) -> bool:
+    """Committed-but-bad checkpoint oracle: when ``ckpt_poison_serial``
+    matches ``serial``, rewrite every float array file under ``dirname``
+    as all-NaN IN PLACE, before the caller commits its _SUCCESS marker.
+    Unlike :func:`ckpt_crash_point`, the serial ends up fully committed
+    and structurally valid — the watcher/loader trusts it, only the
+    serving canary's output-sanity sentinel can catch it (the
+    deterministic trigger for hot-swap auto-rollback).  Walks the dir
+    recursively so sharded serials (``shard_*/``) are poisoned too;
+    integer arrays and unparseable files are left intact.  Returns True
+    when it fired."""
+    plan = active()
+    if plan is None or plan.ckpt_poison_serial is None \
+            or plan.ckpt_poison_serial != int(serial) \
+            or not plan._applies_to_this_rank():
+        return False
+    import numpy as np
+
+    fired = False
+    for root, _dirs, files in os.walk(dirname):
+        for fname in files:
+            path = os.path.join(root, fname)
+            try:
+                arr = np.load(path, allow_pickle=False)
+            except Exception:
+                continue  # markers / manifests / non-npy payloads
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+            with open(path, "wb") as f:
+                np.save(f, np.full_like(arr, np.nan), allow_pickle=False)
+            fired = True
+    if fired:
+        from .log import LOG
+
+        LOG(f"fault: NaN-poisoned checkpoint serial {serial} at {dirname}")
+    return fired
 
 
 def io_delay() -> None:

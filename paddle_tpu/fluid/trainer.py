@@ -264,7 +264,14 @@ def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
                                                 "0") or 0))
     # fault hooks bracket the commit point: a crash 'before' leaves an
     # unmarked dir restore must skip; 'after' leaves a complete serial a
-    # crash cannot un-commit
+    # crash cannot un-commit; the poison hook rewrites this serial's
+    # weights as NaN and then lets the commit proceed — a structurally
+    # valid checkpoint only the serving canary can catch
+    try:
+        _fault.ckpt_poison(int(os.path.basename(cur).rsplit("_", 1)[1]),
+                           cur)
+    except (ValueError, IndexError):
+        pass  # non-serial dirname: nothing to key the poison on
     _fault.ckpt_crash_point("before")
     with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
         f.write("")

@@ -392,7 +392,8 @@ class DecodeModel:
         self.pos_table = _position_encoding(self.max_len, self.cfg.d_model)
         self.startup = fluid.Program()
         self._prefill = {}
-        self.step_program, self.step_fetch = self._build_step()
+        self.step_program, self.step_fetch, self.logits_fetch = \
+            self._build_step()
 
     # -- graph pieces shared by the step and prefill programs --
 
@@ -485,7 +486,7 @@ class DecodeModel:
                                param_attr=ParamAttr(name="dlm_out_w"))
             nxt = layers.token_select(logits, mask=active,
                                       end_id=self.end_id)
-        return prog, nxt.name
+        return prog, nxt.name, logits.name
 
     # -- bucketed prefill --
 
@@ -541,6 +542,16 @@ class DecodeModel:
                                 lambda q, k, v_, i=i: window_attn(q, k, v_, i))
         self._prefill[plen] = prog
         return prog
+
+    def weight_names(self):
+        """The hot-swap rebind set: every learned weight shared by name
+        across the startup/prefill/step family.  Excludes the
+        ``dlm{i}_cache_k/v`` slot caches — those are engine-lifetime
+        activations of whichever weights wrote them, never checkpoint
+        state (a swap that rebound them would tear every in-flight
+        stream's K/V prefix)."""
+        return sorted(v.name for v in self.startup.list_vars()
+                      if v.persistable and "_cache_" not in v.name)
 
     # -- host-side helpers the engine uses to build tick feeds --
 

@@ -487,6 +487,10 @@ def save_sharded_serial(state: dict, root: str, serial: int,
     if process_index() == 0:
         with open(os.path.join(cur, META_FILE), "w") as f:
             _json.dump(meta, f)
+        # poison hook before the commit: a matching serial is rewritten
+        # NaN (every rank's shards — the walk is recursive) yet still
+        # gets its _SUCCESS, the serving canary's rollback oracle
+        _fault.ckpt_poison(int(serial), cur)
         _fault.ckpt_crash_point("before")
         with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
             f.write("")

@@ -20,11 +20,15 @@ Quick start::
 """
 
 from .decode import DecodeConfig, DecodeEngine, create_decode_engine
-from .engine import (EngineClosed, EngineOverloaded, RequestTimeout,
-                     ServingConfig, ServingEngine, create_serving_engine)
+from .engine import (DrainTimeout, EngineClosed, EngineOverloaded,
+                     RequestTimeout, ServingConfig, ServingEngine,
+                     create_serving_engine)
 from .metrics import ServingMetrics
+from .registry import (ModelRegistry, load_serial_weights,
+                       write_weights_serial)
 
 __all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
            "EngineOverloaded", "RequestTimeout", "EngineClosed",
-           "create_serving_engine",
-           "DecodeEngine", "DecodeConfig", "create_decode_engine"]
+           "DrainTimeout", "create_serving_engine",
+           "DecodeEngine", "DecodeConfig", "create_decode_engine",
+           "ModelRegistry", "load_serial_weights", "write_weights_serial"]

@@ -41,6 +41,15 @@ mirrored into the process registry; the SLO watchdog watches
 ``serving.ttft_s``/``serving.intertoken_s`` (deterministic breach
 oracle: ``PADDLE_FAULT_DECODE_STALL_MS``).
 
+Hot model swap (ISSUE 16): weights are shared BY NAME across the
+startup/prefill/step programs through the engine's one scope, and the
+executor re-gathers state from the scope on every dispatch — so
+:meth:`DecodeEngine.swap_weights` is a scope rebind between ticks under
+``_dispatch_lock``, never a recompile, and the fixed-executable-set
+invariant holds across arbitrarily many checkpoint swaps.  The
+per-tick monitor hook (:meth:`DecodeEngine.set_tick_monitor`) hands the
+step's logits to ``serving.registry``'s canary sentinel.
+
 Knobs (``fluid.envcontract``): ``PADDLE_SERVE_DECODE`` (kill switch),
 ``PADDLE_SERVE_SLOTS``, ``PADDLE_SERVE_MAX_LEN``,
 ``PADDLE_SERVE_PREFILL_BUCKETS``.
@@ -49,15 +58,17 @@ Knobs (``fluid.envcontract``): ``PADDLE_SERVE_DECODE`` (kill switch),
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .engine import EngineClosed, EngineOverloaded, RequestTimeout, _Request
+from .engine import (DrainTimeout, EngineClosed, EngineOverloaded,
+                     RequestTimeout, _Request)
 from .metrics import ServingMetrics
 
 __all__ = ["DecodeConfig", "DecodeEngine", "create_decode_engine"]
@@ -120,7 +131,11 @@ class DecodeEngine:
         self._n_active = 0
         self._ticks = 0
         self._draining = False
+        self._paused = False  # hot-swap drain: hold admissions, keep queue
         self._stopped = False
+        self._rid = itertools.count()
+        self._tick_monitor = None  # registry canary sentinel (or None)
+        self._last_logits = None
         # serializes every dispatch: the worker holds it per iteration,
         # warmup()/decode_static() grab it between iterations
         self._dispatch_lock = threading.Lock()
@@ -177,6 +192,7 @@ class DecodeEngine:
         req = _Request(None, 1, None, fut, now + timeout_ms / 1000.0
                        if timeout_ms else None, now)
         req.prompt, req.max_new, req.out_tokens = prompt, max_new, []
+        req.rid = f"d{next(self._rid)}"
         with self._cond:
             if self._stopped or self._draining:
                 raise EngineClosed("decode engine is draining/stopped")
@@ -215,8 +231,10 @@ class DecodeEngine:
 
         while True:
             with self._cond:
-                while not self._queue and not self._n_active \
-                        and not self._stopped:
+                # a paused engine (mid hot-swap drain) must not spin on
+                # its queue: only admissible work or live slots wake it
+                while not self._n_active and not self._stopped \
+                        and not (self._queue and not self._paused):
                     self._cond.wait(self.config.idle_wait_s)
                 if self._stopped:
                     break
@@ -224,12 +242,24 @@ class DecodeEngine:
                 # robustness-harness hook: per-tick injected stall (the
                 # deterministic inter-token-latency breach oracle)
                 _fault.decode_stall()
+                self._reap_abandoned()
                 self._admit()
                 if self._n_active:
                     self._tick()
             with self._cond:
                 self._cond.notify_all()  # drain() watches progress
         self._fail_leftovers()
+
+    def _reap_abandoned(self):
+        """Free slots whose futures were already resolved from outside
+        the worker (the bounded-drain timeout fails stuck futures with
+        DrainTimeout; their slots must not keep decoding dead work)."""
+        for i, r in enumerate(self._slots):
+            if r is not None and r.future.done():
+                self._slots[i] = None
+                self._n_active -= 1
+        self.metrics.note_slots(self._n_active,
+                                self.model.max_slots - self._n_active)
 
     def _fail_leftovers(self):
         """Worker exit with work still resident (drain timeout path):
@@ -241,16 +271,19 @@ class DecodeEngine:
             leftovers += list(self._queue)
             self._queue.clear()
         for r in leftovers:
+            if r.future.done():
+                continue  # already failed by the bounded-drain path
             self.metrics.inc("failed")
             if r.span is not None:
                 r.span.end(status="engine_stopped")
-            if not r.future.done():
-                r.future.set_exception(
-                    EngineClosed("decode engine stopped"))
+            r.future.set_exception(
+                EngineClosed("decode engine stopped"))
 
     def _admit(self):
         """Fill free slots from the queue: one bucketed prefill dispatch
         per admitted request writes its K/V prefix in place."""
+        if self._paused:
+            return  # hot-swap drain: queue keeps building, nothing sheds
         while True:
             free = next((i for i, r in enumerate(self._slots)
                          if r is None), None)
@@ -273,6 +306,16 @@ class DecodeEngine:
                     req = cand
                     break
                 self.metrics.set_gauge("queue_depth", len(self._queue))
+                if req is not None:
+                    # reserve the slot HERE, still under _cond: between
+                    # the queue pop and the end of the prefill dispatch
+                    # the request must stay visible to the bounded-drain
+                    # abort (which scans queue + slots under _cond) — a
+                    # drain expiry in that window would otherwise miss
+                    # it and the request would decode to completion
+                    # unaborted
+                    self._slots[free] = req
+                    self._n_active += 1
             if req is None:
                 return
             self._prefill(req, free)
@@ -295,8 +338,6 @@ class DecodeEngine:
         # the first decode tick re-derives position plen-1 (same token,
         # same weights => bit-identical K/V) and emits the first token
         req.pos = plen - 1
-        self._slots[slot] = req
-        self._n_active += 1
         self.metrics.inc("prefills")
         self.metrics.note_slots(self._n_active,
                                 model.max_slots - self._n_active)
@@ -328,12 +369,17 @@ class DecodeEngine:
 
     def _step_dispatch(self, slots):
         """ONE compiled decode step over all slots; returns the [S] next
-        tokens (host ints)."""
-        (nxt,) = self._run(self.model.step_program,
-                           self._tick_feeds(slots),
-                           [self.model.step_fetch])
+        tokens (host ints).  The [S, V] logits ride along as a second
+        fetch of the SAME executable (a fixed fetch set from warmup on,
+        so the canary sentinel never perturbs the compile counter) and
+        land in ``_last_logits`` for the tick monitor."""
+        nxt, logits = self._run(self.model.step_program,
+                                self._tick_feeds(slots),
+                                [self.model.step_fetch,
+                                 self.model.logits_fetch])
         self._ticks += 1
         self.metrics.inc("decode_ticks")
+        self._last_logits = np.asarray(logits)
         return np.asarray(nxt).reshape(-1)
 
     def _tick(self):
@@ -341,6 +387,7 @@ class DecodeEngine:
 
         model = self.model
         t0 = time.perf_counter()
+        dispatched = list(self._slots)  # rows the logits correspond to
         nxt = self._step_dispatch(self._slots)
         t1 = time.perf_counter()
         for i, req in enumerate(list(self._slots)):
@@ -371,6 +418,21 @@ class DecodeEngine:
                 self._retire(i, error=RequestTimeout(
                     f"deadline expired after {len(req.out_tokens)} "
                     f"generated tokens"))
+        mon = self._tick_monitor
+        if mon is not None:
+            # canary sentinel: this tick's logits + the slot table they
+            # were computed for (post-retire, so completions are visible
+            # to the probation counter).  A sentinel fault must never
+            # take down the worker it watches.
+            try:
+                mon(self._last_logits, dispatched)
+            except Exception:
+                from .. import observe
+
+                import traceback
+
+                observe.emit("model.monitor_error",
+                             error=traceback.format_exc(limit=3))
 
     def _retire(self, slot: int, error: Optional[Exception] = None):
         req = self._slots[slot]
@@ -378,6 +440,8 @@ class DecodeEngine:
         self._n_active -= 1
         self.metrics.note_slots(self._n_active,
                                 self.model.max_slots - self._n_active)
+        if req.future.done():
+            return  # failed externally (bounded-drain timeout)
         if error is not None:
             self.metrics.inc("expired" if isinstance(error, RequestTimeout)
                              else "failed")
@@ -500,12 +564,116 @@ class DecodeEngine:
                     for j in range(len(batch))]
 
     # ------------------------------------------------------------------
+    # hot model swap surface (serving.registry drives these)
+    # ------------------------------------------------------------------
+
+    def snapshot_weights(self, names: Sequence[str]) -> Dict[str, np.ndarray]:
+        """Host copies of the named scope vars, taken between dispatches
+        — the registry's rollback set (the old serial stays resident as
+        plain host arrays until the new one is promoted)."""
+        with self._dispatch_lock:
+            out = {}
+            for name in names:
+                val = self._scope.get(name)
+                if val is None:
+                    raise KeyError(f"no scope var named {name!r}")
+                out[name] = np.array(val, copy=True)
+            return out
+
+    def _rebind_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        """Scope rebind — caller MUST hold ``_dispatch_lock`` (or be the
+        worker inside a tick).  The executor re-gathers state from the
+        scope on every dispatch and the jit cache key carries no state
+        values, so the next tick runs the SAME executables over the new
+        weights: a swap is never a recompile."""
+        for name, arr in weights.items():
+            self._scope.set(name, np.asarray(arr))
+
+    def swap_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        """Atomically rebind the named weights between decode ticks."""
+        with self._dispatch_lock:
+            self._rebind_weights(weights)
+
+    def _scrub_caches(self) -> None:
+        """Zero every slot K/V cache — caller holds ``_dispatch_lock``
+        (or is the worker inside a tick).  The rollback path needs this:
+        a poisoned canary serial writes NaN into resident caches, and
+        NaN rides THROUGH the -inf validity mask (NaN + -inf = NaN), so
+        rebinding healthy weights alone would leave every future request
+        in that slot poisoned.  Zeros restore the engine-start state:
+        fresh admissions prefill over them and are bitwise-clean."""
+        for v in self.model.startup.list_vars():
+            if not v.persistable or "_cache_" not in v.name:
+                continue
+            cur = self._scope.get(v.name)
+            if cur is not None:
+                self._scope.set(v.name, np.zeros(np.shape(cur),
+                                                 np.asarray(cur).dtype))
+
+    def pause_admissions(self) -> None:
+        """Hold admissions (the drain swap policy): submits still land in
+        the queue — nothing sheds — but no slot is filled until
+        :meth:`resume_admissions`.  Resident slots keep ticking."""
+        with self._cond:
+            self._paused = True
+            self._cond.notify_all()
+
+    def resume_admissions(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout_s: float = 60.0) -> bool:
+        """Wait until no slot is resident (queued work may remain when
+        admissions are paused).  Returns False on timeout."""
+        deadline = time.perf_counter() + timeout_s
+        with self._cond:
+            while self._n_active:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def abort_resident(self, what: str = "swap drain") -> List[str]:
+        """Fail every resident request's future with :class:`DrainTimeout`
+        (the bounded-drain expiry path, reused by the drain swap policy
+        when old-version slots refuse to retire).  Returns the stuck
+        request ids; the worker reaps the dead slots on its next pass."""
+        stuck = [r for r in self._slots
+                 if r is not None and not r.future.done()]
+        ids = [r.rid for r in stuck]
+        if stuck:
+            exc = DrainTimeout(
+                f"{what} timed out with {len(ids)} resident "
+                f"request(s) still generating: {', '.join(ids)}", ids)
+            for r in stuck:
+                self.metrics.inc("failed")
+                if r.span is not None:
+                    r.span.end(status="drain_timeout")
+                if not r.future.done():
+                    r.future.set_exception(exc)
+        with self._cond:
+            self._cond.notify_all()
+        return ids
+
+    def set_tick_monitor(self, fn) -> None:
+        """Install/remove (None) the per-tick monitor: called on the
+        worker thread after each decode tick with ``(logits, slots)`` —
+        the [S, V] logits of the dispatch and the slot table it ran
+        over.  The registry's canary output-sanity sentinel lives here."""
+        self._tick_monitor = fn
+
+    # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
 
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Stop admitting; wait until every queued and resident request
-        has resolved.  Returns True when fully drained."""
+        has resolved.  Returns True when fully drained.  On expiry every
+        outstanding future fails with :class:`DrainTimeout` naming the
+        stuck request ids — callers never block forever on a wedged
+        generation."""
         deadline = time.perf_counter() + timeout_s
         with self._cond:
             self._draining = True
@@ -513,9 +681,33 @@ class DecodeEngine:
             while self._queue or self._n_active:
                 left = deadline - time.perf_counter()
                 if left <= 0:
+                    self._abort_outstanding_locked("drain")
                     return False
                 self._cond.wait(min(left, 0.05))
         return True
+
+    def _abort_outstanding_locked(self, what: str) -> None:
+        """Fail every queued + resident future with DrainTimeout (caller
+        holds ``_cond``).  Resident slots are left for the worker's
+        reap pass — the worker may be mid-tick holding the dispatch
+        lock, so they cannot be cleared from here."""
+        stuck = list(self._queue) + [r for r in self._slots
+                                     if r is not None
+                                     and not r.future.done()]
+        self._queue.clear()
+        self.metrics.set_gauge("queue_depth", 0)
+        if not stuck:
+            return
+        ids = [r.rid for r in stuck]
+        exc = DrainTimeout(
+            f"{what} timed out after {len(ids)} outstanding decode "
+            f"request(s): {', '.join(ids)}", ids)
+        for r in stuck:
+            self.metrics.inc("failed")
+            if r.span is not None:
+                r.span.end(status="drain_timeout")
+            if not r.future.done():
+                r.future.set_exception(exc)
 
     def shutdown(self, timeout_s: float = 60.0) -> bool:
         ok = self.drain(timeout_s=timeout_s)

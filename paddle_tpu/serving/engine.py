@@ -34,6 +34,7 @@ so the jit cache sees a single writer.  Results travel back on
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
@@ -47,7 +48,8 @@ import numpy as np
 from .metrics import ServingMetrics
 
 __all__ = ["ServingConfig", "ServingEngine", "EngineOverloaded",
-           "RequestTimeout", "EngineClosed", "create_serving_engine"]
+           "RequestTimeout", "EngineClosed", "DrainTimeout",
+           "create_serving_engine"]
 
 
 class EngineOverloaded(RuntimeError):
@@ -61,6 +63,18 @@ class RequestTimeout(TimeoutError):
 
 class EngineClosed(RuntimeError):
     """submit() after drain()/shutdown() began."""
+
+
+class DrainTimeout(TimeoutError):
+    """A bounded ``drain()``/``shutdown()`` (or the hot-swap ``drain``
+    policy) expired with requests still outstanding.  Every stuck future
+    fails with one of these; ``request_ids`` names the requests so the
+    operator can correlate them against spans/events instead of staring
+    at a hung process."""
+
+    def __init__(self, message: str, request_ids: Sequence[str] = ()):
+        super().__init__(message)
+        self.request_ids = list(request_ids)
 
 
 @dataclass
@@ -140,7 +154,7 @@ class _Request:
     MID-GENERATION, not just in the queue)."""
 
     __slots__ = ("feed", "rows", "sig", "future", "deadline", "t_submit",
-                 "t_taken", "span",
+                 "t_taken", "span", "rid",
                  # per-token decode state (ISSUE 15)
                  "prompt", "max_new", "slot", "pos", "out_tokens",
                  "t_prev_token")
@@ -154,6 +168,7 @@ class _Request:
         self.t_submit = t_submit
         self.t_taken = None       # when the batcher popped it (perf time)
         self.span = None          # observe.trace request span (or None)
+        self.rid = None           # engine-assigned request id (DrainTimeout)
         self.prompt = None        # list[int] prompt token ids (decode)
         self.max_new = 0          # generation budget (decode)
         self.slot = None          # KV-cache slot while resident (decode)
@@ -188,6 +203,8 @@ class ServingEngine:
         self._cond = threading.Condition(self._lock)
         self._queue: collections.deque = collections.deque()
         self._inflight = 0
+        self._inflight_reqs: set = set()  # popped-but-unresolved _Requests
+        self._rid = itertools.count()
         self._draining = False
         self._stopped = False
         self._warm = not self.config.require_warmup
@@ -237,6 +254,7 @@ class ServingEngine:
         deadline = now + timeout_ms / 1000.0 if timeout_ms else None
         fut: Future = Future()
         req = _Request(feed, rows, sig, fut, deadline, now)
+        req.rid = f"r{next(self._rid)}"
         with self._cond:
             if self._stopped or self._draining:
                 raise EngineClosed("serving engine is draining/stopped")
@@ -341,6 +359,7 @@ class ServingEngine:
             finally:
                 with self._cond:
                     self._inflight -= len(batch)
+                    self._inflight_reqs.difference_update(batch)
                     self._cond.notify_all()
 
     def _take_batch(self) -> Optional[List[_Request]]:
@@ -359,6 +378,7 @@ class ServingEngine:
             # drain() must not conclude "all done" while the batcher holds
             # requests that left the queue but have not dispatched yet
             self._inflight += 1
+            self._inflight_reqs.add(first)
             first.t_taken = time.perf_counter()
             batch, rows = [first], first.rows
             flush_at = first.t_submit + self.config.max_wait_ms / 1000.0
@@ -370,6 +390,7 @@ class ServingEngine:
                         break
                     self._queue.popleft()
                     self._inflight += 1
+                    self._inflight_reqs.add(nxt)
                     nxt.t_taken = time.perf_counter()
                     batch.append(nxt)
                     rows += nxt.rows
@@ -389,6 +410,8 @@ class ServingEngine:
         now = time.perf_counter()
         live: List[_Request] = []
         for req in batch:
+            if req.future.done():
+                continue  # failed externally (bounded-drain timeout)
             if req.deadline is not None and now > req.deadline:
                 self.metrics.inc("expired")
                 if req.span is not None:
@@ -420,6 +443,8 @@ class ServingEngine:
                 rows, bucket)
         except BaseException as exc:
             for req in live:
+                if req.future.done():
+                    continue
                 self.metrics.inc("failed")
                 if req.span is not None:
                     req.span.end(status="error")
@@ -436,6 +461,9 @@ class ServingEngine:
         done = time.perf_counter()
         start = 0
         for req in live:
+            if req.future.done():
+                start += req.rows
+                continue  # failed externally (bounded-drain timeout)
             res = []
             for o in outs:
                 data = np.asarray(o.data)
@@ -723,7 +751,10 @@ class ServingEngine:
 
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Stop admitting; wait until every queued and in-flight request
-        has resolved.  Returns True when fully drained."""
+        has resolved.  Returns True when fully drained.  On expiry every
+        outstanding future fails with :class:`DrainTimeout` naming the
+        stuck request ids — callers never block forever on a wedged
+        dispatch."""
         deadline = time.perf_counter() + timeout_s
         with self._cond:
             self._draining = True
@@ -731,9 +762,32 @@ class ServingEngine:
             while self._queue or self._inflight:
                 left = deadline - time.perf_counter()
                 if left <= 0:
+                    self._abort_outstanding_locked("drain")
                     return False
                 self._cond.wait(min(left, 0.05))
         return True
+
+    def _abort_outstanding_locked(self, what: str) -> None:
+        """Fail every queued + in-flight future with DrainTimeout (caller
+        holds ``_cond``).  In-flight requests stay counted — the batcher
+        owns the count and decrements it when its dispatch returns; the
+        done-guards at the resolve sites make that return a no-op."""
+        stuck = list(self._queue) + [r for r in self._inflight_reqs
+                                     if not r.future.done()]
+        self._queue.clear()
+        self.metrics.set_gauge("queue_depth", 0)
+        if not stuck:
+            return
+        ids = [r.rid for r in stuck]
+        exc = DrainTimeout(
+            f"{what} timed out after {len(ids)} outstanding "
+            f"request(s): {', '.join(ids)}", ids)
+        for r in stuck:
+            self.metrics.inc("failed")
+            if r.span is not None:
+                r.span.end(status="drain_timeout")
+            if not r.future.done():
+                r.future.set_exception(exc)
 
     def shutdown(self, timeout_s: float = 60.0) -> bool:
         """drain() then stop and join the worker threads."""

@@ -46,7 +46,10 @@ class ServingMetrics:
                 # continuous-batching decode (ISSUE 15): iteration-level
                 # scheduling counters, zero-reported on batch engines too
                 # so snapshot consumers never branch on engine kind
-                "prefills", "decode_ticks", "tokens_generated")
+                "prefills", "decode_ticks", "tokens_generated",
+                # hot model swap (ISSUE 16): registry swap/rollback counts;
+                # the serving.model_serial gauge rides set_gauge
+                "model_swaps", "model_rollbacks")
 
     def __init__(self, latency_window: int = 4096,
                  registry: Optional[MetricsRegistry] = None):

@@ -1,0 +1,492 @@
+"""Versioned model registry: zero-downtime hot checkpoint swap (ISSUE 16).
+
+A serving replica today is frozen at the weights it loaded; a new
+training checkpoint means a restart — every in-flight request shed, a
+full cold start, a compile storm.  This module closes ROADMAP item 4's
+last robustness gap by streaming the trainer's committed serials into a
+LIVE :class:`~paddle_tpu.serving.decode.DecodeEngine`:
+
+ - **Watcher over the ``_SUCCESS`` protocol**: :meth:`ModelRegistry.
+   poll_once` discovers serial N+1 exactly like ``trainer.
+   load_checkpoint`` trusts one — dir named ``checkpoint_<n>``, marker
+   present — and falls back serial-by-serial on anything unreadable
+   (torn files, shape drift, missing vars).  A corrupt-but-committed
+   serial is SKIPPED with a ``model.swap_skipped`` event, never a crash:
+   the engine keeps serving what it has.
+ - **Any training topology** (the PR 14 reshard-on-load seam): a serial
+   written sharded by a dp4×tp2 fleet carries its ``meta.json`` mesh
+   record; :func:`load_serial_weights` assembles the full logical arrays
+   on host via ``parallel.reshard.assemble_logical``, so a single-chip
+   replica ingests it unchanged.  Flat single-process serials load
+   straight from their per-var files.
+ - **Swap = scope rebind, never a recompile**: weights are shared by
+   name across the startup/prefill/step programs, the executor
+   re-gathers state from the scope per dispatch, and the jit cache key
+   carries no state values — so ``engine.swap_weights`` between two
+   decode ticks flips the served model while ``bucket_compiles`` and the
+   executable count stay exactly flat (the PR 15 fixed-executable-set
+   invariant holds across arbitrarily many swaps).
+ - **In-flight policy** (KV caches are activations of the OLD weights):
+   ``drain`` pauses admissions (queue keeps building — zero shed), lets
+   resident slots finish on serial N, swaps, resumes — every request's
+   tokens are bitwise those of a single-version engine.  ``immediate``
+   rebinds under live slots: no pause at all, but a mid-generation
+   stream finishes its tail on N+1 over a K/V prefix N wrote — its
+   output matches NEITHER pure-N nor pure-N+1 (the documented
+   consistency tradeoff; choose it when freshness beats replayability).
+ - **Canary + auto-rollback**: with ``PADDLE_SERVE_CANARY_REQUESTS`` >
+   0, serial N's weights stay host-resident after the swap and a
+   per-tick sentinel watches the new serial's probation traffic: any
+   non-finite logit, argmax-entropy collapse (3 consecutive ticks below
+   the ``PADDLE_SERVE_SENTINEL_ENTROPY`` floor), or a fresh SLO-watchdog
+   breach on TTFT / inter-token / request latency rolls the scope
+   straight back to N — from inside the tick, so the very next dispatch
+   serves the old model — vetoes the bad serial forever, and emits a
+   stamped ``model.rollback`` incident.  Probation survived → ``model.
+   promote`` and N's buffers are released.  The sentinel reads the step
+   logits that are ALWAYS part of the decode-step fetch set (fetch names
+   key the jit cache, so fetching them only during canary would mint a
+   second executable).
+
+   Deviation from per-request canary routing: the decode step writes
+   every fed slot's K/V position unconditionally, so two weight sets
+   cannot tick distinct slot subsets of ONE cache without corrupting
+   each other — the canary is therefore time-sliced (the whole replica
+   probes N+1 for the probation window; a fleet cans x% of replicas to
+   get x% of traffic).
+
+Observability: ``model.swap`` / ``model.canary`` / ``model.rollback`` /
+``model.promote`` stamped events, ``serving.model_serial`` gauge (which
+version served every scrape window), ``model_swaps`` /
+``model_rollbacks`` counters.
+
+Knobs (``fluid.envcontract``): ``PADDLE_SERVE_SWAP_POLICY``,
+``PADDLE_SERVE_CANARY_REQUESTS``, ``PADDLE_SERVE_SWAP_POLL_S``,
+``PADDLE_SERVE_SENTINEL_ENTROPY``; the forced-bad-checkpoint oracle is
+``PADDLE_FAULT_CKPT_POISON_SERIAL`` (``fluid.fault.ckpt_poison``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..fluid.trainer import CKPT_PREFIX, SUCCESS_MARK, _serial_dirs
+
+__all__ = ["ModelRegistry", "load_serial_weights", "write_weights_serial"]
+
+#: SLO-watchdog metrics the canary treats as rollback triggers
+_CANARY_SLO_METRICS = ("serving.ttft_s", "serving.intertoken_s",
+                       "serving.latency_s")
+#: consecutive low-entropy ticks before the collapse sentinel trips
+_ENTROPY_TRIP_TICKS = 3
+
+
+def _is_sharded_serial(serial_dir: str) -> bool:
+    """Sharded serials carry a meta.json and/or shard manifests; flat
+    single-process serials are bare per-var files."""
+    from ..parallel.multihost import META_FILE
+
+    if os.path.exists(os.path.join(serial_dir, META_FILE)):
+        return True
+    try:
+        return any(n.startswith("shard") for n in os.listdir(serial_dir))
+    except OSError:
+        return False
+
+
+def load_serial_weights(serial_dir: str, names: Sequence[str],
+                        shapes: Optional[Dict[str, tuple]] = None
+                        ) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Host-load the named weights from one committed serial, whatever
+    topology wrote it.  Returns ``(weights, info)``; raises ``IOError``
+    on anything structurally unusable (missing var, shape drift, torn
+    file) so the watcher's serial-fallback loop can skip it — the same
+    corrupt-serial contract as ``trainer.load_checkpoint``.
+
+    Deliberately NO finite-value check here: a NaN-poisoned serial is
+    structurally perfect and must load — catching it is the canary
+    sentinel's job, not the loader's (a loader-side screen would mask
+    the rollback path the poison oracle exists to exercise)."""
+    info: dict = {"serial_dir": serial_dir}
+    if _is_sharded_serial(serial_dir):
+        import json as _json
+
+        from ..parallel import reshard as _reshard
+        from ..parallel.multihost import META_FILE
+
+        meta = {}
+        meta_path = os.path.join(serial_dir, META_FILE)
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    meta = _json.load(f)
+            except (OSError, ValueError) as exc:
+                raise IOError(f"unreadable serial meta {meta_path}: "
+                              f"{exc!r}")
+        try:
+            logical = _reshard.assemble_logical(serial_dir)
+        except _reshard.ReshardError:
+            raise  # unviable topology, not corruption: do not fall back
+        except Exception as exc:
+            raise IOError(f"sharded serial {serial_dir} failed to "
+                          f"assemble: {exc!r}")
+        info["source"] = "sharded"
+        axes = _reshard.recorded_axes(meta)
+        if axes:
+            info["from_mesh"] = dict(axes)
+        info["resharded"] = bool(_reshard.needs_reshard(meta))
+    else:
+        logical = {}
+        for name in names:
+            path = os.path.join(serial_dir, name)
+            try:
+                logical[name] = np.load(path, allow_pickle=False)
+            except Exception as exc:
+                raise IOError(f"weight file {path} unreadable: {exc!r}")
+        info["source"] = "flat"
+    weights: Dict[str, np.ndarray] = {}
+    for name in names:
+        if name not in logical:
+            raise IOError(f"serial {serial_dir} is missing weight "
+                          f"{name!r}")
+        arr = np.asarray(logical[name])
+        if shapes is not None and name in shapes \
+                and tuple(arr.shape) != tuple(shapes[name]):
+            raise IOError(
+                f"serial {serial_dir} weight {name!r} has shape "
+                f"{tuple(arr.shape)}, engine expects "
+                f"{tuple(shapes[name])}")
+        weights[name] = arr
+    return weights, info
+
+
+def write_weights_serial(root: str, serial: int,
+                         weights: Dict[str, np.ndarray]) -> str:
+    """Commit a host weight dict as ``<root>/checkpoint_<serial>/`` under
+    the ``_SUCCESS`` protocol (flat single-process layout, one np.save
+    file per var) — the serving-side twin of ``trainer.save_checkpoint``
+    for exporting/republishing an in-memory model.  Runs the
+    ``ckpt_poison`` fault hook before the marker, so the forced-bad-
+    checkpoint oracle covers this writer too.  Returns the serial dir."""
+    from ..fluid import fault as _fault
+    from ..fluid import io as _io
+
+    cur = os.path.join(root, f"{CKPT_PREFIX}_{int(serial)}")
+    os.makedirs(cur, exist_ok=True)
+    _io.write_var_files(cur, weights)
+    _fault.ckpt_poison(int(serial), cur)
+    with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
+        f.write("")
+    return cur
+
+
+class ModelRegistry:
+    """Checkpoint-dir watcher + hot-swap driver for one decode engine.
+
+    ::
+
+        reg = ModelRegistry(engine, ckpt_dir, canary_requests=8)
+        reg.start()            # background watcher (poll_once() to drive
+        ...                    # it synchronously from tests/tools)
+        reg.stop()
+
+    The engine must expose the hot-swap surface
+    (``weight_names``/``snapshot_weights``/``swap_weights``/
+    ``pause_admissions``/``wait_idle``/``set_tick_monitor`` — today's
+    :class:`~paddle_tpu.serving.decode.DecodeEngine`).
+
+    Locking: the registry lock is held across a swap (which takes the
+    engine's dispatch lock), while the canary sentinel runs ON the
+    worker thread UNDER the dispatch lock — so the sentinel only ever
+    takes the registry lock non-blocking, skipping its tick when the
+    registry is mid-operation.  Rollback happens inside the tick via the
+    unlocked ``_rebind_weights`` (the dispatch lock is already held);
+    taking ``swap_weights`` there would self-deadlock.
+    """
+
+    def __init__(self, engine, ckpt_dir: str,
+                 policy: Optional[str] = None,
+                 canary_requests: Optional[int] = None,
+                 drain_timeout_s: float = 30.0,
+                 serial: Optional[int] = None):
+        from ..fluid import envcontract as _ec
+
+        self.engine = engine
+        self.ckpt_dir = str(ckpt_dir)
+        self.policy = policy if policy is not None \
+            else _ec.get("PADDLE_SERVE_SWAP_POLICY")
+        if self.policy not in ("drain", "immediate"):
+            raise ValueError(f"swap policy must be 'drain' or "
+                             f"'immediate', got {self.policy!r}")
+        self.canary_requests = int(
+            canary_requests if canary_requests is not None
+            else _ec.get("PADDLE_SERVE_CANARY_REQUESTS"))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.sentinel_entropy = float(
+            _ec.get("PADDLE_SERVE_SENTINEL_ENTROPY"))
+        self.serial = -1 if serial is None else int(serial)
+        self._names = list(engine.model.weight_names())
+        # the engine's live shapes gate every load: a serial from an
+        # architecturally different model is corrupt BY DEFINITION here
+        self._shapes = {n: tuple(a.shape) for n, a in
+                        engine.snapshot_weights(self._names).items()}
+        self._lock = threading.RLock()
+        self._prev: Optional[Tuple[int, Dict[str, np.ndarray]]] = None
+        self._canary: Optional[dict] = None
+        self._vetoed: set = set()  # rolled-back serials, never retried
+        self._watcher: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        engine.metrics.set_gauge("model_serial", self.serial)
+
+    # ------------------------------------------------------------------
+    # discovery
+    # ------------------------------------------------------------------
+
+    def complete_serials(self):
+        """Committed serials in the watched dir, ascending — exactly the
+        trust rule of ``load_checkpoint``: named ``checkpoint_<n>`` AND
+        carrying ``_SUCCESS`` (a torn/unmarked dir is invisible)."""
+        return [s for s, name in _serial_dirs(self.ckpt_dir)
+                if os.path.exists(os.path.join(self.ckpt_dir, name,
+                                               SUCCESS_MARK))]
+
+    def vetoed(self):
+        """Serials auto-rollback has permanently disqualified."""
+        with self._lock:
+            return sorted(self._vetoed)
+
+    # ------------------------------------------------------------------
+    # the watcher step
+    # ------------------------------------------------------------------
+
+    def poll_once(self) -> Optional[int]:
+        """One watcher step: finish a stalled canary if its probation
+        count was met off-tick, then try to swap to the newest complete,
+        non-vetoed serial above the current one — falling back serial-by-
+        serial on unreadable candidates.  Returns the serial swapped to,
+        or None.  Never raises on a bad checkpoint dir."""
+        from .. import observe
+
+        with self._lock:
+            if self._canary is not None:
+                # traffic may have gone quiet mid-probation: settle the
+                # canary from here so promotion never needs a tick
+                self._check_canary(None, None)
+                if self._canary is not None:
+                    return None  # probation still running: one at a time
+            current = self.serial
+            candidates = [s for s in self.complete_serials()
+                          if s > current and s not in self._vetoed]
+            for serial in sorted(candidates, reverse=True):
+                cur = os.path.join(self.ckpt_dir,
+                                   f"{CKPT_PREFIX}_{serial}")
+                try:
+                    weights, info = load_serial_weights(
+                        cur, self._names, self._shapes)
+                except Exception as exc:
+                    # committed-yet-unreadable: skip it, try the next-
+                    # newest — the load_checkpoint fallback contract,
+                    # applied to a live engine (never crash serving)
+                    observe.emit("model.swap_skipped", serial=int(serial),
+                                 path=cur, error=repr(exc))
+                    continue
+                self._swap_to(serial, weights, info)
+                return serial
+            return None
+
+    def _swap_to(self, serial: int, weights: Dict[str, np.ndarray],
+                 info: dict) -> None:
+        """Execute the swap under the configured in-flight policy.
+        Caller holds the registry lock."""
+        from .. import observe
+
+        eng = self.engine
+        prev_w = eng.snapshot_weights(self._names)
+        from_serial = self.serial
+        t0 = time.perf_counter()
+        drained = True
+        if self.policy == "drain":
+            # hold admissions (queue keeps accepting — zero shed), let
+            # every resident slot finish its generation on the OLD
+            # weights, swap between ticks, resume: bitwise vs a
+            # single-version engine for every request
+            eng.pause_admissions()
+            try:
+                drained = eng.wait_idle(self.drain_timeout_s)
+                if not drained:
+                    stuck = eng.abort_resident("swap drain")
+                    observe.emit("model.swap_drain_timeout",
+                                 serial=int(serial),
+                                 request_ids=stuck)
+                eng.swap_weights(weights)
+            finally:
+                eng.resume_admissions()
+        else:
+            # immediate: resident slots continue on N+1 over K/V their
+            # old weights wrote — fresh model now, mixed-version tails
+            eng.swap_weights(weights)
+        self.serial = int(serial)
+        eng.metrics.inc("model_swaps")
+        eng.metrics.set_gauge("model_serial", self.serial)
+        canary = self.canary_requests > 0
+        observe.emit("model.swap", serial=int(serial),
+                     from_serial=int(from_serial), policy=self.policy,
+                     drained=bool(drained), canary=canary,
+                     dur_s=round(time.perf_counter() - t0, 6),
+                     source=info.get("source"),
+                     from_mesh=info.get("from_mesh"),
+                     resharded=info.get("resharded"))
+        if not canary:
+            self._prev = None
+            return
+        # probation: keep N host-resident for instant rollback, baseline
+        # the watchdog's breach counts, arm the per-tick sentinel
+        from ..observe import watchdog as _watchdog
+
+        wd = _watchdog.get_watchdog()
+        self._prev = (int(from_serial), prev_w)
+        self._canary = {
+            "serial": int(serial),
+            "completed0": eng.metrics.counter("completed"),
+            "wd0": dict(wd.breaches) if wd is not None else {},
+            "low_entropy_ticks": 0,
+        }
+        eng.set_tick_monitor(self._on_tick)
+        observe.emit("model.canary", serial=int(serial),
+                     requests=self.canary_requests,
+                     entropy_floor=self.sentinel_entropy)
+
+    # ------------------------------------------------------------------
+    # canary sentinel (worker thread, dispatch lock held)
+    # ------------------------------------------------------------------
+
+    def _on_tick(self, logits, slots) -> None:
+        """Per-tick monitor installed during probation.  Non-blocking on
+        the registry lock: if the registry is mid-poll the sentinel
+        skips one tick rather than deadlocking the worker against a
+        swap that wants the dispatch lock."""
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            self._check_canary(logits, slots)
+        finally:
+            self._lock.release()
+
+    def _check_canary(self, logits, slots) -> None:
+        """Sentinel + promotion checks; registry lock held.  ``logits``/
+        ``slots`` are None when called off-tick (poll path): output
+        sanity is skipped, breach/promotion checks still run."""
+        cn = self._canary
+        if cn is None:
+            return
+        if logits is not None and slots is not None:
+            rows = [i for i, r in enumerate(slots) if r is not None]
+            if rows:
+                sub = np.asarray(logits)[rows]
+                if not np.all(np.isfinite(sub)):
+                    self._rollback("nonfinite_logits")
+                    return
+                # argmax entropy collapse: a broken-but-finite model
+                # saturates one logit; healthy small-vocab decode keeps
+                # measurable distributional entropy
+                x = sub - sub.max(axis=-1, keepdims=True)
+                p = np.exp(x)
+                p /= p.sum(axis=-1, keepdims=True)
+                ent = -(p * np.log(np.maximum(p, 1e-20))).sum(axis=-1)
+                if float(ent.max()) < self.sentinel_entropy:
+                    cn["low_entropy_ticks"] += 1
+                    if cn["low_entropy_ticks"] >= _ENTROPY_TRIP_TICKS:
+                        self._rollback("entropy_collapse")
+                        return
+                else:
+                    cn["low_entropy_ticks"] = 0
+        from ..observe import watchdog as _watchdog
+
+        wd = _watchdog.get_watchdog()
+        if wd is not None:
+            for metric in _CANARY_SLO_METRICS:
+                if wd.breaches.get(metric, 0) > cn["wd0"].get(metric, 0):
+                    self._rollback(f"slo_breach:{metric}")
+                    return
+        done = self.engine.metrics.counter("completed") - cn["completed0"]
+        if done >= self.canary_requests:
+            self._promote()
+
+    def _rollback(self, reason: str) -> None:
+        """Auto-rollback to the retained previous serial.  Registry lock
+        held; when called from the sentinel the worker already holds the
+        dispatch lock, so the rebind is the unlocked one — the NEXT tick
+        (same executables) serves the old weights again."""
+        from .. import observe
+
+        cn, self._canary = self._canary, None
+        self.engine.set_tick_monitor(None)
+        bad = cn["serial"]
+        self._vetoed.add(bad)
+        prev_serial, prev_w = self._prev
+        self._prev = None
+        self.engine._rebind_weights(prev_w)
+        # the bad serial's ticks wrote into resident K/V caches (NaN, if
+        # poisoned — which survives the -inf validity mask): scrub them
+        # so every FRESH admission is bitwise the old model again.
+        # Streams in flight at rollback are tainted either way — their
+        # tails ran on the bad serial.
+        self.engine._scrub_caches()
+        self.serial = int(prev_serial)
+        self.engine.metrics.inc("model_rollbacks")
+        self.engine.metrics.set_gauge("model_serial", self.serial)
+        observe.emit("model.rollback", serial=int(prev_serial),
+                     from_serial=int(bad), reason=reason)
+
+    def _promote(self) -> None:
+        """Probation survived: release serial N's buffers."""
+        from .. import observe
+
+        cn, self._canary = self._canary, None
+        self.engine.set_tick_monitor(None)
+        self._prev = None
+        observe.emit("model.promote", serial=int(cn["serial"]),
+                     requests=self.canary_requests)
+
+    # ------------------------------------------------------------------
+    # background watcher
+    # ------------------------------------------------------------------
+
+    def start(self, poll_s: Optional[float] = None) -> None:
+        """Start the daemon watcher thread (idempotent)."""
+        from ..fluid import envcontract as _ec
+
+        if self._watcher is not None and self._watcher.is_alive():
+            return
+        interval = float(poll_s if poll_s is not None
+                         else _ec.get("PADDLE_SERVE_SWAP_POLL_S"))
+        self._stop_evt.clear()
+
+        def loop():
+            from .. import observe
+
+            while not self._stop_evt.wait(interval):
+                try:
+                    self.poll_once()
+                except Exception:
+                    # the watcher must never take down the engine it
+                    # feeds — log the incident and keep watching
+                    import traceback
+
+                    observe.emit("model.watcher_error",
+                                 error=traceback.format_exc(limit=3))
+
+        self._watcher = threading.Thread(target=loop, daemon=True,
+                                         name="model-registry-watcher")
+        self._watcher.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop_evt.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=timeout_s)
+            self._watcher = None

@@ -1,0 +1,499 @@
+"""Zero-downtime hot model swap (ISSUE 16): registry watcher over the
+``_SUCCESS`` serial protocol, drain/immediate in-flight policies, the
+cross-topology reshard-on-load seam, canary auto-rollback on the poison
+oracle and on SLO breaches, and the bounded-drain ``DrainTimeout``
+contract on both engines.
+
+Oracles:
+ - IMMEDIATE swap mid-generation: the in-flight request finishes its
+   full budget (zero shed), ``bucket_compiles`` stays exactly flat
+   across the swap (fixed-executable-set invariant), and fresh traffic
+   serves the new weights;
+ - DRAIN swap mid-generation: the resident request's tokens are BITWISE
+   the single-version serial-N output, the request submitted during the
+   drain window queues (zero shed) and is bitwise serial-N+1;
+ - watcher fallback: a torn/shape-drifted serial that IS committed gets
+   skipped with ``model.swap_skipped``; an unmarked dir is invisible;
+ - a serial written sharded under a dp2 mesh record is ingested by this
+   single-chip replica via ``reshard.assemble_logical``;
+ - ``PADDLE_FAULT_CKPT_POISON_SERIAL`` commits an all-NaN serial WITH a
+   valid marker (both writers), the canary sentinel trips on the first
+   probation tick, rolls back, vetoes the serial, and post-rollback
+   traffic is bitwise the pre-swap engine (K/V scrub).
+
+One module-scoped engine serves most tests; an autouse fixture rebinds
+the original weights (and scrubs caches) after each test so swaps can't
+leak across assertions.  Definition order is load-bearing under the
+tier-1 ``-p no:randomly`` contract: the DrainTimeout tests sit LAST
+because draining is terminal — the decode one spends the module engine,
+the batch one builds its own predictor.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.fluid import fault as _fault
+from paddle_tpu.models import transformer
+from paddle_tpu.serving import (DecodeEngine, DrainTimeout, ModelRegistry,
+                                load_serial_weights, write_weights_serial)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(slots=4, max_len=192, buckets=(4, 8)):
+    return transformer.DecodeModel(cfg=transformer.decode_lm_config(),
+                                   max_slots=slots, max_len=max_len,
+                                   prefill_buckets=list(buckets))
+
+
+def _prompts(n, rng_seed=0, length=3, vocab=64):
+    rng = np.random.RandomState(rng_seed)
+    return [[int(t) for t in rng.randint(2, vocab - 1, size=length)]
+            for _ in range(n)]
+
+
+def _perturb(weights, seed=1, scale=0.05):
+    """A 'newer training serial': same shapes, visibly different floats."""
+    rng = np.random.RandomState(seed)
+    out = {}
+    for name in sorted(weights):
+        a = np.asarray(weights[name])
+        if np.issubdtype(a.dtype, np.floating):
+            out[name] = (a + scale * rng.normal(size=a.shape)
+                         ).astype(a.dtype)
+        else:
+            out[name] = np.array(a, copy=True)
+    return out
+
+
+def _events(root, name):
+    from paddle_tpu.observe.fleet import fleet_events
+
+    observe.get_sink().flush()
+    return [r for r in fleet_events(str(root)) if r.get("event") == name]
+
+
+@pytest.fixture(scope="module")
+def eng():
+    engine = DecodeEngine(_model())
+    engine.warmup()
+    yield engine
+    engine.shutdown()
+
+
+@pytest.fixture(scope="module")
+def w0(eng):
+    return eng.snapshot_weights(eng.model.weight_names())
+
+
+@pytest.fixture(autouse=True)
+def _restore_weights(eng, w0):
+    yield
+    eng.set_tick_monitor(None)
+    eng.resume_admissions()
+    with eng._dispatch_lock:
+        eng._rebind_weights(w0)
+        eng._scrub_caches()
+
+
+def _wait(pred, timeout_s=10.0):
+    deadline = time.perf_counter() + timeout_s
+    while not pred():
+        if time.perf_counter() > deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# loader + watcher discovery
+# ---------------------------------------------------------------------------
+
+
+def test_serial_roundtrip_and_shape_gate(eng, w0, tmp_path):
+    """write_weights_serial commits under _SUCCESS; load_serial_weights
+    round-trips bitwise and rejects architecture drift as IOError."""
+    root = str(tmp_path)
+    w1 = _perturb(w0, seed=2)
+    cur = write_weights_serial(root, 0, w1)
+    assert os.path.exists(os.path.join(cur, "_SUCCESS"))
+    names = list(w0)
+    got, info = load_serial_weights(cur, names,
+                                    {n: np.asarray(w0[n]).shape
+                                     for n in names})
+    assert info["source"] == "flat"
+    for n in names:
+        np.testing.assert_array_equal(got[n], w1[n])
+    # a serial from a DIFFERENT architecture is corrupt by definition
+    with pytest.raises(IOError):
+        load_serial_weights(cur, names,
+                            {names[0]: (3, 3)})
+    with pytest.raises(IOError):
+        load_serial_weights(cur, names + ["no_such_weight"])
+
+
+def test_watcher_fallback_torn_unmarked_corrupt(eng, w0, tmp_path):
+    """Newest-first discovery with the load_checkpoint trust rule: a
+    committed-but-torn serial and a committed shape-drifted serial are
+    skipped (model.swap_skipped), an unmarked dir is invisible, and the
+    watcher lands on the newest serial that actually loads."""
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    name0 = sorted(w0)[0]
+    w1 = _perturb(w0, seed=3)
+    write_weights_serial(root, 1, w1)
+    # serial 2: committed, but one weight file is torn garbage
+    d2 = write_weights_serial(root, 2, _perturb(w0, seed=4))
+    with open(os.path.join(d2, name0), "wb") as f:
+        f.write(b"this is not an npy file")
+    # serial 3: fully written but NO _SUCCESS -> must be invisible
+    d3 = write_weights_serial(root, 3, _perturb(w0, seed=5))
+    os.remove(os.path.join(d3, "_SUCCESS"))
+    # serial 4: committed, but one weight has the wrong shape
+    w4 = _perturb(w0, seed=6)
+    w4[name0] = np.zeros((3, 3), np.float32)
+    write_weights_serial(root, 4, w4)
+
+    reg = ModelRegistry(eng, root, policy="immediate", canary_requests=0,
+                        serial=0)
+    assert reg.complete_serials() == [1, 2, 4]
+    assert reg.poll_once() == 1
+    assert reg.serial == 1
+    got = eng.snapshot_weights([name0])[name0]
+    np.testing.assert_array_equal(got, w1[name0])
+    skipped = _events(tmp_path / "obs", "model.swap_skipped")
+    assert [r["serial"] for r in skipped] == [4, 2]  # newest-first
+    swaps = _events(tmp_path / "obs", "model.swap")
+    assert [r["serial"] for r in swaps] == [1]
+    # nothing newer and loadable: the watcher stays put
+    assert reg.poll_once() is None
+
+
+# ---------------------------------------------------------------------------
+# in-flight policies
+# ---------------------------------------------------------------------------
+
+
+def test_immediate_swap_mid_generation_no_shed_flat_compiles(
+        eng, w0, tmp_path):
+    """Acceptance: swap while a stream is mid-generation under the
+    immediate policy — the stream finishes its full budget (zero shed,
+    zero failures), bucket_compiles stays exactly flat, the serial gauge
+    moves, and fresh traffic serves the new weights."""
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    p = _prompts(2, rng_seed=9)
+    base = eng.generate(p[0], 8)
+    write_weights_serial(root, 1, _perturb(w0, seed=7))
+    reg = ModelRegistry(eng, root, policy="immediate", canary_requests=0,
+                        serial=0)
+    m0 = eng.metrics.snapshot()
+    assert m0["model_serial"] == 0
+
+    fut = eng.submit(p[1], 48)
+    assert _wait(lambda: eng._n_active > 0)  # stream is resident
+    assert reg.poll_once() == 1              # swap under a live slot
+    toks = fut.result(timeout=60)
+    assert len(toks) == 48                   # finished, never shed
+
+    m1 = eng.metrics.snapshot()
+    assert m1["bucket_compiles"] == m0["bucket_compiles"]
+    assert m1["failed"] == m0["failed"]
+    assert m1["shed"] == m0["shed"]
+    assert m1["model_serial"] == 1
+    assert m1["model_swaps"] == m0["model_swaps"] + 1
+    assert eng.generate(p[0], 8) != base     # new weights actually serve
+    ev = _events(tmp_path / "obs", "model.swap")
+    assert ev and ev[-1]["serial"] == 1 and ev[-1]["from_serial"] == 0
+    assert ev[-1]["policy"] == "immediate" and ev[-1]["source"] == "flat"
+
+
+def test_drain_swap_is_bitwise_single_version(eng, w0, tmp_path):
+    """Acceptance: under the drain policy a mid-generation request
+    finishes BITWISE on serial N, a request submitted during the drain
+    window queues (zero shed) and runs bitwise on serial N+1."""
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    w1 = _perturb(w0, seed=11)
+    write_weights_serial(root, 1, w1)
+    reg = ModelRegistry(eng, root, policy="drain", canary_requests=0,
+                        serial=0)
+    (pA, pB) = _prompts(2, rng_seed=13)
+    ref_a = eng.decode_static([(pA, 48)])[0][0]  # pure serial-0 output
+    m0 = eng.metrics.snapshot()
+
+    fut_a = eng.submit(pA, 48)
+    assert _wait(lambda: eng._n_active > 0)
+    swapped = []
+    th = threading.Thread(target=lambda: swapped.append(reg.poll_once()))
+    th.start()                                   # blocks in the drain
+    assert _wait(lambda: eng._paused)            # admissions are held
+    fut_b = eng.submit(pB, 8)                    # queues -- NOT shed
+    out_a = fut_a.result(timeout=60)
+    th.join(timeout=60)
+    out_b = fut_b.result(timeout=60)
+
+    assert swapped == [1]
+    assert out_a == ref_a                        # finished wholly on N
+    ref_b = eng.decode_static([(pB, 8)])[0][0]   # engine is now pure N+1
+    assert out_b == ref_b
+    m1 = eng.metrics.snapshot()
+    assert m1["shed"] == m0["shed"] and m1["failed"] == m0["failed"]
+    assert m1["bucket_compiles"] == m0["bucket_compiles"]
+    ev = _events(tmp_path / "obs", "model.swap")
+    assert ev[-1]["policy"] == "drain" and ev[-1]["drained"] is True
+
+
+def test_cross_topology_sharded_serial_swap(eng, w0, tmp_path):
+    """A serial written SHARDED under a dp2 mesh record (the trainer
+    fleet's layout) is assembled to full logical arrays and hot-swapped
+    into this single-chip replica — the PR 14 reshard-on-load seam."""
+    from paddle_tpu.parallel import multihost as mh
+    from paddle_tpu.parallel.mesh import mesh_from_spec
+
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    w1 = _perturb(w0, seed=15)
+    mesh = mesh_from_spec("dp2")
+    mh.save_sharded_serial(dict(w1), root, serial=1, mesh=mesh)
+    meta_path = os.path.join(root, "checkpoint_1", "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    assert dict(meta["mesh_axes"]) == {"dp": 2}  # topology is on record
+
+    reg = ModelRegistry(eng, root, policy="immediate", canary_requests=0,
+                        serial=0)
+    assert reg.poll_once() == 1
+    got = eng.snapshot_weights(list(w0))
+    for n in sorted(w0):
+        np.testing.assert_array_equal(got[n], np.asarray(w1[n]))
+    ev = _events(tmp_path / "obs", "model.swap")
+    assert ev[-1]["source"] == "sharded"
+    assert ev[-1]["from_mesh"] == {"dp": 2}
+
+
+# ---------------------------------------------------------------------------
+# canary + auto-rollback
+# ---------------------------------------------------------------------------
+
+
+def test_poisoned_serial_canary_auto_rollback(eng, w0, tmp_path):
+    """Acceptance: the forced-bad-checkpoint oracle.  The poisoned
+    serial commits WITH a valid marker, loads (the loader must not
+    screen it), trips the non-finite sentinel on its first probation
+    tick, auto-rolls back to the retained weights, vetoes the serial
+    forever, and post-rollback traffic is bitwise the pre-swap engine —
+    while every request in the window still got served."""
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    prompts = _prompts(3, rng_seed=21)
+    base = [eng.generate(p, 6) for p in prompts]
+    m0 = eng.metrics.snapshot()
+
+    _fault.install(_fault.FaultPlan(ckpt_poison_serial=1))
+    try:
+        cur = write_weights_serial(root, 1, _perturb(w0, seed=17))
+    finally:
+        _fault.clear()
+    assert os.path.exists(os.path.join(cur, "_SUCCESS"))
+    wts, _ = load_serial_weights(cur, list(w0))
+    assert all(np.isnan(np.asarray(a)).all() for a in wts.values()
+               if np.issubdtype(np.asarray(a).dtype, np.floating))
+
+    reg = ModelRegistry(eng, root, policy="immediate", canary_requests=8,
+                        serial=0)
+    assert reg.poll_once() == 1
+    assert eng.metrics.snapshot()["model_serial"] == 1
+    out = eng.generate(prompts[0], 6)  # first probation traffic
+    assert len(out) == 6               # served, not shed (tainted content)
+    assert _wait(lambda: reg.serial == 0)
+
+    assert reg.vetoed() == [1]
+    assert reg.poll_once() is None     # the veto is permanent
+    m1 = eng.metrics.snapshot()
+    assert m1["model_serial"] == 0     # gauge restored
+    assert m1["model_rollbacks"] == m0["model_rollbacks"] + 1
+    # the K/V scrub makes fresh admissions bitwise the old model again
+    after = [eng.generate(p, 6) for p in prompts]
+    assert after == base
+    rb = _events(tmp_path / "obs", "model.rollback")
+    assert rb and rb[-1]["from_serial"] == 1 and rb[-1]["serial"] == 0
+    assert rb[-1]["reason"] == "nonfinite_logits"
+    assert _events(tmp_path / "obs", "model.canary")
+
+
+def test_healthy_canary_promotes_then_next_serial_swaps(eng, w0, tmp_path):
+    """A healthy serial survives probation: model.promote fires once the
+    completion budget is met, the retained weights are released, and the
+    registry moves on to newer serials (one canary at a time until
+    then)."""
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    write_weights_serial(root, 1, _perturb(w0, seed=23))
+    reg = ModelRegistry(eng, root, policy="immediate", canary_requests=2,
+                        serial=0)
+    assert reg.poll_once() == 1
+    write_weights_serial(root, 2, _perturb(w0, seed=24))
+    assert reg.poll_once() is None       # probation: one canary at a time
+    for p in _prompts(2, rng_seed=31):
+        assert len(eng.generate(p, 6)) == 6
+    # probation budget met -> the next poll settles the promotion and is
+    # then free to pick up serial 2 (which starts ITS probation)
+    assert reg.poll_once() == 2
+    promoted = _events(tmp_path / "obs", "model.promote")
+    assert [r["serial"] for r in promoted] == [1]
+    assert reg.serial == 2 and reg.vetoed() == []
+
+
+def test_slo_breach_during_probation_rolls_back(
+        eng, w0, tmp_path, monkeypatch):
+    """A canary that is numerically healthy but violates the serving SLO
+    (deterministically: the decode-stall fault inflates every tick) must
+    be rolled back by the watchdog-breach sentinel."""
+    monkeypatch.setenv("PADDLE_SLO", "1")
+    monkeypatch.setenv("PADDLE_SLO_COOLDOWN_S", "0.0")
+    observe.configure(str(tmp_path / "obs"), flush_s=60.0)
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    # healthy ticks build the watchdog's rolling baseline pre-swap
+    eng.generate(_prompts(1, rng_seed=41)[0], 12)
+    write_weights_serial(root, 1, _perturb(w0, seed=25))
+    reg = ModelRegistry(eng, root, policy="immediate", canary_requests=50,
+                        serial=0)
+    assert reg.poll_once() == 1
+    try:
+        _fault.install(_fault.FaultPlan(decode_stall_ms=120.0))
+        eng.generate(_prompts(1, rng_seed=42)[0], 4)
+    finally:
+        _fault.clear()
+    assert _wait(lambda: reg.serial == 0)
+    rb = _events(tmp_path / "obs", "model.rollback")
+    assert rb and rb[-1]["reason"].startswith("slo_breach:")
+    assert reg.vetoed() == [1]
+
+
+# ---------------------------------------------------------------------------
+# trainer-side poison oracle + smoke tool
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_checkpoint_poison_oracle(tmp_path):
+    """PADDLE_FAULT_CKPT_POISON_SERIAL on the TRAINER writer: serial 0
+    commits with a valid _SUCCESS while every float persistable is NaN —
+    structurally perfect, numerically garbage."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import trainer as trainer_mod
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ckpt = str(tmp_path / "ckpt")
+    _fault.install(_fault.FaultPlan(ckpt_poison_serial=0))
+    try:
+        serial = trainer_mod.save_checkpoint(exe, ckpt,
+                                             fluid.default_main_program())
+    finally:
+        _fault.clear()
+    assert serial == 0
+    cur = os.path.join(ckpt, "checkpoint_0")
+    assert os.path.exists(os.path.join(cur, "_SUCCESS"))
+    poisoned = 0
+    for name in os.listdir(cur):
+        path = os.path.join(cur, name)
+        try:
+            arr = np.load(path, allow_pickle=False)
+        except Exception:
+            continue
+        if np.issubdtype(arr.dtype, np.floating):
+            assert np.isnan(arr).all(), name
+            poisoned += 1
+    assert poisoned >= 2  # fc weight + bias at minimum
+
+
+def test_swap_smoke_tool_runs_clean():
+    """tools/swap_smoke.py is the tier-1 smoke: serve -> commit N+1 ->
+    hot swap with zero shed -> poison N+2 -> auto-rollback, executable
+    set closed throughout."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    try:
+        import tools.swap_smoke as smoke
+
+        report = smoke.main()
+    finally:
+        sys.path.remove(REPO)
+    assert report["ok"], report
+
+
+# ---------------------------------------------------------------------------
+# bounded drain (LAST: throwaway engines, wedged on purpose)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_drain_timeout_names_stuck_requests(eng):
+    """drain(timeout_s) on a wedged decode engine returns False and
+    fails every outstanding future with DrainTimeout listing the stuck
+    request ids — callers never block forever.  Draining is terminal:
+    this reuses the module engine and MUST stay the last decode test in
+    the file (the fixture's shutdown still works on a drained engine)."""
+    try:
+        _fault.install(_fault.FaultPlan(decode_stall_ms=400.0))
+        futs = [eng.submit(p, 40) for p in _prompts(2, rng_seed=51)]
+        assert eng.drain(timeout_s=0.4) is False
+        for fut in futs:
+            with pytest.raises(DrainTimeout) as exc_info:
+                fut.result(timeout=60)
+            assert exc_info.value.request_ids  # stuck rids are named
+            assert all(r.startswith("d") for r in
+                       exc_info.value.request_ids)
+    finally:
+        _fault.clear()
+
+
+def test_batch_engine_drain_timeout_names_stuck_requests(tmp_path):
+    """Same bounded-drain contract on the batch ServingEngine, wedged
+    via the serve-delay fault."""
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.fluid.executor as _executor
+    from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    h = fluid.layers.fc(img, size=8, act="relu")
+    pred_out = fluid.layers.fc(h, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(str(tmp_path), ["img"], [pred_out], exe)
+    _executor._global_scope = _executor.Scope()
+
+    pred = create_paddle_predictor(AnalysisConfig(
+        model_dir=str(tmp_path), use_tpu=False, enable_serving=True,
+        serving_max_batch_size=4, serving_max_wait_ms=5.0))
+    engine = pred._engine
+    engine.warmup()
+    row = np.random.RandomState(0).normal(size=(1, 16)).astype(np.float32)
+    try:
+        _fault.install(_fault.FaultPlan(serve_delay_ms=2000.0))
+        fut = engine.submit([PaddleTensor(name="img", data=row)])
+        assert engine.drain(timeout_s=0.3) is False
+        with pytest.raises(DrainTimeout) as exc_info:
+            fut.result(timeout=60)
+        assert exc_info.value.request_ids
+        assert all(r.startswith("r") for r in exc_info.value.request_ids)
+    finally:
+        _fault.clear()
+        engine.shutdown(timeout_s=10.0)

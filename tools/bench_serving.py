@@ -187,6 +187,36 @@ def run_decode_bench(args) -> dict:
     budgets = [args.long_new if rng.random_sample() < 0.2
                else args.short_new for _ in range(256)]
 
+    # --swaps N: hot-swap N fresh serials THROUGH the open-loop window
+    # (ISSUE 16 acceptance: zero shed, p99 inside the no-swap band).
+    # The registry's own background watcher does the swapping; the
+    # arrival loop only commits serials on schedule, like a trainer
+    # publishing checkpoints mid-traffic.
+    reg = None
+    swap_serials = []
+    n_swaps = int(getattr(args, "swaps", 0) or 0)
+    if n_swaps > 0:
+        import tempfile
+
+        from paddle_tpu.serving import ModelRegistry, write_weights_serial
+
+        swap_root = tempfile.mkdtemp(prefix="bench_swap_")
+        w0 = eng.snapshot_weights(model.weight_names())
+        prng = np.random.RandomState(1)
+
+        def _serial_weights():
+            return {n: (np.asarray(a)
+                        + 0.01 * prng.normal(size=np.shape(a))
+                        ).astype(np.asarray(a).dtype)
+                    if np.issubdtype(np.asarray(a).dtype, np.floating)
+                    else np.array(a, copy=True)
+                    for n, a in w0.items()}
+
+        reg = ModelRegistry(eng, swap_root, policy=args.swap_policy,
+                            canary_requests=0, serial=0)
+        reg.start(poll_s=0.1)
+        _write_serial = write_weights_serial
+
     results = {"ok": 0, "shed": 0, "err": 0}
     rlock = threading.Lock()
 
@@ -198,13 +228,23 @@ def run_decode_bench(args) -> dict:
                 results["err"] += 1
 
     period = 1.0 / args.qps
-    t_end = time.perf_counter() + args.duration
-    next_fire = time.perf_counter()
+    t_start = time.perf_counter()
+    t_end = t_start + args.duration
+    next_fire = t_start
+    # commit serials at evenly spaced points INSIDE the window so every
+    # swap happens under live load, none in the drain tail
+    commit_at = [t_start + args.duration * (i + 1) / (n_swaps + 1)
+                 for i in range(n_swaps)]
     sent = 0
     while True:
         now = time.perf_counter()
         if now >= t_end:
             break
+        if commit_at and now >= commit_at[0]:
+            commit_at.pop(0)
+            serial = len(swap_serials) + 1
+            _write_serial(swap_root, serial, _serial_weights())
+            swap_serials.append(serial)
         if now < next_fire:
             time.sleep(min(next_fire - now, 0.002))
             continue
@@ -217,6 +257,14 @@ def run_decode_bench(args) -> dict:
         except EngineOverloaded:
             with rlock:
                 results["shed"] += 1
+    if reg is not None:
+        # give the watcher one beat to ingest the last committed serial,
+        # then stop it before the drain (no swaps against an empty engine)
+        deadline = time.perf_counter() + 5.0
+        while swap_serials and reg.serial < swap_serials[-1] \
+                and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        reg.stop()
     eng.drain(timeout_s=60.0)
     snap = eng.metrics.snapshot()
     executables = eng.executables()
@@ -249,6 +297,8 @@ def run_decode_bench(args) -> dict:
         "max_len": args.max_len,
         "short_new": args.short_new,
         "long_new": args.long_new,
+        "swaps": snap["model_swaps"] - warm["model_swaps"],
+        "swap_policy": args.swap_policy if n_swaps > 0 else None,
         "smoke": bool(args.smoke),
     }
 
@@ -278,6 +328,12 @@ def main(argv=None) -> int:
                    help="short-request token budget (80%% of arrivals)")
     p.add_argument("--long-new", type=int, default=64,
                    help="long-request token budget (20%% of arrivals)")
+    p.add_argument("--swaps", type=int, default=0,
+                   help="hot-swap this many fresh serials through the "
+                        "decode window (registry watcher; ISSUE 16)")
+    p.add_argument("--swap-policy", default="immediate",
+                   choices=["immediate", "drain"],
+                   help="in-flight policy for --swaps")
     p.add_argument("--smoke", action="store_true",
                    help="2-second CPU sanity pass for CI")
     args = p.parse_args(argv)
