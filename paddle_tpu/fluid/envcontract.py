@@ -268,6 +268,40 @@ declare("PADDLE_SERVE_SENTINEL_ENTROPY", "float", 0.05, "serving",
         "Canary sentinel floor (nats): argmax-entropy collapse below "
         "this across 3 consecutive decode ticks triggers auto-rollback")
 
+# -- serving fleet (router over N engine replicas; serving/fleet.py) --
+declare("PADDLE_ROUTER_MAX_REPLICAS", "int", 4, "router",
+        "Autoscale ceiling: replicas per model the scale-out policy may "
+        "reach (also bounded by the fleet's device pool)")
+declare("PADDLE_ROUTER_MIN_REPLICAS", "int", 1, "router",
+        "Autoscale floor: scale-in never drops a model below this")
+declare("PADDLE_ROUTER_COOLDOWN_S", "float", 5.0, "router",
+        "Seconds between scale/drain actions on one model (hysteresis: "
+        "a fresh replica must prove itself before the next decision)")
+declare("PADDLE_ROUTER_QUEUE_HIGH", "int", 8, "router",
+        "Per-model router-queue depth above which sustained pressure "
+        "reads as overload (scale-out watermark)")
+declare("PADDLE_ROUTER_QUEUE_LOW", "int", 1, "router",
+        "Per-model router-queue depth below which sustained idleness "
+        "reads as overprovisioning (scale-in watermark)")
+declare("PADDLE_ROUTER_QUEUE_HARD", "int", 64, "router",
+        "Per-model router-queue hard cap: submits beyond it shed with "
+        "EngineOverloaded — but only AFTER the scale policy has had its "
+        "chance (a poked scale-out admits the overflow while warming)")
+declare("PADDLE_ROUTER_HYSTERESIS_TICKS", "int", 2, "router",
+        "Consecutive policy evaluations a watermark must hold before "
+        "the decision fires (debounces arrival bursts)")
+declare("PADDLE_ROUTER_EVAL_S", "float", 0.25, "router",
+        "Autoscale policy evaluation interval (seconds)")
+declare("PADDLE_ROUTER_STRAGGLER_FACTOR", "float", 3.0, "router",
+        "Drain-and-replace a replica whose median inter-token latency "
+        "exceeds factor x the median of its peers (leave-one-out)")
+declare("PADDLE_ROUTER_CANARY_FRACTION", "float", 0.125, "router",
+        "Fraction of a model's traffic routed to its canary replica "
+        "while a new serial is on probation (the fleet-level x% canary)")
+declare("PADDLE_ROUTER_HB_TIMEOUT_S", "float", 2.0, "router",
+        "Replica heartbeat staleness beyond which the pool census "
+        "declares the replica dead and re-spawns it")
+
 # -- fault injection (PADDLE_FAULT_* family; deterministic test faults) --
 declare("PADDLE_FAULT_", "prefix", None, "fault",
         "Family prefix: any PADDLE_FAULT_* key is part of the injection "
@@ -338,6 +372,10 @@ declare("PADDLE_FAULT_HOST_LOSS_RANK", "int", None, "fault",
         "reads — the replacement fleet is SMALLER (mesh-ladder oracle)")
 declare("PADDLE_FAULT_HOST_LOSS_AT_STEP", "int", 0, "fault",
         "Training step at which the host-loss fault fires")
+declare("PADDLE_FAULT_REPLICA_KILL_AFTER", "int", None, "fault",
+        "Serving-fleet replica death: kill the replica that served the "
+        "n-th fleet request (one-shot) — the deterministic oracle for "
+        "the router's re-spawn + cache-hit re-warm path")
 
 # -- memory observability --
 declare("PADDLE_MEM_BUDGET_MB", "float", None, "memory",

@@ -64,6 +64,18 @@ worker processes:
                                   LADDER).  Keyed on its own rank knob like
                                   the straggler, so it composes with other
                                   rank-scoped faults in one scenario.
+    PADDLE_FAULT_REPLICA_KILL_AFTER=n
+                                  serving-fleet replica death: the fleet
+                                  consults :func:`replica_kill` after every
+                                  completed request; the call whose running
+                                  total reaches n returns True ONCE, and
+                                  the fleet kills the replica that served
+                                  that request (resident futures fail, the
+                                  pool census re-spawns it on surviving
+                                  devices) — the deterministic oracle for
+                                  the router's zero-shed failover and
+                                  cache-hit re-warm path.  Never a process
+                                  exit: a replica dies, the fleet survives.
     PADDLE_FAULT_SERVE_DELAY_MS=t sleep t ms per serving-engine request
                                   (slow-model / GC-pause simulation on the
                                   inference path)
@@ -142,7 +154,7 @@ __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "ckpt_poison",
     "io_delay",
-    "barrier_stall", "serving_request", "decode_stall",
+    "barrier_stall", "serving_request", "decode_stall", "replica_kill",
     "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
     "shard_corrupt", "mem_pressure_bytes", "straggler_delay",
@@ -175,6 +187,7 @@ class FaultPlan:
                  barrier_stall_s: float = 0.0,
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
                  decode_stall_ms: float = 0.0,
+                 replica_kill_after: Optional[int] = None,
                  cache_corrupt: bool = False,
                  data_stall_ms: float = 0.0,
                  data_stall_at: Optional[int] = None,
@@ -208,6 +221,8 @@ class FaultPlan:
         self.serve_delay_ms = float(serve_delay_ms)
         self.serve_fail_every = int(serve_fail_every)
         self.decode_stall_ms = float(decode_stall_ms)
+        self.replica_kill_after = None if replica_kill_after is None \
+            else int(replica_kill_after)
         self.cache_corrupt = bool(cache_corrupt)
         self.data_stall_ms = float(data_stall_ms)
         self.data_stall_at = None if data_stall_at is None \
@@ -224,6 +239,7 @@ class FaultPlan:
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
+        self._replica_kill_fired = False
         self._nan_fired = False
         self._stall_fired = False
         self._serve_count = 0
@@ -245,6 +261,7 @@ class FaultPlan:
         spike = env.get("PADDLE_FAULT_LOSS_SPIKE_STEP", "").strip()
         stall_at = env.get("PADDLE_FAULT_DATA_STALL_AT", "").strip()
         poison = env.get("PADDLE_FAULT_CKPT_POISON_SERIAL", "").strip()
+        rkill = env.get("PADDLE_FAULT_REPLICA_KILL_AFTER", "").strip()
         return cls(
             kill_step=int(kill) if kill else None,
             ckpt_crash=env.get("PADDLE_FAULT_CKPT_CRASH", "").strip() or None,
@@ -261,6 +278,7 @@ class FaultPlan:
             serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
             serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
             decode_stall_ms=getf("PADDLE_FAULT_DECODE_STALL_MS"),
+            replica_kill_after=int(rkill) if rkill else None,
             cache_corrupt=env.get("PADDLE_FAULT_CACHE_CORRUPT", "").strip()
             .lower() in ("1", "true", "yes"),
             data_stall_ms=getf("PADDLE_FAULT_DATA_STALL_MS"),
@@ -545,6 +563,29 @@ def decode_stall(n_ticks: int = 1) -> None:
             or not plan._applies_to_this_rank():
         return
     time.sleep(plan.decode_stall_ms * max(1, int(n_ticks)) / 1000.0)
+
+
+def replica_kill(served_total: int) -> bool:
+    """Serving-fleet replica-death oracle, consulted by the fleet after
+    every completed request with the fleet-wide served total.  True
+    EXACTLY ONCE, when the total first reaches ``replica_kill_after`` —
+    the fleet then kills the replica that served that request (its
+    resident futures fail, the pool census re-spawns it on surviving
+    devices).  Deliberately never a process exit, whatever ``mode`` says:
+    the fault models a dead replica inside a living fleet, and an
+    ``os._exit`` would take the router and every other replica with it."""
+    plan = active()
+    if plan is None or plan.replica_kill_after is None \
+            or plan._replica_kill_fired \
+            or not plan._applies_to_this_rank():
+        return False
+    if int(served_total) < plan.replica_kill_after:
+        return False
+    plan._replica_kill_fired = True
+    from .log import LOG
+
+    LOG(f"fault: replica kill after {served_total} served requests")
+    return True
 
 
 def cache_corrupt() -> bool:

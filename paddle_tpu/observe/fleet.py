@@ -28,9 +28,10 @@ import time
 from typing import Dict, List, Optional
 
 from .events import merge_events
+from .registry import split_name
 
 __all__ = ["scan_dir", "fleet_snapshot", "fleet_events", "write_fleet",
-           "rank_skew", "follow_events"]
+           "rank_skew", "follow_events", "label_sums"]
 
 METRICS_GLOB = "metrics-*.json"
 EVENTS_GLOB = "events-*.jsonl"
@@ -104,6 +105,35 @@ def fleet_snapshot(root: str) -> dict:
 def fleet_events(root: str) -> List[dict]:
     """Every worker generation's events, one wall-clock-ordered stream."""
     return merge_events(scan_dir(root)["events"])
+
+
+def label_sums(counters: Dict[str, float], key: str,
+               prefix: str = "") -> Dict[str, Dict[str, float]]:
+    """Group a flat counter/gauge dict by one label dimension (ISSUE 17
+    satellite): ``label value -> {base metric name -> summed value}``.
+
+    Serving replicas mirror their counters into the process registry
+    with ``model=``/``replica=`` labels (``serving.completed{model=
+    "chat",replica="chat-r1"}``); this is the structured join the fleet
+    view does over them — per-model (``key="model"``) or per-replica
+    (``key="replica"``) sums via :func:`~paddle_tpu.observe.registry.
+    split_name`, never by string-parsing metric names.  Metrics without
+    the label are skipped; remaining labels (e.g. ``replica`` inside a
+    per-model sum) are summed over.  ``prefix`` filters base names
+    (``"serving."`` for the serving family)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for rendered, v in counters.items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        name, labels = split_name(rendered)
+        if prefix and not name.startswith(prefix):
+            continue
+        val = dict(labels).get(key)
+        if val is None:
+            continue
+        bucket = out.setdefault(val, {})
+        bucket[name] = bucket.get(name, 0) + v
+    return out
 
 
 def _median(vals) -> float:

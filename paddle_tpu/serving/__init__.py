@@ -23,12 +23,17 @@ from .decode import DecodeConfig, DecodeEngine, create_decode_engine
 from .engine import (DrainTimeout, EngineClosed, EngineOverloaded,
                      RequestTimeout, ServingConfig, ServingEngine,
                      create_serving_engine)
+from .fleet import (AutoscalePolicy, Decision, DevicePool, ModelSignals,
+                    Replica, ServingFleet)
 from .metrics import ServingMetrics
 from .registry import (ModelRegistry, load_serial_weights,
                        write_weights_serial)
+from .router import Router, RouterConfig
 
 __all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
            "EngineOverloaded", "RequestTimeout", "EngineClosed",
            "DrainTimeout", "create_serving_engine",
            "DecodeEngine", "DecodeConfig", "create_decode_engine",
-           "ModelRegistry", "load_serial_weights", "write_weights_serial"]
+           "ModelRegistry", "load_serial_weights", "write_weights_serial",
+           "ServingFleet", "Router", "RouterConfig", "AutoscalePolicy",
+           "ModelSignals", "Decision", "DevicePool", "Replica"]

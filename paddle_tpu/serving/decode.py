@@ -104,7 +104,7 @@ class DecodeEngine:
     manager or call ``shutdown()``."""
 
     def __init__(self, model=None, config: Optional[DecodeConfig] = None,
-                 place=None):
+                 place=None, metrics_labels: Optional[Dict[str, str]] = None):
         from ..fluid import envcontract as _ec
 
         if not _ec.get("PADDLE_SERVE_DECODE"):
@@ -117,7 +117,10 @@ class DecodeEngine:
             model = DecodeModel()
         self.model = model
         self.config = config or DecodeConfig()
-        self.metrics = ServingMetrics()
+        # metrics_labels (e.g. {"model": ..., "replica": ...}) dimension
+        # this engine's process-registry mirrors so a fleet of engines
+        # stays separable in one registry (serving/fleet.py sets them)
+        self.metrics = ServingMetrics(labels=metrics_labels)
         from ..fluid import core as _core
         from ..fluid.executor import Executor, Scope
 
@@ -151,6 +154,12 @@ class DecodeEngine:
         if srv is not None:
             srv.add_provider(self.metrics.export_snapshot)
             srv.add_health(self._health)
+
+    @property
+    def alive(self) -> bool:
+        """False once the engine stopped (shutdown, kill, worker death) —
+        the fleet census's liveness probe."""
+        return not self._stopped and self._worker.is_alive()
 
     def _health(self) -> dict:
         with self._cond:
@@ -481,26 +490,135 @@ class DecodeEngine:
         fixed set: one decode step + one per warmed prefill bucket)."""
         return len(self._exe._cache)
 
-    def warmup(self) -> int:
+    def _warm_fingerprints(self) -> Dict[str, str]:
+        """Content fingerprints of the fixed executable set, keyed
+        ``prefill:<bucket>`` / ``step`` — the decode twin of the batch
+        engine's bucket fingerprints.  The model builds its programs
+        rename-invariantly from a deterministic seed, so two separately
+        constructed engines over the same config (fleet replicas) hash
+        identically and share store entries.  Empty dict on any
+        fingerprint failure (caller falls back to full dispatch)."""
+        from .. import compile_cache as _cc
+
+        model = self.model
+        fps: Dict[str, str] = {}
+        try:
+            for b in model.prefill_buckets:
+                fps[f"prefill:{int(b)}"] = _cc.program_fingerprint(
+                    model.prefill_program(b),
+                    feeds=[(model.PF_SLOT, (1,), "int64"),
+                           (model.PF_TOKENS, (1, int(b)), "int64")],
+                    fetches=[],
+                    extra={"kind": "decode_prefill", "bucket": int(b)})
+            step_feed = self._tick_feeds([None] * model.max_slots)
+            fps["step"] = _cc.program_fingerprint(
+                model.step_program,
+                feeds=sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in step_feed.items()),
+                fetches=[model.step_fetch, model.logits_fetch],
+                extra={"kind": "decode_step"})
+        except Exception:
+            return {}
+        return fps
+
+    def _write_warm_manifest(self, fps: Dict[str, str]) -> None:
+        """Atomic (tmp + rename) decode warmup manifest next to the batch
+        engine's bucket manifests under ``<store>/serving/``; never fails
+        warmup.  A re-spawned replica's cold start is driven by the SAME
+        store entries, the manifest records what the set was."""
+        import json
+        import os
+
+        from .. import compile_cache as _cc
+
+        store = _cc.get_store()
+        if store is None or "step" not in fps:
+            return
+        model = self.model
+        manifest = {
+            "version": 1,
+            "created": time.time(),
+            "kind": "decode",
+            "max_slots": int(model.max_slots),
+            "max_len": int(model.max_len),
+            "prefill_buckets": [int(b) for b in model.prefill_buckets],
+            "fingerprints": dict(fps),
+        }
+        try:
+            path = store.serving_manifest_path(f"decode-{fps['step']}")
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1)
+            os.replace(tmp, path)
+        except Exception:
+            pass
+
+    def warmup(self, only_missing: Optional[bool] = None) -> int:
         """Precompile the ENTIRE fixed executable set — the one decode
         step plus every prefill bucket — before traffic, so steady state
         never compiles (any later ``bucket_compiles`` growth is a bug:
-        an unplanned shape reached the executor).  Safe to call again;
-        returns the executable count."""
+        an unplanned shape reached the executor).
+
+        With the persistent compile cache enabled (``only_missing`` left
+        at its default), programs whose fingerprints are already in the
+        store are NOT dispatched: a prior process — or another replica of
+        the same model — compiled them into the shared backend cache, so
+        a scale-out/re-spawned replica's warm is cache-hit-only
+        (``warmup_cached`` counts up, ``warmup_dispatches`` stays 0; the
+        executable loads from the store on first use).
+        ``only_missing=False`` forces full dispatch.
+
+        Safe to call again; returns the executable count."""
+        from .. import compile_cache as _cc
+
+        store = _cc.get_store()
+        if only_missing is None:
+            only_missing = store is not None
         model = self.model
+        fps = self._warm_fingerprints() if store is not None else {}
+
+        def _cached(key: str) -> bool:
+            fp = fps.get(key)
+            return bool(only_missing and store is not None
+                        and fp is not None and store.get(fp) is not None)
+
+        def _record(key: str, program, meta: dict) -> None:
+            fp = fps.get(key)
+            if store is None or fp is None:
+                return
+            try:  # cache bookkeeping never fails warmup
+                store.put(fp, program.serialize_to_string(), meta)
+            except Exception:
+                pass
+
         with self._dispatch_lock:
             for b in model.prefill_buckets:
+                key = f"prefill:{int(b)}"
+                if _cached(key):
+                    self.metrics.inc("warmup_cached")
+                    continue
                 self._run(model.prefill_program(b),
                           {model.PF_TOKENS: np.zeros((1, b), np.int64),
                            model.PF_SLOT: np.zeros((1,), np.int64)}, [])
                 self.metrics.inc("warmup_dispatches")
-            self._step_dispatch([None] * model.max_slots)
-            self.metrics.inc("warmup_dispatches")
+                _record(key, model.prefill_program(b),
+                        {"kind": "decode_prefill", "bucket": int(b)})
+            if _cached("step"):
+                self.metrics.inc("warmup_cached")
+            else:
+                self._step_dispatch([None] * model.max_slots)
+                self.metrics.inc("warmup_dispatches")
+                _record("step", model.step_program,
+                        {"kind": "decode_step"})
+        self._write_warm_manifest(fps)
         from .. import observe
 
         observe.emit("serving.warmup", kind="decode",
                      prefill_buckets=model.prefill_buckets,
                      max_slots=model.max_slots, max_len=model.max_len,
+                     dispatched=self.metrics.counter("warmup_dispatches"),
+                     cached=self.metrics.counter("warmup_cached"),
                      executables=self.executables())
         return self.executables()
 
@@ -717,6 +835,28 @@ class DecodeEngine:
         self._worker.join(timeout=timeout_s)
         return ok
 
+    def kill(self, join_timeout_s: float = 10.0) -> List[str]:
+        """Hard stop WITHOUT drain — the replica-death path (crash
+        simulation: ``PADDLE_FAULT_REPLICA_KILL_AFTER``, exercised by
+        ``serving/fleet.py``).  Every queued and resident request fails
+        with :class:`EngineClosed` when the worker exits; the fleet's
+        router re-enqueues those, so a killed replica never sheds.
+        Returns the request ids that were in flight."""
+        with self._cond:
+            in_flight = [r.rid for r in
+                         list(self._queue) + [s for s in self._slots
+                                              if s is not None]
+                         if not r.future.done()]
+            self._stopped = True
+            self._cond.notify_all()
+        if threading.current_thread() is not self._worker:
+            self._worker.join(timeout=join_timeout_s)
+        from .. import observe
+
+        observe.emit("serving.engine_killed", kind="decode",
+                     in_flight=len(in_flight))
+        return in_flight
+
     def __enter__(self):
         return self
 
@@ -726,6 +866,7 @@ class DecodeEngine:
 
 
 def create_decode_engine(cfg=None, config: Optional[DecodeConfig] = None,
+                         metrics_labels: Optional[Dict[str, str]] = None,
                          **model_kwargs) -> DecodeEngine:
     """Build a DecodeEngine over a fresh step-form decode model.  ``cfg``
     is a transformer Config (default: CPU-test-scale decode LM);
@@ -733,4 +874,5 @@ def create_decode_engine(cfg=None, config: Optional[DecodeConfig] = None,
     prefill_buckets default from the env contract)."""
     from ..models.transformer import DecodeModel
 
-    return DecodeEngine(DecodeModel(cfg=cfg, **model_kwargs), config)
+    return DecodeEngine(DecodeModel(cfg=cfg, **model_kwargs), config,
+                        metrics_labels=metrics_labels)

@@ -1,0 +1,1023 @@
+"""Serving fleet: N engine replicas x M models behind one router
+(ISSUE 17 tentpole).
+
+Everything below PR 15/16 serves from ONE engine: one replica's worth of
+slots, one model, and the PR 16 canary is time-sliced (the whole replica
+probes the new serial).  This module is the fleet layer those PRs were
+built for:
+
+ - **ReplicaPool lifecycle** (:class:`Replica`, inside
+   :class:`ServingFleet`): ``spawn -> warm -> ready -> draining|dead``.
+   A scale-out replica warms from the SAME persistent compile store the
+   first replica populated (PR 4): with the cache enabled its cold start
+   is cache-hit-only — ``warmup_dispatches == 0``, ``warmup_cached ==
+   executable set`` — so added capacity is serving in milliseconds, not
+   a compile storm.  Replica death is detected by the PR 14 census
+   machinery (``elastic.write_heartbeat`` files going stale +
+   ``host_loss_markers``, plus the in-process ``engine.alive`` probe);
+   the dead replica's device is marked lost in the :class:`DevicePool`
+   and a replacement spawns on a surviving device.
+ - **Router** (:mod:`paddle_tpu.serving.router`): per-model bounded
+   queues, least-loaded dispatch over live slot/queue gauges,
+   end-to-end deadlines, and requeue-on-replica-death — a killed
+   replica's in-flight requests fail over to survivors with ZERO shed.
+ - **AutoscalePolicy** (pure, unit-testable): consumes queue depth,
+   SLO-breach counts, warming-replica counts and per-replica inter-token
+   p50s and distinguishes *queue pressure* (scale out) from *compile
+   stall* (capacity already warming: wait) from a *straggling replica*
+   (drain + replace).  Hysteresis ticks and a scale cooldown keep it
+   from flapping; every knob is ``PADDLE_ROUTER_*`` in the env
+   contract.  The router's queue-overflow "last chance" hook bypasses
+   the hysteresis (emergency scale-out), which is what guarantees a
+   ``fleet.scale_out`` event strictly before the first ``fleet.shed``.
+ - **Fleet-level canary**: one replica per watched model runs the PR 16
+   :class:`~paddle_tpu.serving.registry.ModelRegistry`; while its
+   probation runs, the fleet routes exactly the canary fraction of that
+   model's traffic to it (every k-th request, ``k = round(1 /
+   PADDLE_ROUTER_CANARY_FRACTION)``) and the OTHER replicas never see
+   serial N+1 — a poisoned serial rolls back on the canary replica
+   (sentinel/breach, PR 16) and the rest of the fleet is bitwise
+   unaffected.  A survived probation promotes FLEET-WIDE: the serial is
+   loaded once and drain-swapped into every sibling replica.
+
+Events: ``fleet.spawn`` / ``fleet.replica_ready`` /
+``fleet.replica_dead`` / ``fleet.scale_out`` / ``fleet.scale_in`` /
+``fleet.drain_replica`` / ``fleet.shed`` (router) /
+``fleet.canary_start`` / ``fleet.canary_rollback`` /
+``fleet.canary_promote`` / ``fleet.rollout``.  Gauges:
+``fleet.replicas{model=}`` / ``fleet.queue_depth{model=}``; each
+replica's engine mirrors its serving counters with ``model=``/
+``replica=`` labels (``observe.fleet.label_sums`` joins them).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .router import Router, RouterConfig
+
+__all__ = ["DevicePool", "Replica", "ModelSignals", "Decision",
+           "AutoscalePolicy", "ServingFleet",
+           "SPAWNING", "WARMING", "READY", "DRAINING", "DEAD"]
+
+# replica lifecycle states
+SPAWNING = "spawning"   # factory building the engine
+WARMING = "warming"     # engine up, precompiling / cache-loading
+READY = "ready"         # taking traffic
+DRAINING = "draining"   # planned exit: finishing resident work
+DEAD = "dead"           # gone (killed, crashed, or retired)
+
+#: slot-utilization floor below which an idle queue reads as overcapacity
+_SCALE_IN_UTILIZATION = 0.25
+
+#: program construction goes through process-global framework state
+#: (default-program/unique-name scopes), so concurrent replica spawns
+#: serialize their build+warm section; with the shared compile store a
+#: follow-up replica's warm is cache-hit-only, so the critical section
+#: is short for everything after the first replica of an architecture
+_BUILD_LOCK = threading.Lock()
+
+
+def _emit(event: str, **fields) -> None:
+    from .. import observe
+
+    observe.emit(event, **fields)
+
+
+def _median(vals) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+# ---------------------------------------------------------------------------
+# device pool
+# ---------------------------------------------------------------------------
+
+
+class DevicePool:
+    """Shared logical device pool the whole fleet leases from.  A lost
+    device (its replica died / its host dropped a loss marker) is never
+    re-leased — re-spawn happens on surviving devices only, exactly the
+    elastic supervisor's survivor-census rule applied to serving."""
+
+    def __init__(self, n_devices: Optional[int] = None):
+        if n_devices is None:
+            try:
+                import jax
+
+                n_devices = max(4, jax.device_count())
+            except Exception:
+                n_devices = 4
+        self.n_devices = int(n_devices)
+        self._lock = threading.Lock()
+        self._leased: set = set()
+        self._lost: set = set()
+
+    def acquire(self) -> Optional[int]:
+        with self._lock:
+            for d in range(self.n_devices):
+                if d not in self._leased and d not in self._lost:
+                    self._leased.add(d)
+                    return d
+            return None
+
+    def release(self, device: int) -> None:
+        with self._lock:
+            self._leased.discard(int(device))
+
+    def mark_lost(self, device: int) -> None:
+        """Permanently retire a device (unplanned replica death)."""
+        with self._lock:
+            self._leased.discard(int(device))
+            self._lost.add(int(device))
+
+    def available(self) -> int:
+        with self._lock:
+            return self.n_devices - len(self._leased) - len(self._lost)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"n_devices": self.n_devices,
+                    "leased": sorted(self._leased),
+                    "lost": sorted(self._lost),
+                    "available": self.n_devices - len(self._leased)
+                    - len(self._lost)}
+
+
+# ---------------------------------------------------------------------------
+# one replica
+# ---------------------------------------------------------------------------
+
+
+class Replica:
+    """One engine replica of one model: lifecycle + liveness reporting.
+
+    ``factory(metrics_labels)`` builds the engine (a
+    :class:`~paddle_tpu.serving.decode.DecodeEngine`); the labels carry
+    ``model=``/``replica=`` so the process registry keeps every
+    replica's serving counters separable.  The replica heartbeats into
+    the fleet's ``hb_dir`` via the elastic worker protocol
+    (``hb_<rank>`` files, atomic rename); the heartbeat thread dies
+    with the engine, so a killed replica's file goes stale and the
+    census flags it even without the in-process ``alive`` probe."""
+
+    def __init__(self, model_id: str, name: str, rank: int, device: int,
+                 factory: Callable, hb_dir: Optional[str] = None,
+                 hb_interval_s: float = 0.25):
+        self.model_id = str(model_id)
+        self.name = str(name)
+        self.rank = int(rank)
+        self.device = int(device)
+        self.state = SPAWNING
+        self.engine = None
+        self.served = 0
+        self.planned_exit = False
+        self.accounted = False  # census has processed this death
+        self.death_reason: Optional[str] = None
+        self.t_spawn = time.perf_counter()
+        self._factory = factory
+        self._hb_dir = hb_dir
+        self._hb_interval_s = float(hb_interval_s)
+        self._dead_once = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    def start(self, on_ready: Optional[Callable] = None) -> None:
+        """Spawn asynchronously: build engine, warm, go READY."""
+        self._thread = threading.Thread(
+            target=self._spawn, args=(on_ready,), daemon=True,
+            name=f"replica-spawn-{self.name}")
+        self._thread.start()
+
+    def _spawn(self, on_ready) -> None:
+        _emit("fleet.spawn", model=self.model_id, replica=self.name,
+              device=self.device, rank=self.rank)
+        try:
+            with _BUILD_LOCK:
+                self.engine = self._factory({"model": self.model_id,
+                                             "replica": self.name})
+                self.state = WARMING
+                self.engine.warmup()
+        except Exception as exc:
+            self.state = DEAD
+            self.death_reason = f"spawn_failed: {exc!r}"
+            _emit("fleet.replica_error", model=self.model_id,
+                  replica=self.name, error=repr(exc))
+            return
+        self.state = READY
+        self._heartbeat()
+        m = self.engine.metrics
+        _emit("fleet.replica_ready", model=self.model_id,
+              replica=self.name, device=self.device,
+              warmup_dispatches=m.counter("warmup_dispatches"),
+              warmup_cached=m.counter("warmup_cached"),
+              executables=self.engine.executables(),
+              dur_s=round(time.perf_counter() - self.t_spawn, 6))
+        if self._hb_dir:
+            threading.Thread(target=self._hb_loop, daemon=True,
+                             name=f"replica-hb-{self.name}").start()
+        if on_ready is not None:
+            try:
+                on_ready(self)
+            except Exception:
+                pass
+
+    def _heartbeat(self) -> None:
+        if not self._hb_dir:
+            return
+        from ..parallel import elastic as _elastic
+
+        _elastic.write_heartbeat(self._hb_dir, step=self.served,
+                                 rank=self.rank)
+
+    def _hb_loop(self) -> None:
+        while self.state in (READY, DRAINING):
+            eng = self.engine
+            if eng is None or not eng.alive:
+                return  # dead engine: let the file go stale
+            self._heartbeat()
+            time.sleep(self._hb_interval_s)
+
+    # -- traffic (router-facing) --
+
+    def load(self) -> float:
+        """Dispatch-cost estimate: resident slots + engine queue depth
+        (the live gauges the engines already keep — racy reads are fine
+        for load balancing)."""
+        eng = self.engine
+        if eng is None or self.state != READY:
+            return float("inf")
+        return eng._n_active + len(eng._queue)
+
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int,
+               timeout_ms: Optional[float] = None):
+        """Forward one request to the engine; runs the replica-kill
+        fault hook against the served-request count (the deterministic
+        replica-death oracle: ``PADDLE_FAULT_REPLICA_KILL_AFTER=n``
+        kills THIS replica right after its n-th accepted request — the
+        request fails over through the router like any crash)."""
+        from ..fluid import fault as _fault
+
+        eng = self.engine
+        if eng is None:
+            from .engine import EngineClosed
+
+            raise EngineClosed(f"replica {self.name} has no engine")
+        fut = eng.submit(prompt_ids, max_new_tokens,
+                         timeout_ms=timeout_ms)
+        self.served += 1
+        if _fault.replica_kill(self.served):
+            self.die("fault_injected")
+        return fut
+
+    # -- death / retirement --
+
+    def die(self, reason: str) -> None:
+        """Hard-kill the replica (crash semantics): the engine stops
+        without drain, every in-flight future fails with EngineClosed
+        and fails over through the router."""
+        if self._dead_once.is_set():
+            return
+        self._dead_once.set()
+        self.state = DEAD
+        self.death_reason = reason
+        eng = self.engine
+        if eng is not None:
+            try:
+                eng.kill()
+            except Exception:
+                pass
+        _emit("fleet.replica_dead", model=self.model_id,
+              replica=self.name, device=self.device, reason=reason,
+              served=self.served)
+
+    def note_dead(self) -> None:
+        """Router-side death report (an EngineClosed future): converge
+        the state without double-emitting."""
+        eng = self.engine
+        if eng is not None and eng.alive:
+            return  # transient (e.g. drain-rejected submit): not death
+        self.die(self.death_reason or "engine_closed")
+
+    def retire(self, drain_timeout_s: float = 30.0) -> bool:
+        """Planned exit (scale-in / straggler replacement): drain
+        resident work, shut down, release nothing here — the fleet owns
+        the device lease."""
+        self.planned_exit = True
+        self.state = DRAINING
+        eng = self.engine
+        ok = True
+        if eng is not None:
+            try:
+                ok = eng.shutdown(timeout_s=drain_timeout_s)
+            except Exception:
+                ok = False
+        self._dead_once.set()  # planned: no fleet.replica_dead event
+        self.state = DEAD
+        self.death_reason = "retired"
+        return ok
+
+
+# ---------------------------------------------------------------------------
+# autoscale policy (pure)
+# ---------------------------------------------------------------------------
+
+
+class ModelSignals:
+    """One model's observed state at one policy tick — plain data, so
+    :class:`AutoscalePolicy` stays enginelessly unit-testable.
+
+    ``breaches`` is CUMULATIVE (the SLO watchdog's running count as
+    visible to this model); the policy differentiates it internally.
+    ``intertoken_p50_ms`` maps replica name -> that replica's rolling
+    inter-token p50 (None/missing entries are skipped)."""
+
+    def __init__(self, queue_depth: int = 0, replicas_ready: int = 1,
+                 replicas_warming: int = 0, slots_active: int = 0,
+                 slots_total: int = 0, breaches: int = 0,
+                 intertoken_p50_ms: Optional[Dict[str, float]] = None):
+        self.queue_depth = int(queue_depth)
+        self.replicas_ready = int(replicas_ready)
+        self.replicas_warming = int(replicas_warming)
+        self.slots_active = int(slots_active)
+        self.slots_total = int(slots_total)
+        self.breaches = int(breaches)
+        self.intertoken_p50_ms = dict(intertoken_p50_ms or {})
+
+
+class Decision:
+    """One policy verdict: ``action`` in ``none | wait | scale_out |
+    scale_in | drain_replica`` (+ ``replica`` for drain)."""
+
+    def __init__(self, action: str, reason: str = "",
+                 replica: Optional[str] = None):
+        self.action = action
+        self.reason = reason
+        self.replica = replica
+
+    def __repr__(self):
+        extra = f", replica={self.replica!r}" if self.replica else ""
+        return f"Decision({self.action!r}, {self.reason!r}{extra})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Decision)
+                and (self.action, self.replica)
+                == (other.action, other.replica))
+
+
+class _ModelPolicyState:
+    __slots__ = ("over_ticks", "under_ticks", "last_breaches",
+                 "last_scale", "birth")
+
+    def __init__(self):
+        self.over_ticks = 0
+        self.under_ticks = 0
+        self.last_breaches = 0
+        self.last_scale = float("-inf")
+        self.birth = None  # first decide() stamp: scale-in grace anchor
+
+
+class AutoscalePolicy:
+    """Breach-driven autoscaling, pure: ``decide(model_id, signals,
+    now)`` -> :class:`Decision`.  Signal precedence:
+
+    1. **warming replica** -> ``wait``: queue pressure while capacity is
+       already compiling/cache-loading is a *compile stall*, not a
+       capacity gap — scaling again would thrash the device pool.
+    2. **straggling replica** (>= 2 ready, per-replica inter-token p50
+       exceeds ``straggler_factor`` x the leave-one-out median of its
+       siblings) -> ``drain_replica``: one slow replica drags the
+       fleet p99 no matter how many healthy siblings it has.
+    3. **pressure** (queue depth > ``queue_high`` OR the cumulative
+       breach count advanced since the last tick) sustained
+       ``hysteresis_ticks`` consecutive ticks -> ``scale_out``, bounded
+       by ``max_replicas`` and the ``cooldown_s`` since the last scaling
+       action.
+    4. **idle** (queue depth <= ``queue_low`` AND slot utilization under
+       25%) sustained the same hysteresis -> ``scale_in`` down to
+       ``min_replicas``.
+
+    All knobs default from the ``PADDLE_ROUTER_*`` env contract;
+    constructor args override (tests pass exact values + explicit
+    ``now`` timestamps, so runs are fully deterministic)."""
+
+    def __init__(self, max_replicas: Optional[int] = None,
+                 min_replicas: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 queue_high: Optional[int] = None,
+                 queue_low: Optional[int] = None,
+                 hysteresis_ticks: Optional[int] = None,
+                 straggler_factor: Optional[float] = None):
+        from ..fluid import envcontract as _ec
+
+        def knob(v, name):
+            return v if v is not None else _ec.get(name)
+
+        self.max_replicas = int(knob(max_replicas,
+                                     "PADDLE_ROUTER_MAX_REPLICAS"))
+        self.min_replicas = int(knob(min_replicas,
+                                     "PADDLE_ROUTER_MIN_REPLICAS"))
+        self.cooldown_s = float(knob(cooldown_s,
+                                     "PADDLE_ROUTER_COOLDOWN_S"))
+        self.queue_high = int(knob(queue_high,
+                                   "PADDLE_ROUTER_QUEUE_HIGH"))
+        self.queue_low = int(knob(queue_low, "PADDLE_ROUTER_QUEUE_LOW"))
+        self.hysteresis_ticks = int(knob(
+            hysteresis_ticks, "PADDLE_ROUTER_HYSTERESIS_TICKS"))
+        self.straggler_factor = float(knob(
+            straggler_factor, "PADDLE_ROUTER_STRAGGLER_FACTOR"))
+        self._state: Dict[str, _ModelPolicyState] = {}
+
+    def _st(self, model_id: str) -> _ModelPolicyState:
+        return self._state.setdefault(str(model_id), _ModelPolicyState())
+
+    def decide(self, model_id: str, sig: ModelSignals,
+               now: float) -> Decision:
+        st = self._st(model_id)
+        if st.birth is None:
+            st.birth = now
+        breach_delta = max(0, sig.breaches - st.last_breaches)
+        st.last_breaches = sig.breaches
+        # 1. capacity already on its way: never stack scale decisions
+        #    on top of a warming replica (the compile-stall branch)
+        if sig.replicas_warming > 0:
+            st.over_ticks = 0
+            st.under_ticks = 0
+            return Decision("wait", "replica_warming")
+        # 2. straggler: leave-one-out median over the sibling p50s
+        p50s = {k: float(v) for k, v in sig.intertoken_p50_ms.items()
+                if isinstance(v, (int, float))}
+        if len(p50s) >= 2 and sig.replicas_ready >= 2 \
+                and now - st.last_scale >= self.cooldown_s:
+            for name, own in sorted(p50s.items()):
+                others = [v for k, v in p50s.items() if k != name]
+                base = _median(others)
+                if base > 0.0 and own > base * self.straggler_factor:
+                    st.last_scale = now
+                    st.over_ticks = 0
+                    st.under_ticks = 0
+                    return Decision(
+                        "drain_replica",
+                        f"straggler: p50 {own:.1f}ms vs sibling median "
+                        f"{base:.1f}ms (x{own / base:.1f})", replica=name)
+        # 3/4. pressure vs idle, with hysteresis + cooldown
+        over = sig.queue_depth > self.queue_high or breach_delta > 0
+        under = (sig.queue_depth <= self.queue_low
+                 and sig.slots_active
+                 <= sig.slots_total * _SCALE_IN_UTILIZATION)
+        st.over_ticks = st.over_ticks + 1 if over else 0
+        st.under_ticks = st.under_ticks + 1 if under and not over else 0
+        if st.over_ticks >= self.hysteresis_ticks:
+            if sig.replicas_ready + sig.replicas_warming \
+                    >= self.max_replicas:
+                return Decision("none", "at_max_replicas")
+            if now - st.last_scale < self.cooldown_s:
+                return Decision("wait", "cooldown")
+            st.last_scale = now
+            st.over_ticks = 0
+            return Decision("scale_out",
+                            "slo_breach" if breach_delta > 0
+                            else "queue_pressure")
+        if st.under_ticks >= self.hysteresis_ticks:
+            if sig.replicas_ready <= self.min_replicas:
+                return Decision("none", "at_min_replicas")
+            # scale-in honors a startup grace too (now - birth): a fleet
+            # must not retire a just-warmed replica before traffic has
+            # had one cooldown window to show up
+            if now - st.last_scale < self.cooldown_s \
+                    or now - st.birth < self.cooldown_s:
+                return Decision("none", "cooldown")
+            st.last_scale = now
+            st.under_ticks = 0
+            return Decision("scale_in", "idle")
+        return Decision("none", "")
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+
+class _ModelState:
+    """Per-model fleet bookkeeping (replicas list, canary wiring)."""
+
+    def __init__(self, model_id: str, factory: Callable,
+                 initial_replicas: int):
+        self.model_id = model_id
+        self.factory = factory
+        self.initial_replicas = int(initial_replicas)
+        self.replicas: List[Replica] = []
+        self.rseq = itertools.count()
+        self.spawn_lock = threading.Lock()
+        # canary wiring (None until watch_checkpoints)
+        self.ckpt_dir: Optional[str] = None
+        self.registry = None
+        self.fleet_serial = -1
+        self.canary_routing = False
+        self.vetoed_seen = 0
+
+    def ready(self) -> List[Replica]:
+        return [r for r in list(self.replicas) if r.state == READY]
+
+    def warming(self) -> List[Replica]:
+        return [r for r in list(self.replicas)
+                if r.state in (SPAWNING, WARMING)]
+
+    def canary_replica(self) -> Optional[Replica]:
+        reg = self.registry
+        if reg is None:
+            return None
+        for r in list(self.replicas):
+            if r.engine is reg.engine:
+                return r
+        return None
+
+
+class ServingFleet:
+    """The serving-side supervisor: owns the replicas, the router and
+    the policy loop.  ``model_factories`` maps model id -> a callable
+    ``factory(metrics_labels) -> DecodeEngine`` (each call must build an
+    INDEPENDENT engine; deterministic factories give bitwise-identical
+    replicas, which is what makes failover invisible to clients).
+
+    ::
+
+        fleet = ServingFleet({"chat": make_chat, "code": make_code},
+                             replicas=2, hb_dir=tmp)
+        fleet.start()                      # spawn + warm every replica
+        fut = fleet.submit("chat", [2, 3], 8, timeout_ms=2000)
+        fleet.watch_checkpoints("chat", ckpt_dir)   # fleet canary
+        fleet.shutdown()
+    """
+
+    def __init__(self, model_factories: Dict[str, Callable],
+                 replicas=1,
+                 device_pool: Optional[DevicePool] = None,
+                 hb_dir: Optional[str] = None,
+                 policy: Optional[AutoscalePolicy] = None,
+                 router_config: Optional[RouterConfig] = None,
+                 canary_fraction: Optional[float] = None,
+                 canary_requests: Optional[int] = None,
+                 eval_s: Optional[float] = None,
+                 hb_timeout_s: Optional[float] = None,
+                 drain_timeout_s: float = 30.0):
+        from ..fluid import envcontract as _ec
+
+        if not model_factories:
+            raise ValueError("model_factories must name at least one "
+                             "model")
+        n_for = (replicas if isinstance(replicas, dict)
+                 else {m: int(replicas) for m in model_factories})
+        self._models: Dict[str, _ModelState] = {
+            str(m): _ModelState(str(m), f, n_for.get(m, 1))
+            for m, f in model_factories.items()}
+        self.hb_dir = hb_dir
+        self.policy = policy or AutoscalePolicy()
+        # default pool: room for every model at max scale plus one
+        # respawn device per model (a dead replica's device is lost)
+        self.pool = device_pool or DevicePool(
+            len(self._models) * (self.policy.max_replicas + 1))
+        self.eval_s = float(eval_s if eval_s is not None
+                            else _ec.get("PADDLE_ROUTER_EVAL_S"))
+        self.hb_timeout_s = float(
+            hb_timeout_s if hb_timeout_s is not None
+            else _ec.get("PADDLE_ROUTER_HB_TIMEOUT_S"))
+        self.canary_fraction = float(
+            canary_fraction if canary_fraction is not None
+            else _ec.get("PADDLE_ROUTER_CANARY_FRACTION"))
+        self.canary_requests = canary_requests
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._rank = itertools.count()
+        self._stop_evt = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.router = Router(self._select, router_config,
+                             last_chance=self._last_chance)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self, wait_ready_s: Optional[float] = 60.0) -> None:
+        """Spawn the initial replica set and the monitor loop; blocks
+        (up to ``wait_ready_s``) until every model has one READY
+        replica."""
+        for ms in self._models.values():
+            for _ in range(ms.initial_replicas):
+                self._spawn(ms, reason="initial")
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="fleet-monitor")
+        self._monitor.start()
+        if wait_ready_s:
+            deadline = time.perf_counter() + float(wait_ready_s)
+            for ms in self._models.values():
+                while not ms.ready() and ms.warming() \
+                        and time.perf_counter() < deadline:
+                    time.sleep(0.01)
+
+    def submit(self, model_id: str, prompt_ids: Sequence[int],
+               max_new_tokens: int, timeout_ms: Optional[float] = None):
+        return self.router.submit(model_id, prompt_ids, max_new_tokens,
+                                  timeout_ms=timeout_ms)
+
+    def generate(self, model_id: str, prompt_ids: Sequence[int],
+                 max_new_tokens: int,
+                 timeout_ms: Optional[float] = None) -> List[int]:
+        return self.submit(model_id, prompt_ids, max_new_tokens,
+                           timeout_ms=timeout_ms).result()
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=timeout_s)
+        self.router.drain(timeout_s=timeout_s)
+        self.router.stop()
+        for ms in self._models.values():
+            for r in list(ms.replicas):
+                if r.state in (READY, DRAINING, WARMING, SPAWNING):
+                    r.retire(drain_timeout_s=min(timeout_s, 10.0))
+                self.pool.release(r.device)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # ------------------------------------------------------------------
+    # routing policy (router callbacks)
+    # ------------------------------------------------------------------
+
+    def _select(self, model_id: str, seq: int):
+        """Replica candidates for one dispatch.  While a canary
+        probation runs, the canary replica gets EXACTLY every k-th
+        request (k from the canary fraction) and is excluded from the
+        rest — the traffic split that keeps the blast radius of a bad
+        serial to its slice."""
+        ms = self._models.get(str(model_id))
+        if ms is None:
+            return []
+        ready = ms.ready()
+        if ms.canary_routing:
+            canary = ms.canary_replica()
+            if canary is not None and canary.state == READY:
+                every = max(1, int(round(1.0 / max(
+                    self.canary_fraction, 1e-6))))
+                if seq % every == 0:
+                    return [canary]
+                rest = [r for r in ready if r is not canary]
+                return rest or ready
+        return ready
+
+    def _last_chance(self, model_id: str) -> bool:
+        """Router queue-overflow hook: the scale policy's emergency
+        path.  Accept the overflow whenever capacity is already warming
+        or an emergency scale-out can fire NOW (hysteresis and cooldown
+        deliberately bypassed — a hard-limit overflow IS the sustained
+        signal); shed only when the fleet is genuinely at its ceiling.
+
+        Called from client threads under the router lock: touches only
+        replica-list snapshots and the device pool (its own lock) —
+        never the router."""
+        ms = self._models.get(str(model_id))
+        if ms is None:
+            return False
+        if ms.warming():
+            return True
+        live = len(ms.ready()) + len(ms.warming())
+        if live >= self.policy.max_replicas:
+            return False
+        rep = self._spawn(ms, reason="queue_hard")
+        if rep is None:
+            return False
+        _emit("fleet.scale_out", model=ms.model_id, reason="queue_hard",
+              replica=rep.name, replicas=live + 1, emergency=True)
+        return True
+
+    # ------------------------------------------------------------------
+    # replica lifecycle
+    # ------------------------------------------------------------------
+
+    def _spawn(self, ms: _ModelState, reason: str) -> Optional[Replica]:
+        with ms.spawn_lock:
+            device = self.pool.acquire()
+            if device is None:
+                _emit("fleet.spawn_blocked", model=ms.model_id,
+                      reason="no_device", pool=self.pool.summary())
+                return None
+            rep = Replica(ms.model_id, f"{ms.model_id}-r{next(ms.rseq)}",
+                          rank=next(self._rank), device=device,
+                          factory=ms.factory, hb_dir=self.hb_dir,
+                          hb_interval_s=max(0.05, self.hb_timeout_s / 4))
+            ms.replicas.append(rep)
+        rep.start(on_ready=lambda _r: self.router.kick())
+        return rep
+
+    def _retire(self, ms: _ModelState, rep: Replica,
+                reason: str) -> None:
+        def run():
+            rep.retire(drain_timeout_s=self.drain_timeout_s)
+            self.pool.release(rep.device)
+            self.router.kick()
+
+        rep.planned_exit = True
+        rep.state = DRAINING
+        threading.Thread(target=run, daemon=True,
+                         name=f"replica-retire-{rep.name}").start()
+
+    # ------------------------------------------------------------------
+    # the monitor loop: census -> canary -> policy
+    # ------------------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_evt.wait(self.eval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                import traceback
+
+                _emit("fleet.monitor_error",
+                      error=traceback.format_exc(limit=3))
+
+    def poll_once(self) -> None:
+        """One monitor step (tests/tools drive it synchronously)."""
+        now = time.monotonic()
+        for ms in self._models.values():
+            self._census(ms)
+            self._canary_step(ms)
+            self._policy_step(ms, now)
+            self._note_gauges(ms)
+
+    # -- census --
+
+    def _census(self, ms: _ModelState) -> None:
+        """Death detection: the in-process liveness probe plus the
+        PR 14 heartbeat/census protocol (stale ``hb_<rank>`` files and
+        ``host_lost_*`` markers) — so the fleet converges on the same
+        evidence whether the replica died in-process or its whole host
+        went away.  An unplanned death marks the device lost and spawns
+        a replacement on a surviving device."""
+        from ..parallel import elastic as _elastic
+
+        lost_markers = (_elastic.host_loss_markers(self.hb_dir)
+                        if self.hb_dir else [])
+        for rep in list(ms.replicas):
+            if rep.state in (READY, DRAINING) and not rep.planned_exit:
+                # silent-death detection over the live set
+                dead_reason = None
+                eng = rep.engine
+                if eng is None or not eng.alive:
+                    dead_reason = rep.death_reason or "engine_dead"
+                elif any(m.endswith(f"_r{rep.rank}")
+                         for m in lost_markers):
+                    dead_reason = "host_lost"
+                elif self.hb_dir:
+                    hb = _elastic.read_heartbeat(self.hb_dir, rep.rank)
+                    if hb is not None and \
+                            time.time() - float(hb.get("ts", 0)) \
+                            > self.hb_timeout_s:
+                        dead_reason = "heartbeat_stale"
+                if dead_reason is not None:
+                    rep.die(dead_reason)
+            # account every unplanned death exactly once, however it
+            # was reported (census probe, router EngineClosed, fault
+            # hook, manual die()): retire the device, spawn replacement
+            if rep.state != DEAD or rep.planned_exit or rep.accounted:
+                continue
+            rep.accounted = True
+            self.pool.mark_lost(rep.device)
+            live = len(ms.ready()) + len(ms.warming())
+            floor = max(self.policy.min_replicas, ms.initial_replicas)
+            if live < min(floor, self.policy.max_replicas):
+                new = self._spawn(ms, reason="respawn")
+                if new is not None:
+                    _emit("fleet.respawn", model=ms.model_id,
+                          dead=rep.name, replica=new.name,
+                          device=new.device,
+                          reason=rep.death_reason or "unknown")
+
+    # -- fleet canary --
+
+    def watch_checkpoints(self, model_id: str, ckpt_dir: str,
+                          serial: Optional[int] = None) -> None:
+        """Arm the fleet canary for one model: a designated replica
+        watches ``ckpt_dir`` through the PR 16 :class:`ModelRegistry`
+        (canary probation, sentinel, auto-rollback); the fleet routes
+        the canary traffic slice to it and rolls a SURVIVED serial out
+        fleet-wide.  ``serial`` seeds the currently-served version
+        (default: whatever the registry discovers first)."""
+        ms = self._models[str(model_id)]
+        ms.ckpt_dir = str(ckpt_dir)
+        if serial is not None:
+            ms.fleet_serial = int(serial)
+        self._ensure_registry(ms)
+
+    def _ensure_registry(self, ms: _ModelState) -> None:
+        if ms.ckpt_dir is None:
+            return
+        reg = ms.registry
+        if reg is not None:
+            rep = ms.canary_replica()
+            if rep is not None and rep.state in (READY, DRAINING):
+                return
+            # canary replica died: the registry died with it
+            ms.registry = None
+            ms.canary_routing = False
+        candidates = ms.ready()
+        if not candidates:
+            return
+        from .registry import ModelRegistry
+
+        host = candidates[0]
+        ms.registry = ModelRegistry(
+            host.engine, ms.ckpt_dir,
+            canary_requests=self.canary_requests,
+            serial=ms.fleet_serial)
+        ms.vetoed_seen = len(ms.registry.vetoed())
+        _emit("fleet.canary_host", model=ms.model_id, replica=host.name,
+              serial=ms.fleet_serial)
+
+    def _canary_step(self, ms: _ModelState) -> None:
+        self._ensure_registry(ms)
+        reg = ms.registry
+        if reg is None:
+            return
+        try:
+            reg.poll_once()
+        except Exception:
+            import traceback
+
+            _emit("fleet.canary_error", model=ms.model_id,
+                  error=traceback.format_exc(limit=3))
+            return
+        canary = ms.canary_replica()
+        vetoed = reg.vetoed()
+        if len(vetoed) > ms.vetoed_seen:
+            # the sentinel rolled the canary replica back: the rest of
+            # the fleet never saw the bad serial — nothing to undo
+            ms.vetoed_seen = len(vetoed)
+            ms.canary_routing = False
+            _emit("fleet.canary_rollback", model=ms.model_id,
+                  serial=int(vetoed[-1]),
+                  replica=canary.name if canary else None,
+                  fleet_serial=ms.fleet_serial)
+            return
+        if reg.canary_active():
+            if not ms.canary_routing:
+                ms.canary_routing = True
+                _emit("fleet.canary_start", model=ms.model_id,
+                      serial=int(reg.serial),
+                      replica=canary.name if canary else None,
+                      fraction=self.canary_fraction)
+            return
+        ms.canary_routing = False
+        if reg.serial > ms.fleet_serial:
+            # probation survived (or canary disabled): promote fleet-wide
+            serial = int(reg.serial)
+            _emit("fleet.canary_promote", model=ms.model_id,
+                  serial=serial,
+                  replica=canary.name if canary else None)
+            self._rollout(ms, serial)
+
+    def _rollout(self, ms: _ModelState, serial: int) -> None:
+        """Drain-swap a promoted serial into every sibling replica:
+        loaded from disk ONCE, then rebound engine by engine (pause ->
+        idle -> swap -> resume: zero shed, every request single-
+        version)."""
+        from ..fluid.trainer import CKPT_PREFIX
+        from .registry import load_serial_weights
+
+        canary = ms.canary_replica()
+        targets = [r for r in ms.ready() if r is not canary]
+        swapped = []
+        weights = None
+        for rep in targets:
+            eng = rep.engine
+            try:
+                if weights is None:
+                    names = list(eng.model.weight_names())
+                    shapes = {n: tuple(np.shape(a)) for n, a in
+                              eng.snapshot_weights(names).items()}
+                    weights, _info = load_serial_weights(
+                        os.path.join(ms.ckpt_dir,
+                                     f"{CKPT_PREFIX}_{int(serial)}"),
+                        names, shapes)
+                eng.pause_admissions()
+                try:
+                    eng.wait_idle(self.drain_timeout_s)
+                    eng.swap_weights(weights)
+                finally:
+                    eng.resume_admissions()
+                eng.metrics.inc("model_swaps")
+                eng.metrics.set_gauge("model_serial", int(serial))
+                swapped.append(rep.name)
+            except Exception as exc:
+                _emit("fleet.rollout_error", model=ms.model_id,
+                      replica=rep.name, serial=int(serial),
+                      error=repr(exc))
+        ms.fleet_serial = int(serial)
+        _emit("fleet.rollout", model=ms.model_id, serial=int(serial),
+              replicas=swapped,
+              canary=canary.name if canary else None)
+
+    # -- autoscaling --
+
+    def _signals(self, ms: _ModelState) -> ModelSignals:
+        ready = ms.ready()
+        slots_active = 0
+        slots_total = 0
+        p50s: Dict[str, float] = {}
+        for r in ready:
+            eng = r.engine
+            slots_active += eng._n_active
+            slots_total += eng.model.max_slots
+            snap = eng.metrics.snapshot()
+            p50 = snap.get("intertoken_p50_ms")
+            if isinstance(p50, (int, float)):
+                p50s[r.name] = float(p50)
+        from ..observe import watchdog as _watchdog
+
+        wd = _watchdog.get_watchdog()
+        breaches = int(sum(wd.breaches.values())) if wd is not None \
+            else 0
+        return ModelSignals(
+            queue_depth=self.router.queue_depth(ms.model_id),
+            replicas_ready=len(ready),
+            replicas_warming=len(ms.warming()),
+            slots_active=slots_active, slots_total=slots_total,
+            breaches=breaches, intertoken_p50_ms=p50s)
+
+    def _policy_step(self, ms: _ModelState, now: float) -> None:
+        sig = self._signals(ms)
+        decision = self.policy.decide(ms.model_id, sig, now)
+        if decision.action == "scale_out":
+            rep = self._spawn(ms, reason=decision.reason)
+            if rep is not None:
+                _emit("fleet.scale_out", model=ms.model_id,
+                      reason=decision.reason, replica=rep.name,
+                      replicas=sig.replicas_ready + 1, emergency=False)
+        elif decision.action == "scale_in":
+            ready = ms.ready()
+            canary = ms.canary_replica()
+            victims = [r for r in ready if r is not canary]
+            if victims:
+                victim = max(victims, key=lambda r: r.name)
+                self._retire(ms, victim, decision.reason)
+                _emit("fleet.scale_in", model=ms.model_id,
+                      replica=victim.name, reason=decision.reason,
+                      replicas=len(ready) - 1)
+        elif decision.action == "drain_replica":
+            rep = next((r for r in ms.ready()
+                        if r.name == decision.replica), None)
+            if rep is not None:
+                replacement = self._spawn(ms, reason="straggler_replace")
+                self._retire(ms, rep, decision.reason)
+                _emit("fleet.drain_replica", model=ms.model_id,
+                      replica=rep.name, reason=decision.reason,
+                      replacement=(replacement.name
+                                   if replacement else None))
+
+    def _note_gauges(self, ms: _ModelState) -> None:
+        from ..observe import registry as _registry
+
+        reg = _registry()
+        labels = {"model": ms.model_id}
+        reg.set_gauge("fleet.replicas", len(ms.ready()), labels=labels)
+        reg.set_gauge("fleet.replicas_warming", len(ms.warming()),
+                      labels=labels)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def status(self) -> dict:
+        """Structured fleet view (tools/bench/smoke read this)."""
+        models = {}
+        for m, ms in self._models.items():
+            models[m] = {
+                "replicas": [{
+                    "name": r.name, "state": r.state,
+                    "device": r.device, "served": r.served,
+                    "death_reason": r.death_reason,
+                } for r in list(ms.replicas)],
+                "ready": len(ms.ready()),
+                "warming": len(ms.warming()),
+                "queue_depth": self.router.queue_depth(m),
+                "shed": self.router.shed_count(m),
+                "dispatched": self.router.dispatched_count(m),
+                "fleet_serial": ms.fleet_serial,
+                "canary_routing": ms.canary_routing,
+            }
+        return {"models": models, "pool": self.pool.summary()}

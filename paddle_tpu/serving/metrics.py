@@ -23,6 +23,16 @@ tool's BENCH-line format) or diff two snapshots.  Per-event wiring into
 ``fluid.profiler.record_event`` means a ``fluid.profiler.profiler()``
 context around serving traffic gets ``serving_request`` /
 ``serving_dispatch[...]`` rows in the standard aggregate table for free.
+
+Fleet label dimension (ISSUE 17 satellite): ``ServingMetrics(labels=
+{"model": ..., "replica": ...})`` stamps every GLOBAL-registry mirror
+with those labels — ``serving.completed{model="chat",replica="chat-r0"}``
+— so the fleet aggregator (``observe.fleet.label_sums``) sums per-model
+/ per-replica through the registry's structured label support instead of
+string-parsing metric names.  The PRIVATE registry stays unlabeled (it
+is per-engine by construction; ``snapshot()`` keys stay flat), and the
+SLO-watchdog feeds stay on the unlabeled series names (breach policy is
+fleet-wide).
 """
 
 from __future__ import annotations
@@ -52,8 +62,13 @@ class ServingMetrics:
                 "model_swaps", "model_rollbacks")
 
     def __init__(self, latency_window: int = 4096,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self._reg = registry or MetricsRegistry()
+        #: labels stamped on every process-registry mirror (model=/replica=
+        #: in a fleet); None keeps the single-engine flat names
+        self._labels = {str(k): str(v) for k, v in labels.items()} \
+            if labels else None
         self._lock = self._reg.lock  # one lock for registry + ring state
         for k in self.COUNTERS:
             self._reg.inc(k, 0)
@@ -78,11 +93,12 @@ class ServingMetrics:
     # -- recording --
     def inc(self, name: str, n: int = 1) -> None:
         self._reg.inc(name, n)
-        _global_registry().inc(f"serving.{name}", n)
+        _global_registry().inc(f"serving.{name}", n, labels=self._labels)
 
     def set_gauge(self, name: str, value) -> None:
         self._reg.set_gauge(name, value)
-        _global_registry().set_gauge(f"serving.{name}", value)
+        _global_registry().set_gauge(f"serving.{name}", value,
+                                     labels=self._labels)
         if name == "queue_depth":
             from ..observe import watchdog as _watchdog
 
@@ -98,14 +114,16 @@ class ServingMetrics:
                             labels={"bucket": int(bucket)})
         _global_registry().set_gauge("serving.bucket_bytes",
                                      float(peak_bytes),
-                                     labels={"bucket": int(bucket)})
+                                     labels=dict(self._labels or {},
+                                                 bucket=int(bucket)))
 
     def observe_latency(self, seconds: float) -> None:
         """One completed request's queue+execute latency."""
         with self._lock:
             self._lat[self._lat_n % self._window] = float(seconds)
             self._lat_n += 1
-        _global_registry().observe("serving.latency_s", seconds)
+        _global_registry().observe("serving.latency_s", seconds,
+                                   labels=self._labels)
         from ..observe import watchdog as _watchdog
 
         # per-request latency feeds the SLO watchdog: a p99 regression IS
@@ -123,7 +141,8 @@ class ServingMetrics:
         with self._lock:
             self._ttft[self._ttft_n % self._window] = float(seconds)
             self._ttft_n += 1
-        _global_registry().observe("serving.ttft_s", seconds)
+        _global_registry().observe("serving.ttft_s", seconds,
+                                   labels=self._labels)
         from ..observe import watchdog as _watchdog
 
         _watchdog.observe_value("serving.ttft_s", seconds)
@@ -139,7 +158,8 @@ class ServingMetrics:
         with self._lock:
             self._itl[self._itl_n % self._window] = float(seconds)
             self._itl_n += 1
-        _global_registry().observe("serving.intertoken_s", seconds)
+        _global_registry().observe("serving.intertoken_s", seconds,
+                                   labels=self._labels)
         from ..observe import watchdog as _watchdog
 
         _watchdog.observe_value("serving.intertoken_s", seconds)

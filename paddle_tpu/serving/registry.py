@@ -260,6 +260,14 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._vetoed)
 
+    def canary_active(self) -> bool:
+        """True while a swapped-in serial is still on probation — the
+        fleet reads this to route the canary traffic slice and to tell a
+        survived probation (serial advanced, canary settled) from one
+        still in flight."""
+        with self._lock:
+            return self._canary is not None
+
     # ------------------------------------------------------------------
     # the watcher step
     # ------------------------------------------------------------------

@@ -1,0 +1,386 @@
+"""Multi-model request router over N engine replicas (ISSUE 17).
+
+One :class:`Router` fronts every replica of every model a
+:class:`~paddle_tpu.serving.fleet.ServingFleet` runs.  It owns exactly
+three things:
+
+ - **Per-model bounded queues**: ``submit(model_id, ...)`` lands in the
+   model's own deque — one slow model can never convoy another model's
+   traffic behind it.  The bound is ``PADDLE_ROUTER_QUEUE_HARD``; an
+   overflowing submit is shed (:class:`EngineOverloaded`) ONLY after the
+   fleet's last-chance hook has had its say — the hook is the scale
+   policy's emergency path, so a load spike always produces a
+   ``fleet.scale_out`` before the first ``fleet.shed`` (the fleet
+   oracle).
+ - **Least-loaded dispatch**: one dispatcher thread drains the queues
+   onto live replicas, picking the READY replica with the smallest
+   (resident slots + engine queue depth) — gauges the engines already
+   keep, no probing dispatches.  Which replicas are candidates for a
+   given request is the fleet's call (``selector(model_id, seq)``):
+   that's where the canary traffic slice and draining-replica exclusion
+   live, so the router itself stays policy-free.
+ - **End-to-end deadlines + zero-shed failover**: a request's deadline
+   is fixed at submit and rides through requeues — the remaining budget
+   (never the original) is what the chosen engine gets.  When a replica
+   dies mid-request (``EngineClosed``/``DrainTimeout`` out of its
+   future), the router puts the request back at the FRONT of its queue
+   and redispatches to a survivor: a killed replica costs latency, not
+   requests.  Only ``retry_limit`` consecutive engine losses fail a
+   request — a fleet with zero live replicas must not loop forever.
+
+The router never constructs replicas and holds no model state; it
+duck-types against the :class:`~paddle_tpu.serving.fleet.Replica`
+surface (``engine``, ``name``, ``load()``, ``note_dead()``).  Tests
+drive it with bare engines wrapped in stubs.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .engine import EngineClosed, EngineOverloaded, RequestTimeout
+from .engine import DrainTimeout  # re-raised by dead-replica futures
+
+__all__ = ["Router", "RouterConfig"]
+
+
+class RouterConfig:
+    """Queue/shed policy knobs, defaulted from the env contract
+    (``PADDLE_ROUTER_*``); constructor args override for tests."""
+
+    def __init__(self, queue_hard: Optional[int] = None,
+                 default_timeout_ms: Optional[float] = None,
+                 retry_limit: int = 5,
+                 idle_wait_s: float = 0.02):
+        from ..fluid import envcontract as _ec
+
+        self.queue_hard = int(queue_hard if queue_hard is not None
+                              else _ec.get("PADDLE_ROUTER_QUEUE_HARD"))
+        self.default_timeout_ms = default_timeout_ms
+        self.retry_limit = int(retry_limit)
+        self.idle_wait_s = float(idle_wait_s)
+
+
+class _RoutedRequest:
+    __slots__ = ("model_id", "prompt", "max_new", "future", "deadline",
+                 "t_submit", "rid", "retries", "replica")
+
+    def __init__(self, model_id, prompt, max_new, future, deadline,
+                 t_submit, rid):
+        self.model_id = model_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.future = future
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.rid = rid
+        self.retries = 0
+        self.replica = None  # the replica currently generating it
+
+
+class Router:
+    """See module docstring.  ``selector(model_id, seq)`` must return
+    the replicas eligible for that model's ``seq``-th dispatch (the
+    fleet's routing policy); ``last_chance(model_id)`` is consulted on
+    queue overflow — return True to accept the request anyway (scale-out
+    under way), False to shed."""
+
+    def __init__(self, selector: Callable[[str, int], Sequence],
+                 config: Optional[RouterConfig] = None,
+                 last_chance: Optional[Callable[[str], bool]] = None):
+        self._selector = selector
+        self._last_chance = last_chance
+        self.config = config or RouterConfig()
+        self._cond = threading.Condition(threading.Lock())
+        self._queues: Dict[str, collections.deque] = {}
+        self._seq: Dict[str, itertools.count] = {}
+        self._in_flight: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
+        self._dispatched: Dict[str, int] = {}
+        self._stopped = False
+        self._rid = itertools.count()
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="router-dispatch")
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    # client surface
+    # ------------------------------------------------------------------
+
+    def submit(self, model_id: str, prompt_ids: Sequence[int],
+               max_new_tokens: int,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one generation request for ``model_id``; returns a
+        Future of the generated token ids.  The deadline (when any) is
+        END-TO-END: queueing, requeues after a replica death, and every
+        generated token all spend the same budget."""
+        if timeout_ms is None:
+            timeout_ms = self.config.default_timeout_ms
+        now = time.perf_counter()
+        fut: Future = Future()
+        req = _RoutedRequest(
+            str(model_id), [int(t) for t in prompt_ids],
+            int(max_new_tokens), fut,
+            now + timeout_ms / 1000.0 if timeout_ms else None,
+            now, f"r{next(self._rid)}")
+        with self._cond:
+            if self._stopped:
+                raise EngineClosed("router stopped")
+            q = self._queues.setdefault(req.model_id, collections.deque())
+            if len(q) >= self.config.queue_hard:
+                # the scale policy gets the LAST word before any shed:
+                # accepting the overflow is correct whenever capacity is
+                # already on its way (warming replica / scale-out fired)
+                if not (self._last_chance is not None
+                        and self._last_chance(req.model_id)):
+                    self._shed[req.model_id] = \
+                        self._shed.get(req.model_id, 0) + 1
+                    self._note_queue(req.model_id, len(q))
+                    from .. import observe
+
+                    observe.emit("fleet.shed", model=req.model_id,
+                                 queue_depth=len(q),
+                                 queue_hard=self.config.queue_hard)
+                    raise EngineOverloaded(
+                        f"router queue for model {req.model_id!r} full "
+                        f"({self.config.queue_hard} pending); request "
+                        f"shed")
+            q.append(req)
+            self._note_queue(req.model_id, len(q))
+            self._cond.notify_all()
+        return fut
+
+    def generate(self, model_id: str, prompt_ids: Sequence[int],
+                 max_new_tokens: int,
+                 timeout_ms: Optional[float] = None) -> List[int]:
+        """Blocking submit."""
+        return self.submit(model_id, prompt_ids, max_new_tokens,
+                           timeout_ms=timeout_ms).result()
+
+    def queue_depth(self, model_id: str) -> int:
+        with self._cond:
+            return len(self._queues.get(str(model_id), ()))
+
+    def in_flight(self, model_id: str) -> int:
+        with self._cond:
+            return self._in_flight.get(str(model_id), 0)
+
+    def shed_count(self, model_id: str) -> int:
+        with self._cond:
+            return self._shed.get(str(model_id), 0)
+
+    def dispatched_count(self, model_id: str) -> int:
+        with self._cond:
+            return self._dispatched.get(str(model_id), 0)
+
+    def kick(self) -> None:
+        """Wake the dispatcher (the fleet calls this when a replica
+        turns READY so queued work doesn't wait out an idle tick)."""
+        with self._cond:
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # the dispatcher
+    # ------------------------------------------------------------------
+
+    def _note_queue(self, model_id: str, depth: int) -> None:
+        from ..observe import registry as _registry
+
+        _registry().set_gauge("fleet.queue_depth", int(depth),
+                              labels={"model": model_id})
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stopped:
+                    break
+                progress = False
+            for model_id in self._model_ids():
+                progress |= self._pump(model_id)
+            with self._cond:
+                if self._stopped:
+                    break
+                if not progress and not any(self._queues.values()):
+                    self._cond.wait(self.config.idle_wait_s)
+                elif not progress:
+                    # queued work but no eligible replica right now:
+                    # wait for a kick (replica ready) or new submits,
+                    # bounded so deadline expiry still gets swept
+                    self._cond.wait(self.config.idle_wait_s)
+
+    def _model_ids(self) -> List[str]:
+        with self._cond:
+            return list(self._queues)
+
+    def _pump(self, model_id: str) -> bool:
+        """Dispatch as much of one model's queue as current capacity
+        takes; returns True when anything moved."""
+        moved = False
+        while True:
+            with self._cond:
+                q = self._queues.get(model_id)
+                req = None
+                while q:
+                    cand = q.popleft()
+                    if cand.future.done():
+                        continue  # client gave up / already failed
+                    now = time.perf_counter()
+                    if cand.deadline is not None and now > cand.deadline:
+                        cand.future.set_exception(RequestTimeout(
+                            f"deadline expired after "
+                            f"{(now - cand.t_submit) * 1e3:.1f} ms in "
+                            f"router queue"))
+                        continue
+                    req = cand
+                    break
+                self._note_queue(model_id, len(q) if q else 0)
+            if req is None:
+                return moved
+            if not self._dispatch(req):
+                # no replica could take it: put it back at the front
+                # exactly as it was and let the next pass retry
+                with self._cond:
+                    self._queues.setdefault(
+                        model_id, collections.deque()).appendleft(req)
+                    self._note_queue(model_id,
+                                     len(self._queues[model_id]))
+                return moved
+            moved = True
+
+    def _pick(self, req: _RoutedRequest):
+        """Least-loaded among the selector's candidates for this seq."""
+        with self._cond:
+            seq = next(self._seq.setdefault(req.model_id,
+                                            itertools.count()))
+        try:
+            candidates = list(self._selector(req.model_id, seq) or ())
+        except Exception:
+            return None
+        live = [r for r in candidates
+                if getattr(r.engine, "alive", True)]
+        if not live:
+            return None
+        return min(live, key=lambda r: r.load())
+
+    def _dispatch(self, req: _RoutedRequest) -> bool:
+        replica = self._pick(req)
+        if replica is None:
+            return False
+        timeout_ms = None
+        if req.deadline is not None:
+            left = req.deadline - time.perf_counter()
+            if left <= 0:
+                req.future.set_exception(RequestTimeout(
+                    "deadline expired before dispatch"))
+                return True
+            timeout_ms = left * 1000.0
+        try:
+            inner = replica.submit(req.prompt, req.max_new,
+                                   timeout_ms=timeout_ms)
+        except (EngineClosed, EngineOverloaded):
+            # stopped engine or a full engine queue: the replica is not
+            # taking work right now — count it like a death (requeue;
+            # the retry cap bounds a queue-full livelock too).  Either
+            # way _handle_loss consumed the request (requeued or
+            # failed), so the pump must NOT put it back a second time
+            self._handle_loss(req)
+            return True
+        except Exception as exc:  # bad request (validation): client's
+            req.future.set_exception(exc)
+            return True
+        req.replica = replica
+        with self._cond:
+            self._in_flight[req.model_id] = \
+                self._in_flight.get(req.model_id, 0) + 1
+            self._dispatched[req.model_id] = \
+                self._dispatched.get(req.model_id, 0) + 1
+        inner.add_done_callback(lambda f, r=req: self._on_done(r, f))
+        return True
+
+    def _on_done(self, req: _RoutedRequest, inner: Future) -> None:
+        with self._cond:
+            self._in_flight[req.model_id] = max(
+                0, self._in_flight.get(req.model_id, 0) - 1)
+        if req.future.done():
+            return
+        exc = inner.exception()
+        if exc is None:
+            req.future.set_result(inner.result())
+            return
+        if isinstance(exc, (EngineClosed, DrainTimeout)):
+            # the replica died under this request: not the client's
+            # fault — requeue at the FRONT and redispatch to a survivor
+            replica = req.replica
+            if replica is not None:
+                try:
+                    replica.note_dead()
+                except Exception:
+                    pass
+            if not self._handle_loss(req):
+                self.kick()
+            return
+        req.future.set_exception(exc)
+
+    def _handle_loss(self, req: _RoutedRequest) -> bool:
+        """Requeue a request its replica lost.  Returns True when the
+        request was finally failed (retry cap / router stopped)."""
+        req.retries += 1
+        req.replica = None
+        if req.retries > self.config.retry_limit:
+            req.future.set_exception(EngineClosed(
+                f"request {req.rid} lost its replica "
+                f"{req.retries} times; giving up"))
+            return True
+        with self._cond:
+            if self._stopped:
+                req.future.set_exception(EngineClosed("router stopped"))
+                return True
+            self._queues.setdefault(
+                req.model_id, collections.deque()).appendleft(req)
+            self._note_queue(req.model_id, len(self._queues[req.model_id]))
+            self._cond.notify_all()
+        return False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait for every queue to empty and every in-flight request to
+        resolve.  Returns False on timeout."""
+        deadline = time.perf_counter() + timeout_s
+        with self._cond:
+            while any(self._queues.values()) \
+                    or any(self._in_flight.values()):
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Stop the dispatcher; queued (undispatched) requests fail with
+        :class:`EngineClosed`.  In-flight requests resolve through their
+        engines as usual."""
+        with self._cond:
+            self._stopped = True
+            leftovers = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            self._cond.notify_all()
+        for r in leftovers:
+            if not r.future.done():
+                r.future.set_exception(EngineClosed("router stopped"))
+        self._worker.join(timeout=timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -34,7 +34,8 @@ from paddle_tpu import observe
 from paddle_tpu.fluid import profiler
 from paddle_tpu.observe.export import (chrome_trace, parse_prometheus_text,
                                        prometheus_text)
-from paddle_tpu.observe.fleet import fleet_events, fleet_snapshot
+from paddle_tpu.observe.fleet import (fleet_events, fleet_snapshot,
+                                      label_sums)
 from paddle_tpu.observe.registry import MetricsRegistry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -379,6 +380,45 @@ def test_serving_metrics_endpoint_matches_snapshot(tmp_path):
     finally:
         eng.shutdown()
     assert eng.metrics_server is None  # endpoint closed with the engine
+
+
+def test_serving_metrics_label_dimension_round_trip():
+    """ISSUE 17 satellite: replica-scoped ServingMetrics stamp their
+    process-registry mirrors with model=/replica= labels, the labeled
+    names survive the Prometheus text round trip, and the fleet
+    aggregation sums per-model through ``label_sums`` (structured label
+    join, no metric-name string-parsing)."""
+    from paddle_tpu.serving import ServingMetrics
+
+    replicas = {("chat", "chat-r0"): 5, ("chat", "chat-r1"): 7,
+                ("code", "code-r0"): 3}
+    for (model, replica), n in replicas.items():
+        m = ServingMetrics(labels={"model": model, "replica": replica})
+        m.inc("completed", n)
+        m.set_gauge("slots_active", n % 2)
+        m.observe_latency(0.01)
+        # the PRIVATE registry (snapshot keys) stays flat — per-engine
+        # identity comes from object ownership, not labels
+        assert m.snapshot()["completed"] == n
+
+    flat = observe.registry().flat()
+    assert flat['serving.completed{model="chat",replica="chat-r0"}'] == 5
+    assert flat['serving.completed{model="chat",replica="chat-r1"}'] == 7
+    assert flat['serving.completed{model="code",replica="code-r0"}'] == 3
+
+    # Prometheus exposition round trip keeps the label identity
+    text = prometheus_text(observe.registry().snapshot())
+    parsed = parse_prometheus_text(text)
+    assert parsed["counters"][
+        'serving_completed{model="chat",replica="chat-r1"}'] == 7
+
+    # fleet view: per-model sums over the replica dimension...
+    per_model = label_sums(flat, "model", prefix="serving.")
+    assert per_model["chat"]["serving.completed"] == 12
+    assert per_model["code"]["serving.completed"] == 3
+    # ...and per-replica slices keep each replica separate
+    per_replica = label_sums(flat, "replica", prefix="serving.")
+    assert per_replica["chat-r1"]["serving.completed"] == 7
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,10 @@ Modes:
                 reports tokens/s, TTFT p50/p99, inter-token p99 and the
                 executable count (fixed-set invariant:
                 compiles_after_warmup must be 0)
+    --router    serving-fleet workload (ISSUE 17): --models x --replicas
+                decode replicas behind one router; the BENCH line
+                reports per-model qps/p50/p99/shed plus the
+                ready-replica-count trajectory sampled through the run
     default     --duration/--qps as given; --device TPU serves from the
                 accelerator when one is attached
 """
@@ -303,6 +307,156 @@ def run_decode_bench(args) -> dict:
     }
 
 
+def run_router_bench(args) -> dict:
+    """Open-loop multi-model load through a ServingFleet (ISSUE 17).
+
+    ``--models M x --replicas R`` tiny decode models behind one router;
+    arrivals round-robin the models on the --qps schedule.  Latencies
+    are measured end to end at the CLIENT (router queueing + failover
+    included), per model; a sampler thread records the ready-replica
+    count per model every 250 ms so the BENCH line carries the fleet's
+    scaling trajectory, not just its endpoint."""
+    import numpy as np
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import (AutoscalePolicy, DecodeEngine,
+                                    EngineOverloaded, ServingFleet)
+
+    # the shared compile store is what makes an R-replica fleet warm in
+    # one compile's time; give the bench one even when the env has none
+    if not os.environ.get("PADDLE_COMPILE_CACHE_DIR"):
+        os.environ["PADDLE_COMPILE_CACHE_DIR"] = \
+            tempfile.mkdtemp(prefix="bench_router_cache_")
+
+    models = [f"m{i}" for i in range(args.models)]
+
+    def factory(seed):
+        def make(labels):
+            model = transformer.DecodeModel(
+                cfg=transformer.decode_lm_config(), max_slots=args.slots,
+                max_len=args.max_len, prefill_buckets=[4, 8], seed=seed)
+            return DecodeEngine(model, metrics_labels=labels)
+        return make
+
+    fleet = ServingFleet(
+        {m: factory(11 + 2 * i) for i, m in enumerate(models)},
+        replicas=args.replicas,
+        hb_dir=tempfile.mkdtemp(prefix="bench_router_hb_"),
+        # the bench measures the offered load, not idle-downscale churn:
+        # pin the floor at the starting shape, let pressure scale out
+        policy=AutoscalePolicy(min_replicas=args.replicas))
+    t_warm = time.perf_counter()
+    fleet.start(wait_ready_s=300.0)
+    warm_s = time.perf_counter() - t_warm
+
+    rng = np.random.RandomState(0)
+    pool = [[int(t) for t in rng.randint(2, 60, size=3)]
+            for _ in range(64)]
+    budgets = [args.long_new if rng.random_sample() < 0.2
+               else args.short_new for _ in range(256)]
+
+    lat = {m: [] for m in models}       # client-side e2e seconds
+    results = {m: {"ok": 0, "shed": 0, "err": 0} for m in models}
+    rlock = threading.Lock()
+
+    def on_done(model, t0):
+        def cb(fut):
+            dt = time.perf_counter() - t0
+            with rlock:
+                if fut.exception() is None:
+                    results[model]["ok"] += 1
+                    lat[model].append(dt)
+                else:
+                    results[model]["err"] += 1
+        return cb
+
+    trajectory = []
+    stop_sampler = threading.Event()
+
+    def sample():
+        t0 = time.perf_counter()
+        while not stop_sampler.wait(0.25):
+            st = fleet.status()
+            trajectory.append(
+                {"t_s": round(time.perf_counter() - t0, 2),
+                 **{m: st["models"][m]["ready"] for m in models}})
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+
+    period = 1.0 / args.qps
+    t_start = time.perf_counter()
+    t_end = t_start + args.duration
+    next_fire = t_start
+    sent = 0
+    while True:
+        now = time.perf_counter()
+        if now >= t_end:
+            break
+        if now < next_fire:
+            time.sleep(min(next_fire - now, 0.002))
+            continue
+        next_fire += period
+        model = models[sent % len(models)]
+        try:
+            fleet.submit(model, pool[sent % len(pool)],
+                         budgets[sent % len(budgets)]) \
+                .add_done_callback(on_done(model, time.perf_counter()))
+        except EngineOverloaded:
+            with rlock:
+                results[model]["shed"] += 1
+        sent += 1
+    fleet.router.drain(timeout_s=120.0)
+    stop_sampler.set()
+    sampler.join(timeout=5.0)
+    window_s = time.perf_counter() - t_start
+    status = fleet.status()
+    fleet.shutdown(timeout_s=60.0)
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)) * 1e3, 3) \
+            if vals else None
+
+    per_model = {}
+    for m in models:
+        r = results[m]
+        per_model[m] = {
+            "completed": r["ok"],
+            "qps": round(r["ok"] / window_s, 3),
+            "p50_ms": pct(lat[m], 50),
+            "p99_ms": pct(lat[m], 99),
+            "shed": r["shed"] + status["models"][m]["shed"],
+            "errors": r["err"],
+            "replicas_final": status["models"][m]["ready"],
+            "dispatched": status["models"][m]["dispatched"],
+        }
+    completed = sum(r["ok"] for r in results.values())
+    return {
+        "metric": f"serving_fleet_openloop_{args.device.lower()}",
+        "value": round(completed / window_s, 3),
+        "unit": "req/s",
+        "offered_qps": args.qps,
+        "duration_s": args.duration,
+        "window_s": round(window_s, 3),
+        "warm_s": round(warm_s, 3),
+        "sent": sent,
+        "completed": completed,
+        "shed": sum(v["shed"] for v in per_model.values()),
+        "errors": sum(r["err"] for r in results.values()),
+        "p50_ms": pct([d for v in lat.values() for d in v], 50),
+        "p99_ms": pct([d for v in lat.values() for d in v], 99),
+        "models": per_model,
+        "replica_trajectory": trajectory,
+        "n_models": args.models,
+        "replicas": args.replicas,
+        "slots": args.slots,
+        "max_len": args.max_len,
+        "short_new": args.short_new,
+        "long_new": args.long_new,
+        "smoke": bool(args.smoke),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model-dir", default="",
@@ -334,19 +488,33 @@ def main(argv=None) -> int:
     p.add_argument("--swap-policy", default="immediate",
                    choices=["immediate", "drain"],
                    help="in-flight policy for --swaps")
+    p.add_argument("--router", action="store_true",
+                   help="multi-model fleet workload: --models x "
+                        "--replicas decode replicas behind one router "
+                        "(per-model qps/p50/p99/shed + the "
+                        "replica-count trajectory)")
+    p.add_argument("--models", type=int, default=2,
+                   help="distinct models behind the router (--router)")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="starting replicas per model (--router)")
     p.add_argument("--smoke", action="store_true",
                    help="2-second CPU sanity pass for CI")
     args = p.parse_args(argv)
     if args.smoke:
         args.duration = 2.0
-        args.qps = min(args.qps, 40.0 if args.decode else 200.0)
+        args.qps = min(args.qps, 40.0 if args.decode or args.router
+                       else 200.0)
         args.device = "CPU"
-        if args.decode:
+        if args.decode or args.router:
             args.slots = min(args.slots, 4)
             args.max_len = min(args.max_len, 64)
             args.long_new = min(args.long_new, 32)
+        if args.router:
+            args.models = min(args.models, 2)
+            args.replicas = min(args.replicas, 2)
 
-    out = run_decode_bench(args) if args.decode else run_bench(args)
+    out = run_router_bench(args) if args.router \
+        else run_decode_bench(args) if args.decode else run_bench(args)
     print(json.dumps(out))
     # smoke contract: the pass fails loudly if nothing was actually served
     if args.smoke and (out["completed"] == 0 or out["p50_ms"] is None):
