@@ -1,0 +1,37 @@
+"""Chaos engine: seeded multi-fault drills with invariant verdicts.
+
+``python -m paddle_tpu.chaos run --scenario elastic --seed 7 --faults 3``
+expands one integer into a deterministic multi-fault plan (sampled from
+the envcontract fault registry), executes the scenario end to end, and
+judges the wreckage purely from persisted artifacts — exactly-once data
+coverage, bitwise resume, goodput-ledger accounting, autoscaler ordering,
+checkpoint veto persistence, device-census hygiene.
+
+The three layers are importable on their own:
+
+- :mod:`.schedule` — seed -> replayable fault plan;
+- :mod:`.runner`   — plan -> executed drill workdir + chaos report;
+- :mod:`.invariants` — workdir -> verdicts (no live state consulted).
+"""
+
+from .invariants import INVARIANTS, evaluate, read_jsonl_tolerant
+from .runner import (SCENARIO_SHAPE, evaluate_and_report, read_report,
+                     run_drill, tamper)
+from .schedule import (CATALOG, ChaosSchedule, canonical_json,
+                       generate_fault_table, uncovered_knobs)
+
+__all__ = [
+    "CATALOG",
+    "ChaosSchedule",
+    "INVARIANTS",
+    "SCENARIO_SHAPE",
+    "canonical_json",
+    "evaluate",
+    "evaluate_and_report",
+    "generate_fault_table",
+    "read_jsonl_tolerant",
+    "read_report",
+    "run_drill",
+    "tamper",
+    "uncovered_knobs",
+]

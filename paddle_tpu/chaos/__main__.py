@@ -1,0 +1,121 @@
+"""CLI for the chaos engine.
+
+::
+
+    python -m paddle_tpu.chaos run --scenario train|elastic|serve|fleet \
+        --seed N [--faults K] [--workdir DIR] [--tamper]
+    python -m paddle_tpu.chaos plan --scenario S --seed N [--faults K]
+    python -m paddle_tpu.chaos faults [--write]
+
+``run`` executes one seeded drill and prints the per-invariant verdicts;
+exit status 0 iff no invariant FAILed.  ``plan`` prints the canonical
+fault-plan JSON without executing anything (two invocations with the
+same seed must be byte-identical — that IS the replayability contract).
+``faults`` prints the auto-generated fault-injection table; ``--write``
+refreshes ``docs/FAULTS.md`` in place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# must precede any jax import (the executors import the framework)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    "--xla_force_host_platform_device_count=1 "
+    "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+
+from .schedule import (ChaosSchedule, canonical_json,  # noqa: E402
+                       generate_fault_table)
+
+_FAULTS_DOC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "docs", "FAULTS.md")
+
+
+def _cmd_plan(args) -> int:
+    from .runner import SCENARIO_SHAPE
+
+    shape = SCENARIO_SHAPE[args.scenario]
+    plan = ChaosSchedule(args.scenario, args.seed, args.faults,
+                         **shape).plan()
+    print(canonical_json(plan))
+    return 0
+
+
+def _cmd_run(args) -> int:
+    import tempfile
+
+    from .runner import run_drill
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
+    report = run_drill(args.scenario, args.seed, args.faults, workdir,
+                       tamper_artifacts=args.tamper)
+    plan = report["plan"]
+    print(f"chaos drill: scenario={args.scenario} seed={args.seed} "
+          f"faults={len(plan.get('faults', []))} workdir={workdir}")
+    for f in plan.get("faults", []):
+        knobs = " ".join(f"{k}={v}" for k, v in sorted(f["env"].items()))
+        print(f"  fault {f['key']}: {knobs}")
+    for v in report["verdicts"]:
+        print(f"  [{v['status']:>4}] {v['invariant']}: {v['detail']}")
+    counts = report["counts"]
+    print(f"verdicts: {counts['PASS']} PASS, {counts['FAIL']} FAIL, "
+          f"{counts['SKIP']} SKIP -> "
+          f"{'OK' if report['ok'] else 'VIOLATED'}")
+    print(f"report: {report['report_path']}")
+    return 0 if report["ok"] else 1
+
+
+def _cmd_faults(args) -> int:
+    table = generate_fault_table()
+    if args.write:
+        os.makedirs(os.path.dirname(_FAULTS_DOC), exist_ok=True)
+        with open(_FAULTS_DOC, "w") as f:
+            f.write(table)
+        print(f"wrote {_FAULTS_DOC}")
+    else:
+        print(table, end="")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.chaos",
+                                 description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="execute one seeded drill")
+    run_p.add_argument("--scenario", required=True,
+                       choices=["train", "elastic", "serve", "fleet"])
+    run_p.add_argument("--seed", type=int, required=True)
+    run_p.add_argument("--faults", type=int, default=2)
+    run_p.add_argument("--workdir", default=None,
+                       help="drill workdir (default: fresh temp dir)")
+    run_p.add_argument("--tamper", action="store_true",
+                       help="corrupt one artifact before the verdict "
+                            "pass (self-test: must FAIL)")
+    run_p.set_defaults(fn=_cmd_run)
+
+    plan_p = sub.add_parser("plan", help="print the canonical fault "
+                                         "plan without executing")
+    plan_p.add_argument("--scenario", required=True,
+                        choices=["train", "elastic", "serve", "fleet"])
+    plan_p.add_argument("--seed", type=int, required=True)
+    plan_p.add_argument("--faults", type=int, default=2)
+    plan_p.set_defaults(fn=_cmd_plan)
+
+    faults_p = sub.add_parser("faults", help="print the fault table")
+    faults_p.add_argument("--write", action="store_true",
+                          help="refresh docs/FAULTS.md")
+    faults_p.set_defaults(fn=_cmd_faults)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,377 @@
+"""Seeded multi-fault chaos schedules (ISSUE 18 tentpole).
+
+One integer (``--seed`` / ``PADDLE_CHAOS_SEED``) deterministically expands
+into a K-fault plan for one drill scenario: which faults from the catalog,
+which knob values, which rank/step each fires at.  Replays are exact —
+the same (scenario, seed, faults) triple always yields the byte-identical
+canonical plan JSON, so a red drill from CI reproduces locally from the
+one integer in its report.
+
+The catalog is NOT a second fault list: every spec points at knobs
+declared in :mod:`paddle_tpu.fluid.envcontract` (subsystem ``fault``),
+and :func:`uncovered_knobs` computes the difference — a newly declared
+fault knob that no :class:`FaultSpec` covers fails the chaos test suite
+until it is either cataloged (samplable) or explicitly excluded with a
+rationale (``scenarios=()``).  Auto-discovery keeps the chaos engine
+honest as the fault family grows.
+
+Trajectory-altering faults (NaN/grad-Inf/loss-spike injection, committed
+checkpoint poisoning, permanent host loss) are cataloged but never
+sampled: they change the converged state or the fleet shape BY DESIGN,
+so the drill's strongest invariant — bitwise resume vs. an uninterrupted
+reference — would be vacuously unfalsifiable with them armed.  They keep
+their own dedicated oracles (guardian / canary / mesh-ladder tests).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..fluid import envcontract as _ec
+
+__all__ = [
+    "FaultSpec", "CATALOG", "SCENARIOS", "EXEMPT_KNOBS",
+    "ChaosSchedule", "canonical_json", "uncovered_knobs",
+    "generate_fault_table",
+]
+
+#: the four drill scenarios the runner implements
+SCENARIOS = ("train", "elastic", "serve", "fleet")
+
+#: the drills' checkpoint cadence (the runner imports this): the sampler
+#: needs it to keep composed plans RECOVERABLE — see the shard_corrupt
+#: constraint in :meth:`ChaosSchedule.plan`
+CKPT_STEP_INTERVAL = 3
+
+#: declared PADDLE_FAULT_* names that are scoping/flavor, not faults:
+#: RANK scopes other faults to one rank, MODE picks the crash flavor, and
+#: the bare prefix entry covers dynamic suffixes for repo_lint
+EXEMPT_KNOBS = frozenset({
+    "PADDLE_FAULT_", "PADDLE_FAULT_RANK", "PADDLE_FAULT_MODE",
+})
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One samplable (or explicitly excluded) fault family.
+
+    ``sample(rng, ctx)`` returns the env assignment for one drawn
+    instance; ``ctx`` carries the drill shape (``nproc``, ``steps``).
+    ``interrupting`` marks faults that end a generation (kill,
+    checkpoint crash) — train/elastic plans guarantee at least one so
+    every drill actually exercises restart+resume.  ``scenarios=()``
+    with a ``rationale`` documents a deliberate exclusion."""
+
+    key: str
+    knobs: Tuple[str, ...]
+    scenarios: Tuple[str, ...]
+    interrupting: bool = False
+    rationale: str = ""
+    sample: Optional[Callable[[random.Random, dict], Dict[str, str]]] = \
+        field(default=None, compare=False)
+
+
+def _mid_third_step(rng: random.Random, ctx: dict) -> int:
+    steps = max(3, int(ctx.get("steps", 12)))
+    return rng.randrange(steps // 3, 2 * steps // 3 + 1)
+
+
+CATALOG: List[FaultSpec] = [
+    # -- interrupting: end generation 0, force a real resume -------------
+    FaultSpec(
+        "kill", ("PADDLE_FAULT_KILL_STEP",), ("train", "elastic"),
+        interrupting=True,
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_KILL_STEP": str(_mid_third_step(rng, ctx))}),
+    FaultSpec(
+        "ckpt_crash", ("PADDLE_FAULT_CKPT_CRASH",), ("train", "elastic"),
+        interrupting=True,
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_CKPT_CRASH": rng.choice(["before", "after"])}),
+    # -- degradations that must NOT alter the committed trajectory ------
+    FaultSpec(
+        "io_delay", ("PADDLE_FAULT_IO_DELAY_MS",), ("train", "elastic"),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_IO_DELAY_MS": str(rng.choice([1, 2, 5]))}),
+    FaultSpec(
+        "io_error",
+        ("PADDLE_FAULT_IO_ERROR_RATE", "PADDLE_FAULT_IO_ERROR_SEED"),
+        ("train", "elastic", "serve", "fleet"),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_IO_ERROR_RATE":
+                str(round(rng.uniform(0.4, 0.9), 3)),
+            "PADDLE_FAULT_IO_ERROR_SEED":
+                str(rng.randrange(1, 1 << 16))}),
+    FaultSpec(
+        "data_stall",
+        ("PADDLE_FAULT_DATA_STALL_MS", "PADDLE_FAULT_DATA_STALL_AT"),
+        ("train", "elastic"),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_DATA_STALL_MS": str(rng.choice([20, 40, 60])),
+            "PADDLE_FAULT_DATA_STALL_AT":
+                str(rng.randrange(0, max(1, int(ctx.get("steps", 12)))))}),
+    FaultSpec(
+        "cache_corrupt", ("PADDLE_FAULT_CACHE_CORRUPT",), ("train",),
+        sample=lambda rng, ctx: {"PADDLE_FAULT_CACHE_CORRUPT": "1"}),
+    FaultSpec(
+        "shard_corrupt", ("PADDLE_FAULT_SHARD_CORRUPT",), ("elastic",),
+        sample=lambda rng, ctx: {"PADDLE_FAULT_SHARD_CORRUPT": "1"}),
+    FaultSpec(
+        # kept well below the drill supervisor's heartbeat timeout: the
+        # stall models a wedge the run RIDES OUT, not a restart trigger
+        "barrier_stall", ("PADDLE_FAULT_BARRIER_STALL",), ("elastic",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_BARRIER_STALL":
+                str(round(rng.uniform(0.05, 0.2), 3))}),
+    FaultSpec(
+        "straggler",
+        ("PADDLE_FAULT_STRAGGLER_RANK", "PADDLE_FAULT_STRAGGLER_MS"),
+        ("elastic",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_STRAGGLER_RANK":
+                str(rng.randrange(max(1, int(ctx.get("nproc", 2))))),
+            "PADDLE_FAULT_STRAGGLER_MS": str(rng.choice([5, 10, 15]))}),
+    FaultSpec(
+        "mem_pressure",
+        ("PADDLE_FAULT_MEM_PRESSURE", "PADDLE_FAULT_MEM_PRESSURE_AT"),
+        ("elastic",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_MEM_PRESSURE": str(rng.choice([1, 2, 4])),
+            "PADDLE_FAULT_MEM_PRESSURE_AT": str(rng.randrange(2, 6))}),
+    # -- serving-path faults ---------------------------------------------
+    FaultSpec(
+        "serve_delay", ("PADDLE_FAULT_SERVE_DELAY_MS",), ("serve",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_SERVE_DELAY_MS": str(rng.choice([1, 2, 5]))}),
+    FaultSpec(
+        "serve_fail", ("PADDLE_FAULT_SERVE_FAIL_EVERY",), ("serve",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_SERVE_FAIL_EVERY": str(rng.randrange(3, 6))}),
+    FaultSpec(
+        "decode_stall", ("PADDLE_FAULT_DECODE_STALL_MS",), ("serve",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_DECODE_STALL_MS": str(rng.choice([1, 2, 4]))}),
+    FaultSpec(
+        "replica_kill", ("PADDLE_FAULT_REPLICA_KILL_AFTER",), ("fleet",),
+        sample=lambda rng, ctx: {
+            "PADDLE_FAULT_REPLICA_KILL_AFTER": str(rng.randrange(2, 7))}),
+    # -- cataloged but never sampled: each breaks an invariant BY DESIGN -
+    FaultSpec(
+        "nan", ("PADDLE_FAULT_NAN_VAR", "PADDLE_FAULT_NAN_STEP"), (),
+        rationale="poisons the training state itself — bitwise-resume "
+                  "vs. the clean reference is unfalsifiable (guardian "
+                  "NaN-policy tests own this oracle)"),
+    FaultSpec(
+        "grad_inf",
+        ("PADDLE_FAULT_GRAD_INF_STEP", "PADDLE_FAULT_GRAD_INF_VALUE"), (),
+        rationale="alters the gradient trajectory in-graph; owned by the "
+                  "guardian sentinel / loss-scaler overflow tests"),
+    FaultSpec(
+        "loss_spike",
+        ("PADDLE_FAULT_LOSS_SPIKE_STEP", "PADDLE_FAULT_LOSS_SPIKE_FACTOR"),
+        (),
+        rationale="rewrites the observed loss; owned by the guardian "
+                  "spike-detector tests"),
+    FaultSpec(
+        "ckpt_poison", ("PADDLE_FAULT_CKPT_POISON_SERIAL",), (),
+        rationale="commits a structurally valid but NaN checkpoint — "
+                  "resume from it CANNOT match the reference; owned by "
+                  "the serving canary auto-rollback tests"),
+    FaultSpec(
+        "host_loss",
+        ("PADDLE_FAULT_HOST_LOSS_RANK", "PADDLE_FAULT_HOST_LOSS_AT_STEP"),
+        (),
+        rationale="permanently shrinks the fleet, so the resumed "
+                  "generation runs a different data sharding than the "
+                  "reference; owned by the mesh-ladder downgrade tests"),
+]
+
+
+def _catalog_by_key() -> Dict[str, FaultSpec]:
+    return {s.key: s for s in CATALOG}
+
+
+def uncovered_knobs() -> List[str]:
+    """Declared fault knobs no catalog entry covers (must be empty —
+    the auto-discovery contract enforced by tests/test_chaos.py)."""
+    covered = set()
+    for spec in CATALOG:
+        covered.update(spec.knobs)
+    return sorted(
+        name for name, knob in _ec.REGISTRY.items()
+        if knob.subsystem == "fault"
+        and name not in EXEMPT_KNOBS
+        and name not in covered)
+
+
+def canonical_json(plan: dict) -> str:
+    """The byte-stable rendering of a plan — what determinism is judged
+    on (and what ``plan.json`` persists)."""
+    return json.dumps(plan, sort_keys=True, separators=(",", ":"))
+
+
+class ChaosSchedule:
+    """Deterministic K-fault plan sampler for one scenario.
+
+    The RNG is seeded from ``sha256(scenario | seed)`` (NOT python's
+    randomized ``hash``), so the same integer replays the same plan in
+    any process, any python version."""
+
+    def __init__(self, scenario: str, seed: int, faults: int = 2,
+                 nproc: int = 2, steps: int = 12):
+        if scenario not in SCENARIOS:
+            raise ValueError(
+                f"scenario must be one of {SCENARIOS}, got {scenario!r}")
+        if faults < 1:
+            raise ValueError("faults must be >= 1")
+        self.scenario = scenario
+        self.seed = int(seed)
+        self.faults = int(faults)
+        self.nproc = int(nproc)
+        self.steps = int(steps)
+        digest = hashlib.sha256(
+            f"{scenario}|{self.seed}".encode()).digest()
+        self.stable_seed = int.from_bytes(digest[:8], "big")
+
+    def plan(self) -> dict:
+        rng = random.Random(self.stable_seed)
+        ctx = {"nproc": self.nproc, "steps": self.steps}
+        eligible = sorted((s for s in CATALOG
+                           if self.scenario in s.scenarios),
+                          key=lambda s: s.key)
+        if not eligible:
+            raise ValueError(f"no faults cataloged for {self.scenario!r}")
+        k = min(self.faults, len(eligible))
+        chosen: List[FaultSpec] = []
+        if self.scenario in ("train", "elastic"):
+            # a drill that never interrupts never exercises resume:
+            # guarantee one generation-ending fault in every plan
+            interrupting = [s for s in eligible if s.interrupting]
+            chosen.append(rng.choice(interrupting))
+            pool = [s for s in eligible if s.key != chosen[0].key]
+            if chosen[0].key == "ckpt_crash":
+                # shard_corrupt tears the FIRST serial's data_state blob
+                # (committed with _SUCCESS when the crash is 'after'):
+                # that serial would be the only complete one, restore
+                # correctly refuses to train silently from scratch, and
+                # the drill is unrecoverable BY DESIGN — never compose
+                # the two
+                pool = [s for s in pool if s.key != "shard_corrupt"]
+            chosen.extend(rng.sample(pool, min(k - 1, len(pool))))
+        else:
+            chosen.extend(rng.sample(eligible, k))
+        faults = []
+        env: Dict[str, str] = {}
+        for spec in sorted(chosen, key=lambda s: s.key):
+            assignment = spec.sample(rng, ctx)
+            faults.append({"key": spec.key, "env": assignment,
+                           "interrupting": spec.interrupting})
+            env.update(assignment)
+        keys = {f["key"] for f in faults}
+        if "shard_corrupt" in keys and "kill" in keys:
+            # the torn data_state hits the FIRST checkpoint commit; the
+            # kill must land after the SECOND clean serial commits, or
+            # restore has nothing to fall back to and the pod dies loud
+            # (the intended all-serials-corrupt behavior, but not a
+            # drill that can ever pass)
+            floor = 2 * CKPT_STEP_INTERVAL + 1
+            if int(env["PADDLE_FAULT_KILL_STEP"]) < floor:
+                step = rng.randrange(floor,
+                                     max(floor + 1, self.steps - 1))
+                env["PADDLE_FAULT_KILL_STEP"] = str(step)
+                for f in faults:
+                    if f["key"] == "kill":
+                        f["env"]["PADDLE_FAULT_KILL_STEP"] = str(step)
+        if self.scenario == "train":
+            # the train drill is in-process: crashes must raise
+            # InjectedFault, not os._exit the evaluating process
+            env["PADDLE_FAULT_MODE"] = "raise"
+        return {
+            "version": 1,
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "stable_seed": self.stable_seed,
+            "faults_requested": self.faults,
+            "nproc": self.nproc,
+            "steps": self.steps,
+            "faults": faults,
+            "env": env,
+        }
+
+
+# ---------------------------------------------------------------------------
+# docs/FAULTS.md generation (mirrors envcontract.generate_markdown: the
+# committed file is diffed against this generator by tools/repo_lint.py)
+# ---------------------------------------------------------------------------
+
+def generate_fault_table() -> str:
+    lines = [
+        "# Fault catalog",
+        "",
+        "<!-- GENERATED FILE - DO NOT EDIT BY HAND -->",
+        "<!-- regenerate: python -m paddle_tpu.chaos faults --write -->",
+        "",
+        "Every deterministic fault the chaos engine can draw from, "
+        "auto-discovered",
+        "from the `fault` subsystem of `fluid.envcontract`.  "
+        "`python -m paddle_tpu.chaos run`",
+        "samples seeded K-fault plans over this catalog; "
+        "`tests/test_chaos.py` fails",
+        "when a newly declared fault knob is missing from it.",
+        "",
+        "## Declared fault knobs",
+        "",
+        "| Knob | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for knob in sorted((k for k in _ec.REGISTRY.values()
+                        if k.subsystem == "fault" and k.type != "prefix"),
+                       key=lambda k: k.name):
+        default = "" if knob.default is None else repr(knob.default)
+        help_text = " ".join(knob.help.split())
+        lines.append(
+            f"| `{knob.name}` | {knob.type} | `{default}` "
+            f"| {help_text} |")
+    lines += [
+        "",
+        "## Chaos catalog (samplable fault families)",
+        "",
+        "| Family | Knobs | Scenarios | Interrupting |",
+        "|---|---|---|---|",
+    ]
+    for spec in sorted(CATALOG, key=lambda s: s.key):
+        if not spec.scenarios:
+            continue
+        knobs = ", ".join(f"`{k}`" for k in spec.knobs)
+        scen = ", ".join(spec.scenarios)
+        lines.append(
+            f"| `{spec.key}` | {knobs} | {scen} "
+            f"| {'yes' if spec.interrupting else 'no'} |")
+    lines += [
+        "",
+        "## Cataloged but never sampled",
+        "",
+        "These faults alter the committed trajectory or the fleet shape "
+        "*by design*,",
+        "so the drill invariants (bitwise resume, exactly-once coverage) "
+        "cannot judge",
+        "them; each keeps its own dedicated oracle.",
+        "",
+    ]
+    for spec in sorted(CATALOG, key=lambda s: s.key):
+        if spec.scenarios:
+            continue
+        knobs = ", ".join(f"`{k}`" for k in spec.knobs)
+        lines.append(f"- **{spec.key}** ({knobs}): {spec.rationale}")
+    lines += [
+        "",
+        "Scoping knobs (`PADDLE_FAULT_RANK`, `PADDLE_FAULT_MODE`) are "
+        "composition",
+        "modifiers, not faults, and are exempt from catalog coverage.",
+        "",
+    ]
+    return "\n".join(lines)

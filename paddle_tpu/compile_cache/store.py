@@ -188,12 +188,24 @@ class CompileCacheStore:
         })
         tmp = os.path.join(self.root, TMP_DIR,
                            f"{fp}.{os.getpid()}.{time.monotonic_ns()}")
+        from ..fluid import fault as _fault
+        from ..fluid.retry import retry_io
+
         try:
             os.makedirs(tmp)
-            with open(os.path.join(tmp, PROGRAM_FILE), "wb") as f:
-                f.write(program_blob)
-            with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
-                json.dump(manifest, f)
+
+            # staged writes + _SUCCESS get bounded transient retry (keyed
+            # on the DESTINATION dir — the tmp name is unique per call);
+            # the rename race below stays unretried: contention is a
+            # protocol outcome, not a storage blip
+            def _stage():
+                _fault.io_error(os.path.join(d, PROGRAM_FILE), "write")
+                with open(os.path.join(tmp, PROGRAM_FILE), "wb") as f:
+                    f.write(program_blob)
+                with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
+                    json.dump(manifest, f)
+
+            retry_io(_stage, what="cache.stage")
             try:
                 os.rename(tmp, d)
             except OSError:
@@ -204,9 +216,14 @@ class CompileCacheStore:
                     return False
                 shutil.rmtree(d, ignore_errors=True)
                 os.rename(tmp, d)
+
             # _SUCCESS last: the commit point (checkpoint convention)
-            with open(os.path.join(d, SUCCESS_MARK), "w") as f:
-                f.write(str(fp))
+            def _commit():
+                _fault.io_error(os.path.join(d, SUCCESS_MARK), "write")
+                with open(os.path.join(d, SUCCESS_MARK), "w") as f:
+                    f.write(str(fp))
+
+            retry_io(_commit, what="cache.success")
         except OSError:
             shutil.rmtree(tmp, ignore_errors=True)
             _counter("error")

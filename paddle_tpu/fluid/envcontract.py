@@ -309,7 +309,8 @@ declare("PADDLE_FAULT_", "prefix", None, "fault",
 declare("PADDLE_FAULT_KILL_STEP", "int", None, "fault",
         "Kill this process at training step N")
 declare("PADDLE_FAULT_MODE", "str", "exit", "fault",
-        "How kill faults fire (exit|segv|hang)")
+        "Crash flavor: hard process exit (default) or an in-process "
+        "InjectedFault raise (exit|raise)")
 declare("PADDLE_FAULT_RANK", "int", None, "fault",
         "Restrict armed faults to one trainer rank")
 declare("PADDLE_FAULT_CKPT_CRASH", "str", None, "fault",
@@ -376,6 +377,26 @@ declare("PADDLE_FAULT_REPLICA_KILL_AFTER", "int", None, "fault",
         "Serving-fleet replica death: kill the replica that served the "
         "n-th fleet request (one-shot) — the deterministic oracle for "
         "the router's re-spawn + cache-hit re-warm path")
+declare("PADDLE_FAULT_IO_ERROR_RATE", "float", 0.0, "fault",
+        "Transient-storage oracle: fraction of (path, op) keys whose "
+        "FIRST read/write attempt raises OSError (seeded per-path hash; "
+        "the retry always succeeds — bounded retry must recover, an "
+        "unretried call site sees a hard failure)")
+declare("PADDLE_FAULT_IO_ERROR_SEED", "int", 0, "fault",
+        "Seed for the transient-I/O oracle's per-path failure hash")
+
+# -- chaos engine (seeded multi-fault drills; paddle_tpu.chaos) --
+declare("PADDLE_CHAOS_SEED", "int", None, "chaos",
+        "Seed for the chaos schedule's deterministic K-fault plan "
+        "sampling (python -m paddle_tpu.chaos run; CLI --seed overrides)")
+
+# -- transient-I/O retry (fluid.retry, wraps durable-state read/write) --
+declare("PADDLE_IO_RETRIES", "int", 3, "io",
+        "Bounded attempts for transient OSErrors on checkpoint, census "
+        "and manifest I/O (1 = no retry; corruption is never retried)")
+declare("PADDLE_IO_RETRY_BASE_S", "float", 0.05, "io",
+        "Base backoff delay between transient-I/O retries (seconds, "
+        "doubling per attempt, capped at 2 s)")
 
 # -- memory observability --
 declare("PADDLE_MEM_BUDGET_MB", "float", None, "memory",

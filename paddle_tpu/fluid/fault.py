@@ -125,6 +125,21 @@ worker processes:
                                   for the memory.live_bytes SLO breach and
                                   the PADDLE_MEM_BUDGET_MB over-budget
                                   event (see observe.memory)
+    PADDLE_FAULT_IO_ERROR_RATE=f  transient-storage oracle: the fraction
+                                  f of (path, op) keys whose FIRST
+                                  read/write attempt raises OSError —
+                                  seeded (PADDLE_FAULT_IO_ERROR_SEED) and
+                                  keyed on the path's tail, so the SAME
+                                  files fail on every run and the retry
+                                  attempt for a failed key always
+                                  succeeds.  Transient by construction:
+                                  bounded retry (fluid.retry.retry_io)
+                                  must recover, while an unretried call
+                                  site still sees a hard failure — and
+                                  content corruption (ValueError) never
+                                  goes through this hook, so the
+                                  serial-condemnation fallback stays
+                                  distinct from the transient path
     PADDLE_FAULT_MODE=exit|raise  crash flavor: hard process exit (default)
                                   or an InjectedFault raise (in-process
                                   tests of the recovery path)
@@ -153,7 +168,7 @@ from typing import Optional
 __all__ = [
     "FaultPlan", "InjectedFault", "install", "clear", "active",
     "on_step", "corrupt_state", "ckpt_crash_point", "ckpt_poison",
-    "io_delay",
+    "io_delay", "io_error",
     "barrier_stall", "serving_request", "decode_stall", "replica_kill",
     "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
@@ -198,6 +213,7 @@ class FaultPlan:
                  straggler_ms: float = 0.0,
                  host_loss_rank: Optional[int] = None,
                  host_loss_at_step: int = 0,
+                 io_error_rate: float = 0.0, io_error_seed: int = 0,
                  rank: Optional[int] = None, mode: str = "exit"):
         if ckpt_crash not in (None, "before", "after"):
             raise ValueError(
@@ -236,9 +252,12 @@ class FaultPlan:
         self.host_loss_rank = None if host_loss_rank is None \
             else int(host_loss_rank)
         self.host_loss_at_step = int(host_loss_at_step)
+        self.io_error_rate = float(io_error_rate)
+        self.io_error_seed = int(io_error_seed)
         self.rank = None if rank is None else int(rank)
         self.mode = mode
         # one-shot disarm state
+        self._io_error_attempts: dict = {}
         self._replica_kill_fired = False
         self._nan_fired = False
         self._stall_fired = False
@@ -249,56 +268,56 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultPlan"]:
-        """Parse the PADDLE_FAULT_* contract; None when nothing is armed."""
+        """Parse the PADDLE_FAULT_* contract; None when nothing is armed.
+
+        Every knob is read through the envcontract registry's typed
+        parser (ISSUE 18 satellite): the declaration in
+        ``fluid.envcontract`` — name, type, default — is the single
+        source of truth the chaos schedule auto-discovers from and
+        ``repo_lint`` enforces, so an undeclared fault knob cannot be
+        consumed here.  ``env`` may be any mapping (the supervisor's
+        per-worker dicts in tests); the default is the live process
+        environment."""
         env = os.environ if env is None else env
-        if not any(k.startswith("PADDLE_FAULT_") and v.strip()
+        if not any(k.startswith("PADDLE_FAULT_") and (v or "").strip()
                    for k, v in env.items()):
             return None
-        getf = lambda k, d=0.0: float(env.get(k, "").strip() or d)  # noqa: E731
-        kill = env.get("PADDLE_FAULT_KILL_STEP", "").strip()
-        rank = env.get("PADDLE_FAULT_RANK", "").strip()
-        ginf = env.get("PADDLE_FAULT_GRAD_INF_STEP", "").strip()
-        spike = env.get("PADDLE_FAULT_LOSS_SPIKE_STEP", "").strip()
-        stall_at = env.get("PADDLE_FAULT_DATA_STALL_AT", "").strip()
-        poison = env.get("PADDLE_FAULT_CKPT_POISON_SERIAL", "").strip()
-        rkill = env.get("PADDLE_FAULT_REPLICA_KILL_AFTER", "").strip()
+        from . import envcontract as _ec
+
+        def val(name):
+            knob = _ec.REGISTRY[name]  # KeyError = undeclared: on purpose
+            return knob.parse(env.get(name))
+
         return cls(
-            kill_step=int(kill) if kill else None,
-            ckpt_crash=env.get("PADDLE_FAULT_CKPT_CRASH", "").strip() or None,
-            ckpt_poison_serial=int(poison) if poison else None,
-            io_delay_ms=getf("PADDLE_FAULT_IO_DELAY_MS"),
-            nan_var=env.get("PADDLE_FAULT_NAN_VAR", "").strip() or None,
-            nan_step=int(getf("PADDLE_FAULT_NAN_STEP")),
-            grad_inf_step=int(ginf) if ginf else None,
-            grad_inf_value=getf("PADDLE_FAULT_GRAD_INF_VALUE",
-                                float("inf")),
-            loss_spike_step=int(spike) if spike else None,
-            loss_spike_factor=getf("PADDLE_FAULT_LOSS_SPIKE_FACTOR", 1e4),
-            barrier_stall_s=getf("PADDLE_FAULT_BARRIER_STALL"),
-            serve_delay_ms=getf("PADDLE_FAULT_SERVE_DELAY_MS"),
-            serve_fail_every=int(getf("PADDLE_FAULT_SERVE_FAIL_EVERY")),
-            decode_stall_ms=getf("PADDLE_FAULT_DECODE_STALL_MS"),
-            replica_kill_after=int(rkill) if rkill else None,
-            cache_corrupt=env.get("PADDLE_FAULT_CACHE_CORRUPT", "").strip()
-            .lower() in ("1", "true", "yes"),
-            data_stall_ms=getf("PADDLE_FAULT_DATA_STALL_MS"),
-            data_stall_at=int(stall_at) if stall_at else None,
-            shard_corrupt=env.get("PADDLE_FAULT_SHARD_CORRUPT", "").strip()
-            .lower() in ("1", "true", "yes"),
-            mem_pressure_mb=getf("PADDLE_FAULT_MEM_PRESSURE"),
-            mem_pressure_at=int(getf("PADDLE_FAULT_MEM_PRESSURE_AT", 8)),
-            straggler_rank=int(env.get("PADDLE_FAULT_STRAGGLER_RANK",
-                                       "").strip() or -1)
-            if env.get("PADDLE_FAULT_STRAGGLER_RANK", "").strip()
-            else None,
-            straggler_ms=getf("PADDLE_FAULT_STRAGGLER_MS"),
-            host_loss_rank=int(env.get("PADDLE_FAULT_HOST_LOSS_RANK",
-                                       "").strip() or -1)
-            if env.get("PADDLE_FAULT_HOST_LOSS_RANK", "").strip()
-            else None,
-            host_loss_at_step=int(getf("PADDLE_FAULT_HOST_LOSS_AT_STEP")),
-            rank=int(rank) if rank else None,
-            mode=env.get("PADDLE_FAULT_MODE", "").strip() or "exit",
+            kill_step=val("PADDLE_FAULT_KILL_STEP"),
+            ckpt_crash=val("PADDLE_FAULT_CKPT_CRASH"),
+            ckpt_poison_serial=val("PADDLE_FAULT_CKPT_POISON_SERIAL"),
+            io_delay_ms=val("PADDLE_FAULT_IO_DELAY_MS"),
+            nan_var=val("PADDLE_FAULT_NAN_VAR"),
+            nan_step=val("PADDLE_FAULT_NAN_STEP"),
+            grad_inf_step=val("PADDLE_FAULT_GRAD_INF_STEP"),
+            grad_inf_value=val("PADDLE_FAULT_GRAD_INF_VALUE"),
+            loss_spike_step=val("PADDLE_FAULT_LOSS_SPIKE_STEP"),
+            loss_spike_factor=val("PADDLE_FAULT_LOSS_SPIKE_FACTOR"),
+            barrier_stall_s=val("PADDLE_FAULT_BARRIER_STALL"),
+            serve_delay_ms=val("PADDLE_FAULT_SERVE_DELAY_MS"),
+            serve_fail_every=val("PADDLE_FAULT_SERVE_FAIL_EVERY"),
+            decode_stall_ms=val("PADDLE_FAULT_DECODE_STALL_MS"),
+            replica_kill_after=val("PADDLE_FAULT_REPLICA_KILL_AFTER"),
+            cache_corrupt=val("PADDLE_FAULT_CACHE_CORRUPT"),
+            data_stall_ms=val("PADDLE_FAULT_DATA_STALL_MS"),
+            data_stall_at=val("PADDLE_FAULT_DATA_STALL_AT"),
+            shard_corrupt=val("PADDLE_FAULT_SHARD_CORRUPT"),
+            mem_pressure_mb=val("PADDLE_FAULT_MEM_PRESSURE"),
+            mem_pressure_at=val("PADDLE_FAULT_MEM_PRESSURE_AT"),
+            straggler_rank=val("PADDLE_FAULT_STRAGGLER_RANK"),
+            straggler_ms=val("PADDLE_FAULT_STRAGGLER_MS"),
+            host_loss_rank=val("PADDLE_FAULT_HOST_LOSS_RANK"),
+            host_loss_at_step=val("PADDLE_FAULT_HOST_LOSS_AT_STEP"),
+            io_error_rate=val("PADDLE_FAULT_IO_ERROR_RATE"),
+            io_error_seed=val("PADDLE_FAULT_IO_ERROR_SEED"),
+            rank=val("PADDLE_FAULT_RANK"),
+            mode=val("PADDLE_FAULT_MODE"),
         )
 
     # -- firing --
@@ -363,11 +382,19 @@ def _host_loss_fire(plan: FaultPlan, lo: int, hi: int) -> None:
     hb_dir = os.environ.get("PADDLE_ELASTIC_HB_DIR")
     if hb_dir:
         gen = os.environ.get("PADDLE_ELASTIC_GENERATION", "0") or "0"
-        try:
+        marker = os.path.join(hb_dir, f"host_lost_g{gen}_r{rank}")
+
+        def _write_marker():
             os.makedirs(hb_dir, exist_ok=True)
-            with open(os.path.join(hb_dir,
-                                   f"host_lost_g{gen}_r{rank}"), "w") as f:
+            with open(marker, "w") as f:
                 f.write(str(time.time()))
+
+        try:
+            from .retry import retry_io
+
+            # the census marker is the survivor count's only input — a
+            # transient blip here must not silently shrink the record
+            retry_io(_write_marker, what="census.host_lost")
         except OSError:
             pass  # the crash below still fires; census just sees a kill
     plan._crash(
@@ -530,6 +557,46 @@ def io_delay() -> None:
     if plan is not None and plan.io_delay_ms > 0 \
             and plan._applies_to_this_rank():
         time.sleep(plan.io_delay_ms / 1000.0)
+
+
+def _io_error_key(path: str) -> str:
+    """Stable identity for a file across runs: the path's last two
+    components (``checkpoint_0/fc_0.w_0``, ``heartbeats/hb_1``) — the
+    enclosing temp/work dir differs per run, the tail does not, so the
+    SAME logical files fail under the same seed in every drill."""
+    parts = [p for p in os.path.normpath(path).split(os.sep) if p]
+    return "/".join(parts[-2:])
+
+
+def io_error(path: str, op: str) -> None:
+    """Deterministic transient-I/O oracle, consulted immediately before
+    each raw read/write of durable state (checkpoint var files, _SUCCESS
+    commits, census heartbeats/markers, warmup manifests, compile-cache
+    commits).  A seeded hash of ``(seed, path tail, op)`` picks the
+    fraction ``io_error_rate`` of keys that fail; for a picked key the
+    FIRST attempt raises OSError and every later attempt succeeds —
+    transient by construction, so bounded retry (``fluid.retry.
+    retry_io``) always recovers while an unretried site sees a hard
+    failure.  Content corruption never flows through here: a torn or
+    bit-rotted payload surfaces as ValueError at parse time and keeps
+    taking the serial-condemnation fallback, never the retry path."""
+    plan = active()
+    if plan is None or plan.io_error_rate <= 0 \
+            or not plan._applies_to_this_rank():
+        return
+    import hashlib
+
+    key = (_io_error_key(path), str(op))
+    digest = hashlib.sha1(
+        f"{plan.io_error_seed}|{key[0]}|{key[1]}".encode()).hexdigest()
+    if int(digest[:8], 16) / float(0xFFFFFFFF) >= plan.io_error_rate:
+        return
+    attempts = plan._io_error_attempts.get(key, 0)
+    plan._io_error_attempts[key] = attempts + 1
+    if attempts == 0:
+        raise OSError(
+            f"injected transient I/O error ({key[1]} {key[0]}, "
+            f"attempt 1 — retry succeeds)")
 
 
 def serving_request() -> None:

@@ -70,13 +70,23 @@ def snapshot_vars(scope, var_list) -> dict:
 
 def write_var_files(dirname, snapshot: dict) -> None:
     """One file per var, np.save format — the single place that encodes
-    the per-var on-disk layout (load_vars is its reader)."""
+    the per-var on-disk layout (load_vars is its reader).  Each write is
+    wrapped in bounded transient retry (``fluid.retry``): an OSError is
+    a storage blip worth another attempt, never a reason to lose the
+    serial."""
     from . import fault as _fault
+    from .retry import retry_io
 
     for name, arr in snapshot.items():
-        _fault.io_delay()
-        with open(os.path.join(dirname, name), "wb") as f:
-            np.save(f, arr, allow_pickle=False)
+        path = os.path.join(dirname, name)
+
+        def _write(path=path, arr=arr):
+            _fault.io_delay()
+            _fault.io_error(path, "write")
+            with open(path, "wb") as f:
+                np.save(f, arr, allow_pickle=False)
+
+        retry_io(_write, what="ckpt.var_write")
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -98,6 +108,9 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                 if v.name in data:
                     scope.set(v.name, data[v.name])
         return
+    from . import fault as _fault
+    from .retry import retry_io
+
     for v in var_list:
         path = os.path.join(dirname, v.name)
         if not os.path.exists(path):
@@ -109,8 +122,16 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             raise IOError(
                 f"load_vars: no saved file for variable '{v.name}' in "
                 f"{dirname} (program/name mismatch with the checkpoint?)")
-        with open(path, "rb") as f:
-            scope.set(v.name, np.load(f, allow_pickle=False))
+
+        def _read(path=path):
+            # transient OSError retries; a corrupt payload raises
+            # ValueError from np.load and flows UNRETRIED to the
+            # caller's serial-condemnation fallback (load_checkpoint)
+            _fault.io_error(path, "read")
+            with open(path, "rb") as f:
+                return np.load(f, allow_pickle=False)
+
+        scope.set(v.name, retry_io(_read, what="ckpt.var_read"))
 
 
 def load_params(executor, dirname, main_program=None, filename=None,

@@ -1,0 +1,81 @@
+"""Bounded retry for transient I/O on durable-state paths (ISSUE 18).
+
+Every durable-state write the recovery machinery depends on — checkpoint
+var files, ``_SUCCESS`` commits, census heartbeats and host-loss markers,
+serving warmup manifests, compile-cache commits — used to treat the first
+transient ``OSError`` as fatal (or, worse, as serial-condemning
+corruption).  :func:`retry_io` is the one wrapper those call sites share:
+``OSError`` means *transient* and earns bounded retry with exponential
+backoff (``master.Backoff``, the reference Go master's reconnect pacing);
+anything else — ``ValueError`` from a torn npy header, ``EOFError``,
+``ReshardError`` — means *content*, is never retried, and keeps flowing
+to the caller's existing condemnation/fallback path untouched.  That
+split is the hardening contract the chaos drills verify: with
+``PADDLE_FAULT_IO_ERROR_RATE`` armed, saves/loads succeed through
+retries, while a genuinely corrupt serial still falls back.
+
+Each retry is observable: one ``io.retry`` run event plus an
+``io.retries{what=...}`` counter bump in the process registry — the
+acceptance oracle ("retry counters nonzero in the observe stream") and
+the postmortem's evidence that storage, not code, was flaky.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, TypeVar
+
+from . import envcontract as _ec
+
+__all__ = ["retry_io"]
+
+T = TypeVar("T")
+
+#: backoff ceiling between attempts — transients are sub-second events;
+#: anything needing longer belongs to the supervisor's restart budget
+_MAX_DELAY_S = 2.0
+
+
+def retry_io(fn: Callable[[], T], *, what: str,
+             attempts: Optional[int] = None,
+             base_s: Optional[float] = None,
+             sleep: Callable[[float], None] = time.sleep) -> T:
+    """Run ``fn`` (a zero-arg I/O closure), retrying ``OSError`` up to
+    ``attempts`` total tries with exponential backoff.
+
+    ``what`` labels the call site (``ckpt.var_write``, ``census.
+    heartbeat``, ...) in the retry counter and event stream.  Defaults
+    come live from the env contract (``PADDLE_IO_RETRIES`` /
+    ``PADDLE_IO_RETRY_BASE_S``), so a subprocess worker's env is honored
+    without plumbing.  The final failure re-raises the last ``OSError``
+    — callers keep exactly the error contract they had before the
+    wrapper, just with transients absorbed."""
+    if attempts is None:
+        attempts = int(_ec.get("PADDLE_IO_RETRIES"))
+    if base_s is None:
+        base_s = float(_ec.get("PADDLE_IO_RETRY_BASE_S"))
+    attempts = max(1, int(attempts))
+    from ..parallel.master import Backoff
+
+    backoff = Backoff(base=float(base_s), factor=2.0,
+                      max_delay=_MAX_DELAY_S)
+    last: Optional[OSError] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except OSError as exc:
+            last = exc
+            if attempt + 1 >= attempts:
+                break
+            try:
+                from .. import observe as _observe
+
+                _observe.registry().inc("io.retries",
+                                        labels={"what": what})
+                _observe.emit("io.retry", what=what, attempt=attempt + 1,
+                              error=f"{type(exc).__name__}: {exc}")
+            except Exception:
+                pass  # telemetry must never fail the I/O it describes
+            sleep(backoff.delay(attempt))
+    assert last is not None
+    raise last

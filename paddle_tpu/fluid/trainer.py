@@ -252,10 +252,17 @@ def save_checkpoint(executor, checkpoint_dir, main_program,
 def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
                        max_num_checkpoints, data_state=None):
     from . import fault as _fault
+    from .retry import retry_io
 
     if trainer_args is not None:
-        with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
-            json.dump(trainer_args, f)
+        args_path = os.path.join(cur, TRAINER_ARGS_FILE)
+
+        def _write_args():
+            _fault.io_error(args_path, "write")
+            with open(args_path, "w") as f:
+                json.dump(trainer_args, f)
+
+        retry_io(_write_args, what="ckpt.trainer_args")
     if data_state is not None:
         from ..data.checkpoint import save_data_state
 
@@ -273,8 +280,16 @@ def _finish_checkpoint(checkpoint_dir, cur, trainer_args,
     except (ValueError, IndexError):
         pass  # non-serial dirname: nothing to key the poison on
     _fault.ckpt_crash_point("before")
-    with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
-        f.write("")
+    success_path = os.path.join(cur, SUCCESS_MARK)
+
+    def _write_success():
+        # the commit point itself: a transient blip here must not turn a
+        # fully-written serial into an ignored corpse — retry, bounded
+        _fault.io_error(success_path, "write")
+        with open(success_path, "w") as f:
+            f.write("")
+
+    retry_io(_write_success, what="ckpt.success")
     _fault.ckpt_crash_point("after")
     try:
         from .. import observe as _observe
@@ -348,8 +363,27 @@ def load_checkpoint(executor, checkpoint_dir, main_program):
         args = {}
         args_path = os.path.join(cur, TRAINER_ARGS_FILE)
         if os.path.exists(args_path):
-            with open(args_path) as f:
-                args = json.load(f)
+            from . import fault as _fault
+            from .retry import retry_io
+
+            def _read_args():
+                _fault.io_error(args_path, "read")
+                with open(args_path) as f:
+                    return f.read()
+
+            try:
+                args = json.loads(retry_io(_read_args,
+                                           what="ckpt.trainer_args"))
+            except (OSError, ValueError) as exc:
+                # same condemnation contract as the weights: a serial
+                # whose args cannot be read (after transient retries)
+                # falls back to the previous complete one
+                from .log import LOG
+
+                LOG(f"checkpoint {cur} trainer args unreadable "
+                    f"({exc!r}); falling back to the previous serial")
+                last_exc = exc
+                continue
         if data_state is not None:
             args["data_state"] = data_state
         return args

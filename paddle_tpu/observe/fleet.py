@@ -46,9 +46,16 @@ def scan_dir(root: str) -> Dict[str, List[str]]:
 def _load_metrics(path: str) -> Optional[dict]:
     try:
         with open(path) as f:
-            return json.load(f)
+            snap = json.load(f)
     except (OSError, ValueError):
         return None  # torn/corrupt snapshot: skip, never fail the fleet view
+    # a torn write can still be VALID json of the wrong shape (e.g. a bare
+    # number from a truncated tail) — shape-check here so the aggregation
+    # below never AttributeErrors on a non-dict "snapshot"
+    if not isinstance(snap, dict) or not isinstance(snap.get("meta", {}),
+                                                    dict):
+        return None
+    return snap
 
 
 def fleet_snapshot(root: str) -> dict:

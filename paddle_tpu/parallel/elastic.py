@@ -143,13 +143,24 @@ def write_heartbeat(hb_dir: str, step: Optional[int] = None,
         except Exception:
             commit_step = None
     try:
+        from ..fluid import fault as _fault
+        from ..fluid.retry import retry_io
+
         os.makedirs(hb_dir, exist_ok=True)
         path = heartbeat_path(hb_dir, rank)
         tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"ts": time.time(), "step": step, "rank": int(rank),
-                       "pid": os.getpid(), "commit_step": commit_step}, f)
-        os.replace(tmp, path)
+
+        def _publish():
+            _fault.io_error(path, "write")
+            with open(tmp, "w") as f:
+                json.dump({"ts": time.time(), "step": step,
+                           "rank": int(rank), "pid": os.getpid(),
+                           "commit_step": commit_step}, f)
+            os.replace(tmp, path)
+
+        # bounded retry first — a missed beat from a storage blip looks
+        # exactly like a dead worker to the supervisor
+        retry_io(_publish, what="census.heartbeat")
     except OSError:
         # liveness reporting must never kill the training it reports on
         pass
@@ -255,7 +266,11 @@ class ElasticSupervisor:
         self.hb_timeout = float(hb_timeout)
         self.poll_interval = float(poll_interval)
         self.max_restarts = int(max_restarts)
-        self.backoff = backoff or Backoff(base=0.5, factor=2.0, max_delay=30.0)
+        # jittered by default (ISSUE 18): after a fleet-wide kill every
+        # pod's supervisor would otherwise re-register on the same
+        # exponential instants — the thundering herd the jitter smears
+        self.backoff = backoff or Backoff(base=0.5, factor=2.0,
+                                          max_delay=30.0, jitter=0.25)
         self.devices_per_host = devices_per_host
         self.extra_env = dict(extra_env or {})
         self.fault_env = dict(fault_env or {})

@@ -40,16 +40,36 @@ class Backoff:
     """Deterministic exponential backoff with a cap — the retry pacing the
     reference's master/pserver clients use between reconnect attempts
     (ref go/master/client.go retry loop), shared by the elastic pod
-    supervisor between generation restarts.  ``delay(k)`` is the wait
-    before attempt k (k=0 -> base)."""
+    supervisor between generation restarts and the transient-I/O retry
+    wrapper (``fluid.retry``).  ``delay(k)`` is the wait before attempt k
+    (k=0 -> base).
+
+    ``jitter`` (ISSUE 18 satellite) spreads a fleet-wide restart:
+    ``delay(k)`` is multiplied by ``1 + jitter * u_k`` with ``u_k`` drawn
+    from a private ``random.Random(seed)`` stream — after a fleet-wide
+    kill, N supervisors re-registering on the bare exponential land on
+    the coordinator in the same instant (the thundering herd); jittered,
+    they smear across ``[d, d * (1 + jitter)]``.  ``seed=None`` keeps
+    production entropy; a pinned seed makes the whole delay sequence
+    reproducible (the unit-test contract)."""
 
     base: float = 1.0
     factor: float = 2.0
     max_delay: float = 30.0
+    jitter: float = 0.0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        import random
+
+        self._rng = random.Random(self.seed)
 
     def delay(self, attempt: int) -> float:
         d = self.base * (self.factor ** max(0, int(attempt)))
-        return min(d, self.max_delay)
+        d = min(d, self.max_delay)
+        if self.jitter > 0.0:
+            d *= 1.0 + self.jitter * self._rng.random()
+        return d
 
 
 @dataclass

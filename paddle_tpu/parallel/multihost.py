@@ -236,7 +236,16 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
     import json
 
     from ..fluid import fault as _fault
+    from ..fluid.retry import retry_io
     from ..fluid.transpiler.ps_dispatcher import assign_writer
+
+    def _save_npy(path, host_arr):
+        def _write():
+            _fault.io_delay()
+            _fault.io_error(path, "write")
+            np.save(path, host_arr)
+
+        retry_io(_write, what="ckpt.shard_write")
 
     pid = process_index()
     d = os.path.join(ckpt_dir, f"shard_{pid}")
@@ -260,8 +269,7 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
             # host run): one blob, written by its assigned process
             if writer_of.get(name, 0) == pid or not _initialized:
                 fn = f"{_safe_name(name)}.full.npy"
-                _fault.io_delay()
-                np.save(os.path.join(d, fn), np.asarray(arr))
+                _save_npy(os.path.join(d, fn), np.asarray(arr))
                 entry["shards"].append({"file": fn, "index": None})
         else:
             seen = set()
@@ -280,16 +288,22 @@ def save_sharded(state: dict, ckpt_dir: str) -> None:
                     # empty index is trivially full): one assigned writer
                     continue
                 fn = f"{_safe_name(name)}.{i}.npy"
-                _fault.io_delay()
-                np.save(os.path.join(d, fn), np.asarray(sh.data))
+                _save_npy(os.path.join(d, fn), np.asarray(sh.data))
                 entry["shards"].append({"file": fn,
                                         "index": [list(p) for p in idx]})
         if entry["shards"]:
             manifest[name] = entry
     # manifest is written LAST: its presence marks this process's shard dir
     # complete (a preempted writer leaves .npy files but no manifest)
-    with open(os.path.join(d, "manifest.json"), "w") as f:
-        json.dump({"process_count": process_count(), "vars": manifest}, f)
+    mf_path = os.path.join(d, "manifest.json")
+
+    def _write_manifest():
+        _fault.io_error(mf_path, "write")
+        with open(mf_path, "w") as f:
+            json.dump({"process_count": process_count(),
+                       "vars": manifest}, f)
+
+    retry_io(_write_manifest, what="ckpt.shard_manifest")
 
 
 def load_sharded(ckpt_dir: str, mesh: Optional[Mesh], specs: dict) -> dict:
@@ -302,6 +316,19 @@ def load_sharded(ckpt_dir: str, mesh: Optional[Mesh], specs: dict) -> dict:
     and returns host numpy arrays (scope-level restore)."""
     import json
 
+    from ..fluid import fault as _fault
+    from ..fluid.retry import retry_io
+
+    def _read_json(path):
+        # transient OSError retries; garbage content raises ValueError
+        # unretried — load_sharded_latest's corrupt-serial fallback owns it
+        def _read():
+            _fault.io_error(path, "read")
+            with open(path) as f:
+                return f.read()
+
+        return json.loads(retry_io(_read, what="ckpt.shard_manifest"))
+
     # process 0's manifest is canonical for the world size: stale higher-
     # index shard dirs from an older, larger-world save in the same
     # directory must be ignored, not merged over fresh weights
@@ -310,8 +337,7 @@ def load_sharded(ckpt_dir: str, mesh: Optional[Mesh], specs: dict) -> dict:
         raise IOError(
             f"sharded checkpoint {ckpt_dir}: shard_0/manifest.json missing "
             f"— no complete checkpoint here")
-    with open(mf0) as f:
-        expected_procs = int(json.load(f).get("process_count", 1))
+    expected_procs = int(_read_json(mf0).get("process_count", 1))
 
     assembled: dict = {}
     covered: dict = {}
@@ -328,8 +354,7 @@ def load_sharded(ckpt_dir: str, mesh: Optional[Mesh], specs: dict) -> dict:
             raise IOError(
                 f"sharded checkpoint {ckpt_dir}: {sub} has no manifest — "
                 f"its writer was interrupted; checkpoint is incomplete")
-        with open(mf) as f:
-            payload = json.load(f)
+        payload = _read_json(mf)
         found_procs.add(pid)
         for name, entry in payload["vars"].items():
             shape = tuple(entry["shape"])
@@ -337,7 +362,13 @@ def load_sharded(ckpt_dir: str, mesh: Optional[Mesh], specs: dict) -> dict:
                 assembled[name] = np.zeros(shape, np.dtype(entry["dtype"]))
                 covered[name] = 0
             for sh in entry["shards"]:
-                data = np.load(os.path.join(sd, sh["file"]))
+                shard_path = os.path.join(sd, sh["file"])
+
+                def _read_shard(path=shard_path):
+                    _fault.io_error(path, "read")
+                    return np.load(path)
+
+                data = retry_io(_read_shard, what="ckpt.shard_read")
                 if sh["index"] is None:
                     assembled[name][...] = data
                     covered[name] = assembled[name].size
@@ -485,15 +516,29 @@ def save_sharded_serial(state: dict, root: str, serial: int,
     mesh_tag = axes_label({a: e for a, e in meta.get("mesh_axes") or []})
     barrier_s = barrier(f"ckpt_shards_{serial}")
     if process_index() == 0:
-        with open(os.path.join(cur, META_FILE), "w") as f:
-            _json.dump(meta, f)
+        from ..fluid.retry import retry_io
+
+        meta_path = os.path.join(cur, META_FILE)
+
+        def _write_meta():
+            _fault.io_error(meta_path, "write")
+            with open(meta_path, "w") as f:
+                _json.dump(meta, f)
+
+        retry_io(_write_meta, what="ckpt.meta")
         # poison hook before the commit: a matching serial is rewritten
         # NaN (every rank's shards — the walk is recursive) yet still
         # gets its _SUCCESS, the serving canary's rollback oracle
         _fault.ckpt_poison(int(serial), cur)
         _fault.ckpt_crash_point("before")
-        with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
-            f.write("")
+        success_path = os.path.join(cur, SUCCESS_MARK)
+
+        def _write_success():
+            _fault.io_error(success_path, "write")
+            with open(success_path, "w") as f:
+                f.write("")
+
+        retry_io(_write_success, what="ckpt.success")
         _fault.ckpt_crash_point("after")
         from .. import observe
 

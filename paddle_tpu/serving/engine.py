@@ -692,11 +692,21 @@ class ServingEngine:
             "memory": dict(mem_table or {}),
         }
         try:
+            from ..fluid import fault as _fault
+            from ..fluid.retry import retry_io
+
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-            os.replace(tmp, path)
+
+            def _commit():
+                _fault.io_error(path, "write")
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, path)
+
+            # transient blips retry; a persistently failing store still
+            # only costs the NEXT process its cached warmup
+            retry_io(_commit, what="serving.manifest")
         except OSError:
             pass
 

@@ -1,0 +1,399 @@
+"""Chaos engine (ISSUE 18): seeded fault schedules, persisted-truth
+invariant verdicts, transient-I/O retry hardening, and the composed
+multi-fault drills the engine exists to run."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.fluid import fault
+from paddle_tpu.fluid.retry import retry_io
+from paddle_tpu.parallel.master import Backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def disarm():
+    fault.clear()
+    observe.reset()
+    yield
+    fault.clear()
+    observe.reset()
+
+
+# ---------------------------------------------------------------------------
+# schedule: seed -> replayable plan, auto-discovered from envcontract
+# ---------------------------------------------------------------------------
+
+def test_catalog_covers_fault_registry():
+    """Every samplable PADDLE_FAULT_* knob in the envcontract registry is
+    either in the chaos catalog or explicitly exempt/excluded — a new
+    fault hook cannot ship invisible to the drills."""
+    from paddle_tpu.chaos import uncovered_knobs
+
+    assert uncovered_knobs() == []
+
+
+def test_plan_deterministic_and_seed_sensitive():
+    from paddle_tpu.chaos import (ChaosSchedule, SCENARIO_SHAPE,
+                                  canonical_json)
+
+    for scenario, shape in SCENARIO_SHAPE.items():
+        a = canonical_json(ChaosSchedule(scenario, 11, 3, **shape).plan())
+        b = canonical_json(ChaosSchedule(scenario, 11, 3, **shape).plan())
+        c = canonical_json(ChaosSchedule(scenario, 12, 3, **shape).plan())
+        assert a == b, scenario
+        assert a != c, scenario
+
+
+def test_plan_shapes():
+    """Interruptible scenarios always draw >=1 interrupting fault (else
+    nothing restarts and resume invariants are vacuous); train plans pin
+    raise-mode so the in-process runner survives the 'kill'."""
+    from paddle_tpu.chaos import ChaosSchedule, SCENARIO_SHAPE
+
+    for seed in range(8):
+        for scenario in ("train", "elastic"):
+            plan = ChaosSchedule(scenario, seed, 3,
+                                 **SCENARIO_SHAPE[scenario]).plan()
+            assert any(f["interrupting"] for f in plan["faults"]), plan
+            knobs = set(plan["env"])
+            for f in plan["faults"]:
+                assert set(f["env"]) <= knobs
+        train = ChaosSchedule("train", seed, 2,
+                              **SCENARIO_SHAPE["train"]).plan()
+        assert train["env"]["PADDLE_FAULT_MODE"] == "raise"
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: jittered restart backoff (thundering-herd smear)
+# ---------------------------------------------------------------------------
+
+def test_backoff_jitter_pinned_sequence():
+    b = Backoff(base=0.5, factor=2.0, max_delay=30.0, jitter=0.25, seed=7)
+    got = [b.delay(k) for k in range(5)]
+    np.testing.assert_allclose(got, [
+        0.5404790956041453, 1.0377122934811256, 2.325467236519927,
+        4.072436286667543, 9.071764008613378], rtol=0, atol=0)
+    # replayable: a fresh instance with the same seed repeats itself
+    b2 = Backoff(base=0.5, factor=2.0, max_delay=30.0, jitter=0.25,
+                 seed=7)
+    assert [b2.delay(k) for k in range(5)] == got
+
+
+def test_backoff_jitter_bounds_and_default_off():
+    b = Backoff(base=0.5, factor=2.0, max_delay=30.0, jitter=0.25,
+                seed=123)
+    for k in range(8):
+        base = min(0.5 * 2.0 ** k, 30.0)
+        assert base <= b.delay(k) <= base * 1.25
+    # jitter=0 stays the exact exponential schedule older callers pin
+    plain = Backoff(base=0.5, factor=2.0, max_delay=30.0)
+    assert [plain.delay(k) for k in range(3)] == [0.5, 1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# transient-I/O oracle + bounded retry (tentpole hardening)
+# ---------------------------------------------------------------------------
+
+def test_io_error_hook_is_transient_and_deterministic(tmp_path):
+    """rate=1.0 picks every path-key; a picked (key, op) raises on the
+    FIRST attempt only — transient by construction, so one retry always
+    clears it; same seed re-picks the same keys."""
+    fault.install(fault.FaultPlan(io_error_rate=1.0, io_error_seed=9))
+    p = str(tmp_path / "a" / "b.json")
+    with pytest.raises(OSError):
+        fault.io_error(p, "write")
+    fault.io_error(p, "write")  # attempt 1: clean
+    with pytest.raises(OSError):
+        fault.io_error(p, "read")  # distinct op: its own first attempt
+
+
+def test_retry_io_recovers_and_counts(tmp_path):
+    observe.configure(str(tmp_path / "obs"))
+    fault.install(fault.FaultPlan(io_error_rate=1.0, io_error_seed=9))
+    target = str(tmp_path / "out.json")
+
+    def _write():
+        fault.io_error(target, "write")
+        with open(target, "w") as f:
+            json.dump({"ok": True}, f)
+
+    retry_io(_write, what="test.write", sleep=lambda s: None)
+    with open(target) as f:
+        assert json.load(f) == {"ok": True}
+    sink = observe.get_sink()
+    sink.flush()
+    from paddle_tpu.observe.fleet import fleet_events, fleet_snapshot
+
+    evs = [r for r in fleet_events(str(tmp_path / "obs"))
+           if r.get("event") == "io.retry"]
+    assert evs and evs[0]["what"] == "test.write"
+    counters = fleet_snapshot(str(tmp_path / "obs"))["counters_sum"]
+    assert counters.get('io.retries{what="test.write"}', 0) >= 1
+
+
+def test_retry_io_reraises_persistent_oserror():
+    boom = OSError("disk on fire")
+    calls = []
+
+    def _always():
+        calls.append(1)
+        raise boom
+
+    with pytest.raises(OSError) as exc:
+        retry_io(_always, what="test.fail", attempts=3,
+                 sleep=lambda s: None)
+    assert exc.value is boom
+    assert len(calls) == 3  # bounded, not infinite
+
+
+def test_sharded_serial_survives_io_oracle(tmp_path):
+    """Checkpoint save/load under a 100% transient-error oracle: every
+    write/read path fails once and recovers through retry_io — the save
+    commits, the load round-trips bitwise."""
+    from paddle_tpu.parallel import multihost as mh
+
+    os.environ["PADDLE_IO_RETRY_BASE_S"] = "0.001"
+    try:
+        observe.configure(str(tmp_path / "obs"))
+        fault.install(fault.FaultPlan(io_error_rate=1.0, io_error_seed=3))
+        root = str(tmp_path / "ckpt")
+        states = [{"w": np.arange(6, dtype=np.float32).reshape(2, 3) + i}
+                  for i in range(2)]
+        for i, st in enumerate(states):
+            mh.save_sharded_serial(st, root, serial=i, meta={"step": i},
+                                   max_num=2)
+        serial, meta, back = mh.load_sharded_latest(root, None, {})
+        assert serial == 1 and meta["step"] == 1
+        np.testing.assert_array_equal(back["w"], states[1]["w"])
+        observe.get_sink().flush()
+        from paddle_tpu.observe.fleet import fleet_events
+
+        whats = {r.get("what") for r in
+                 fleet_events(str(tmp_path / "obs"))
+                 if r.get("event") == "io.retry"}
+        assert whats  # the oracle really fired and really recovered
+    finally:
+        os.environ.pop("PADDLE_IO_RETRY_BASE_S", None)
+
+
+def test_retry_does_not_mask_corruption(tmp_path):
+    """The acceptance edge: with the transient oracle ACTIVE, a genuinely
+    corrupt serial (truncated shard after commit) still condemns and
+    falls back to the previous serial — retry_io retries OSError only,
+    never the ValueError corruption path."""
+    from paddle_tpu.parallel import multihost as mh
+
+    os.environ["PADDLE_IO_RETRY_BASE_S"] = "0.001"
+    try:
+        fault.install(fault.FaultPlan(io_error_rate=1.0, io_error_seed=3))
+        root = str(tmp_path / "ckpt")
+        states = [{"w": np.full((4,), float(i), np.float32)}
+                  for i in range(2)]
+        for i, st in enumerate(states):
+            mh.save_sharded_serial(st, root, serial=i, meta={"step": i},
+                                   max_num=3)
+        victim = os.path.join(root, "checkpoint_1", "shard_0",
+                              "w.full.npy")
+        with open(victim, "r+b") as f:
+            f.truncate(4)
+        serial, meta, back = mh.load_sharded_latest(root, None, {})
+        assert serial == 0 and meta["step"] == 0
+        np.testing.assert_array_equal(back["w"], states[0]["w"])
+    finally:
+        os.environ.pop("PADDLE_IO_RETRY_BASE_S", None)
+
+
+def test_write_heartbeat_retries_under_io_oracle(tmp_path):
+    from paddle_tpu.parallel import elastic
+
+    os.environ["PADDLE_IO_RETRY_BASE_S"] = "0.001"
+    try:
+        fault.install(fault.FaultPlan(io_error_rate=1.0, io_error_seed=5))
+        hb_dir = str(tmp_path / "hb")
+        elastic.write_heartbeat(hb_dir, rank=0, step=7, commit_step=6)
+        path = elastic.heartbeat_path(hb_dir, 0)
+        with open(path) as f:
+            hb = json.load(f)
+        assert hb["step"] == 7 and hb["commit_step"] == 6
+    finally:
+        os.environ.pop("PADDLE_IO_RETRY_BASE_S", None)
+
+
+# ---------------------------------------------------------------------------
+# satellite 6: torn-write tolerance in the verdict path
+# ---------------------------------------------------------------------------
+
+def test_read_jsonl_tolerant_drops_torn_and_nondict(tmp_path):
+    from paddle_tpu.chaos import read_jsonl_tolerant
+
+    p = str(tmp_path / "seq.jsonl")
+    with open(p, "w") as f:
+        f.write('{"digest": "aa"}\n')
+        f.write('123\n')                 # valid json, wrong shape
+        f.write('{"digest": "bb"}\n')
+        f.write('{"digest": "cc"')       # torn final line (no newline)
+    assert read_jsonl_tolerant(p) == [{"digest": "aa"}, {"digest": "bb"}]
+    assert read_jsonl_tolerant(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_chaos_report_reader_tolerates_torn_tail(tmp_path):
+    from paddle_tpu.chaos import read_report
+
+    p = str(tmp_path / "chaos_report.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "plan",
+                            "plan": {"scenario": "train"}}) + "\n")
+        f.write(json.dumps({"kind": "verdict", "invariant": "x",
+                            "status": "PASS", "detail": "d"}) + "\n")
+        f.write('{"kind": "summary", "ok": tr')  # died mid-summary
+    rep = read_report(p)
+    assert rep["plan"] == {"scenario": "train"}
+    assert rep["verdicts"] == [{"invariant": "x", "status": "PASS",
+                                "detail": "d"}]
+    assert rep["summary"] is None  # partial, never a crash
+
+
+def test_fleet_snapshot_tolerates_non_dict_snapshot(tmp_path):
+    """A torn metric snapshot that still parses as valid JSON of the
+    wrong shape (a bare number, a list) is a PARTIAL skip, never an
+    AttributeError inside the aggregation."""
+    from paddle_tpu.observe.fleet import fleet_snapshot
+
+    root = str(tmp_path)
+    good = {"meta": {"host": "h", "rank": 0, "gen": 0},
+            "counters": {"steps": 4}}
+    with open(os.path.join(root, "metrics-h-r0-g0.json"), "w") as f:
+        json.dump(good, f)
+    with open(os.path.join(root, "metrics-h-r1-g0.json"), "w") as f:
+        f.write("123")            # valid json, not a snapshot
+    with open(os.path.join(root, "metrics-h-r2-g0.json"), "w") as f:
+        f.write('{"meta": 7}')    # dict with non-dict meta
+    with open(os.path.join(root, "metrics-h-r3-g0.json"), "w") as f:
+        f.write('{"meta": {"host"')  # torn mid-write
+    snap = fleet_snapshot(root)
+    assert snap["counters_sum"] == {"steps": 4}
+    assert sorted(snap["partial"]) == [
+        "metrics-h-r1-g0.json", "metrics-h-r2-g0.json",
+        "metrics-h-r3-g0.json"]
+
+
+# ---------------------------------------------------------------------------
+# the smoke tool (tier-1 CI oracle: drill PASS + tamper -> FAIL)
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_tool():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py")],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    report = json.loads(out.stdout)
+    assert report["ok"], report
+    assert report["plan_deterministic"] and report["tamper_detected"]
+    assert report["retries_recovered"], report
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: two faults composed in ONE supervised generation
+# ---------------------------------------------------------------------------
+
+def test_supervised_composed_straggler_and_data_stall(tmp_path):
+    """A straggler (rank 1, +30 ms/step) AND a one-shot 150 ms data
+    stall fire in the same supervised 2-rank generation: the pod still
+    finishes in ONE generation (neither fault is fatal), the stall lands
+    as a ``data.stall`` event in the merged stream, and offline
+    rank-skew analysis over the same stream flags exactly rank 1."""
+    from paddle_tpu.chaos import runner as chaos_runner
+    from paddle_tpu.observe.fleet import fleet_events, rank_skew
+    from paddle_tpu.parallel.elastic import ElasticSupervisor
+
+    workdir = str(tmp_path)
+    worker_py = os.path.join(workdir, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(chaos_runner._WORKER)
+    sup = ElasticSupervisor(
+        f"{sys.executable} {worker_py}", nproc=2, workdir=workdir,
+        hb_timeout=120.0, poll_interval=0.2, max_restarts=1,
+        backoff=Backoff(base=0.2, factor=1.0), deadline=240.0,
+        extra_env={
+            "CHAOS_REPO": REPO, "CHAOS_WORKDIR": workdir,
+            "CHAOS_NPROC": "2", "PADDLE_TPU_SPD": "2",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1 "
+                         "--xla_cpu_enable_concurrency_optimized_"
+                         "scheduler=false",
+        },
+        fault_env={
+            "PADDLE_FAULT_STRAGGLER_RANK": "1",
+            "PADDLE_FAULT_STRAGGLER_MS": "30",
+            "PADDLE_FAULT_DATA_STALL_AT": "10",
+            "PADDLE_FAULT_DATA_STALL_MS": "150",
+        },
+        observe_dir=os.path.join(workdir, "observe"))
+    result = sup.run()
+    assert result["status"] == "finished", result
+    assert result["generations"] == 1, result
+    for rank in range(2):
+        path = os.path.join(workdir, f"result_r{rank}_g0.json")
+        assert os.path.exists(path), result
+        with open(path) as f:
+            blob = json.load(f)
+        assert blob["resume_step"] == 0  # never restarted
+
+    records = fleet_events(os.path.join(workdir, "observe"))
+    stalls = [r for r in records if r.get("event") == "data.stall"]
+    assert stalls and max(s.get("wait_ms", 0) for s in stalls) >= 100.0
+
+    skew = rank_skew(records, min_samples=3)
+    flagged = {s["worker"] for s in skew["stragglers"]}
+    assert any(w.endswith(":r1") for w in flagged), skew
+    assert not any(w.endswith(":r0") for w in flagged), skew
+
+
+# ---------------------------------------------------------------------------
+# slow: the acceptance drill + the 8-seed scenario matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_elastic_seed7_acceptance(tmp_path):
+    """ISSUE 18 acceptance verbatim: the seed-7 3-fault elastic drill,
+    run twice, produces byte-identical fault plans and all-PASS
+    verdicts."""
+    reports = []
+    for tag in ("a", "b"):
+        workdir = str(tmp_path / tag)
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.chaos", "run",
+             "--scenario", "elastic", "--seed", "7", "--faults", "3",
+             "--workdir", workdir],
+            capture_output=True, text=True, timeout=420, cwd=REPO)
+        assert out.returncode == 0, (out.stdout[-3000:],
+                                     out.stderr[-3000:])
+        with open(os.path.join(workdir, "plan.json"), "rb") as f:
+            reports.append(f.read())
+    assert reports[0] == reports[1]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario,seed", [
+    ("train", 3), ("train", 6),
+    ("elastic", 7), ("elastic", 2),
+    ("serve", 1), ("serve", 2),
+    ("fleet", 1), ("fleet", 4),
+])
+def test_chaos_seed_matrix(tmp_path, scenario, seed):
+    """Eight seeded drills across the four scenarios — the soak the
+    chaos engine exists for: every sampled plan must execute and every
+    applicable invariant must hold."""
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.chaos", "run",
+         "--scenario", scenario, "--seed", str(seed), "--faults", "3",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-3000:])

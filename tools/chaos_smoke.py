@@ -1,0 +1,116 @@
+"""Chaos-engine smoke (CPU, < 10 s) — the ISSUE 18 CI oracle.
+
+Three claims the chaos engine stands on, checked end to end:
+
+ 1. **replayability** — two :class:`ChaosSchedule` expansions of the same
+    seed produce byte-identical canonical plan JSON (and a different seed
+    produces a different plan);
+ 2. **a real drill passes** — one seeded 2-fault train drill (kill mid-run
+    + transient-I/O oracle) executes, resumes, and every applicable
+    invariant verdict is PASS, with nonzero ``io.retries`` recovered;
+ 3. **the verdicts bite** — tampering one persisted artifact (a batch
+    digest in the coverage log) and re-evaluating the SAME workdir flips
+    the coverage invariant to FAIL (exit path the CLI maps to nonzero).
+
+Run directly (``python tools/chaos_smoke.py``) or from tier-1 via
+``tests/test_chaos.py::test_chaos_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    "--xla_force_host_platform_device_count=1 "
+    "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SCENARIO = "train"
+SEED = 3     # samples kill + io_error for the train scenario
+FAULTS = 2
+
+
+def main() -> dict:
+    from paddle_tpu.chaos import (ChaosSchedule, SCENARIO_SHAPE,
+                                  canonical_json, evaluate_and_report,
+                                  run_drill, tamper)
+
+    t_start = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="chaos_smoke_")
+    report = {"ok": False, "root": root}
+    try:
+        # 1. replayability: same seed -> identical bytes, new seed -> new
+        shape = SCENARIO_SHAPE[SCENARIO]
+        a = canonical_json(ChaosSchedule(SCENARIO, SEED, FAULTS,
+                                         **shape).plan())
+        b = canonical_json(ChaosSchedule(SCENARIO, SEED, FAULTS,
+                                         **shape).plan())
+        c = canonical_json(ChaosSchedule(SCENARIO, SEED + 1, FAULTS,
+                                         **shape).plan())
+        report["plan_deterministic"] = bool(a == b)
+        report["plan_seed_sensitive"] = bool(a != c)
+        keys = sorted(f["key"] for f in json.loads(a)["faults"])
+        report["plan_faults"] = keys
+        report["plan_has_io_error"] = "io_error" in keys
+
+        # 2. the seeded drill: kill mid-run, resume under the IO oracle
+        drill = run_drill(SCENARIO, SEED, FAULTS, root)
+        statuses = {v["invariant"]: v["status"]
+                    for v in drill["verdicts"]}
+        report["verdicts"] = statuses
+        report["drill_ok"] = bool(drill["ok"])
+        report["retries_recovered"] = bool(
+            statuses.get("io_retries_observed") == "PASS")
+        report["coverage_pass"] = bool(
+            statuses.get("exactly_once_coverage") == "PASS")
+        report["bitwise_pass"] = bool(
+            statuses.get("bitwise_resume") == "PASS")
+
+        # 3. tamper one artifact, re-judge the SAME workdir -> FAIL
+        report["tampered"] = os.path.relpath(tamper(root), root)
+        tampered = evaluate_and_report(root)
+        t_status = {v["invariant"]: v["status"]
+                    for v in tampered["verdicts"]}
+        report["tamper_detected"] = bool(
+            not tampered["ok"]
+            and t_status.get("exactly_once_coverage") == "FAIL")
+
+        report["elapsed_s"] = round(time.perf_counter() - t_start, 2)
+        report["ok"] = bool(
+            report["plan_deterministic"]
+            and report["plan_seed_sensitive"]
+            and report["plan_has_io_error"]
+            and report["drill_ok"]
+            and report["retries_recovered"]
+            and report["coverage_pass"]
+            and report["bitwise_pass"]
+            and report["tamper_detected"])
+    except Exception as exc:  # a broken smoke must still print its JSON
+        import traceback
+
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["trace"] = traceback.format_exc(limit=5)
+    finally:
+        try:
+            from paddle_tpu import observe as _obs
+            from paddle_tpu.fluid import fault as _fault
+
+            _fault.clear()
+            _obs.reset()
+        except Exception:
+            pass
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)

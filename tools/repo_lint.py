@@ -204,6 +204,35 @@ def check_env_doc() -> List[Tuple[str, str, int, str]]:
     return []
 
 
+def check_fault_doc() -> List[Tuple[str, str, int, str]]:
+    """docs/FAULTS.md must match the chaos-schedule generator — a new
+    PADDLE_FAULT_* hook cannot ship undocumented or invisible to the
+    seeded drills (ISSUE 18)."""
+    # the submodule directly: the chaos package __init__ pulls in the
+    # drill runner, which the linter has no business importing
+    from paddle_tpu.chaos import schedule as chaos_schedule
+
+    path = os.path.join(REPO, "docs", "FAULTS.md")
+    want = chaos_schedule.generate_fault_table().strip()
+    try:
+        with open(path) as f:
+            have = f.read().strip()
+    except OSError:
+        have = ""
+    if have != want:
+        return [("fault-doc-drift", "docs/FAULTS.md", 0,
+                 "stale — regenerate with `python -m paddle_tpu.chaos "
+                 "faults --write`")]
+    uncovered = chaos_schedule.uncovered_knobs()
+    if uncovered:
+        return [("fault-catalog-gap", "paddle_tpu/chaos/schedule.py", 0,
+                 f"fault knob(s) {uncovered} are declared in envcontract "
+                 f"but neither samplable in the chaos catalog nor "
+                 f"explicitly exempt/excluded — add a CATALOG entry or "
+                 f"an exclusion rationale")]
+    return []
+
+
 def run(root: str = None) -> List[Tuple[str, str, int, str]]:
     sys.path.insert(0, REPO)
     from paddle_tpu.fluid import envcontract
@@ -218,6 +247,7 @@ def run(root: str = None) -> List[Tuple[str, str, int, str]]:
                                           envcontract.declared))
     if os.path.abspath(root) == os.path.join(REPO, "paddle_tpu"):
         findings.extend(check_env_doc())
+        findings.extend(check_fault_doc())
     return findings
 
 
