@@ -182,6 +182,13 @@ CATALOG: List[FaultSpec] = [
                   "resume from it CANNOT match the reference; owned by "
                   "the serving canary auto-rollback tests"),
     FaultSpec(
+        "kv_page_leak", ("PADDLE_FAULT_KV_PAGE_LEAK",), (),
+        rationale="skips page frees BY DESIGN, so the paged-serving "
+                  "invariant the drills would judge (kvpool.pages_free "
+                  "returns to its initial level after drain) is violated "
+                  "on purpose; owned by the kvpool leak-oracle tests "
+                  "(tests/test_kvpool.py)"),
+    FaultSpec(
         "host_loss",
         ("PADDLE_FAULT_HOST_LOSS_RANK", "PADDLE_FAULT_HOST_LOSS_AT_STEP"),
         (),

@@ -267,6 +267,23 @@ declare("PADDLE_SERVE_SWAP_POLL_S", "float", 2.0, "serving",
 declare("PADDLE_SERVE_SENTINEL_ENTROPY", "float", 0.05, "serving",
         "Canary sentinel floor (nats): argmax-entropy collapse below "
         "this across 3 consecutive decode ticks triggers auto-rollback")
+declare("PADDLE_SERVE_PAGED", "bool", False, "serving",
+        "Paged KV cache (serving/kvpool): per-layer K/V storage becomes "
+        "a [num_pages, page_size, d_model] page pool with a host-side "
+        "allocator and a per-tick page-table feed; 0 (default) keeps the "
+        "dense [max_slots, max_len, d_model] cache — the bitwise-restore "
+        "kill switch")
+declare("PADDLE_SERVE_PAGE_SIZE", "int", 4, "serving",
+        "KV-cache page length in token positions; must divide max_len "
+        "AND every prefill bucket (prefill scatters whole pages)")
+declare("PADDLE_SERVE_NUM_PAGES", "int", 0, "serving",
+        "Page-pool capacity in pages (per layer, K+V share the table); "
+        "0 = auto: max_slots * max_len / page_size, i.e. dense-equal "
+        "capacity — set lower to oversubscribe slots against real usage")
+declare("PADDLE_SERVE_PREFIX_SHARE", "bool", True, "serving",
+        "Hash-share read-only full-prompt-page K/V across concurrently "
+        "resident slots (refcounted; kvpool.prefix_hits counts shared "
+        "pages, full-prefix hits skip the prefill dispatch entirely)")
 
 # -- serving fleet (router over N engine replicas; serving/fleet.py) --
 declare("PADDLE_ROUTER_MAX_REPLICAS", "int", 4, "router",
@@ -384,6 +401,11 @@ declare("PADDLE_FAULT_IO_ERROR_RATE", "float", 0.0, "fault",
         "unretried call site sees a hard failure)")
 declare("PADDLE_FAULT_IO_ERROR_SEED", "int", 0, "fault",
         "Seed for the transient-I/O oracle's per-path failure hash")
+declare("PADDLE_FAULT_KV_PAGE_LEAK", "int", None, "fault",
+        "Paged-KV leak oracle: the page-pool allocator SKIPS the next n "
+        "frees (one-shot), so kvpool.pages_free never returns to its "
+        "initial level and the live-buffer ledger / SLO watchdog must "
+        "surface the leak deterministically")
 
 # -- chaos engine (seeded multi-fault drills; paddle_tpu.chaos) --
 declare("PADDLE_CHAOS_SEED", "int", None, "chaos",

@@ -125,6 +125,17 @@ worker processes:
                                   for the memory.live_bytes SLO breach and
                                   the PADDLE_MEM_BUDGET_MB over-budget
                                   event (see observe.memory)
+    PADDLE_FAULT_KV_PAGE_LEAK=n   paged-KV leak oracle: the serving page
+                                  pool's allocator SKIPS its next n page
+                                  frees (one-shot), so retired requests
+                                  leave pages marked live forever —
+                                  kvpool.pages_free never returns to its
+                                  initial level after drain, the
+                                  kvpool.hbm_bytes gauge and live-buffer
+                                  ledger climb, and the leak is
+                                  deterministic enough for the memcheck /
+                                  watchdog tests to assert on exact page
+                                  counts (see serving.kvpool.PagePool)
     PADDLE_FAULT_IO_ERROR_RATE=f  transient-storage oracle: the fraction
                                   f of (path, op) keys whose FIRST
                                   read/write attempt raises OSError —
@@ -170,7 +181,7 @@ __all__ = [
     "on_step", "corrupt_state", "ckpt_crash_point", "ckpt_poison",
     "io_delay", "io_error",
     "barrier_stall", "serving_request", "decode_stall", "replica_kill",
-    "sentinel_injection",
+    "kv_page_leak", "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
     "shard_corrupt", "mem_pressure_bytes", "straggler_delay",
     "current_step", "KILL_EXIT_CODE",
@@ -202,6 +213,7 @@ class FaultPlan:
                  barrier_stall_s: float = 0.0,
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
                  decode_stall_ms: float = 0.0,
+                 kv_page_leak: Optional[int] = None,
                  replica_kill_after: Optional[int] = None,
                  cache_corrupt: bool = False,
                  data_stall_ms: float = 0.0,
@@ -237,6 +249,8 @@ class FaultPlan:
         self.serve_delay_ms = float(serve_delay_ms)
         self.serve_fail_every = int(serve_fail_every)
         self.decode_stall_ms = float(decode_stall_ms)
+        self.kv_page_leak = None if kv_page_leak is None \
+            else int(kv_page_leak)
         self.replica_kill_after = None if replica_kill_after is None \
             else int(replica_kill_after)
         self.cache_corrupt = bool(cache_corrupt)
@@ -265,6 +279,8 @@ class FaultPlan:
         self._data_stall_fired = False
         self._shard_corrupt_fired = False
         self._mem_pressure_calls = 0
+        self._kv_leaks_left = 0 if self.kv_page_leak is None \
+            else self.kv_page_leak
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultPlan"]:
@@ -303,6 +319,7 @@ class FaultPlan:
             serve_delay_ms=val("PADDLE_FAULT_SERVE_DELAY_MS"),
             serve_fail_every=val("PADDLE_FAULT_SERVE_FAIL_EVERY"),
             decode_stall_ms=val("PADDLE_FAULT_DECODE_STALL_MS"),
+            kv_page_leak=val("PADDLE_FAULT_KV_PAGE_LEAK"),
             replica_kill_after=val("PADDLE_FAULT_REPLICA_KILL_AFTER"),
             cache_corrupt=val("PADDLE_FAULT_CACHE_CORRUPT"),
             data_stall_ms=val("PADDLE_FAULT_DATA_STALL_MS"),
@@ -652,6 +669,22 @@ def replica_kill(served_total: int) -> bool:
     from .log import LOG
 
     LOG(f"fault: replica kill after {served_total} served requests")
+    return True
+
+
+def kv_page_leak() -> bool:
+    """Paged-KV leak oracle, consulted by ``serving.kvpool.PagePool``
+    once per page free: True for the first ``kv_page_leak`` calls
+    (decrementing — one skipped free per True), then permanently False.
+    A True return makes the allocator SKIP that free, so the page stays
+    accounted live forever: the deterministic paged twin of the
+    MEM_PRESSURE synthetic leak, visible in ``kvpool.pages_free`` /
+    ``kvpool.hbm_bytes`` and the live-buffer ledger."""
+    plan = active()
+    if plan is None or plan._kv_leaks_left <= 0 \
+            or not plan._applies_to_this_rank():
+        return False
+    plan._kv_leaks_left -= 1
     return True
 
 

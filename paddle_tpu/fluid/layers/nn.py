@@ -33,7 +33,7 @@ __all__ = [
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
     "ring_attention", "moe_ffn", "gpipe_mlp_stack",
-    "kv_cache_update", "token_select",
+    "kv_cache_update", "token_select", "paged_attention",
     "transformer_encoder_stack", "transformer_decoder_stack", "cos_sim",
     "multiplex", "pool3d", "random_crop", "rank_loss",
     "image_resize_short", "Print", "load",
@@ -1297,6 +1297,31 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
                "sp_axis": sp_axis,
                "flash": -1 if flash is None else int(bool(flash))})
     return out
+
+def paged_attention(q, cache_k, cache_v, page_table, bias, scale=1.0,
+                    fused=None, name=None):
+    """One decode step of attention over a PAGED K/V cache
+    (serving/kvpool, ops/decode_ops.py + ops/pallas_paged.py).  q:
+    [slots, 1, d_model]; cache_k/cache_v: [num_pages + 1, page_size,
+    d_model] page pools (the last row is the trash page); page_table:
+    [slots, pages_per_slot] int (unmapped entries point at the trash
+    page); bias: [slots, 1, pages_per_slot * page_size] additive
+    validity bias with exact ``-inf`` past each slot's live length.
+    ``fused``: True forces the Pallas scalar-prefetch gather kernel,
+    False the XLA ``take`` fallback, None (default) = PADDLE_TPU_FUSED
+    auto.  Returns [slots, 1, d_model]."""
+    helper = LayerHelper("paged_attention", **locals())
+    out = helper.create_variable_for_type_inference(helper.input_dtype("q"))
+    out.shape = tuple(q.shape)
+    helper.append_op(
+        type="paged_attention",
+        inputs={"Q": [q], "CacheK": [cache_k], "CacheV": [cache_v],
+                "PageTable": [page_table], "Bias": [bias]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale),
+               "fused": -1 if fused is None else int(bool(fused))})
+    return out
+
 
 def kv_cache_update(cache, new, slots, pos, name=None):
     """Scatter ``new`` [n, w, ...] into rows of the persistable KV cache

@@ -363,11 +363,19 @@ class DecodeModel:
     # decode-step feed names (the engine builds these arrays per tick)
     DC_TOKENS, DC_POSENC, DC_BIAS, DC_POS, DC_ACTIVE = (
         "dc_tokens", "dc_posenc", "dc_bias", "dc_pos", "dc_active")
+    # paged-mode decode feeds (ISSUE 19): the slot->page indirection and
+    # this tick's per-slot write destination (trash page when inactive
+    # or stalled)
+    DC_PTABLE, DC_WPAGE, DC_WOFF = "dc_ptable", "dc_wpage", "dc_woff"
     # prefill feed names (per admitted request)
     PF_TOKENS, PF_SLOT = "pf_tokens", "pf_slot"
+    # paged-mode prefill feed: one page id per prompt page of the bucket
+    # (trash for bucket pad pages)
+    PF_PAGES = "pf_pages"
 
     def __init__(self, cfg=None, max_slots=None, max_len=None,
-                 prefill_buckets=None, end_id=1, seed=7):
+                 prefill_buckets=None, end_id=1, seed=7, paged=None,
+                 page_size=None, num_pages=None):
         from ..fluid import envcontract as _ec
 
         self.cfg = cfg or decode_lm_config()
@@ -386,6 +394,41 @@ class DecodeModel:
         if not self.prefill_buckets:
             raise ValueError(
                 f"no viable prefill bucket <= max_len ({self.max_len})")
+        # paged KV cache (ISSUE 19): same program families, but the
+        # per-layer caches become [num_pages + 1, page_size, d_model]
+        # page pools (row num_pages = trash) addressed through per-tick
+        # page-table feeds.  Feed shapes stay fixed, so the closed
+        # executable set survives: still 1 step + one per bucket.
+        self.paged = bool(_ec.get("PADDLE_SERVE_PAGED")) if paged is None \
+            else bool(paged)
+        if self.paged:
+            self.page_size = int(page_size if page_size is not None
+                                 else _ec.get("PADDLE_SERVE_PAGE_SIZE"))
+            if self.page_size < 1 or self.max_len % self.page_size:
+                raise ValueError(
+                    f"page_size ({self.page_size}) must divide max_len "
+                    f"({self.max_len})")
+            bad = [b for b in self.prefill_buckets
+                   if b % self.page_size]
+            if bad:
+                raise ValueError(
+                    f"page_size ({self.page_size}) must divide every "
+                    f"prefill bucket; {bad} are not divisible")
+            self.pages_per_slot = self.max_len // self.page_size
+            np_req = int(num_pages if num_pages is not None
+                         else _ec.get("PADDLE_SERVE_NUM_PAGES"))
+            # 0 = auto: dense-equal capacity (every slot can run to
+            # max_len); smaller pools oversubscribe and rely on the
+            # engine's admission backpressure + growth stalls
+            self.num_pages = np_req or self.max_slots * self.pages_per_slot
+            if self.num_pages < self.pages_per_slot:
+                raise ValueError(
+                    f"num_pages ({self.num_pages}) cannot hold even one "
+                    f"full slot ({self.pages_per_slot} pages)")
+            self.trash_page = self.num_pages
+        else:
+            self.page_size = self.num_pages = self.trash_page = None
+            self.pages_per_slot = None
         self.end_id = int(end_id)
         self.seed = int(seed)
         self.vocab_size = int(self.cfg.tgt_vocab_size)
@@ -398,13 +441,19 @@ class DecodeModel:
     # -- graph pieces shared by the step and prefill programs --
 
     def _cache_var(self, name):
-        """The persistable [S, L, D] cache param (zero-init, frozen)."""
+        """The persistable cache param (zero-init, frozen): the dense
+        [S, L, D] slot cache, or in paged mode the [P + 1, ps, D] page
+        pool whose last row is the trash page.  Names keep the
+        ``_cache_`` marker either way — the scrub/rebind machinery and
+        ``weight_names`` key on it."""
         from ..fluid.initializer import ConstantInitializer
         from ..fluid.layers import tensor as _tensor
 
+        shape = ([self.num_pages + 1, self.page_size, self.cfg.d_model]
+                 if self.paged
+                 else [self.max_slots, self.max_len, self.cfg.d_model])
         return _tensor.create_parameter(
-            shape=[self.max_slots, self.max_len, self.cfg.d_model],
-            dtype="float32",
+            shape=shape, dtype="float32",
             attr=ParamAttr(name=name, trainable=False,
                            initializer=ConstantInitializer(0.0)))
 
@@ -461,6 +510,19 @@ class DecodeModel:
             active = layers.data(self.DC_ACTIVE, shape=[s],
                                  dtype="float32", append_batch_size=False)
             slots = layers.assign(np.arange(s, dtype=np.int64))
+            if self.paged:
+                # slot->page indirection, fed fresh each tick.  Gathered
+                # length pages_per_slot * page_size == max_len, so the
+                # SAME [S, 1, L] validity bias masks trash/stale pages
+                # with exact -inf — bitwise equality with the dense step
+                # rides on that.
+                ptable = layers.data(
+                    self.DC_PTABLE, shape=[s, self.pages_per_slot],
+                    dtype="int64", append_batch_size=False)
+                wpage = layers.data(self.DC_WPAGE, shape=[s],
+                                    dtype="int64", append_batch_size=False)
+                woff = layers.data(self.DC_WOFF, shape=[s],
+                                   dtype="int64", append_batch_size=False)
 
             x = layers.reshape(self._embed(tokens, posenc), [s, 1, d])
 
@@ -469,6 +531,15 @@ class DecodeModel:
                 cv = self._cache_var(f"dlm{i}_cache_v")
                 # write BEFORE reading so position `pos` (this token)
                 # participates in its own attention window
+                if self.paged:
+                    # same scatter op, page-pool addressed: row = page,
+                    # offset = position within the page (inactive and
+                    # stalled slots aim at the trash page)
+                    ck = layers.kv_cache_update(ck, k, wpage, woff)
+                    cv = layers.kv_cache_update(cv, v_, wpage, woff)
+                    return layers.paged_attention(
+                        layers.scale(q, scale=d ** -0.5), ck, cv,
+                        ptable, bias, scale=1.0)             # [S, 1, D]
                 ck = layers.kv_cache_update(ck, k, slots, pos)
                 cv = layers.kv_cache_update(cv, v_, slots, pos)
                 scores = layers.matmul(
@@ -517,17 +588,33 @@ class DecodeModel:
                 fluid.unique_name.guard():
             tokens = layers.data(self.PF_TOKENS, shape=[1, plen],
                                  dtype="int64", append_batch_size=False)
-            slot = layers.data(self.PF_SLOT, shape=[1], dtype="int64",
-                               append_batch_size=False)
-            start = layers.fill_constant([1], "int64", 0)
+            if self.paged:
+                # per-page destinations instead of a slot id: the K/V
+                # window is cut into bucket//page_size page-sized chunks
+                # and scattered to wherever the pool placed them (pad
+                # pages beyond the prompt are fed the trash page)
+                n_pp = plen // self.page_size
+                pages = layers.data(self.PF_PAGES, shape=[n_pp],
+                                    dtype="int64", append_batch_size=False)
+                zeros = layers.fill_constant([n_pp], "int64", 0)
+            else:
+                slot = layers.data(self.PF_SLOT, shape=[1], dtype="int64",
+                                   append_batch_size=False)
+                start = layers.fill_constant([1], "int64", 0)
             posenc = layers.assign(self.pos_table[:plen])     # [p, D]
             x = self._embed(tokens, posenc)                   # [1, p, D]
 
             def window_attn(q, k, v_, i):
                 ck = self._cache_var(f"dlm{i}_cache_k")
                 cv = self._cache_var(f"dlm{i}_cache_v")
-                layers.kv_cache_update(ck, k, slot, start)
-                layers.kv_cache_update(cv, v_, slot, start)
+                if self.paged:
+                    kr = layers.reshape(k, [n_pp, self.page_size, d])
+                    vr = layers.reshape(v_, [n_pp, self.page_size, d])
+                    layers.kv_cache_update(ck, kr, pages, zeros)
+                    layers.kv_cache_update(cv, vr, pages, zeros)
+                else:
+                    layers.kv_cache_update(ck, k, slot, start)
+                    layers.kv_cache_update(cv, v_, slot, start)
                 # the prompt window attends within itself (causal); the
                 # cache is write-only here — decode ticks read it
                 scores = layers.matmul(

@@ -20,6 +20,15 @@ Both are row-independent over the slot dim on purpose: a slot's token
 stream is a function of its own prompt and cache rows only, which is
 what makes continuous-batching output bitwise identical to per-request
 sequential decode (the ISSUE 15 convoy oracle's correctness half).
+
+ISSUE 19 adds ``paged_attention``: decode attention over a page-pool
+cache (``[num_pages + 1, page_size, d_model]`` + a per-tick ``[slots,
+pages_per_slot]`` page table from serving/kvpool).  Dispatch follows the
+PR 12 fused discipline — ``PADDLE_TPU_FUSED`` gates the Pallas kernel
+(ops/pallas_paged.py, scalar-prefetch gather inside the kernel) against
+an XLA ``take``-based unfused twin that runs the exact same page-table
+math, so CPU tier-1 proves the indirection and the kill switch restores
+the unfused lowering bitwise.
 """
 
 from __future__ import annotations
@@ -52,6 +61,48 @@ def kv_cache_update(ctx):
 
     rows = jax.vmap(write)(rows, new, pos)
     return {"Out": cache.at[slots].set(rows)}
+
+
+@register_op("paged_attention", no_grad_inputs=("PageTable", "Bias"))
+def paged_attention_op(ctx):
+    """Q [S, 1, D], CacheK/CacheV [P + 1, ps, D], PageTable [S, n] int,
+    Bias [S, 1, n·ps] -> Out [S, 1, D]: one decode step of attention with
+    K/V gathered through the page table (row P is the trash page; the
+    bias carries exact ``-inf`` past each slot's live length, so trash
+    and stale pages contribute exp(-inf) = 0 — the same masking that
+    makes the dense step's retired slots inert).
+
+    The unfused lowering mirrors the dense step's op sequence exactly
+    (``matmul`` with transposed Y, ``+ bias``, ``jax.nn.softmax``,
+    ``matmul``) over the ``jnp.take``-gathered pages, so with the same
+    fp32 cache content it is bitwise identical to the dense attention —
+    the paged≡dense sequential-equivalence oracle rides on that."""
+    q = ctx.input("Q")
+    ck = ctx.input("CacheK")
+    cv = ctx.input("CacheV")
+    pt = ctx.input("PageTable")
+    bias = ctx.input("Bias")
+    scale = float(ctx.attr("scale", 1.0))
+    fused_req = int(ctx.attr("fused", -1))
+    from . import pallas_fused
+
+    if pallas_fused.fused_decision(fused_req):
+        from .pallas_paged import paged_attention
+
+        out = paged_attention(q, ck, cv, pt, bias, scale)
+        pallas_fused._note("paged_attention")
+        return {"Out": out}
+    qs = q if scale == 1.0 else q * q.dtype.type(scale)
+    pt32 = pt.astype(jnp.int32)
+    n_pages = pt32.shape[1]
+    ps = ck.shape[1]
+    gk = jnp.take(ck, pt32, axis=0).reshape(
+        q.shape[0], n_pages * ps, ck.shape[2])
+    gv = jnp.take(cv, pt32, axis=0).reshape(
+        q.shape[0], n_pages * ps, cv.shape[2])
+    scores = jnp.matmul(qs, jnp.swapaxes(gk, -1, -2)) + bias
+    probs = jax.nn.softmax(scores, axis=-1)
+    return {"Out": jnp.matmul(probs, gv)}
 
 
 @register_op("token_select", no_grad_inputs=("Mask",))

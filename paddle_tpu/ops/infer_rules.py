@@ -343,6 +343,43 @@ def infer_kv_cache_update(op, ins):
     return {"Out": [cache]}
 
 
+@register_infer("paged_attention")
+def infer_paged_attention(op, ins):
+    """Paged decode attention (ISSUE 19): Out mirrors Q — an explicit
+    rule (like ring_attention's) so the verifier never abstractly
+    evaluates the Pallas paged kernel, plus the static page-table
+    contract abstract evaluation cannot name: an integer table, one row
+    per query slot, and ``pages_per_slot * page_size`` exactly covering
+    the bias's key length (a mismatch would silently attend to a
+    truncated or over-gathered window)."""
+    q = _in(ins, "Q")
+    ck = _in(ins, "CacheK")
+    bias = _in(ins, "Bias")
+    pt = _require_int(op, ins, "PageTable")
+    if ck is not None and len(ck[0]) != 3:
+        raise InferMismatch(
+            f"paged_attention: cache {_names(op, 'CacheK')} {list(ck[0])} "
+            f"must be [num_pages + 1, page_size, d_model]")
+    if q is not None and pt is not None and len(pt[0]) == 2 \
+            and pt[0][0] != q[0][0]:
+        raise InferMismatch(
+            f"paged_attention: page table {_names(op, 'PageTable')} "
+            f"{list(pt[0])} must carry one row per query slot "
+            f"({q[0][0]})")
+    if pt is not None and ck is not None and bias is not None \
+            and len(pt[0]) == 2 and len(bias[0]) == 3 \
+            and pt[0][1] * ck[0][1] != bias[0][2]:
+        raise InferMismatch(
+            f"paged_attention: gathered length {pt[0][1]} pages x "
+            f"{ck[0][1]} tokens/page != bias key length {bias[0][2]} "
+            f"({_names(op, 'PageTable')} vs {_names(op, 'Bias')})")
+    if q is not None and ck is not None and q[0][-1] != ck[0][-1]:
+        raise InferMismatch(
+            f"paged_attention: feature dim {q[0][-1]} of {_names(op, 'Q')} "
+            f"does not match cache feature dim {ck[0][-1]}")
+    return {"Out": [q]}
+
+
 @register_infer("token_select")
 def infer_token_select(op, ins):
     """Greedy token choice: Out is [S] int64 off [S, V] logits; an

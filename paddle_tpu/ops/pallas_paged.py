@@ -1,0 +1,120 @@
+"""Paged decode attention as a Pallas TPU kernel (ISSUE 19 tentpole).
+
+The decode engine's K/V cache lives as fixed-size pages in one
+``[num_pages + 1, page_size, d_model]`` buffer per layer (the last row is
+the trash page absorbing inactive-slot writes), and each tick feeds a
+``[slots, pages_per_slot]`` page table.  The dense decode step gathers the
+whole table with ``jnp.take`` before one big attention matmul; this kernel
+moves the gather INSIDE the attention loop: the page table rides the
+grid's scalar-prefetch slot, so each (slot, page) grid step DMAs exactly
+one K/V page — ``BlockSpec`` index maps read ``pt[s, j]`` — and the
+``[slots, L]`` score matrix never round-trips through a gathered HBM copy.
+
+Bitwise discipline (the PR 15 sequential-equivalence invariant): scores
+accumulate per page into a VMEM ``[1, L]`` scratch row and the softmax at
+the LAST page iteration replays ``jax.nn.softmax``'s exact sequence
+(max, exp(x - max), divide by sum) over the full row — NOT the online
+recurrence flash attention uses, which is numerically but not bitwise
+equal.  Validity masking arrives as the same additive ``-inf`` bias the
+dense step uses, so trash/stale pages contribute exp(-inf) = 0 exactly.
+(Kernel vs the XLA fallback still differs at fp32 ULP under jit —
+reduction-order freedom in the batched dots — which is why the engine
+pins ONE lowering per deployment: the sequential-equivalence oracle is
+exact within either lowering, and ``PADDLE_TPU_FUSED=0`` restores the
+unfused one verbatim.)
+
+Falls back to interpret mode off-TPU so CPU tier-1 exercises the same
+page-table math (``ops/decode_ops.py`` holds the XLA ``take`` unfused
+twin behind the ``PADDLE_TPU_FUSED`` kill switch).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _paged_kernel(pt_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                  scores_ref, vbuf_ref, *, scale, n_pages, ps):
+    """Grid step (slot, page): score ONE gathered K/V page against the
+    slot's single query row, park the partial score segment + fp32 V copy
+    in VMEM scratch, and run the exact full-row softmax at the last page.
+
+    ``pt_ref`` is the scalar-prefetched page table — it is consumed by the
+    in_spec index maps (``pt[s, j]`` picks the cache block), not read here.
+    """
+    del pt_ref
+    j = pl.program_id(1)
+    # all index math in i32: under the package-wide x64 mode python ints
+    # promote to i64, which Mosaic's index ops reject
+    off = j * jnp.int32(ps)
+    q = q_ref[0].astype(jnp.float32)                    # [1, d]
+    if scale != 1.0:
+        q = q * jnp.float32(scale)
+    k = k_ref[0].astype(jnp.float32)                    # [ps, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [1, ps]
+    s = s + bias_ref[0].astype(jnp.float32)
+    scores_ref[:, pl.ds(off, ps)] = s
+    vbuf_ref[pl.ds(off, ps), :] = v_ref[0].astype(jnp.float32)
+
+    @pl.when(j == jnp.int32(n_pages - 1))
+    def _flush():
+        z = scores_ref[:]                               # [1, L]
+        m = jnp.max(z, axis=-1, keepdims=True)
+        e = jnp.exp(z - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        o_ref[0] = jax.lax.dot_general(
+            p, vbuf_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def paged_attention(q, cache_k, cache_v, page_table, bias, scale=1.0,
+                    interpret=None):
+    """``softmax(scale · q Kᵀ + bias) V`` where K/V are gathered through
+    ``page_table`` from a paged cache.
+
+    q: ``[S, 1, D]`` (one decode step per slot); cache_k/cache_v:
+    ``[P + 1, ps, D]`` (row P is the trash page); page_table: ``[S,
+    n_pages]`` int (unmapped entries point at the trash page); bias:
+    ``[S, 1, L]`` additive validity bias with ``L == n_pages * ps`` and
+    exact ``-inf`` beyond each slot's live length.  Returns ``[S, 1, D]``.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    s_n, _, d = q.shape
+    n_pages = page_table.shape[1]
+    ps = cache_k.shape[1]
+    ell = n_pages * ps
+    if bias.shape != (s_n, 1, ell):
+        raise ValueError(
+            f"paged_attention bias must be [S, 1, n_pages * page_size] = "
+            f"[{s_n}, 1, {ell}]; got {bias.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_n, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda s, j, pt: (s, 0, 0)),
+            pl.BlockSpec((1, ps, d), lambda s, j, pt: (pt[s, j], 0, 0)),
+            pl.BlockSpec((1, ps, d), lambda s, j, pt: (pt[s, j], 0, 0)),
+            pl.BlockSpec((1, 1, ps), lambda s, j, pt: (s, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda s, j, pt: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, ell), jnp.float32),   # full score row
+            pltpu.VMEM((ell, d), jnp.float32),   # gathered fp32 V
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=float(scale),
+                          n_pages=n_pages, ps=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, 1, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), q, cache_k, cache_v, bias)

@@ -25,6 +25,7 @@ from .engine import (DrainTimeout, EngineClosed, EngineOverloaded,
                      create_serving_engine)
 from .fleet import (AutoscalePolicy, Decision, DevicePool, ModelSignals,
                     Replica, ServingFleet)
+from .kvpool import PageGrant, PagePool
 from .metrics import ServingMetrics
 from .registry import (ModelRegistry, load_serial_weights,
                        write_weights_serial)
@@ -36,4 +37,5 @@ __all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
            "DecodeEngine", "DecodeConfig", "create_decode_engine",
            "ModelRegistry", "load_serial_weights", "write_weights_serial",
            "ServingFleet", "Router", "RouterConfig", "AutoscalePolicy",
-           "ModelSignals", "Decision", "DevicePool", "Replica"]
+           "ModelSignals", "Decision", "DevicePool", "Replica",
+           "PagePool", "PageGrant"]

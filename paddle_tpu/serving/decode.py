@@ -50,9 +50,23 @@ invariant holds across arbitrarily many checkpoint swaps.  The
 per-tick monitor hook (:meth:`DecodeEngine.set_tick_monitor`) hands the
 step's logits to ``serving.registry``'s canary sentinel.
 
+Paged KV cache (ISSUE 19): with ``PADDLE_SERVE_PAGED=1`` the model's
+per-layer caches become ``[num_pages + 1, page_size, d_model]`` page
+pools and the engine drives a host-side :class:`~.kvpool.PagePool` —
+admission allocates pages (or re-queues on exhaustion: backpressure,
+never a crash), decode growth allocates one page per ``page_size``
+ticks (a dry pool stalls the slot one bitwise-invisible tick), retire
+and deadline expiry return pages EXPLICITLY, and full prompt pages are
+refcount-shared across requests with a common prefix (``full_hit``
+admissions skip the prefill dispatch outright).  Decode output stays
+bitwise identical to the dense engine — the page indirection only moves
+where K/V rows live, never what they contain or how they reduce.
+
 Knobs (``fluid.envcontract``): ``PADDLE_SERVE_DECODE`` (kill switch),
 ``PADDLE_SERVE_SLOTS``, ``PADDLE_SERVE_MAX_LEN``,
-``PADDLE_SERVE_PREFILL_BUCKETS``.
+``PADDLE_SERVE_PREFILL_BUCKETS``; paged mode adds
+``PADDLE_SERVE_PAGED``, ``PADDLE_SERVE_PAGE_SIZE``,
+``PADDLE_SERVE_NUM_PAGES``, ``PADDLE_SERVE_PREFIX_SHARE``.
 """
 
 from __future__ import annotations
@@ -128,6 +142,21 @@ class DecodeEngine:
         self._exe = Executor(place if place is not None
                              else _core.CPUPlace())
         self._exe.run(model.startup, scope=self._scope)
+        # paged KV cache (ISSUE 19): when the model was built paged, all
+        # page policy lives in this host-side pool — the worker consults
+        # it under _dispatch_lock for admissions (backpressure), growth
+        # (per-tick stalls) and frees (retire/expiry/reap)
+        self._pool = None
+        if getattr(model, "paged", False):
+            from .kvpool import PagePool
+
+            page_bytes = (model.page_size * model.cfg.d_model * 4
+                          * 2 * model.cfg.n_layer)
+            self._pool = PagePool(
+                model.num_pages, model.page_size, model.pages_per_slot,
+                model.max_slots, page_bytes=page_bytes,
+                prefix_share=bool(_ec.get("PADDLE_SERVE_PREFIX_SHARE")),
+                metrics=self.metrics)
         self._cond = threading.Condition(threading.Lock())
         self._queue: collections.deque = collections.deque()
         self._slots: List[Optional[_Request]] = [None] * model.max_slots
@@ -267,6 +296,8 @@ class DecodeEngine:
             if r is not None and r.future.done():
                 self._slots[i] = None
                 self._n_active -= 1
+                if self._pool is not None:
+                    self._pool.release(i)
         self.metrics.note_slots(self._n_active,
                                 self.model.max_slots - self._n_active)
 
@@ -274,6 +305,10 @@ class DecodeEngine:
         """Worker exit with work still resident (drain timeout path):
         nothing will ever resolve these futures — fail them loudly."""
         leftovers = [r for r in self._slots if r is not None]
+        if self._pool is not None:
+            for i, r in enumerate(self._slots):
+                if r is not None:
+                    self._pool.release(i)
         self._slots = [None] * self.model.max_slots
         self._n_active = 0
         with self._cond:
@@ -327,6 +362,30 @@ class DecodeEngine:
                     self._n_active += 1
             if req is None:
                 return
+            if self._pool is not None:
+                grant = self._pool.admit(
+                    free, req.prompt,
+                    self.model.bucket_for(len(req.prompt)))
+                if grant is None:
+                    # admission backpressure: not enough free pages —
+                    # put the request BACK at the head of the queue and
+                    # give the slot up.  Resident streams retire pages
+                    # over the next ticks; the request re-admits then.
+                    with self._cond:
+                        self._slots[free] = None
+                        self._n_active -= 1
+                        self._queue.appendleft(req)
+                        self.metrics.inc("page_requeues")
+                        self.metrics.set_gauge("queue_depth",
+                                               len(self._queue))
+                        idle = self._n_active == 0
+                    if idle:
+                        # nothing is retiring pages: don't busy-spin the
+                        # worker against a dry pool (release() notifies
+                        # nobody; the idle wait is the retry cadence)
+                        time.sleep(self.config.idle_wait_s)
+                    return
+                req.grant = grant
             self._prefill(req, free)
 
     def _prefill(self, req: _Request, slot: int):
@@ -338,58 +397,98 @@ class DecodeEngine:
         tokens = np.zeros((1, bucket), np.int64)
         tokens[0, :plen] = req.prompt
         t0 = time.perf_counter()
-        self._run(model.prefill_program(bucket),
-                  {model.PF_TOKENS: tokens,
-                   model.PF_SLOT: np.asarray([slot], np.int64)}, [])
+        # prefix sharing: when every page the prefill would write below
+        # plen-1 is already resident (full_hit), the dispatch is pure
+        # re-derivation of bit-identical K/V — skip it entirely.  On a
+        # PARTIAL hit the prefill still runs: rewriting a shared page
+        # with the same (bucket, prefix) content is bitwise idempotent.
+        grant = getattr(req, "grant", None)
+        skip = (self._pool is not None and grant is not None
+                and grant.full_hit)
+        if not skip:
+            feeds = {model.PF_TOKENS: tokens}
+            if self._pool is not None:
+                feeds[model.PF_PAGES] = self._pool.prefill_pages(slot,
+                                                                 bucket)
+            else:
+                feeds[model.PF_SLOT] = np.asarray([slot], np.int64)
+            self._run(model.prefill_program(bucket), feeds, [])
+            self.metrics.inc("prefills")
+        else:
+            self.metrics.inc("prefill_skips")
+            from .. import observe
+
+            observe.registry().inc("kvpool.prefill_skips")
         t1 = time.perf_counter()
         req.t_taken = t0
         req.slot = slot
         # the first decode tick re-derives position plen-1 (same token,
         # same weights => bit-identical K/V) and emits the first token
         req.pos = plen - 1
-        self.metrics.inc("prefills")
         self.metrics.note_slots(self._n_active,
                                 model.max_slots - self._n_active)
         if req.span is not None:
             _trace.emit_span("serving.queue", req.t_submit, t0,
                              parent=req.span)
-            _trace.emit_span("serving.prefill", t0, t1, parent=req.span,
-                             bucket=bucket, slot=slot, prompt_len=plen)
+            if not skip:
+                _trace.emit_span("serving.prefill", t0, t1,
+                                 parent=req.span, bucket=bucket,
+                                 slot=slot, prompt_len=plen)
 
     def _tick_feeds(self, slots):
-        """Fixed-shape decode-step feeds off the current slot table."""
+        """Fixed-shape decode-step feeds off the current slot table.
+        Returns ``(feeds, stalled)``: in paged mode a slot whose cache
+        growth found the pool dry STALLS this tick — its active flag
+        drops, its write aims at the trash page and the caller discards
+        its token (the next tick re-derives the same bits, so a stall is
+        invisible in the output stream)."""
         model = self.model
         s = model.max_slots
         tokens = np.zeros((s, 1), np.int64)
         pos = np.zeros((s,), np.int64)
         active = np.zeros((s,), np.float32)
+        stalled = set()
+        if self._pool is not None:
+            wpage = np.full((s,), self._pool.trash_page, np.int64)
+            woff = np.zeros((s,), np.int64)
         for i, r in enumerate(slots):
             if r is None:
                 continue
+            if self._pool is not None:
+                if not self._pool.ensure(i, int(r.pos)):
+                    stalled.add(i)
+                    continue  # active stays 0: masked like a free slot
+                wpage[i], woff[i] = self._pool.write_loc(i, int(r.pos))
             active[i] = 1.0
             tokens[i, 0] = (r.out_tokens[-1] if r.out_tokens
                             else r.prompt[-1])
             pos[i] = r.pos
-        return {model.DC_TOKENS: tokens, model.DC_POS: pos,
-                model.DC_ACTIVE: active,
-                model.DC_POSENC:
-                    model.posenc_rows(pos).astype(np.float32),
-                model.DC_BIAS: model.validity_bias(pos)}
+        feeds = {model.DC_TOKENS: tokens, model.DC_POS: pos,
+                 model.DC_ACTIVE: active,
+                 model.DC_POSENC:
+                     model.posenc_rows(pos).astype(np.float32),
+                 model.DC_BIAS: model.validity_bias(pos)}
+        if self._pool is not None:
+            feeds[model.DC_PTABLE] = self._pool.table()
+            feeds[model.DC_WPAGE] = wpage
+            feeds[model.DC_WOFF] = woff
+        return feeds, stalled
 
     def _step_dispatch(self, slots):
         """ONE compiled decode step over all slots; returns the [S] next
-        tokens (host ints).  The [S, V] logits ride along as a second
-        fetch of the SAME executable (a fixed fetch set from warmup on,
-        so the canary sentinel never perturbs the compile counter) and
-        land in ``_last_logits`` for the tick monitor."""
-        nxt, logits = self._run(self.model.step_program,
-                                self._tick_feeds(slots),
+        tokens (host ints) plus the set of paged slots that stalled this
+        tick.  The [S, V] logits ride along as a second fetch of the
+        SAME executable (a fixed fetch set from warmup on, so the canary
+        sentinel never perturbs the compile counter) and land in
+        ``_last_logits`` for the tick monitor."""
+        feeds, stalled = self._tick_feeds(slots)
+        nxt, logits = self._run(self.model.step_program, feeds,
                                 [self.model.step_fetch,
                                  self.model.logits_fetch])
         self._ticks += 1
         self.metrics.inc("decode_ticks")
         self._last_logits = np.asarray(logits)
-        return np.asarray(nxt).reshape(-1)
+        return np.asarray(nxt).reshape(-1), stalled
 
     def _tick(self):
         from ..observe import trace as _trace
@@ -397,10 +496,21 @@ class DecodeEngine:
         model = self.model
         t0 = time.perf_counter()
         dispatched = list(self._slots)  # rows the logits correspond to
-        nxt = self._step_dispatch(self._slots)
+        nxt, stalled = self._step_dispatch(self._slots)
         t1 = time.perf_counter()
         for i, req in enumerate(list(self._slots)):
             if req is None:
+                continue
+            if i in stalled:
+                # pool-dry stall: this row ran masked (trash write,
+                # active=0) — discard its token, keep pos, retry next
+                # tick once a retirement frees pages.  Deadlines still
+                # apply: an expired staller must retire and return its
+                # pages, or mutual stalls could live-lock the pool.
+                if req.deadline is not None and t1 > req.deadline:
+                    self._retire(i, error=RequestTimeout(
+                        f"deadline expired after {len(req.out_tokens)} "
+                        f"generated tokens (pool-stalled)"))
                 continue
             tok = int(nxt[i])
             req.out_tokens.append(tok)
@@ -447,6 +557,12 @@ class DecodeEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._n_active -= 1
+        if self._pool is not None:
+            # explicit page return on EVERY retirement path — completion
+            # AND deadline expiry (the lazy-reclaim bug: an expired
+            # stream's rows used to stay resident until slot reuse).
+            # Refcounted prefix pages survive until their last sharer.
+            self._pool.release(slot)
         self.metrics.note_slots(self._n_active,
                                 self.model.max_slots - self._n_active)
         if req.future.done():
@@ -501,22 +617,30 @@ class DecodeEngine:
         from .. import compile_cache as _cc
 
         model = self.model
+        paged = self._pool is not None
         fps: Dict[str, str] = {}
         try:
             for b in model.prefill_buckets:
+                if paged:
+                    pf_feeds = [(model.PF_PAGES,
+                                 (int(b) // model.page_size,), "int64"),
+                                (model.PF_TOKENS, (1, int(b)), "int64")]
+                else:
+                    pf_feeds = [(model.PF_SLOT, (1,), "int64"),
+                                (model.PF_TOKENS, (1, int(b)), "int64")]
                 fps[f"prefill:{int(b)}"] = _cc.program_fingerprint(
                     model.prefill_program(b),
-                    feeds=[(model.PF_SLOT, (1,), "int64"),
-                           (model.PF_TOKENS, (1, int(b)), "int64")],
+                    feeds=pf_feeds,
                     fetches=[],
-                    extra={"kind": "decode_prefill", "bucket": int(b)})
-            step_feed = self._tick_feeds([None] * model.max_slots)
+                    extra={"kind": "decode_prefill", "bucket": int(b),
+                           "paged": paged})
+            step_feed = self._tick_feeds([None] * model.max_slots)[0]
             fps["step"] = _cc.program_fingerprint(
                 model.step_program,
                 feeds=sorted((k, tuple(v.shape), str(v.dtype))
                              for k, v in step_feed.items()),
                 fetches=[model.step_fetch, model.logits_fetch],
-                extra={"kind": "decode_step"})
+                extra={"kind": "decode_step", "paged": paged})
         except Exception:
             return {}
         return fps
@@ -542,6 +666,9 @@ class DecodeEngine:
             "max_slots": int(model.max_slots),
             "max_len": int(model.max_len),
             "prefill_buckets": [int(b) for b in model.prefill_buckets],
+            "paged": self._pool is not None,
+            "page_size": (int(model.page_size) if self._pool is not None
+                          else None),
             "fingerprints": dict(fps),
         }
         try:
@@ -598,9 +725,16 @@ class DecodeEngine:
                 if _cached(key):
                     self.metrics.inc("warmup_cached")
                     continue
-                self._run(model.prefill_program(b),
-                          {model.PF_TOKENS: np.zeros((1, b), np.int64),
-                           model.PF_SLOT: np.zeros((1,), np.int64)}, [])
+                feeds = {model.PF_TOKENS: np.zeros((1, b), np.int64)}
+                if self._pool is not None:
+                    # warm against the trash page: zero-token K/V lands
+                    # nowhere a real stream will ever read
+                    feeds[model.PF_PAGES] = np.full(
+                        (b // model.page_size,), self._pool.trash_page,
+                        np.int64)
+                else:
+                    feeds[model.PF_SLOT] = np.zeros((1,), np.int64)
+                self._run(model.prefill_program(b), feeds, [])
                 self.metrics.inc("warmup_dispatches")
                 _record(key, model.prefill_program(b),
                         {"kind": "decode_prefill", "bucket": int(b)})
@@ -644,42 +778,78 @@ class DecodeEngine:
                 raise RuntimeError("decode_static requires an idle engine")
             slots: List[Optional[_Request]] = [None] * self.model.max_slots
             t_start = []
-            for i, (prompt, max_new) in enumerate(batch):
-                fut: Future = Future()
-                t0 = time.perf_counter()
-                req = _Request(None, 1, None, fut, None, t0)
-                req.prompt = [int(t) for t in prompt]
-                req.max_new = int(max_new)
-                req.out_tokens = []
-                plen = len(req.prompt)
-                bucket = self.model.bucket_for(plen)
-                tokens = np.zeros((1, bucket), np.int64)
-                tokens[0, :plen] = req.prompt
-                self._run(self.model.prefill_program(bucket),
-                          {self.model.PF_TOKENS: tokens,
-                           self.model.PF_SLOT:
-                               np.asarray([i], np.int64)}, [])
-                req.pos = plen - 1
-                slots[i] = req
-                t_start.append(t0)
-            finished = [False] * len(batch)
-            while not all(finished):
-                live = [r if r is not None and not finished[j] else None
-                        for j, r in enumerate(slots[:len(batch)])]
-                live += [None] * (self.model.max_slots - len(live))
-                nxt = self._step_dispatch(live)
-                for j, req in enumerate(slots[:len(batch)]):
-                    if finished[j]:
-                        continue
-                    tok = int(nxt[j])
-                    req.out_tokens.append(tok)
-                    req.pos += 1
-                    finished[j] = (tok == self.model.end_id
-                                   or len(req.out_tokens) >= req.max_new
-                                   or req.pos >= self.model.max_len)
-            t_end = time.perf_counter()
-            return [(list(slots[j].out_tokens), t_end - t_start[j])
-                    for j in range(len(batch))]
+            admitted: List[int] = []
+            try:
+                for i, (prompt, max_new) in enumerate(batch):
+                    fut: Future = Future()
+                    t0 = time.perf_counter()
+                    req = _Request(None, 1, None, fut, None, t0)
+                    req.prompt = [int(t) for t in prompt]
+                    req.max_new = int(max_new)
+                    req.out_tokens = []
+                    plen = len(req.prompt)
+                    bucket = self.model.bucket_for(plen)
+                    tokens = np.zeros((1, bucket), np.int64)
+                    tokens[0, :plen] = req.prompt
+                    feeds = {self.model.PF_TOKENS: tokens}
+                    skip = False
+                    if self._pool is not None:
+                        grant = self._pool.admit(i, req.prompt, bucket)
+                        if grant is None:
+                            raise RuntimeError(
+                                f"page pool cannot admit static batch "
+                                f"member {i} "
+                                f"({self._pool.pages_free} pages free)")
+                        admitted.append(i)
+                        skip = grant.full_hit
+                        feeds[self.model.PF_PAGES] = \
+                            self._pool.prefill_pages(i, bucket)
+                    else:
+                        feeds[self.model.PF_SLOT] = \
+                            np.asarray([i], np.int64)
+                    if not skip:
+                        self._run(self.model.prefill_program(bucket),
+                                  feeds, [])
+                    req.pos = plen - 1
+                    slots[i] = req
+                    t_start.append(t0)
+                finished = [False] * len(batch)
+                while not all(finished):
+                    live = [r if r is not None and not finished[j]
+                            else None
+                            for j, r in enumerate(slots[:len(batch)])]
+                    live += [None] * (self.model.max_slots - len(live))
+                    nxt, stalled = self._step_dispatch(live)
+                    progressed = False
+                    for j, req in enumerate(slots[:len(batch)]):
+                        if finished[j] or j in stalled:
+                            continue
+                        progressed = True
+                        tok = int(nxt[j])
+                        req.out_tokens.append(tok)
+                        req.pos += 1
+                        finished[j] = (tok == self.model.end_id
+                                       or len(req.out_tokens)
+                                       >= req.max_new
+                                       or req.pos >= self.model.max_len)
+                        if finished[j] and self._pool is not None:
+                            self._pool.release(j)
+                            if j in admitted:
+                                admitted.remove(j)
+                    if not progressed:
+                        # every live slot stalled and none can retire:
+                        # a static batch has no churn to free pages
+                        raise RuntimeError(
+                            "page pool exhausted with the whole static "
+                            "batch resident — no retirement can free "
+                            "pages; use a smaller batch or more pages")
+                t_end = time.perf_counter()
+                return [(list(slots[j].out_tokens), t_end - t_start[j])
+                        for j in range(len(batch))]
+            finally:
+                if self._pool is not None:
+                    for j in list(admitted):
+                        self._pool.release(j)
 
     # ------------------------------------------------------------------
     # hot model swap surface (serving.registry drives these)
@@ -706,6 +876,12 @@ class DecodeEngine:
         weights: a swap is never a recompile."""
         for name, arr in weights.items():
             self._scope.set(name, np.asarray(arr))
+        if self._pool is not None:
+            # resident prefix pages were written by the OLD weights: a
+            # new admission's prefill would produce different bits, so
+            # the share index must forget them (holders keep decoding —
+            # their whole cache is old-weight-consistent until retire)
+            self._pool.flush_index()
 
     def swap_weights(self, weights: Dict[str, np.ndarray]) -> None:
         """Atomically rebind the named weights between decode ticks."""
@@ -727,6 +903,8 @@ class DecodeEngine:
             if cur is not None:
                 self._scope.set(v.name, np.zeros(np.shape(cur),
                                                  np.asarray(cur).dtype))
+        if self._pool is not None:
+            self._pool.flush_index()  # scrubbed pages share nothing
 
     def pause_admissions(self) -> None:
         """Hold admissions (the drain swap policy): submits still land in

@@ -157,7 +157,9 @@ class _Request:
                  "t_taken", "span", "rid",
                  # per-token decode state (ISSUE 15)
                  "prompt", "max_new", "slot", "pos", "out_tokens",
-                 "t_prev_token")
+                 "t_prev_token",
+                 # paged-KV admission grant (ISSUE 19)
+                 "grant")
 
     def __init__(self, feed, rows, sig, future, deadline, t_submit):
         self.feed = feed          # name -> ndarray, leading dim == rows

@@ -1,0 +1,11 @@
+"""Paged KV-cache subsystem for the decode engine (ISSUE 19 tentpole).
+
+Host-side page-pool allocator + prefix-sharing index over the device
+page pools the paged :class:`~paddle_tpu.models.transformer.DecodeModel`
+declares (``[num_pages + 1, page_size, d_model]`` per layer; the last
+row is the trash page).  See :class:`PagePool`.
+"""
+
+from .pool import PageGrant, PagePool
+
+__all__ = ["PageGrant", "PagePool"]

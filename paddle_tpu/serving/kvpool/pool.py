@@ -1,0 +1,297 @@
+"""Host-side page-pool allocator + prefix-sharing index (ISSUE 19).
+
+The device side of the paged KV cache is dumb on purpose: per layer, one
+``[num_pages + 1, page_size, d_model]`` K and V pool (the last row is
+the TRASH page — inactive-slot decode writes, prefill pad pages and
+unmapped page-table entries all land there, and the exact ``-inf``
+validity bias guarantees its garbage never reaches an output bit).  ALL
+allocation policy lives here, in plain host data structures the engine
+mutates under its dispatch lock:
+
+ - **free list**: pages allocate on admit (``(plen - 1) // page_size +
+   1`` pages — the last is always slot-private, decode growth adds more
+   one at a time) and return on retire/expiry.  ``admit`` returns None
+   when the pool cannot cover a request (admission backpressure: the
+   engine re-queues, never crashes) and ``ensure`` returns False when
+   growth finds the pool dry (the slot stalls one tick, bitwise-invisibly
+   — the discarded tick re-derives the same token later).
+ - **prefix sharing**: every FULL prompt page (all of its positions <
+   plen - 1, so decode writes can never touch it) is published in an
+   exact-match index keyed by ``(bucket, prompt-prefix-tokens)`` and
+   refcount-shared read-only across slots.  Keys are the full token
+   tuple — no hashing, no collisions — and carry the prefill bucket so a
+   hit's resident K/V is guaranteed BITWISE identical to what this
+   request's own prefill would write (same program, same causal window).
+   When every shareable page hits and the private page would start
+   empty, the engine skips the prefill dispatch entirely.
+ - **accounting**: every mutation republishes the always-on gauges
+   (``kvpool.pages_free/pages_live/hbm_bytes``), feeds the PR 11
+   live-buffer ledger (scope ``kvpool`` — a page leak breaches the SLO
+   watchdog like any other live-bytes growth), and the page-free path
+   consults the ``PADDLE_FAULT_KV_PAGE_LEAK`` oracle (fluid.fault),
+   which makes the ledger/watchdog leak story deterministically
+   testable.
+
+Thread-safety: one internal lock; the arrays ``table()`` returns are
+rebuilt copies, safe to hand to the executor.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PageGrant", "PagePool"]
+
+
+@dataclass
+class PageGrant:
+    """One admission's page set.  ``pages[:hits]`` came refcount-shared
+    from the prefix index; the rest are freshly allocated (the last one
+    is always slot-private).  ``full_hit`` means every prompt position
+    the prefill would write below ``plen - 1`` is already resident, so
+    the engine may skip the prefill dispatch (the first decode tick
+    writes position ``plen - 1`` itself)."""
+    slot: int
+    pages: List[int]
+    hits: int
+    full_hit: bool
+
+
+class PagePool:
+    """Allocator + prefix index over ``num_pages`` device pages.
+
+    ``page_bytes`` is the HBM cost of ONE page across K+V and all layers
+    (``page_size * d_model * 4 bytes * 2 * n_layer``) — only used for
+    gauges.  ``metrics`` (a :class:`..metrics.ServingMetrics`) receives
+    the ``prefix_hits`` counter and ``kvpool_*`` gauge mirrors so bench
+    snapshots carry them without reaching into the process registry."""
+
+    def __init__(self, num_pages: int, page_size: int, pages_per_slot: int,
+                 max_slots: int, page_bytes: int = 0,
+                 prefix_share: bool = True, metrics=None):
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        self.max_slots = int(max_slots)
+        self.page_bytes = int(page_bytes)
+        self.prefix_share = bool(prefix_share)
+        self.trash_page = self.num_pages
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        # LIFO free list: pop() from the end => low page ids stay hot,
+        # allocation order is deterministic for the churn oracles
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._ref: Dict[int, int] = {}
+        self._index: Dict[tuple, int] = {}   # (bucket, prefix) -> page
+        self._page_key: Dict[int, tuple] = {}
+        self._leaked = 0
+        self._publish_locked()
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def pages_live(self) -> int:
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    @property
+    def pages_leaked(self) -> int:
+        return self._leaked
+
+    def pages_needed(self, prompt_len: int) -> int:
+        """Pages one admission allocates up front: every full prompt page
+        plus the always-private page the first decode tick writes into."""
+        return (int(prompt_len) - 1) // self.page_size + 1
+
+    def slot_pages(self, slot: int) -> List[int]:
+        with self._lock:
+            return list(self._slot_pages.get(slot, ()))
+
+    def table(self) -> np.ndarray:
+        """The per-tick ``[max_slots, pages_per_slot]`` page-table feed:
+        each slot's owned pages in position order, trash elsewhere."""
+        with self._lock:
+            t = np.full((self.max_slots, self.pages_per_slot),
+                        self.trash_page, np.int64)
+            for slot, pages in self._slot_pages.items():
+                t[slot, :len(pages)] = pages
+            return t
+
+    def write_loc(self, slot: int, pos: int) -> Tuple[int, int]:
+        """(page, offset) for this slot's decode write at ``pos`` — call
+        only after :meth:`ensure` returned True for the position."""
+        with self._lock:
+            pages = self._slot_pages[slot]
+            return pages[pos // self.page_size], pos % self.page_size
+
+    # -- allocate ----------------------------------------------------------
+
+    def _prefix_key(self, bucket: int, prompt, j: int) -> tuple:
+        return (int(bucket), tuple(prompt[:(j + 1) * self.page_size]))
+
+    def admit(self, slot: int, prompt, bucket: int) -> Optional[PageGrant]:
+        """Allocate the admission page set for ``prompt`` into ``slot``;
+        None = insufficient free pages (the engine re-queues the request
+        — backpressure, not failure).  Shared full-prompt pages already
+        in the index are attached by refcount instead of allocated."""
+        ps = self.page_size
+        plen = len(prompt)
+        f_share = (plen - 1) // ps  # full pages, all positions < plen-1
+        with self._lock:
+            hits: List[int] = []
+            if self.prefix_share:
+                # keys are full-prefix tuples, so hits always form a
+                # prefix chain: page j+1 in the index implies some live
+                # holder also pins page j's entry
+                for j in range(f_share):
+                    page = self._index.get(
+                        self._prefix_key(bucket, prompt, j))
+                    if page is None:
+                        break
+                    hits.append(page)
+            fresh = (f_share + 1) - len(hits)
+            if fresh > len(self._free):
+                return None
+            for page in hits:
+                self._ref[page] += 1
+            pages = list(hits)
+            for j in range(len(hits), f_share + 1):
+                page = self._free.pop()
+                self._ref[page] = 1
+                pages.append(page)
+                if self.prefix_share and j < f_share:
+                    key = self._prefix_key(bucket, prompt, j)
+                    self._index[key] = page
+                    self._page_key[page] = key
+            self._slot_pages[slot] = pages
+            full_hit = bool(self.prefix_share and f_share > 0
+                            and len(hits) == f_share
+                            and (plen - 1) % ps == 0)
+            self._publish_locked()
+        if hits:
+            self._count("kvpool.prefix_hits", len(hits))
+            if self._metrics is not None:
+                self._metrics.inc("prefix_hits", len(hits))
+        return PageGrant(slot=int(slot), pages=pages, hits=len(hits),
+                         full_hit=full_hit)
+
+    def ensure(self, slot: int, pos: int) -> bool:
+        """Grow the slot's page list to cover a decode write at ``pos``;
+        False = pool dry (the slot stalls this tick: the engine feeds the
+        trash page, masks the output token, and retries next tick)."""
+        with self._lock:
+            pages = self._slot_pages.get(slot)
+            if pages is None:
+                return False
+            need = pos // self.page_size
+            if need < len(pages):
+                return True
+            if not self._free:  # need == len(pages): grow by exactly one
+                return False
+            page = self._free.pop()
+            self._ref[page] = 1
+            pages.append(page)
+            self._publish_locked()
+            return True
+
+    def prefill_pages(self, slot: int, bucket: int) -> np.ndarray:
+        """The ``[bucket // page_size]`` int64 PF_PAGES feed: the slot's
+        owned pages, then trash for bucket pad pages beyond them (their
+        pad-token K/V must land nowhere real)."""
+        n = int(bucket) // self.page_size
+        out = np.full((n,), self.trash_page, np.int64)
+        with self._lock:
+            pages = self._slot_pages.get(slot, ())
+            k = min(n, len(pages))
+            out[:k] = pages[:k]
+        return out
+
+    # -- release -----------------------------------------------------------
+
+    def release(self, slot: int) -> int:
+        """Return the slot's pages (retire, deadline expiry, reap, static
+        teardown).  Shared pages only reach the free list at refcount
+        zero — a sharer's expiry never tears pages out from under the
+        other holders.  Each actual free consults the
+        ``PADDLE_FAULT_KV_PAGE_LEAK`` oracle; a leaked page stays live in
+        the gauges (that growth IS the drill signal).  Returns the number
+        of pages actually freed."""
+        from ...fluid import fault as _fault
+
+        freed = 0
+        with self._lock:
+            pages = self._slot_pages.pop(slot, None)
+            if pages is None:
+                return 0
+            for page in pages:
+                self._ref[page] -= 1
+                if self._ref[page] > 0:
+                    continue
+                del self._ref[page]
+                key = self._page_key.pop(page, None)
+                # evict the prefix entry only if it still names this page
+                # (flush_index may have dropped or re-bound the key)
+                if key is not None and self._index.get(key) == page:
+                    del self._index[key]
+                if _fault.kv_page_leak():
+                    self._leaked += 1
+                    continue  # the skipped free: page never returns
+                self._free.append(page)
+                freed += 1
+            self._publish_locked()
+        return freed
+
+    def flush_index(self) -> None:
+        """Drop every prefix entry (weight rebind / cache scrub: resident
+        page content no longer matches what a NEW admission's prefill
+        would write).  Holders keep their refcounts; pages just stop
+        being discoverable."""
+        with self._lock:
+            self._index.clear()
+            self._page_key.clear()
+
+    # -- accounting --------------------------------------------------------
+
+    def _publish_locked(self) -> None:
+        free = len(self._free)
+        live = self.num_pages - free
+        gauges = {
+            "kvpool.pages_free": free,
+            "kvpool.pages_live": live,
+            "kvpool.pages_leaked": self._leaked,
+            "kvpool.hbm_bytes": live * self.page_bytes,
+            "kvpool.pool_bytes": (self.num_pages + 1) * self.page_bytes,
+        }
+        try:
+            from ... import observe
+            from ...observe.memory import ledger
+
+            reg = observe.registry()
+            for name, val in gauges.items():
+                reg.set_gauge(name, val)
+            # live-buffer ledger: paged-KV residency breaches the SLO
+            # watchdog like any other leak (PR 11 wiring)
+            ledger().update("kvpool", live * self.page_bytes)
+        except Exception:
+            pass  # accounting must never fail the allocator
+        if self._metrics is not None:
+            for name, val in gauges.items():
+                self._metrics.set_gauge(name.replace(".", "_"), val)
+
+    def _count(self, name: str, n: int) -> None:
+        try:
+            from ... import observe
+
+            observe.registry().inc(name, n)
+        except Exception:
+            pass

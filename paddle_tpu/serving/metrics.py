@@ -59,7 +59,15 @@ class ServingMetrics:
                 "prefills", "decode_ticks", "tokens_generated",
                 # hot model swap (ISSUE 16): registry swap/rollback counts;
                 # the serving.model_serial gauge rides set_gauge
-                "model_swaps", "model_rollbacks")
+                "model_swaps", "model_rollbacks",
+                # paged KV cache (ISSUE 19): prefix-cache hits (one per
+                # shared full-prompt page attached at admit) and whole
+                # prefill dispatches skipped because every prompt page was
+                # already resident — plus admissions bounced back to the
+                # queue because the page pool ran dry (backpressure, the
+                # paged twin of "shed" — except nothing is lost).  All
+                # zero-reported on dense engines.
+                "prefix_hits", "prefill_skips", "page_requeues")
 
     def __init__(self, latency_window: int = 4096,
                  registry: Optional[MetricsRegistry] = None,

@@ -177,17 +177,38 @@ def run_decode_bench(args) -> dict:
     from paddle_tpu.serving import (DecodeConfig, DecodeEngine,
                                     EngineOverloaded, ServingMetrics)
 
+    paged = None if args.paged < 0 else bool(args.paged)
+    if getattr(args, "prefix_share", -1) >= 0:
+        import os as _os
+
+        _os.environ["PADDLE_SERVE_PREFIX_SHARE"] = str(args.prefix_share)
     model = transformer.DecodeModel(
         cfg=transformer.decode_lm_config(),
         max_slots=args.slots, max_len=args.max_len,
-        prefill_buckets=[4, 8])
+        prefill_buckets=[4, 8], paged=paged,
+        page_size=args.page_size, num_pages=args.num_pages)
     eng = DecodeEngine(model, DecodeConfig(max_queue_depth=args.queue_depth))
     eng.warmup()
     warm = eng.metrics.snapshot()
+    # dense KV footprint for the equal-HBM comparison in either mode
+    kv_dense_bytes = (model.max_slots * model.max_len
+                      * model.cfg.d_model * 4 * 2 * model.cfg.n_layer)
 
     rng = np.random.RandomState(0)
-    pool = [[int(t) for t in rng.randint(2, model.vocab_size - 1, size=3)]
-            for _ in range(64)]
+    if args.shared_prefix:
+        # every prompt shares one full first page (page-size tokens of
+        # common prefix + one distinct tail token): under prefix sharing
+        # concurrent admissions hit the resident page and, with the tail
+        # on the private page boundary, skip their prefill outright
+        ps = model.page_size if getattr(model, "paged", False) else 4
+        base = [int(t) for t in rng.randint(2, model.vocab_size - 1,
+                                            size=ps)]
+        pool = [base + [int(t)]
+                for t in rng.randint(2, model.vocab_size - 1, size=64)]
+    else:
+        pool = [[int(t) for t in rng.randint(2, model.vocab_size - 1,
+                                             size=3)]
+                for _ in range(64)]
     budgets = [args.long_new if rng.random_sample() < 0.2
                else args.short_new for _ in range(256)]
 
@@ -240,8 +261,13 @@ def run_decode_bench(args) -> dict:
     commit_at = [t_start + args.duration * (i + 1) / (n_swaps + 1)
                  for i in range(n_swaps)]
     sent = 0
+    kv_peak_pages = 0
+    peak_active = 0
     while True:
         now = time.perf_counter()
+        peak_active = max(peak_active, eng._n_active)
+        if eng._pool is not None:
+            kv_peak_pages = max(kv_peak_pages, eng._pool.pages_live)
         if now >= t_end:
             break
         if commit_at and now >= commit_at[0]:
@@ -301,6 +327,29 @@ def run_decode_bench(args) -> dict:
         "max_len": args.max_len,
         "short_new": args.short_new,
         "long_new": args.long_new,
+        # paged KV cache (ISSUE 19): device KV footprint in both modes
+        # (kvpool_hbm_bytes = the page pool incl. trash page; dense =
+        # the [slots, max_len] caches) so two BENCH lines prove the
+        # more-slots-at-equal-HBM claim, plus the sharing counters
+        "paged": bool(getattr(model, "paged", False)),
+        "page_size": model.page_size if getattr(model, "paged", False)
+        else None,
+        "num_pages": model.num_pages if getattr(model, "paged", False)
+        else None,
+        "kvpool_hbm_bytes": ((model.num_pages + 1) * model.page_size
+                             * model.cfg.d_model * 4 * 2
+                             * model.cfg.n_layer
+                             if getattr(model, "paged", False) else None),
+        "kvpool_peak_live_pages": (kv_peak_pages
+                                   if getattr(model, "paged", False)
+                                   else None),
+        "kv_dense_bytes": kv_dense_bytes,
+        "peak_active_slots": peak_active,
+        "prefix_hits": snap["prefix_hits"] - warm["prefix_hits"],
+        "prefill_skips": snap["prefill_skips"] - warm["prefill_skips"],
+        "page_requeues": snap["page_requeues"] - warm["page_requeues"],
+        "prefills": snap["prefills"] - warm["prefills"],
+        "shared_prefix": bool(args.shared_prefix),
         "swaps": snap["model_swaps"] - warm["model_swaps"],
         "swap_policy": args.swap_policy if n_swaps > 0 else None,
         "smoke": bool(args.smoke),
@@ -482,6 +531,25 @@ def main(argv=None) -> int:
                    help="short-request token budget (80%% of arrivals)")
     p.add_argument("--long-new", type=int, default=64,
                    help="long-request token budget (20%% of arrivals)")
+    p.add_argument("--paged", type=int, default=-1, choices=[-1, 0, 1],
+                   help="paged KV cache for --decode: 1 on, 0 dense, "
+                        "-1 defer to PADDLE_SERVE_PAGED (ISSUE 19)")
+    p.add_argument("--page-size", type=int, default=None,
+                   help="tokens per KV page (--paged; default "
+                        "PADDLE_SERVE_PAGE_SIZE)")
+    p.add_argument("--num-pages", type=int, default=None,
+                   help="device page-pool size (--paged; 0/unset = "
+                        "max_slots * max_len / page_size).  Size this to "
+                        "a SMALLER dense engine's kv_cache_bytes to "
+                        "measure more slots at equal HBM")
+    p.add_argument("--prefix-share", type=int, default=-1,
+                   choices=[-1, 0, 1],
+                   help="prefix sharing for --paged (default "
+                        "PADDLE_SERVE_PREFIX_SHARE)")
+    p.add_argument("--shared-prefix", action="store_true",
+                   help="decode workload where every prompt shares one "
+                        "full first page (drives prefix_hits / "
+                        "prefill_skips)")
     p.add_argument("--swaps", type=int, default=0,
                    help="hot-swap this many fresh serials through the "
                         "decode window (registry watcher; ISSUE 16)")
