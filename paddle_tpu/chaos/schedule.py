@@ -189,6 +189,13 @@ CATALOG: List[FaultSpec] = [
                   "on purpose; owned by the kvpool leak-oracle tests "
                   "(tests/test_kvpool.py)"),
     FaultSpec(
+        "spec_draft_poison", ("PADDLE_FAULT_SPEC_DRAFT_POISON",), (),
+        rationale="only meaningful with PADDLE_SERVE_SPEC=k>0 armed; the "
+                  "drill scenarios run speculation off, so the knob "
+                  "would be a silent no-op there — owned by the "
+                  "acceptance-collapse oracle in tests/test_specdec.py "
+                  "(fallback fires, output stays bitwise)"),
+    FaultSpec(
         "host_loss",
         ("PADDLE_FAULT_HOST_LOSS_RANK", "PADDLE_FAULT_HOST_LOSS_AT_STEP"),
         (),

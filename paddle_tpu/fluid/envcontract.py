@@ -284,6 +284,27 @@ declare("PADDLE_SERVE_PREFIX_SHARE", "bool", True, "serving",
         "Hash-share read-only full-prompt-page K/V across concurrently "
         "resident slots (refcounted; kvpool.prefix_hits counts shared "
         "pages, full-prefix hits skip the prefill dispatch entirely)")
+declare("PADDLE_SERVE_SPEC", "int", 0, "serving",
+        "Speculative decoding depth k (serving/specdec): each engine "
+        "tick runs k cheap draft steps then ONE wide verify step scoring "
+        "k+1 positions per slot; greedy acceptance keeps output bitwise "
+        "identical to sequential decode. 0 (default) = kill switch, the "
+        "plain one-token tick verbatim")
+declare("PADDLE_SERVE_SPEC_DRAFT_LAYERS", "int", 1, "serving",
+        "Self-draft depth: the draft model reuses the target's first n "
+        "decoder layers (+ embeddings/head, shared by name) with its own "
+        "dense KV cache; 0 = full-depth self-draft (every draft token "
+        "accepted — a throughput ceiling probe, not a speedup). Ignored "
+        "when DecodeConfig.spec_draft_serial loads a registry serial")
+declare("PADDLE_SERVE_SPEC_MIN_ACCEPT", "float", 0.3, "serving",
+        "Adaptive-fallback floor: rolling draft-acceptance rate below "
+        "this over a full PADDLE_SERVE_SPEC_WINDOW of spec ticks drops "
+        "the engine to plain one-token ticks (specdec.fallback event), "
+        "re-arming after a cooldown of the same length")
+declare("PADDLE_SERVE_SPEC_WINDOW", "int", 32, "serving",
+        "Spec-tick window for the rolling acceptance-rate gauge and the "
+        "adaptive controller (also the fallback cooldown length, in "
+        "plain ticks)")
 
 # -- serving fleet (router over N engine replicas; serving/fleet.py) --
 declare("PADDLE_ROUTER_MAX_REPLICAS", "int", 4, "router",
@@ -406,6 +427,12 @@ declare("PADDLE_FAULT_KV_PAGE_LEAK", "int", None, "fault",
         "frees (one-shot), so kvpool.pages_free never returns to its "
         "initial level and the live-buffer ledger / SLO watchdog must "
         "surface the leak deterministically")
+declare("PADDLE_FAULT_SPEC_DRAFT_POISON", "int", None, "fault",
+        "Speculative-draft poison oracle: from engine tick n on, every "
+        "drafted token is replaced with deterministic garbage, so "
+        "acceptance collapses to ~1/vocab — the adaptive controller "
+        "must fire specdec.fallback while emitted output stays bitwise "
+        "correct (corrections are always the target argmax)")
 
 # -- chaos engine (seeded multi-fault drills; paddle_tpu.chaos) --
 declare("PADDLE_CHAOS_SEED", "int", None, "chaos",

@@ -136,6 +136,17 @@ worker processes:
                                   deterministic enough for the memcheck /
                                   watchdog tests to assert on exact page
                                   counts (see serving.kvpool.PagePool)
+    PADDLE_FAULT_SPEC_DRAFT_POISON=n  speculative-draft poison oracle:
+                                  from engine tick n on, every token the
+                                  draft model proposes is replaced with
+                                  deterministic garbage, so draft
+                                  acceptance collapses to ~1/vocab — the
+                                  specdec adaptive controller must fire
+                                  its specdec.fallback event while the
+                                  emitted stream stays bitwise correct
+                                  (every accepted/correction token is a
+                                  target argmax regardless of what the
+                                  draft proposed; see serving/specdec)
     PADDLE_FAULT_IO_ERROR_RATE=f  transient-storage oracle: the fraction
                                   f of (path, op) keys whose FIRST
                                   read/write attempt raises OSError —
@@ -181,7 +192,7 @@ __all__ = [
     "on_step", "corrupt_state", "ckpt_crash_point", "ckpt_poison",
     "io_delay", "io_error",
     "barrier_stall", "serving_request", "decode_stall", "replica_kill",
-    "kv_page_leak", "sentinel_injection",
+    "kv_page_leak", "spec_draft_poison", "sentinel_injection",
     "sentinel_injection_window", "cache_corrupt", "data_stall",
     "shard_corrupt", "mem_pressure_bytes", "straggler_delay",
     "current_step", "KILL_EXIT_CODE",
@@ -214,6 +225,7 @@ class FaultPlan:
                  serve_delay_ms: float = 0.0, serve_fail_every: int = 0,
                  decode_stall_ms: float = 0.0,
                  kv_page_leak: Optional[int] = None,
+                 spec_draft_poison: Optional[int] = None,
                  replica_kill_after: Optional[int] = None,
                  cache_corrupt: bool = False,
                  data_stall_ms: float = 0.0,
@@ -251,6 +263,8 @@ class FaultPlan:
         self.decode_stall_ms = float(decode_stall_ms)
         self.kv_page_leak = None if kv_page_leak is None \
             else int(kv_page_leak)
+        self.spec_draft_poison = None if spec_draft_poison is None \
+            else int(spec_draft_poison)
         self.replica_kill_after = None if replica_kill_after is None \
             else int(replica_kill_after)
         self.cache_corrupt = bool(cache_corrupt)
@@ -320,6 +334,7 @@ class FaultPlan:
             serve_fail_every=val("PADDLE_FAULT_SERVE_FAIL_EVERY"),
             decode_stall_ms=val("PADDLE_FAULT_DECODE_STALL_MS"),
             kv_page_leak=val("PADDLE_FAULT_KV_PAGE_LEAK"),
+            spec_draft_poison=val("PADDLE_FAULT_SPEC_DRAFT_POISON"),
             replica_kill_after=val("PADDLE_FAULT_REPLICA_KILL_AFTER"),
             cache_corrupt=val("PADDLE_FAULT_CACHE_CORRUPT"),
             data_stall_ms=val("PADDLE_FAULT_DATA_STALL_MS"),
@@ -686,6 +701,22 @@ def kv_page_leak() -> bool:
         return False
     plan._kv_leaks_left -= 1
     return True
+
+
+def spec_draft_poison() -> Optional[int]:
+    """Speculative-draft poison oracle, consulted by ``serving.specdec``
+    once per spec tick: the armed tick threshold, or None when disarmed.
+    From engine tick >= threshold the SpecDecoder replaces every drafted
+    token with deterministic garbage, collapsing acceptance to ~1/vocab.
+    Proves two things at once: the adaptive controller fires
+    ``specdec.fallback`` within its window, and the emitted stream stays
+    bitwise correct anyway (acceptance only ever keeps target argmaxes,
+    so a garbage draft costs throughput, never correctness)."""
+    plan = active()
+    if plan is None or plan.spec_draft_poison is None \
+            or not plan._applies_to_this_rank():
+        return None
+    return plan.spec_draft_poison
 
 
 def cache_corrupt() -> bool:

@@ -33,7 +33,8 @@ __all__ = [
     "image_resize", "resize_bilinear", "autoincreased_step_counter",
     "lod_reset", "prelu", "dice_loss", "log_loss", "huber_loss",
     "ring_attention", "moe_ffn", "gpipe_mlp_stack",
-    "kv_cache_update", "token_select", "paged_attention",
+    "kv_cache_update", "kv_cache_scatter", "token_select",
+    "paged_attention", "spec_accept",
     "transformer_encoder_stack", "transformer_decoder_stack", "cos_sim",
     "multiplex", "pool3d", "random_crop", "rank_loss",
     "image_resize_short", "Print", "load",
@@ -1340,6 +1341,48 @@ def kv_cache_update(cache, new, slots, pos, name=None):
                 "Pos": [pos]},
         outputs={"Out": [cache]})
     return cache
+
+
+def kv_cache_scatter(cache, new, rows, offs, name=None):
+    """Scatter per-token K/V rows ``new`` [n, ...] into the persistable
+    cache ``cache`` [rows, width, ...] at explicit destinations: token j
+    lands at ``cache[rows[j], offs[j]]`` (speculative verify step,
+    ops/decode_ops.py).  Dense caches pass (slot, absolute position);
+    paged caches pass (page, in-page offset).  Out-of-range rows are
+    scatter-dropped — the dense trash slot.  In-place by name like
+    ``kv_cache_update``; returns ``cache``."""
+    helper = LayerHelper("kv_cache_scatter", **locals())
+    helper.append_op(
+        type="kv_cache_scatter",
+        inputs={"Cache": [cache], "New": [new], "Rows": [rows],
+                "Offs": [offs]},
+        outputs={"Out": [cache]})
+    return cache
+
+
+def spec_accept(logits, draft, mask=None, end_id=0, name=None):
+    """Greedy speculative acceptance (serving/specdec): given verify
+    logits [slots, k+1, vocab] and the k drafted tokens [slots, k],
+    return ``(tokens, num_accept)`` — tokens [slots, k+1] int64 is the
+    target argmax at every scored position, num_accept [slots] int64 the
+    longest draft==argmax prefix.  The engine consumes
+    ``tokens[s, :n+1]``, all target argmaxes, so speculative output is
+    bitwise identical to sequential greedy decode.  Inactive slots
+    (mask == 0) emit ``end_id`` and accept 0."""
+    helper = LayerHelper("spec_accept", **locals())
+    toks = helper.create_variable_for_type_inference(
+        core.convert_dtype("int64"), stop_gradient=True)
+    toks.shape = tuple(logits.shape[:-1])
+    nacc = helper.create_variable_for_type_inference(
+        core.convert_dtype("int64"), stop_gradient=True)
+    nacc.shape = (logits.shape[0],)
+    inputs = {"Logits": [logits], "Draft": [draft]}
+    if mask is not None:
+        inputs["Mask"] = [mask]
+    helper.append_op(type="spec_accept", inputs=inputs,
+                     outputs={"Tokens": [toks], "NumAccept": [nacc]},
+                     attrs={"end_id": int(end_id)})
+    return toks, nacc
 
 
 def token_select(logits, mask=None, end_id=0, name=None):

@@ -372,6 +372,16 @@ class DecodeModel:
     # paged-mode prefill feed: one page id per prompt page of the bucket
     # (trash for bucket pad pages)
     PF_PAGES = "pf_pages"
+    # speculative-verify feed names (ISSUE 20): the k+1-position verify
+    # step serving/specdec dispatches once per spec tick.  Per-position
+    # feeds are indexed — ``SP_TOK.format(j)`` for j in 0..k — because
+    # the program is built as k+1 shape-clones of the step body.
+    SP_TOK, SP_PE, SP_BIAS_J = "sp_tok{}", "sp_pe{}", "sp_bias{}"
+    # per-position K/V write destinations [S]: dense = (slot |
+    # max_slots-OOB trash, absolute position), paged = (page | trash
+    # page, in-page offset)
+    SP_WROW, SP_WOFF = "sp_wrow{}", "sp_woff{}"
+    SP_DRAFT, SP_ACTIVE, SP_PTABLE = "sp_draft", "sp_active", "sp_ptable"
 
     def __init__(self, cfg=None, max_slots=None, max_len=None,
                  prefill_buckets=None, end_id=1, seed=7, paged=None,
@@ -435,6 +445,7 @@ class DecodeModel:
         self.pos_table = _position_encoding(self.max_len, self.cfg.d_model)
         self.startup = fluid.Program()
         self._prefill = {}
+        self._spec = {}
         self.step_program, self.step_fetch, self.logits_fetch = \
             self._build_step()
 
@@ -629,6 +640,115 @@ class DecodeModel:
                                 lambda q, k, v_, i=i: window_attn(q, k, v_, i))
         self._prefill[plen] = prog
         return prog
+
+    # -- speculative verify (ISSUE 20) --
+
+    def spec_program(self, k):
+        """The (lazily built, cached) verify program for speculation
+        depth ``k``: ONE fixed-shape dispatch scoring k + 1 positions
+        per slot.  Position j's sub-graph is a SHAPE-CLONE of the step
+        program's body — embed [S, 1] tokens, project q/k/v, write this
+        position's K/V, attend under a [S, 1, L] validity bias, project
+        [S, V] logits — repeated k + 1 times over a shared cache (writes
+        land in program order, so position j attends over positions
+        <= pos + j exactly as sequential decode would).  The k + 1
+        logits rows stack into [S, k+1, V] and ``spec_accept`` takes the
+        longest draft == argmax prefix plus the correction token.
+
+        Why clones instead of one wide [S, k+1, ·] step: XLA's fusion
+        choices change with the position width (the matmul+bias+softmax
+        epilogue reassociates), so a wide verify's logits drift ~1e-7
+        from the step's — enough to flip an argmax at a near-tie.  With
+        same-shaped sub-graphs the compiler has the step program's exact
+        fusion problem, so verify logits at position j are bitwise the
+        step's at that position; greedy acceptance is then bitwise
+        sequential BY CONSTRUCTION, not by tie-luck.  The whole point of
+        the verify step is fewer host round-trips and one dispatch per
+        tick, which survives; the tests/test_specdec.py bitwise oracles
+        enforce this contract.
+
+        The only write-path difference from the step: K/V lands through
+        ``kv_cache_scatter`` at explicit fed (row, offset) pairs, so
+        non-participating slots steer to the dense out-of-bounds trash
+        slot / the paged trash page instead of writing garbage at a
+        clamped position.
+
+        Returns ``(prog, tokens_fetch, naccept_fetch, logits_fetch)``;
+        the logits fetch is position 0's [S, V] — exactly the plain
+        step's logits, so the engine's tick monitor keeps watching the
+        same slice."""
+        if k < 1:
+            raise ValueError(f"speculation depth must be >= 1, got {k}")
+        cached = self._spec.get(k)
+        if cached is not None:
+            return cached
+        s, l, w = self.max_slots, self.max_len, k + 1
+        d, v = self.cfg.d_model, self.vocab_size
+        prog, scratch_startup = fluid.Program(), fluid.Program()
+        prog.random_seed = scratch_startup.random_seed = self.seed
+        prog._donate_state = True
+        with fluid.program_guard(prog, scratch_startup), \
+                fluid.unique_name.guard():
+            draft = layers.data(self.SP_DRAFT, shape=[s, k],
+                                dtype="int64", append_batch_size=False)
+            active = layers.data(self.SP_ACTIVE, shape=[s],
+                                 dtype="float32", append_batch_size=False)
+            if self.paged:
+                ptable = layers.data(
+                    self.SP_PTABLE, shape=[s, self.pages_per_slot],
+                    dtype="int64", append_batch_size=False)
+            logit_rows = []
+            for j in range(w):
+                tokens = layers.data(self.SP_TOK.format(j), shape=[s, 1],
+                                     dtype="int64",
+                                     append_batch_size=False)
+                posenc = layers.data(self.SP_PE.format(j), shape=[s, d],
+                                     dtype="float32",
+                                     append_batch_size=False)
+                bias = layers.data(self.SP_BIAS_J.format(j),
+                                   shape=[s, 1, l], dtype="float32",
+                                   append_batch_size=False)
+                wrow = layers.data(self.SP_WROW.format(j), shape=[s],
+                                   dtype="int64", append_batch_size=False)
+                woff = layers.data(self.SP_WOFF.format(j), shape=[s],
+                                   dtype="int64", append_batch_size=False)
+
+                x = layers.reshape(self._embed(tokens, posenc), [s, 1, d])
+
+                def sub_attn(q, kk, v_, i, bias=bias, wrow=wrow,
+                             woff=woff):
+                    ck = self._cache_var(f"dlm{i}_cache_k")
+                    cv = self._cache_var(f"dlm{i}_cache_v")
+                    ck = layers.kv_cache_scatter(
+                        ck, layers.reshape(kk, [s, d]), wrow, woff)
+                    cv = layers.kv_cache_scatter(
+                        cv, layers.reshape(v_, [s, d]), wrow, woff)
+                    if self.paged:
+                        return layers.paged_attention(
+                            layers.scale(q, scale=d ** -0.5), ck, cv,
+                            ptable, bias, scale=1.0)         # [S, 1, D]
+                    scores = layers.matmul(
+                        layers.scale(q, scale=d ** -0.5), ck,
+                        transpose_y=True)                    # [S, 1, L]
+                    probs = layers.softmax(
+                        layers.elementwise_add(scores, bias))
+                    return layers.matmul(probs, cv)          # [S, 1, D]
+
+                for i in range(self.cfg.n_layer):
+                    x = self._layer(
+                        x, i,
+                        lambda q, kk, v_, i=i: sub_attn(q, kk, v_, i))
+                logit_rows.append(layers.fc(
+                    layers.reshape(x, [s, d]), v, bias_attr=False,
+                    param_attr=ParamAttr(name="dlm_out_w")))
+            logits = layers.concat(
+                [layers.reshape(r, [s, 1, v]) for r in logit_rows],
+                axis=1)                                      # [S, w, V]
+            toks, nacc = layers.spec_accept(logits, draft, mask=active,
+                                            end_id=self.end_id)
+        out = (prog, toks.name, nacc.name, logit_rows[0].name)
+        self._spec[k] = out
+        return out
 
     def weight_names(self):
         """The hot-swap rebind set: every learned weight shared by name

@@ -29,6 +29,23 @@ PR 12 fused discipline — ``PADDLE_TPU_FUSED`` gates the Pallas kernel
 an XLA ``take``-based unfused twin that runs the exact same page-table
 math, so CPU tier-1 proves the indirection and the kill switch restores
 the unfused lowering bitwise.
+
+ISSUE 20 adds the speculative-decode pair:
+
+ - ``kv_cache_scatter``: per-token K/V writes at explicit (row, offset)
+   destinations.  The verify step writes k + 1 positions per slot in one
+   dispatch; ``kv_cache_update``'s whole-row scatter loses writes when
+   the same slot appears twice (last duplicated row wins), so the wide
+   step needs true element-granular destinations.  One op covers both
+   layouts: dense caches pass (slot, absolute position), paged caches
+   pass (page, in-page offset).  Out-of-range rows are JAX-scatter-
+   dropped — the dense-mode "trash slot" that mirrors the pool's trash
+   page.
+ - ``spec_accept``: device-side greedy acceptance — the longest prefix
+   where the draft token equals the verify argmax, plus the first
+   correction token.  Because every emitted token IS a target argmax
+   at a position whose cache prefix matches sequential decode, accepted
+   output is bitwise identical to one-token greedy by construction.
 """
 
 from __future__ import annotations
@@ -63,6 +80,50 @@ def kv_cache_update(ctx):
     return {"Out": cache.at[slots].set(rows)}
 
 
+@register_op("kv_cache_scatter", stateful=True,
+             no_grad_inputs=("Rows", "Offs"))
+def kv_cache_scatter(ctx):
+    """Cache [R, W, ...], New [n, ...], Rows [n] int, Offs [n] int ->
+    Out = Cache with ``New[j]`` written at ``Cache[Rows[j], Offs[j]]``.
+    Unlike ``kv_cache_update`` this scatters single positions, so a slot
+    may appear in ``Rows`` many times (the verify step's k + 1 writes)
+    as long as each (row, off) pair is unique.  Rows >= R (or < 0) are
+    dropped by JAX scatter semantics — callers steer masked-out lanes
+    there on purpose."""
+    cache = ctx.input("Cache")
+    new = ctx.input("New").astype(cache.dtype)
+    rows = ctx.input("Rows").astype(jnp.int32).reshape(-1)
+    offs = ctx.input("Offs").astype(jnp.int32).reshape(-1)
+    return {"Out": cache.at[rows, offs].set(new)}
+
+
+@register_op("spec_accept", no_grad_inputs=("Draft", "Mask"))
+def spec_accept(ctx):
+    """Logits [S, k+1, V], Draft [S, k] int (+ optional Mask [S]) ->
+    Tokens [S, k+1] int64, NumAccept [S] int64.
+
+    ``Tokens[s] = argmax(Logits[s], -1)`` is what sequential greedy
+    decode would emit at each of the k + 1 scored positions given the
+    accepted prefix; ``NumAccept[s] = n`` is the longest prefix with
+    ``Draft[s, i] == Tokens[s, i]`` — the engine consumes tokens
+    ``Tokens[s, :n+1]`` (n accepted + 1 correction/bonus), all of them
+    target argmaxes, so output is bitwise greedy by construction.
+    Inactive slots (mask == 0) emit ``end_id`` everywhere and accept 0,
+    the token_select idiom widened."""
+    logits = ctx.input("Logits")
+    draft = ctx.input("Draft").astype(jnp.int64)
+    end_id = int(ctx.attr("end_id", 0))
+    toks = jnp.argmax(logits, axis=-1).astype(jnp.int64)   # [S, k+1]
+    match = (draft == toks[:, :-1]).astype(jnp.int64)      # [S, k]
+    nacc = jnp.cumprod(match, axis=1).sum(axis=1)          # [S]
+    mask = ctx.input("Mask") if ctx.has_input("Mask") else None
+    if mask is not None:
+        live = mask.reshape(-1) > 0
+        toks = jnp.where(live[:, None], toks, jnp.int64(end_id))
+        nacc = jnp.where(live, nacc, jnp.int64(0))
+    return {"Tokens": toks, "NumAccept": nacc}
+
+
 @register_op("paged_attention", no_grad_inputs=("PageTable", "Bias"))
 def paged_attention_op(ctx):
     """Q [S, 1, D], CacheK/CacheV [P + 1, ps, D], PageTable [S, n] int,
@@ -86,7 +147,10 @@ def paged_attention_op(ctx):
     fused_req = int(ctx.attr("fused", -1))
     from . import pallas_fused
 
-    if pallas_fused.fused_decision(fused_req):
+    # The Pallas kernel is specialized to one query row per slot; the
+    # speculative verify step passes k + 1 rows and always takes the
+    # generic unfused lowering (bitwise-identical math either way).
+    if q.shape[1] == 1 and pallas_fused.fused_decision(fused_req):
         from .pallas_paged import paged_attention
 
         out = paged_attention(q, ck, cv, pt, bias, scale)

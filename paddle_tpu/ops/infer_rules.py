@@ -343,6 +343,65 @@ def infer_kv_cache_update(op, ins):
     return {"Out": [cache]}
 
 
+@register_infer("kv_cache_scatter")
+def infer_kv_cache_scatter(op, ins):
+    """Per-token KV scatter (ISSUE 20): Out mirrors Cache; New must carry
+    the cache's feature dims, and Rows/Offs one integer index per written
+    token (a float index would silently truncate, a count mismatch would
+    silently drop or duplicate writes)."""
+    cache, new = _in(ins, "Cache"), _in(ins, "New")
+    rows = _require_int(op, ins, "Rows")
+    offs = _require_int(op, ins, "Offs")
+    if cache is None:
+        return None
+    if new is not None:
+        if tuple(new[0][1:]) != tuple(cache[0][2:]):
+            raise InferMismatch(
+                f"kv_cache_scatter: token rows {_names(op, 'New')} "
+                f"{list(new[0])} must carry the cache feature dims "
+                f"{list(cache[0][2:])} ({_names(op, 'Cache')})")
+        for slot_name, v in (("Rows", rows), ("Offs", offs)):
+            if v is not None and int(np.prod(v[0], dtype=np.int64)) \
+                    != new[0][0]:
+                raise InferMismatch(
+                    f"kv_cache_scatter: {slot_name} "
+                    f"{_names(op, slot_name)} {list(v[0])} must carry one "
+                    f"index per written token ({new[0][0]})")
+    return {"Out": [cache]}
+
+
+@register_infer("spec_accept")
+def infer_spec_accept(op, ins):
+    """Greedy speculative acceptance (ISSUE 20): Tokens is [S, k+1]
+    int64, NumAccept [S] int64; the draft must be exactly one token
+    narrower than the scored window (k drafted, k + 1 verified) and the
+    mask one flag per slot — off-by-one here would silently accept the
+    wrong prefix."""
+    logits = _in(ins, "Logits")
+    draft = _require_int(op, ins, "Draft")
+    mask = _in(ins, "Mask")
+    if logits is None:
+        return None
+    if len(logits[0]) != 3:
+        raise InferMismatch(
+            f"spec_accept: logits {_names(op, 'Logits')} "
+            f"{list(logits[0])} must be [slots, k+1, vocab]")
+    if draft is not None:
+        if len(draft[0]) != 2 or draft[0][0] != logits[0][0] \
+                or draft[0][1] != logits[0][1] - 1:
+            raise InferMismatch(
+                f"spec_accept: draft {_names(op, 'Draft')} "
+                f"{list(draft[0])} must be [slots, k] against verify "
+                f"logits {list(logits[0])} (k + 1 scored positions)")
+    if mask is not None and int(np.prod(mask[0], dtype=np.int64)) \
+            != logits[0][0]:
+        raise InferMismatch(
+            f"spec_accept: mask {_names(op, 'Mask')} {list(mask[0])} "
+            f"must carry one flag per slot ({logits[0][0]})")
+    return {"Tokens": [(tuple(logits[0][:-1]), "int64")],
+            "NumAccept": [((logits[0][0],), "int64")]}
+
+
 @register_infer("paged_attention")
 def infer_paged_attention(op, ins):
     """Paged decode attention (ISSUE 19): Out mirrors Q — an explicit

@@ -30,6 +30,7 @@ from .metrics import ServingMetrics
 from .registry import (ModelRegistry, load_serial_weights,
                        write_weights_serial)
 from .router import Router, RouterConfig
+from .specdec import DraftSource, SpecController, SpecDecoder
 
 __all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
            "EngineOverloaded", "RequestTimeout", "EngineClosed",
@@ -38,4 +39,5 @@ __all__ = ["ServingEngine", "ServingConfig", "ServingMetrics",
            "ModelRegistry", "load_serial_weights", "write_weights_serial",
            "ServingFleet", "Router", "RouterConfig", "AutoscalePolicy",
            "ModelSignals", "Decision", "DevicePool", "Replica",
-           "PagePool", "PageGrant"]
+           "PagePool", "PageGrant",
+           "SpecDecoder", "DraftSource", "SpecController"]

@@ -66,7 +66,10 @@ Knobs (``fluid.envcontract``): ``PADDLE_SERVE_DECODE`` (kill switch),
 ``PADDLE_SERVE_SLOTS``, ``PADDLE_SERVE_MAX_LEN``,
 ``PADDLE_SERVE_PREFILL_BUCKETS``; paged mode adds
 ``PADDLE_SERVE_PAGED``, ``PADDLE_SERVE_PAGE_SIZE``,
-``PADDLE_SERVE_NUM_PAGES``, ``PADDLE_SERVE_PREFIX_SHARE``.
+``PADDLE_SERVE_NUM_PAGES``, ``PADDLE_SERVE_PREFIX_SHARE``; speculative
+decoding (ISSUE 20, ``serving.specdec``) adds ``PADDLE_SERVE_SPEC``,
+``PADDLE_SERVE_SPEC_DRAFT_LAYERS``, ``PADDLE_SERVE_SPEC_MIN_ACCEPT``,
+``PADDLE_SERVE_SPEC_WINDOW``.
 """
 
 from __future__ import annotations
@@ -101,11 +104,24 @@ class DecodeConfig:
                            Decode deadlines are checked PER TOKEN: a
                            request can expire mid-generation and free
                            its slot for the queue;
-    ``idle_wait_s``        worker-condition wait while fully idle.
+    ``idle_wait_s``        worker-condition wait while fully idle;
+    ``spec``               speculation depth k (draft+verify ticks,
+                           ISSUE 20).  None = use ``PADDLE_SERVE_SPEC``
+                           (config beats env; 0 is the kill switch);
+    ``spec_draft_layers``  self-draft depth override for
+                           ``PADDLE_SERVE_SPEC_DRAFT_LAYERS`` (0 =
+                           full-depth self-draft);
+    ``spec_draft_serial``  registry serial directory to load the draft
+                           model's weights from instead of sharing the
+                           target's (serving.registry
+                           ``load_serial_weights`` path).
     """
     max_queue_depth: int = 256
     default_timeout_ms: Optional[float] = None
     idle_wait_s: float = 0.05
+    spec: Optional[int] = None
+    spec_draft_layers: Optional[int] = None
+    spec_draft_serial: Optional[str] = None
 
 
 class DecodeEngine:
@@ -157,6 +173,25 @@ class DecodeEngine:
                 model.max_slots, page_bytes=page_bytes,
                 prefix_share=bool(_ec.get("PADDLE_SERVE_PREFIX_SHARE")),
                 metrics=self.metrics)
+        # speculative decoding (ISSUE 20): PADDLE_SERVE_SPEC=k>0 arms
+        # draft+verify ticks; DecodeConfig fields beat the env knobs.
+        # k=0 is the kill switch — the plain tick runs verbatim and no
+        # draft model is even built.
+        self._spec = None
+        spec_k = (self.config.spec if self.config.spec is not None
+                  else int(_ec.get("PADDLE_SERVE_SPEC") or 0))
+        if spec_k > 0:
+            from .specdec import SpecDecoder
+
+            draft_layers = (
+                self.config.spec_draft_layers
+                if self.config.spec_draft_layers is not None
+                else int(_ec.get("PADDLE_SERVE_SPEC_DRAFT_LAYERS")))
+            self._spec = SpecDecoder(
+                self, spec_k, draft_layers,
+                min_accept=float(_ec.get("PADDLE_SERVE_SPEC_MIN_ACCEPT")),
+                window=int(_ec.get("PADDLE_SERVE_SPEC_WINDOW")),
+                serial=self.config.spec_draft_serial)
         self._cond = threading.Condition(threading.Lock())
         self._queue: collections.deque = collections.deque()
         self._slots: List[Optional[_Request]] = [None] * model.max_slots
@@ -419,6 +454,10 @@ class DecodeEngine:
             from .. import observe
 
             observe.registry().inc("kvpool.prefill_skips")
+        if self._spec is not None:
+            # the draft cache is private and unshared: its prefill runs
+            # even when the target's was a full-hit skip
+            self._spec.prefill(slot, tokens, bucket)
         t1 = time.perf_counter()
         req.t_taken = t0
         req.slot = slot
@@ -474,84 +513,112 @@ class DecodeEngine:
             feeds[model.DC_WOFF] = woff
         return feeds, stalled
 
-    def _step_dispatch(self, slots):
+    def _step_dispatch(self, slots, count_tick=True):
         """ONE compiled decode step over all slots; returns the [S] next
-        tokens (host ints) plus the set of paged slots that stalled this
-        tick.  The [S, V] logits ride along as a second fetch of the
-        SAME executable (a fixed fetch set from warmup on, so the canary
-        sentinel never perturbs the compile counter) and land in
-        ``_last_logits`` for the tick monitor."""
+        tokens (host ints), the set of paged slots that stalled this
+        tick, and the [S, V] logits.  The logits ride along as a second
+        fetch of the SAME executable (a fixed fetch set from warmup on,
+        so the canary sentinel never perturbs the compile counter) and
+        land in ``_last_logits`` for the tick monitor.
+
+        ``count_tick=False`` runs the dispatch without advancing the
+        engine tick (the spec tick's tail dispatch: slots too close to
+        max_len to speculate ride the plain step INSIDE the one spec
+        tick, so one scheduling iteration still counts once)."""
         feeds, stalled = self._tick_feeds(slots)
         nxt, logits = self._run(self.model.step_program, feeds,
                                 [self.model.step_fetch,
                                  self.model.logits_fetch])
-        self._ticks += 1
-        self.metrics.inc("decode_ticks")
-        self._last_logits = np.asarray(logits)
-        return np.asarray(nxt).reshape(-1), stalled
+        logits = np.asarray(logits)
+        if count_tick:
+            self._ticks += 1
+            self.metrics.inc("decode_ticks")
+            self._last_logits = logits
+        return np.asarray(nxt).reshape(-1), stalled, logits
 
-    def _tick(self):
+    def _consume(self, i: int, req: _Request, tok: int, t0: float,
+                 t1: float) -> bool:
+        """Commit ONE generated token to slot ``i`` with all the stream
+        bookkeeping (latency observations, span, retirement on end_id /
+        token budget / cache capacity, the per-token deadline).  Shared
+        by the plain tick and the spec tick's accepted-prefix commit so
+        the two paths cannot drift.  Returns True when the request
+        retired (caller must stop feeding it tokens)."""
         from ..observe import trace as _trace
 
         model = self.model
+        req.out_tokens.append(tok)
+        req.pos += 1
+        self.metrics.inc("tokens_generated")
+        if len(req.out_tokens) == 1:
+            self.metrics.observe_ttft(t1 - req.t_submit)
+        else:
+            self.metrics.observe_intertoken(t1 - req.t_prev_token)
+        req.t_prev_token = t1
+        if req.span is not None:
+            _trace.emit_span("serving.decode_step", t0, t1,
+                             parent=req.span, slot=i,
+                             token_index=len(req.out_tokens) - 1,
+                             tick=self._ticks)
+        done = (tok == model.end_id
+                or len(req.out_tokens) >= req.max_new
+                or req.pos >= model.max_len)
+        if done:
+            self._retire(i)
+            return True
+        if req.deadline is not None and t1 > req.deadline:
+            # per-token deadline: expire MID-GENERATION and free the
+            # slot for the queue instead of decoding a dead request
+            self._retire(i, error=RequestTimeout(
+                f"deadline expired after {len(req.out_tokens)} "
+                f"generated tokens"))
+            return True
+        return False
+
+    def _stall_expire(self, i: int, req: _Request, t1: float) -> None:
+        """Pool-dry stall: the row ran masked (trash write, active=0) —
+        its token is discarded, pos keeps, and it retries next tick once
+        a retirement frees pages.  Deadlines still apply: an expired
+        staller must retire and return its pages, or mutual stalls could
+        live-lock the pool."""
+        if req.deadline is not None and t1 > req.deadline:
+            self._retire(i, error=RequestTimeout(
+                f"deadline expired after {len(req.out_tokens)} "
+                f"generated tokens (pool-stalled)"))
+
+    def _run_monitor(self, logits, dispatched) -> None:
+        """Canary sentinel invocation: this tick's logits + the slot
+        table they were computed for (pre-retire copy, so completions
+        are visible to the probation counter).  A sentinel fault must
+        never take down the worker it watches."""
+        mon = self._tick_monitor
+        if mon is None:
+            return
+        try:
+            mon(logits, dispatched)
+        except Exception:
+            import traceback
+
+            from .. import observe
+
+            observe.emit("model.monitor_error",
+                         error=traceback.format_exc(limit=3))
+
+    def _tick(self):
+        if self._spec is not None and self._spec.run_tick():
+            return  # draft+verify tick ran (specdec.SpecDecoder)
         t0 = time.perf_counter()
         dispatched = list(self._slots)  # rows the logits correspond to
-        nxt, stalled = self._step_dispatch(self._slots)
+        nxt, stalled, _ = self._step_dispatch(self._slots)
         t1 = time.perf_counter()
         for i, req in enumerate(list(self._slots)):
             if req is None:
                 continue
             if i in stalled:
-                # pool-dry stall: this row ran masked (trash write,
-                # active=0) — discard its token, keep pos, retry next
-                # tick once a retirement frees pages.  Deadlines still
-                # apply: an expired staller must retire and return its
-                # pages, or mutual stalls could live-lock the pool.
-                if req.deadline is not None and t1 > req.deadline:
-                    self._retire(i, error=RequestTimeout(
-                        f"deadline expired after {len(req.out_tokens)} "
-                        f"generated tokens (pool-stalled)"))
+                self._stall_expire(i, req, t1)
                 continue
-            tok = int(nxt[i])
-            req.out_tokens.append(tok)
-            req.pos += 1
-            self.metrics.inc("tokens_generated")
-            if len(req.out_tokens) == 1:
-                self.metrics.observe_ttft(t1 - req.t_submit)
-            else:
-                self.metrics.observe_intertoken(t1 - req.t_prev_token)
-            req.t_prev_token = t1
-            if req.span is not None:
-                _trace.emit_span("serving.decode_step", t0, t1,
-                                 parent=req.span, slot=i,
-                                 token_index=len(req.out_tokens) - 1,
-                                 tick=self._ticks)
-            done = (tok == model.end_id
-                    or len(req.out_tokens) >= req.max_new
-                    or req.pos >= model.max_len)
-            if done:
-                self._retire(i)
-            elif req.deadline is not None and t1 > req.deadline:
-                # per-token deadline: expire MID-GENERATION and free the
-                # slot for the queue instead of decoding a dead request
-                self._retire(i, error=RequestTimeout(
-                    f"deadline expired after {len(req.out_tokens)} "
-                    f"generated tokens"))
-        mon = self._tick_monitor
-        if mon is not None:
-            # canary sentinel: this tick's logits + the slot table they
-            # were computed for (post-retire, so completions are visible
-            # to the probation counter).  A sentinel fault must never
-            # take down the worker it watches.
-            try:
-                mon(self._last_logits, dispatched)
-            except Exception:
-                from .. import observe
-
-                import traceback
-
-                observe.emit("model.monitor_error",
-                             error=traceback.format_exc(limit=3))
+            self._consume(i, req, int(nxt[i]), t0, t1)
+        self._run_monitor(self._last_logits, dispatched)
 
     def _retire(self, slot: int, error: Optional[Exception] = None):
         req = self._slots[slot]
@@ -563,6 +630,10 @@ class DecodeEngine:
             # stream's rows used to stay resident until slot reuse).
             # Refcounted prefix pages survive until their last sharer.
             self._pool.release(slot)
+        if self._spec is not None:
+            # the next resident of this slot id starts with a fresh
+            # rolling acceptance rate
+            self._spec.controller.retire_slot(slot)
         self.metrics.note_slots(self._n_active,
                                 self.model.max_slots - self._n_active)
         if req.future.done():
@@ -588,14 +659,17 @@ class DecodeEngine:
     # dispatch plumbing + warmup
     # ------------------------------------------------------------------
 
-    def _run(self, program, feed, fetch_list):
+    def _run(self, program, feed, fetch_list, scope=None):
         """Executor dispatch with compile-counter accounting: any jit-
         cache growth under traffic shows up on ``bucket_compiles`` — the
         fixed-executable-set invariant's counter (must stay flat after
-        warmup)."""
+        warmup).  ``scope`` overrides the engine scope (the spec draft
+        model dispatches against its own scope through the SAME executor
+        so its compiles land on the same counter)."""
         before = len(self._exe._cache)
         outs = self._exe.run(program, feed=feed, fetch_list=fetch_list,
-                             scope=self._scope)
+                             scope=scope if scope is not None
+                             else self._scope)
         grown = len(self._exe._cache) - before
         if grown > 0:
             self.metrics.inc("bucket_compiles", grown)
@@ -745,6 +819,11 @@ class DecodeEngine:
                 self.metrics.inc("warmup_dispatches")
                 _record("step", model.step_program,
                         {"kind": "decode_step"})
+            if self._spec is not None:
+                # the spec additions to the executable set (draft
+                # prefills, draft step, verify) precompile here too —
+                # spec traffic must not grow bucket_compiles either
+                self._spec.warmup()
         self._write_warm_manifest(fps)
         from .. import observe
 
@@ -819,7 +898,7 @@ class DecodeEngine:
                             else None
                             for j, r in enumerate(slots[:len(batch)])]
                     live += [None] * (self.model.max_slots - len(live))
-                    nxt, stalled = self._step_dispatch(live)
+                    nxt, stalled, _ = self._step_dispatch(live)
                     progressed = False
                     for j, req in enumerate(slots[:len(batch)]):
                         if finished[j] or j in stalled:
@@ -882,6 +961,11 @@ class DecodeEngine:
             # the share index must forget them (holders keep decoding —
             # their whole cache is old-weight-consistent until retire)
             self._pool.flush_index()
+        if self._spec is not None:
+            # a self-draft shares weights BY NAME: re-copy so draft and
+            # target keep agreeing (serial-backed drafts are pinned and
+            # sync() is a no-op for them)
+            self._spec.draft.sync(self._scope)
 
     def swap_weights(self, weights: Dict[str, np.ndarray]) -> None:
         """Atomically rebind the named weights between decode ticks."""
@@ -905,6 +989,8 @@ class DecodeEngine:
                                                  np.asarray(cur).dtype))
         if self._pool is not None:
             self._pool.flush_index()  # scrubbed pages share nothing
+        if self._spec is not None:
+            self._spec.draft.scrub()  # draft caches are poisonable too
 
     def pause_admissions(self) -> None:
         """Hold admissions (the drain swap policy): submits still land in

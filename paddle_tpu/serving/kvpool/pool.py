@@ -218,37 +218,62 @@ class PagePool:
 
     # -- release -----------------------------------------------------------
 
+    def _drop_page_locked(self, page: int) -> int:
+        """THE single page-release path: refcount decrement, prefix-index
+        eviction at zero, the ``PADDLE_FAULT_KV_PAGE_LEAK`` oracle, then
+        the actual free.  Every way a page leaves a slot — retire,
+        expiry, reap, teardown, speculative rewind — funnels through
+        here, so the leak oracle and the gauges see them all.  Returns
+        pages actually freed (0 on shared or leaked pages)."""
+        from ...fluid import fault as _fault
+
+        self._ref[page] -= 1
+        if self._ref[page] > 0:
+            return 0
+        del self._ref[page]
+        key = self._page_key.pop(page, None)
+        # evict the prefix entry only if it still names this page
+        # (flush_index may have dropped or re-bound the key)
+        if key is not None and self._index.get(key) == page:
+            del self._index[key]
+        if _fault.kv_page_leak():
+            self._leaked += 1
+            return 0  # the skipped free: page never returns
+        self._free.append(page)
+        return 1
+
     def release(self, slot: int) -> int:
         """Return the slot's pages (retire, deadline expiry, reap, static
         teardown).  Shared pages only reach the free list at refcount
         zero — a sharer's expiry never tears pages out from under the
-        other holders.  Each actual free consults the
-        ``PADDLE_FAULT_KV_PAGE_LEAK`` oracle; a leaked page stays live in
-        the gauges (that growth IS the drill signal).  Returns the number
-        of pages actually freed."""
-        from ...fluid import fault as _fault
-
+        other holders.  Returns the number of pages actually freed."""
         freed = 0
         with self._lock:
             pages = self._slot_pages.pop(slot, None)
             if pages is None:
                 return 0
             for page in pages:
-                self._ref[page] -= 1
-                if self._ref[page] > 0:
-                    continue
-                del self._ref[page]
-                key = self._page_key.pop(page, None)
-                # evict the prefix entry only if it still names this page
-                # (flush_index may have dropped or re-bound the key)
-                if key is not None and self._index.get(key) == page:
-                    del self._index[key]
-                if _fault.kv_page_leak():
-                    self._leaked += 1
-                    continue  # the skipped free: page never returns
-                self._free.append(page)
-                freed += 1
+                freed += self._drop_page_locked(page)
             self._publish_locked()
+        return freed
+
+    def rewind(self, slot: int, keep_pos: int) -> int:
+        """Shrink the slot's page list to exactly cover positions
+        ``<= keep_pos`` (speculative rollback, ISSUE 20): pages grown
+        for rejected draft positions return through the single release
+        path.  The page holding ``keep_pos`` itself is always kept —
+        rewinding never tears a slot's committed frontier.  Returns the
+        number of pages actually freed."""
+        freed = 0
+        with self._lock:
+            pages = self._slot_pages.get(slot)
+            if pages is None:
+                return 0
+            keep = int(keep_pos) // self.page_size + 1
+            while len(pages) > keep:
+                freed += self._drop_page_locked(pages.pop())
+            if freed:
+                self._publish_locked()
         return freed
 
     def flush_index(self) -> None:

@@ -67,7 +67,15 @@ class ServingMetrics:
                 # queue because the page pool ran dry (backpressure, the
                 # paged twin of "shed" — except nothing is lost).  All
                 # zero-reported on dense engines.
-                "prefix_hits", "prefill_skips", "page_requeues")
+                "prefix_hits", "prefill_skips", "page_requeues",
+                # speculative decoding (ISSUE 20): spec ticks taken, draft
+                # tokens proposed vs accepted (their ratio is the rolling
+                # acceptance rate the adaptive controller watches, also
+                # published as the spec_accept_rate gauge), and controller
+                # fallbacks to plain one-token ticks.  Zero-reported with
+                # speculation off.
+                "spec_ticks", "spec_draft_tokens", "spec_accepted_tokens",
+                "spec_fallbacks")
 
     def __init__(self, latency_window: int = 4096,
                  registry: Optional[MetricsRegistry] = None,

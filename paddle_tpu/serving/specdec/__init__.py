@@ -1,0 +1,38 @@
+"""paddle_tpu.serving.specdec: speculative decoding for DecodeEngine
+(ISSUE 20 tentpole).
+
+With ``PADDLE_SERVE_SPEC=k > 0`` the engine's one-token tick becomes a
+draft + verify tick:
+
+ - a :class:`~.draft.DraftSource` — a cheap self-draft model built from
+   the target's first ``PADDLE_SERVE_SPEC_DRAFT_LAYERS`` decoder layers
+   (weights shared BY NAME), or any registry serial loaded through the
+   PR 16 ``load_serial_weights`` path — runs k sequential one-token
+   steps over its own slot-parallel dense KV cache;
+ - ONE wider fixed-shape target verify step
+   (``DecodeModel.spec_program(k)``) scores all k + 1 positions per
+   slot, and the device-side ``spec_accept`` op takes the longest
+   prefix where draft token == target argmax plus the first correction
+   token — so accepted output is bitwise identical to sequential greedy
+   decode by construction;
+ - rejected speculative positions roll back through the PR 19
+   :class:`~..kvpool.PagePool`: the slot's write frontier rewinds,
+   stale writes steer to the trash page, and speculatively-grown pages
+   return through the pool's single release path
+   (``kvpool.pages_leaked`` stays 0 under churn).
+
+The executable set stays closed — one draft step + one verify step +
+the draft prefill buckets join the warmed set, and ``bucket_compiles``
+stays flat after warmup.  A :class:`~.controller.SpecController` watches
+rolling acceptance: below ``PADDLE_SERVE_SPEC_MIN_ACCEPT`` over a
+``PADDLE_SERVE_SPEC_WINDOW`` of spec ticks the engine falls back to
+plain one-token ticks (``specdec.fallback`` event), re-arming after a
+cooldown.  ``PADDLE_SERVE_SPEC=0`` is the kill switch: the PR 15/19
+tick runs verbatim.  See docs/SERVING.md "Speculative decoding".
+"""
+
+from .controller import SpecController
+from .decoder import SpecDecoder
+from .draft import DraftSource
+
+__all__ = ["SpecDecoder", "DraftSource", "SpecController"]

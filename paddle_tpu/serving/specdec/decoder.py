@@ -1,0 +1,303 @@
+"""The draft + verify tick (ISSUE 20 tentpole).
+
+One spec tick replaces one plain engine tick:
+
+::
+
+    draft  x k+1 [S,1]-shaped draft-model steps over the draft's own
+                 dense cache — k cheap dispatches proposing d_1 .. d_k
+                 per slot, plus one cache-fill step (proposal
+                 discarded) so a full accept leaves no stale draft row
+    verify x 1   ONE (k+1)-position target dispatch
+                 (``DecodeModel.spec_program``): position j re-derives
+                 exactly what sequential step j would, writes its K/V,
+                 and ``spec_accept`` takes the longest draft == argmax
+                 prefix plus the first correction token on device
+    commit       the engine consumes ``n + 1`` tokens per slot
+                 (n = accepted drafts), then rewinds the page pool to
+                 the committed frontier — speculatively grown pages
+                 return through the pool's single release path
+
+Acceptance is greedy-bitwise BY CONSTRUCTION: every committed token is
+a target argmax over a cache prefix identical to sequential decode's
+(see ``spec_program``'s shape-clone rationale), so churn, stalls and
+fallback can reorder WHEN tokens appear but never WHICH tokens.
+
+Slots too close to ``max_len`` to score k + 1 positions (and any tick
+where speculation is off) ride a plain step dispatch instead — the
+same warmed executable, so the executable set stays closed:
+
+    1 step + 1 prefill/bucket            (the PR 15/19 set)
+  + 1 draft step + 1 draft prefill/bucket + 1 verify
+
+``bucket_compiles`` stays flat after :meth:`SpecDecoder.warmup`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .controller import SpecController
+from .draft import DraftSource
+
+__all__ = ["SpecDecoder"]
+
+
+class SpecDecoder:
+    """Speculative tick orchestration for one DecodeEngine.
+
+    Lives entirely inside the engine's dispatch lock — the worker calls
+    :meth:`run_tick` from ``_tick``, admission calls :meth:`prefill`,
+    the swap surface calls ``draft.sync`` / ``draft.scrub``.  No
+    internal locking."""
+
+    def __init__(self, engine, k: int, draft_layers: int,
+                 min_accept: float, window: int,
+                 serial: Optional[str] = None):
+        if int(k) < 1:
+            raise ValueError(f"speculation depth must be >= 1, got {k}")
+        self.engine = engine
+        self.k = int(k)
+        self.draft = DraftSource(engine.model, engine._exe,
+                                 draft_layers, serial=serial)
+        self.draft.sync(engine._scope)
+        self.controller = SpecController(min_accept, window,
+                                         metrics=engine.metrics)
+        (self._verify_prog, self._tok_fetch, self._nacc_fetch,
+         self._logits_fetch) = engine.model.spec_program(self.k)
+        # cumulative dispatch wall-time, split draft vs verify — the
+        # bench's draft_ms / verify_ms columns read these
+        self.draft_s = 0.0
+        self.verify_s = 0.0
+
+    # ------------------------------------------------------------------
+    # admission + warmup + the draft phase
+    # ------------------------------------------------------------------
+
+    def prefill(self, slot: int, tokens: np.ndarray, bucket: int) -> None:
+        """Write the prompt's K/V prefix into the DRAFT cache (engine
+        ``_prefill`` hook).  Always dispatched — even when the target
+        prefill was a prefix-share full hit, the draft's private dense
+        cache has no sharing to hit."""
+        dm = self.draft.model
+        self.engine._run(dm.prefill_program(bucket),
+                         {dm.PF_TOKENS: tokens,
+                          dm.PF_SLOT: np.asarray([slot], np.int64)},
+                         [], scope=self.draft.scope)
+
+    def warmup(self) -> None:
+        """Precompile the spec additions to the executable set: every
+        draft prefill bucket, the draft step, and the verify program.
+        Caller (engine ``warmup``) holds the dispatch lock."""
+        eng, dm = self.engine, self.draft.model
+        for b in dm.prefill_buckets:
+            self.prefill(0, np.zeros((1, b), np.int64), b)
+            eng.metrics.inc("warmup_dispatches")
+        self._draft_step(np.zeros((eng.model.max_slots, 1), np.int64),
+                         np.zeros((eng.model.max_slots,), np.int64),
+                         np.zeros((eng.model.max_slots,), np.float32))
+        eng.metrics.inc("warmup_dispatches")
+        self._dispatch_verify(self._idle_verify_feeds())
+        eng.metrics.inc("warmup_dispatches")
+
+    def _draft_step(self, tokens, pos, active) -> np.ndarray:
+        dm = self.draft.model
+        feeds = {dm.DC_TOKENS: tokens, dm.DC_POS: pos,
+                 dm.DC_ACTIVE: active,
+                 dm.DC_POSENC: dm.posenc_rows(pos).astype(np.float32),
+                 dm.DC_BIAS: dm.validity_bias(pos)}
+        (nxt,) = self.engine._run(dm.step_program, feeds,
+                                  [dm.step_fetch],
+                                  scope=self.draft.scope)
+        # writable host copy: the poison hook mutates drafted tokens
+        return np.array(nxt, np.int64).reshape(-1)
+
+    def _dispatch_verify(self, feeds):
+        outs = self.engine._run(
+            self._verify_prog, feeds,
+            [self._tok_fetch, self._nacc_fetch, self._logits_fetch])
+        return (np.asarray(outs[0]), np.asarray(outs[1]),
+                np.asarray(outs[2]))
+
+    def _idle_verify_feeds(self) -> dict:
+        """All-inactive verify feeds (warmup): every write aims at the
+        trash destination, every row is masked."""
+        model = self.engine.model
+        s, w = model.max_slots, self.k + 1
+        trash = (self.engine._pool.trash_page
+                 if self.engine._pool is not None else model.max_slots)
+        feeds = {model.SP_DRAFT: np.zeros((s, self.k), np.int64),
+                 model.SP_ACTIVE: np.zeros((s,), np.float32)}
+        if self.engine._pool is not None:
+            feeds[model.SP_PTABLE] = self.engine._pool.table()
+        zero_pos = np.zeros((s,), np.int64)
+        for j in range(w):
+            feeds[model.SP_TOK.format(j)] = np.zeros((s, 1), np.int64)
+            feeds[model.SP_PE.format(j)] = \
+                model.posenc_rows(zero_pos).astype(np.float32)
+            feeds[model.SP_BIAS_J.format(j)] = model.validity_bias(zero_pos)
+            feeds[model.SP_WROW.format(j)] = np.full((s,), trash, np.int64)
+            feeds[model.SP_WOFF.format(j)] = np.zeros((s,), np.int64)
+        return feeds
+
+    # ------------------------------------------------------------------
+    # the spec tick
+    # ------------------------------------------------------------------
+
+    def run_tick(self) -> bool:
+        """One draft + verify tick over the engine's slot table; returns
+        False when this tick should run the plain path instead (fallback
+        cooldown, or no slot has room to score k + 1 positions)."""
+        from ...fluid import fault as _fault
+
+        eng = self.engine
+        if not self.controller.armed:
+            # a plain tick is about to run; count it toward cooldown
+            self.controller.note_plain_tick()
+            return False
+        model, k, w = eng.model, self.k, self.k + 1
+        s = model.max_slots
+        slots = list(eng._slots)
+        # a slot speculates only when positions pos .. pos+k all fit the
+        # cache; tail slots ride a plain step dispatch this same tick
+        eligible = [i for i, r in enumerate(slots)
+                    if r is not None and int(r.pos) + k <= model.max_len - 1]
+        if not eligible:
+            return False
+        tail = [i for i, r in enumerate(slots)
+                if r is not None and i not in eligible]
+
+        # -- draft: k sequential cheap steps over the draft cache ------
+        t0 = time.perf_counter()
+        tok0 = np.zeros((s, 1), np.int64)
+        base = np.zeros((s,), np.int64)
+        act = np.zeros((s,), np.float32)
+        for i in eligible:
+            r = slots[i]
+            tok0[i, 0] = (r.out_tokens[-1] if r.out_tokens
+                          else r.prompt[-1])
+            base[i] = int(r.pos)
+            act[i] = 1.0
+        poison_from = _fault.spec_draft_poison()
+        poisoned = poison_from is not None and eng._ticks >= poison_from
+        drafted = np.zeros((s, k), np.int64)
+        cur = tok0.copy()
+        for j in range(k):
+            nxt = self._draft_step(cur, base + j, act)
+            if poisoned:
+                # deterministic garbage, valid vocab ids: acceptance
+                # collapses, the controller trips, and every committed
+                # token is still a target argmax — zero wrong bits out
+                for i in eligible:
+                    nxt[i] = (int(base[i]) + 31 * j + 7 * i) \
+                        % model.vocab_size
+            drafted[:, j] = nxt
+            cur = nxt.reshape(s, 1).astype(np.int64)
+        # one extra step, proposal discarded: a FULL accept commits
+        # k + 1 tokens, so the draft cache needs row base+k (token d_k)
+        # before the next tick's attention reads it — without this
+        # write every full accept leaves one stale row behind and the
+        # draft diverges from the committed stream until a partial
+        # accept happens to overwrite it
+        self._draft_step(cur, base + k, act)
+        self.draft_s += time.perf_counter() - t0
+
+        # -- verify: one (k+1)-position target dispatch ----------------
+        t1 = time.perf_counter()
+        pool = eng._pool
+        trash = pool.trash_page if pool is not None else model.max_slots
+        wrow = [np.full((s,), trash, np.int64) for _ in range(w)]
+        woff = [np.zeros((s,), np.int64) for _ in range(w)]
+        n_cap: Dict[int, int] = {}
+        stalled = set()
+        if pool is not None:
+            for i in eligible:
+                p = int(base[i])
+                covered = 0
+                for j in range(w):
+                    if not pool.ensure(i, p + j):
+                        break  # pool dry: rows >= j write trash, and
+                    covered += 1  # acceptance caps below them
+                if covered == 0:
+                    stalled.add(i)  # not even the mandatory write fits:
+                    continue        # stall whole-slot like a plain tick
+                n_cap[i] = covered - 1
+                for j in range(covered):
+                    wrow[j][i], woff[j][i] = pool.write_loc(i, p + j)
+        else:
+            for i in eligible:
+                p = int(base[i])
+                n_cap[i] = k
+                for j in range(w):
+                    wrow[j][i], woff[j][i] = i, p + j
+        act2 = act.copy()
+        for i in stalled:
+            act2[i] = 0.0
+        feeds = {model.SP_DRAFT: drafted, model.SP_ACTIVE: act2}
+        if pool is not None:
+            feeds[model.SP_PTABLE] = pool.table()
+        for j in range(w):
+            tok_j = np.zeros((s, 1), np.int64)
+            for i in eligible:
+                if i in stalled:
+                    continue
+                tok_j[i, 0] = tok0[i, 0] if j == 0 else drafted[i, j - 1]
+            pos_j = np.where(act2 > 0, base + j, 0)
+            feeds[model.SP_TOK.format(j)] = tok_j
+            feeds[model.SP_PE.format(j)] = \
+                model.posenc_rows(pos_j).astype(np.float32)
+            feeds[model.SP_BIAS_J.format(j)] = model.validity_bias(pos_j)
+            feeds[model.SP_WROW.format(j)] = wrow[j]
+            feeds[model.SP_WOFF.format(j)] = woff[j]
+        toks, nacc, logits0 = self._dispatch_verify(feeds)
+        self.verify_s += time.perf_counter() - t1
+
+        # -- tail: plain step over the slots that couldn't speculate --
+        merged_logits = np.array(logits0)
+        tail_nxt, tail_stalled = None, set()
+        if tail:
+            tail_slots: List = [slots[i] if i in tail else None
+                                for i in range(s)]
+            tail_nxt, tail_stalled, tail_logits = \
+                eng._step_dispatch(tail_slots, count_tick=False)
+            for i in tail:
+                merged_logits[i] = tail_logits[i]
+        t2 = time.perf_counter()
+
+        # -- commit: consume accepted prefix + correction per slot -----
+        eng._ticks += 1
+        eng.metrics.inc("decode_ticks")
+        eng.metrics.inc("spec_ticks")
+        eng._last_logits = merged_logits
+        sample: Dict[int, Tuple[int, int]] = {}
+        for i in eligible:
+            req = slots[i]
+            if i in stalled:
+                eng._stall_expire(i, req, t2)
+                continue
+            n = min(int(nacc[i]), n_cap[i])
+            sample[i] = (n, k)
+            eng.metrics.inc("spec_draft_tokens", k)
+            eng.metrics.inc("spec_accepted_tokens", n)
+            for j in range(n + 1):
+                if eng._consume(i, req, int(toks[i, j]), t1, t2):
+                    break  # retired (end_id / budget / expiry):
+                           # _retire released every page
+            else:
+                if pool is not None:
+                    # rejected speculative growth rewinds to the
+                    # committed frontier (req.pos = the next write)
+                    pool.rewind(i, int(req.pos))
+        for i in tail:
+            req = slots[i]
+            if i in tail_stalled:
+                eng._stall_expire(i, req, t2)
+                continue
+            eng._consume(i, req, int(tail_nxt[i]), t1, t2)
+        if sample:
+            self.controller.observe(sample)
+        eng._run_monitor(merged_logits, slots)
+        return True

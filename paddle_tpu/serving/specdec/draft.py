@@ -1,0 +1,104 @@
+"""The draft side of speculative decoding: a cheap DecodeModel that
+proposes k tokens per tick for the target to verify.
+
+Two ways to get one (ISSUE 20):
+
+ - **self-draft** (default): a truncated clone of the target — the
+   first ``PADDLE_SERVE_SPEC_DRAFT_LAYERS`` decoder layers, sharing
+   embeddings and weights BY NAME.  The truncated model's parameter
+   names (``dlm_emb``, ``dlm_out_w``, ``dlm{i}_*`` for ``i < depth``)
+   are exactly a prefix of the target's, so :meth:`sync` is a plain
+   name-for-name copy from the target scope — no surgery, and a weight
+   hot-swap re-syncs the same way.  ``draft_layers=0`` means full
+   depth: the draft IS the target (acceptance 1.0 — the throughput
+   ceiling probe ``tools/bench_serving.py`` uses).
+ - **registry serial**: any PR 16 serial directory whose weights match
+   the draft architecture, loaded through
+   :func:`..registry.load_serial_weights` (same manifest/digest checks
+   as a hot swap).  Serial-backed drafts keep their own weights across
+   target swaps.
+
+The draft always runs a DENSE slot cache regardless of the target's
+paged mode: draft K/V is private scratch (never shared, never read by
+the target), rollback is free — the validity bias masks everything past
+the committed frontier, so rejected draft positions are simply
+overwritten next tick — and the page pool stays dedicated to target
+state the bitwise contract actually depends on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...fluid.executor import Scope
+from ...models.transformer import Config, DecodeModel
+
+__all__ = ["DraftSource"]
+
+
+class DraftSource:
+    """The draft model plus its private scope and per-slot chain state.
+
+    ``exe`` is the ENGINE's executor — draft programs dispatch through
+    it (so ``bucket_compiles`` accounting sees them) but against
+    ``self.scope``, keeping draft weights and caches fully separate
+    from the target's."""
+
+    def __init__(self, target: DecodeModel, exe, draft_layers: int,
+                 serial: Optional[str] = None):
+        depth = int(draft_layers)
+        if depth < 0 or depth > target.cfg.n_layer:
+            raise ValueError(
+                f"draft_layers ({depth}) must be in [0, "
+                f"{target.cfg.n_layer}] (0 = full-depth self-draft)")
+        if depth == 0:
+            depth = target.cfg.n_layer
+        c = target.cfg
+        dcfg = Config(f"{c.name}_draft{depth}", src_vocab_size=c.src_vocab_size,
+                      tgt_vocab_size=c.tgt_vocab_size, d_model=c.d_model,
+                      d_inner=c.d_inner, n_head=c.n_head, n_layer=depth,
+                      dropout=0.0, label_smooth=0.0)
+        self.depth = depth
+        self.serial = serial
+        self.model = DecodeModel(
+            cfg=dcfg, max_slots=target.max_slots, max_len=target.max_len,
+            prefill_buckets=target.prefill_buckets, end_id=target.end_id,
+            seed=target.seed, paged=False)
+        self._exe = exe
+        self.scope = Scope()
+        exe.run(self.model.startup, scope=self.scope)
+        if serial is not None:
+            self._load_serial(serial)
+
+    # -- weights -----------------------------------------------------------
+
+    def _load_serial(self, serial: str) -> None:
+        from ..registry import load_serial_weights
+
+        names = self.model.weight_names()
+        shapes = {n: tuple(np.asarray(self.scope.get(n)).shape)
+                  for n in names}
+        weights, _meta = load_serial_weights(serial, names, shapes=shapes)
+        for name, arr in weights.items():
+            self.scope.set(name, np.asarray(arr, np.float32))
+
+    def sync(self, target_scope) -> None:
+        """Copy the shared-by-name weight set target -> draft.  Called
+        once after engine startup and again after every weight swap;
+        a no-op for serial-backed drafts (their weights are pinned)."""
+        if self.serial is not None:
+            return
+        for name in self.model.weight_names():
+            val = target_scope.get(name)
+            if val is not None:
+                self.scope.set(name, np.array(val, np.float32, copy=True))
+
+    def scrub(self) -> None:
+        """Zero the draft slot caches (engine ``_scrub_caches`` hook)."""
+        for name in (v.name for v in self.model.startup.list_vars()
+                     if v.persistable and "_cache_" in v.name):
+            arr = self.scope.get(name)
+            if arr is not None:
+                self.scope.set(name, np.zeros_like(np.asarray(arr)))

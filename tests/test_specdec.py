@@ -1,0 +1,320 @@
+"""Speculative decoding subsystem (ISSUE 20): draft+verify ticks over
+the paged KV pool with bitwise-greedy acceptance and adaptive fallback.
+
+Oracles:
+ - BITWISE: spec-decoded streams (draft+verify ticks, admit/retire
+   churn, paged AND dense caches) are bit-identical to per-request
+   sequential greedy decode — acceptance commits only tokens the target
+   itself argmax-derived over a sequential-identical cache prefix;
+ - ROLLBACK: rejected speculative positions rewind through the page
+   pool's single release path — ``pages_leaked`` stays 0 and the free
+   list returns to its initial size after every drain, including a
+   deadline expiry that kills a slot MID-speculation;
+ - COMPOSITION: prefix-shared prompts and speculation stack (shared
+   admissions skip prefill AND speculate; outputs stay bitwise);
+ - CLOSED SET: the spec executables (draft prefills, draft step,
+   verify) all warm up front — ``executables()`` is flat under spec
+   traffic;
+ - FALLBACK: ``PADDLE_FAULT_SPEC_DRAFT_POISON`` collapses acceptance
+   into a ``specdec.fallback`` with ZERO wrong tokens emitted, and the
+   controller re-arms after cooldown (exercised inside the smoke tool);
+ - KILL SWITCH: ``PADDLE_SERVE_SPEC=0`` builds no draft model and runs
+   the plain tick verbatim, bitwise-identical to the spec engine.
+
+One module-scoped dense+paged spec-armed engine pair serves the engine
+tests (construction + warmup is the expensive part).  Tests run in
+definition order under the tier-1 ``-p no:randomly`` contract.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import fault as _fault
+from paddle_tpu.fluid import layers
+from paddle_tpu.models import transformer
+from paddle_tpu.serving import (DecodeConfig, DecodeEngine, PagePool,
+                                RequestTimeout, SpecController)
+
+SLOTS, MAX_LEN, BUCKETS, PS, K = 3, 24, (4, 8), 4, 2
+
+
+def _model(paged, **kw):
+    return transformer.DecodeModel(cfg=transformer.decode_lm_config(),
+                                   max_slots=kw.pop("slots", SLOTS),
+                                   max_len=kw.pop("max_len", MAX_LEN),
+                                   prefill_buckets=list(
+                                       kw.pop("buckets", BUCKETS)),
+                                   paged=paged, page_size=PS, **kw)
+
+
+def _jobs(vocab, n=6, seed=21):
+    rng = np.random.RandomState(seed)
+    lengths = [3, 5, 8, 4, 6, 3][:n]
+    news = [6, 5, 7, 4, 6, 8][:n]
+    return [([int(t) for t in rng.randint(2, vocab - 1, size=ln)], m)
+            for ln, m in zip(lengths, news)]
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cfg = DecodeConfig(spec=K, spec_draft_layers=1)
+    dense = DecodeEngine(_model(False), cfg)
+    paged = DecodeEngine(_model(True), cfg)
+    dense.warmup()
+    paged.warmup()
+    yield dense, paged
+    paged.shutdown(timeout_s=30)
+    dense.shutdown(timeout_s=30)
+
+
+# ---------------------------------------------------------------------------
+# host-side units (no executor)
+# ---------------------------------------------------------------------------
+
+def test_controller_fallback_cooldown_rearm():
+    ctl = SpecController(min_accept=0.5, window=3)
+    assert ctl.armed and ctl.rate() is None
+    ctl.observe({0: (2, 2), 1: (1, 2)})       # 3/4
+    assert ctl.armed and ctl.rate() == pytest.approx(0.75)
+    assert ctl.slot_rate(0) == pytest.approx(1.0)
+    assert ctl.slot_rate(7) is None
+    # a low rate does NOT trip before the window fills
+    ctl.observe({0: (0, 2)})
+    assert ctl.armed
+    ctl.observe({0: (0, 2), 1: (0, 2)})       # window full, 3/10 < 0.5
+    assert not ctl.armed and ctl.fallbacks == 1
+    # cooldown: window-many plain ticks, then re-arm with a clean slate
+    ctl.note_plain_tick()
+    ctl.note_plain_tick()
+    assert not ctl.armed
+    ctl.note_plain_tick()
+    assert ctl.armed and ctl.rate() is None
+    # retired slots drop their rolling state
+    ctl.observe({2: (1, 2)})
+    ctl.retire_slot(2)
+    assert ctl.slot_rate(2) is None
+
+
+def test_pool_rewind_returns_growth_through_release_path():
+    pool = PagePool(num_pages=6, page_size=4, pages_per_slot=6,
+                    max_slots=1, prefix_share=False)
+    g = pool.admit(0, [2, 3, 4], bucket=4)    # one private page
+    assert g is not None and len(g.pages) == 1
+    for pos in (4, 8, 12):                    # speculative growth
+        assert pool.ensure(0, pos)
+    assert pool.pages_free == 2
+    # commit frontier at pos 5: keep pages covering 0..5, free the rest
+    assert pool.rewind(0, 5) == 2
+    assert pool.pages_free == 4
+    assert len(pool.slot_pages(0)) == 2
+    assert pool.rewind(0, 5) == 0             # idempotent
+    # rewind funnels through THE release path: the leak fault sees it
+    assert pool.ensure(0, 8)
+    _fault.install(_fault.FaultPlan(kv_page_leak=1))
+    try:
+        assert pool.rewind(0, 5) == 0         # free skipped -> leaked
+    finally:
+        _fault.clear()
+    assert pool.pages_leaked == 1
+    assert pool.release(0) == 2
+    assert pool.pages_free == 5               # 6 minus the leaked page
+
+
+def test_spec_accept_op_semantics():
+    """Device acceptance rule: longest draft==argmax prefix + the first
+    correction token; masked rows emit end_id and accept nothing."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        logits = layers.data("sa_l", shape=[2, 3, 5], dtype="float32",
+                             append_batch_size=False)
+        draft = layers.data("sa_d", shape=[2, 2], dtype="int64",
+                            append_batch_size=False)
+        mask = layers.data("sa_m", shape=[2], dtype="float32",
+                           append_batch_size=False)
+        toks, nacc = layers.spec_accept(logits, draft, mask=mask,
+                                        end_id=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    lg = np.zeros((2, 3, 5), np.float32)
+    for j, t in enumerate([2, 4, 3]):         # row 0 argmaxes: 2, 4, 3
+        lg[0, j, t] = 1.0
+    lg[1, :, 2] = 1.0                         # row 1 argmax all-2s (masked)
+    t_out, n_out = exe.run(
+        prog, feed={"sa_l": lg,
+                    "sa_d": np.array([[2, 0], [2, 2]], np.int64),
+                    "sa_m": np.array([1.0, 0.0], np.float32)},
+        fetch_list=[toks, nacc])
+    # slot 0: draft [2, 0] vs argmax [2, 4] -> 1 accepted; tokens pass
+    assert list(np.asarray(t_out)[0]) == [2, 4, 3]
+    # slot 1 masked: end_id tokens, zero acceptance (despite matching)
+    assert list(np.asarray(t_out)[1]) == [1, 1, 1]
+    assert list(np.asarray(n_out)) == [1, 0]
+
+
+def test_kv_cache_scatter_drops_oob_trash_rows():
+    """Dense spec writes steer non-participants to row id == max_slots:
+    JAX scatter drops out-of-bounds rows, the in-range write lands."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        cache = layers.data("sc_c", shape=[2, 4, 3], dtype="float32",
+                            append_batch_size=False)
+        new = layers.data("sc_n", shape=[2, 3], dtype="float32",
+                          append_batch_size=False)
+        rows = layers.data("sc_r", shape=[2], dtype="int64",
+                           append_batch_size=False)
+        offs = layers.data("sc_o", shape=[2], dtype="int64",
+                           append_batch_size=False)
+        out = layers.kv_cache_scatter(cache, new, rows, offs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(
+        prog, feed={"sc_c": np.zeros((2, 4, 3), np.float32),
+                    "sc_n": np.ones((2, 3), np.float32),
+                    "sc_r": np.array([1, 2], np.int64),   # row 2 = trash
+                    "sc_o": np.array([3, 0], np.int64)},
+        fetch_list=[out])
+    res = np.asarray(res)
+    assert res[1, 3].tolist() == [1.0, 1.0, 1.0]
+    assert res.sum() == 3.0                   # the OOB write went nowhere
+
+
+# ---------------------------------------------------------------------------
+# engine level: bitwise under churn, both cache layouts
+# ---------------------------------------------------------------------------
+
+def test_spec_bitwise_under_churn_dense_and_paged(engines):
+    """More requests than slots through both spec engines: admit/retire
+    churn mid-flight, speculative page growth + rewind, and every
+    stream bitwise equal to sequential greedy decode."""
+    for eng in engines:
+        pool = eng._pool
+        free0 = pool.pages_free if pool is not None else None
+        exes0 = eng.executables()
+        jobs = _jobs(eng.model.vocab_size)
+        sequential = [eng.decode_static([j])[0][0] for j in jobs]
+        futs = [eng.submit(p, n) for p, n in jobs]
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == sequential
+        snap = eng.metrics.snapshot()
+        assert snap["spec_ticks"] > 0
+        assert snap["spec_draft_tokens"] > 0
+        assert snap["spec_accepted_tokens"] >= 0
+        assert eng.executables() == exes0     # closed executable set
+        assert eng.wait_idle(timeout_s=30)
+        if pool is not None:
+            assert pool.pages_free == free0
+            assert pool.pages_leaked == 0
+
+
+def test_spec_composes_with_prefix_sharing(engines):
+    """Shared-prefix admissions (prefill skipped outright) still
+    speculate, and divergent tails stay per-stream bitwise."""
+    dense, paged = engines
+    base = [11, 12, 13, 14]                   # plen 5: (plen-1) % PS == 0
+    pa, pb = base + [9], base + [10]
+    seq_a = paged.decode_static([(pa, 6)])[0][0]
+    seq_b = paged.decode_static([(pb, 6)])[0][0]
+    skips0 = paged.metrics.snapshot()["prefill_skips"]
+    paged.pause_admissions()
+    futs = [paged.submit(p, 6) for p in (pa, pa, pb)]
+    paged.resume_admissions()
+    oa1, oa2, ob = [f.result(timeout=120) for f in futs]
+    assert oa1 == seq_a and oa2 == seq_a and ob == seq_b
+    assert paged.metrics.snapshot()["prefill_skips"] > skips0
+    assert paged.wait_idle(timeout_s=30)
+    assert paged._pool.pages_leaked == 0
+
+
+def test_deadline_expiry_mid_speculation_releases_pages(engines):
+    """A speculating slot can expire between ticks: its pages —
+    including speculatively grown ones — return through release, and
+    the surviving stream stays bitwise."""
+    dense, paged = engines
+    pool = paged._pool
+    free0 = pool.pages_free
+    jobs = _jobs(paged.model.vocab_size, n=2, seed=33)
+    survivor_seq = paged.decode_static([jobs[1]])[0][0]
+    expired0 = paged.metrics.snapshot()["expired"]
+    try:
+        _fault.install(_fault.FaultPlan(decode_stall_ms=40.0))
+        paged.pause_admissions()
+        fa = paged.submit(jobs[0][0], 18, timeout_ms=150.0)
+        fb = paged.submit(jobs[1][0], jobs[1][1])
+        paged.resume_admissions()
+        with pytest.raises(RequestTimeout):
+            fa.result(timeout=120)
+        assert fb.result(timeout=120) == survivor_seq
+    finally:
+        _fault.clear()
+    assert paged.metrics.snapshot()["expired"] == expired0 + 1
+    assert paged.wait_idle(timeout_s=30)
+    assert pool.pages_free == free0
+    assert pool.pages_leaked == 0
+
+
+def test_full_depth_self_draft_accepts_everything():
+    """draft_layers=0 makes the draft the target itself: acceptance is
+    1.0 by construction and every spec tick commits k+1 tokens — the
+    bench's throughput-ceiling configuration."""
+    eng = DecodeEngine(_model(False, slots=2, max_len=16, buckets=(4,)),
+                       DecodeConfig(spec=K, spec_draft_layers=0))
+    try:
+        eng.warmup()
+        out = eng.submit([3, 5, 7], 9).result(timeout=120)
+        snap = eng.metrics.snapshot()  # before the comparator's ticks
+        assert out == eng.decode_static([([3, 5, 7], 9)])[0][0]
+        assert snap["spec_draft_tokens"] > 0
+        assert snap["spec_accepted_tokens"] == snap["spec_draft_tokens"]
+        # tokens per tick strictly beats the one-token plain tick
+        assert snap["tokens_generated"] > snap["decode_ticks"]
+    finally:
+        eng.shutdown(timeout_s=30)
+
+
+def test_spec_kill_switch_restores_plain_tick(engines, monkeypatch):
+    """PADDLE_SERVE_SPEC=0 (the default) builds NO draft model and the
+    engine output is bitwise the spec engine's."""
+    monkeypatch.delenv("PADDLE_SERVE_SPEC", raising=False)
+    dense, _ = engines
+    job = _jobs(dense.model.vocab_size, n=1, seed=44)[0]
+    spec_out = dense.submit(job[0], job[1]).result(timeout=120)
+    plain = DecodeEngine(_model(False))   # env default: spec off
+    try:
+        assert plain._spec is None
+        assert plain.submit(job[0], job[1]).result(timeout=120) \
+            == spec_out
+        assert plain.metrics.snapshot()["spec_ticks"] == 0
+    finally:
+        plain.shutdown(timeout_s=30)
+    # config beats env: DecodeConfig(spec=0) would also disarm, and the
+    # env knob itself is declared in the contract
+    from paddle_tpu.fluid import envcontract as _ec
+    assert _ec.get("PADDLE_SERVE_SPEC") == 0
+
+
+def test_draft_poison_hook_unarmed_by_default():
+    assert _fault.spec_draft_poison() is None
+    _fault.install(_fault.FaultPlan(spec_draft_poison=7))
+    try:
+        assert _fault.spec_draft_poison() == 7
+    finally:
+        _fault.clear()
+    assert _fault.spec_draft_poison() is None
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 CI entry
+# ---------------------------------------------------------------------------
+
+def test_spec_smoke_tool():
+    """tools/spec_smoke.py is the tier-1 CI entry (JSON 'ok'); run its
+    main() in-process so a regression fails here.  Covers the poison ->
+    fallback drill and the pages_leaked == 0 churn oracle."""
+    import tools.spec_smoke as smoke
+
+    report = smoke.main()
+    assert report["ok"], report
+    assert report["bitwise_vs_sequential"] and report["poison_bitwise"]
+    assert report["acceptance_rate"] > 0
+    assert report["spec_fallbacks"] > 0
+    assert report["executables_flat"]
+    assert report["pages_leaked"] == 0

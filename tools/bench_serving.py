@@ -187,7 +187,15 @@ def run_decode_bench(args) -> dict:
         max_slots=args.slots, max_len=args.max_len,
         prefill_buckets=[4, 8], paged=paged,
         page_size=args.page_size, num_pages=args.num_pages)
-    eng = DecodeEngine(model, DecodeConfig(max_queue_depth=args.queue_depth))
+    # --spec k arms speculative decoding (ISSUE 20).  --draft-layers
+    # defaults to 0 = full-depth self-draft: the acceptance ceiling
+    # (rate 1.0), so the line measures the draft+verify machinery's
+    # throughput headroom; pass a small n for a realistic cheap draft.
+    spec_k = int(getattr(args, "spec", 0) or 0)
+    eng = DecodeEngine(model, DecodeConfig(
+        max_queue_depth=args.queue_depth,
+        spec=spec_k if spec_k > 0 else None,
+        spec_draft_layers=getattr(args, "draft_layers", None)))
     eng.warmup()
     warm = eng.metrics.snapshot()
     # dense KV footprint for the equal-HBM comparison in either mode
@@ -204,6 +212,10 @@ def run_decode_bench(args) -> dict:
         base = [int(t) for t in rng.randint(2, model.vocab_size - 1,
                                             size=ps)]
         pool = [base + [int(t)]
+                for t in rng.randint(2, model.vocab_size - 1, size=64)]
+    elif spec_k > 0:
+        # repetitive prompts: the draftable load speculation pays on
+        pool = [[int(t)] * 3
                 for t in rng.randint(2, model.vocab_size - 1, size=64)]
     else:
         pool = [[int(t) for t in rng.randint(2, model.vocab_size - 1,
@@ -298,9 +310,15 @@ def run_decode_bench(args) -> dict:
     eng.drain(timeout_s=60.0)
     snap = eng.metrics.snapshot()
     executables = eng.executables()
+    spec = eng._spec
     eng.shutdown()
 
     win = ServingMetrics.window(warm, snap)
+    spec_ticks_d = snap["spec_ticks"] - warm["spec_ticks"]
+    drafted_d = snap["spec_draft_tokens"] - warm["spec_draft_tokens"]
+    accepted_d = snap["spec_accepted_tokens"] - warm["spec_accepted_tokens"]
+    ticks_d = snap["decode_ticks"] - warm["decode_ticks"]
+    tokens_d = snap["tokens_generated"] - warm["tokens_generated"]
     return {
         "metric": f"serving_decode_openloop_{args.device.lower()}",
         "value": win["tokens_per_s"],
@@ -352,6 +370,22 @@ def run_decode_bench(args) -> dict:
         "shared_prefix": bool(args.shared_prefix),
         "swaps": snap["model_swaps"] - warm["model_swaps"],
         "swap_policy": args.swap_policy if n_swaps > 0 else None,
+        # speculative decoding (ISSUE 20): window acceptance, committed
+        # tokens per engine tick (all slots; plain decode caps at one
+        # per ACTIVE slot per tick, speculation at k+1), and the
+        # per-spec-tick draft/verify cost split
+        "spec_k": spec_k,
+        "draft_layers": (spec.draft.model.cfg.n_layer
+                         if spec is not None else None),
+        "acceptance_rate": (round(accepted_d / drafted_d, 4)
+                            if drafted_d else None),
+        "tokens_per_tick": (round(tokens_d / ticks_d, 4)
+                            if ticks_d else None),
+        "spec_fallbacks": snap["spec_fallbacks"] - warm["spec_fallbacks"],
+        "draft_ms": (round(spec.draft_s / spec_ticks_d * 1e3, 3)
+                     if spec is not None and spec_ticks_d else None),
+        "verify_ms": (round(spec.verify_s / spec_ticks_d * 1e3, 3)
+                      if spec is not None and spec_ticks_d else None),
         "smoke": bool(args.smoke),
     }
 
@@ -550,6 +584,15 @@ def main(argv=None) -> int:
                    help="decode workload where every prompt shares one "
                         "full first page (drives prefix_hits / "
                         "prefill_skips)")
+    p.add_argument("--spec", type=int, default=0,
+                   help="speculative decoding: k draft tokens per tick "
+                        "through a self-drafted verify dispatch "
+                        "(ISSUE 20; 0 = off)")
+    p.add_argument("--draft-layers", type=int, default=None,
+                   help="self-draft depth for --spec (default "
+                        "PADDLE_SERVE_SPEC_DRAFT_LAYERS; 0 = full-depth "
+                        "self-draft, the acceptance-1.0 throughput "
+                        "ceiling)")
     p.add_argument("--swaps", type=int, default=0,
                    help="hot-swap this many fresh serials through the "
                         "decode window (registry watcher; ISSUE 16)")

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Speculative-decoding smoke (CPU, < 10 s) — the ISSUE 20 CI oracle.
+
+A churn workload through a PAGED DecodeEngine with ``spec=k`` armed,
+checked five ways:
+
+ - every spec-decoded stream is BITWISE identical to per-request
+   sequential greedy decode over the same config/seed (the draft+verify
+   tick changes WHEN tokens appear, never WHICH tokens);
+ - acceptance is real: ``spec_accepted_tokens / spec_draft_tokens > 0``
+   and ``spec_ticks > 0`` (the engine actually speculated);
+ - the executable set stays closed: ``executables()`` is flat across
+   the whole loaded run after warmup and ``bucket_compiles`` does not
+   grow under traffic;
+ - the page pool survives speculative grow/rewind churn:
+   ``kvpool.pages_leaked == 0`` and ``pages_free`` returns exactly to
+   the initial pool size after drain;
+ - the ``PADDLE_FAULT_SPEC_DRAFT_POISON`` drill collapses acceptance
+   into a ``specdec.fallback`` (``spec_fallbacks > 0``) while the
+   poisoned stream STILL decodes bitwise — garbage drafts cost
+   throughput, never correctness.
+
+Run directly (``python tools/spec_smoke.py``) or from tier-1 via
+``tests/test_specdec.py::test_spec_smoke_tool``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SLOTS = 3
+MAX_LEN = 32
+BUCKETS = [8]  # one bucket: two fewer prefill compiles keeps this <10s
+PAGE_SIZE = 4
+SPEC_K = 2
+
+
+def _jobs(vocab):
+    import numpy as np
+
+    rng = np.random.RandomState(20)
+    lengths = [3, 5, 8, 4, 6, 3]
+    news = [6, 5, 7, 4, 6, 8]
+    return [([int(t) for t in rng.randint(2, vocab - 1, size=n)], m)
+            for n, m in zip(lengths, news)]
+
+
+def main() -> dict:
+    from paddle_tpu.fluid import fault as _fault
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    os.environ["PADDLE_SERVE_SPEC_WINDOW"] = "4"
+    t_start = time.perf_counter()
+    report = {"ok": False}
+    eng = None
+    try:
+        model = transformer.DecodeModel(
+            cfg=transformer.decode_lm_config(), max_slots=SLOTS,
+            max_len=MAX_LEN, prefill_buckets=list(BUCKETS),
+            paged=True, page_size=PAGE_SIZE)
+        eng = DecodeEngine(model, DecodeConfig(spec=SPEC_K,
+                                               spec_draft_layers=1))
+        pool = eng._pool
+        report["spec_k"] = SPEC_K
+        report["pages_free_initial"] = pool.pages_free
+        eng.warmup()
+        exes_after_warmup = eng.executables()
+        report["executables_after_warmup"] = exes_after_warmup
+
+        jobs = _jobs(model.vocab_size)
+        # the bitwise oracle: per-request sequential greedy decode over
+        # the SAME engine/weights (decode_static never speculates)
+        sequential = [eng.decode_static([j])[0][0] for j in jobs]
+
+        # churn: twice the slot count in flight forces admit/retire
+        # waves, speculative page growth and mid-stream rewinds
+        futs = [eng.submit(p, n) for p, n in jobs]
+        outs = [f.result(timeout=60) for f in futs]
+        report["bitwise_vs_sequential"] = outs == sequential
+
+        snap = eng.metrics.snapshot()
+        drafted = snap["spec_draft_tokens"]
+        accepted = snap["spec_accepted_tokens"]
+        report["spec_ticks"] = snap["spec_ticks"]
+        report["acceptance_rate"] = round(accepted / drafted, 4) \
+            if drafted else 0.0
+        report["executables_flat"] = \
+            eng.executables() == exes_after_warmup
+        report["bucket_compiles_under_traffic"] = (
+            snap["bucket_compiles"] - eng.metrics.counter(
+                "warmup_dispatches"))
+
+        # draft-poison drill: garbage drafts from tick 0 — acceptance
+        # collapses, the controller trips, the output stays bitwise
+        _fault.install(_fault.FaultPlan(spec_draft_poison=0))
+        try:
+            poisoned = eng.submit(jobs[0][0], jobs[0][1]).result(
+                timeout=60)
+        finally:
+            _fault.clear()
+        report["poison_bitwise"] = poisoned == sequential[0]
+        report["spec_fallbacks"] = eng.metrics.counter("spec_fallbacks")
+
+        eng.wait_idle(timeout_s=30)
+        report["pages_free_after_drain"] = pool.pages_free
+        report["pages_leaked"] = pool.pages_leaked
+        report["elapsed_s"] = round(time.perf_counter() - t_start, 2)
+        report["ok"] = bool(
+            report["bitwise_vs_sequential"]
+            and report["poison_bitwise"]
+            and report["spec_ticks"] > 0
+            and report["acceptance_rate"] > 0
+            and report["executables_flat"]
+            and report["spec_fallbacks"] > 0
+            and report["pages_free_after_drain"]
+            == report["pages_free_initial"]
+            and report["pages_leaked"] == 0)
+    except Exception as exc:  # a broken smoke must still print its JSON
+        import traceback
+
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["trace"] = traceback.format_exc(limit=5)
+    finally:
+        if eng is not None:
+            try:
+                eng.shutdown(timeout_s=10)
+            except Exception:
+                pass
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main()["ok"] else 1)
